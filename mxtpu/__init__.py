@@ -1,0 +1,69 @@
+"""mxtpu — a TPU-native deep-learning framework with Apache MXNet 1.x's
+capabilities (reference: jlcontreras/incubator-mxnet), built on JAX/XLA/
+Pallas rather than ported from the reference's C++/CUDA engine.
+
+Import surface mirrors ``import mxnet as mx``:
+
+    import mxtpu as mx
+    x = mx.nd.ones((2, 3), ctx=mx.tpu(0))
+    with mx.autograd.record():
+        y = (x * 2).sum()
+    y.backward()
+
+See SURVEY.md for the architecture map against the reference.
+"""
+
+from . import base
+from .context import Context, cpu, cpu_pinned, gpu, tpu, num_gpus, num_tpus, current_context
+from . import engine
+from . import random
+from . import ndarray
+from . import ndarray as nd
+from . import autograd
+from .ndarray import NDArray
+
+__version__ = "0.1.0"
+
+# Subpackages that pull heavier deps load lazily via attribute access.
+_LAZY = {
+    "gluon": ".gluon",
+    "optimizer": ".optimizer",
+    "lr_scheduler": ".optimizer.lr_scheduler",
+    "initializer": ".initializer",
+    "init": ".initializer",
+    "metric": ".metric",
+    "kvstore": ".kvstore",
+    "kv": ".kvstore",
+    "io": ".io",
+    "image": ".image",
+    "recordio": ".recordio",
+    "profiler": ".profiler",
+    "runtime": ".runtime",
+    "callback": ".callback",
+    "monitor": ".monitor",
+    "visualization": ".visualization",
+    "symbol": ".symbol",
+    "sym": ".symbol",
+    "module": ".module",
+    "mod": ".module",
+    "model": ".model",
+    "parallel": ".parallel",
+    "amp": ".amp",
+    "test_utils": ".test_utils",
+    "util": ".util",
+}
+
+
+def __getattr__(name):
+    import importlib
+
+    target = _LAZY.get(name)
+    if target is None:
+        raise AttributeError(f"module 'mxtpu' has no attribute {name!r}")
+    mod = importlib.import_module(target, __name__)
+    globals()[name] = mod
+    return mod
+
+
+def __dir__():
+    return sorted(list(globals()) + list(_LAZY))

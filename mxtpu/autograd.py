@@ -1,0 +1,321 @@
+"""Imperative autograd (parity: python/mxnet/autograd.py +
+src/imperative/imperative.cc Imperative::Backward / RecordOp / MarkVariables).
+
+The reference records an NNVM graph node per imperative op (AGInfo on each
+NDArray entry) and runs a Gradient pass to build the backward graph.  Here
+the tape records, per executed op, the ``jax.vjp`` residual closure; backward
+walks the tape in reverse execution order accumulating cotangents.  jax is
+the gradient-pass engine, so there is no separate gradient graph IR — the
+vjp closures *are* the backward program, and when ops executed under
+``hybridize()`` the whole compiled block is a single tape node whose vjp is
+the XLA-compiled backward.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+__all__ = [
+    "record", "pause", "train_mode", "predict_mode",
+    "is_recording", "is_training", "set_recording", "set_training",
+    "mark_variables", "backward", "grad", "Function", "get_symbol",
+]
+
+
+class _State(threading.local):
+    def __init__(self):
+        self.recording = False
+        self.training = False
+
+
+_STATE = _State()
+
+
+def is_recording() -> bool:
+    return _STATE.recording
+
+
+def is_training() -> bool:
+    return _STATE.training
+
+
+def set_recording(flag: bool) -> bool:
+    prev, _STATE.recording = _STATE.recording, bool(flag)
+    return prev
+
+
+def set_training(flag: bool) -> bool:
+    prev, _STATE.training = _STATE.training, bool(flag)
+    return prev
+
+
+class _Scope:
+    def __init__(self, recording: Optional[bool], training: Optional[bool]):
+        self._rec, self._train = recording, training
+
+    def __enter__(self):
+        if self._rec is not None:
+            self._prev_rec = set_recording(self._rec)
+        if self._train is not None:
+            self._prev_train = set_training(self._train)
+        return self
+
+    def __exit__(self, *a):
+        if self._rec is not None:
+            set_recording(self._prev_rec)
+        if self._train is not None:
+            set_training(self._prev_train)
+
+
+def record(train_mode: bool = True) -> _Scope:
+    return _Scope(True, train_mode)
+
+
+def pause(train_mode: bool = False) -> _Scope:
+    return _Scope(False, train_mode)
+
+
+def train_mode() -> _Scope:
+    return _Scope(None, True)
+
+
+def predict_mode() -> _Scope:
+    return _Scope(None, False)
+
+
+# ---------------------------------------------------------------------------
+# tape
+# ---------------------------------------------------------------------------
+
+class TapeNode:
+    """One recorded op: vjp closure + input/output NDArrays.
+
+    Outputs are held as strong references: cotangent routing is keyed by
+    object id, and a GC'd output whose id is reused by a later array would
+    misroute gradients.  The resulting ref cycle (output._tape_node -> node
+    -> output) is collected by Python's cycle GC once the graph is dropped.
+    """
+
+    __slots__ = ("vjp_fn", "inputs", "outputs", "name", "freed", "_seq")
+
+    def __init__(self, vjp_fn, inputs, outputs, name=""):
+        self.vjp_fn = vjp_fn
+        self.inputs = list(inputs)          # NDArray objects (strong refs)
+        self.outputs = list(outputs)        # NDArray objects (strong refs)
+        self.name = name
+        self.freed = False
+
+
+def _on_tape(nd) -> bool:
+    return getattr(nd, "_tape_node", None) is not None or getattr(
+        nd, "_grad_req", "null") != "null"
+
+
+def mark_variables(variables, gradients, grad_reqs="write"):
+    """Parity: autograd.mark_variables / C MXAutogradMarkVariables."""
+    if isinstance(grad_reqs, str):
+        grad_reqs = [grad_reqs] * len(variables)
+    for v, g, req in zip(variables, gradients, grad_reqs):
+        v._grad_req = req
+        v._grad = g
+
+
+def backward(heads, head_grads=None, retain_graph=False, train_mode=True):
+    """Run backward from head NDArrays, writing leaf gradients into .grad.
+
+    Mirrors Imperative::Backward: topological walk of recorded nodes from
+    the heads, per-node vjp, gradient accumulation honoring grad_req
+    ('write' overwrites, 'add' accumulates across backward calls).
+    """
+    from .ndarray import NDArray  # circular-at-import, fine at runtime
+
+    if isinstance(heads, NDArray):
+        heads = [heads]
+    if head_grads is None:
+        head_grads = [None] * len(heads)
+    elif isinstance(head_grads, NDArray):
+        head_grads = [head_grads]
+
+    # 1. collect reachable nodes (reverse reachability from heads)
+    nodes: List[TapeNode] = []
+    seen = set()
+    stack = [h._tape_node[0] for h in heads if getattr(h, "_tape_node", None)]
+    while stack:
+        node = stack.pop()
+        if id(node) in seen:
+            continue
+        if node.freed:
+            raise RuntimeError(
+                "autograd graph has already been freed by a previous "
+                "backward(); pass retain_graph=True to backward() to keep it")
+        seen.add(id(node))
+        nodes.append(node)
+        for inp in node.inputs:
+            tn = getattr(inp, "_tape_node", None)
+            if tn is not None and id(tn[0]) not in seen:
+                stack.append(tn[0])
+
+    # 2. topo-sort: order by recording sequence (nodes hold _seq)
+    nodes.sort(key=lambda n: n._seq if hasattr(n, "_seq") else 0)
+
+    # cotangent per array id
+    cots: Dict[int, Any] = {}
+    leaf_grads: Dict[int, Any] = {}
+    leaf_objs: Dict[int, Any] = {}
+
+    for h, hg in zip(heads, head_grads):
+        g = hg.data if hasattr(hg, "data") else (
+            jnp.ones(h.shape, h.dtype) if hg is None else jnp.asarray(hg))
+        cots[id(h)] = cots.get(id(h), 0) + g
+        if getattr(h, "_grad_req", "null") != "null":
+            leaf_grads[id(h)] = cots[id(h)]
+            leaf_objs[id(h)] = h
+
+    # 3. reverse pass
+    for node in reversed(nodes):
+        outs = []
+        any_cot = False
+        for o in node.outputs:
+            c = cots.get(id(o))
+            if c is None:
+                c = jnp.zeros(o.shape, o._data.dtype)
+            else:
+                any_cot = True
+            outs.append(c)
+        if not any_cot:
+            continue
+        in_grads = node.vjp_fn(tuple(outs) if len(outs) > 1 else outs[0])
+        for inp, g in zip(node.inputs, in_grads):
+            if g is None:
+                continue
+            req = getattr(inp, "_grad_req", "null")
+            if req != "null":
+                cur = leaf_grads.get(id(inp))
+                leaf_grads[id(inp)] = g if cur is None else cur + g
+                leaf_objs[id(inp)] = inp
+            if getattr(inp, "_tape_node", None) is not None:
+                cur = cots.get(id(inp))
+                cots[id(inp)] = g if cur is None else cur + g
+
+    # 4. write leaf grads per grad_req
+    for oid, g in leaf_grads.items():
+        leaf = leaf_objs.get(oid)
+        if leaf is None:
+            continue
+        req = leaf._grad_req
+        if req == "write" or leaf._grad is None:
+            if leaf._grad is None:
+                leaf._grad = NDArray(g)
+            else:
+                leaf._grad._data = g.astype(leaf._grad.dtype)
+        elif req == "add":
+            leaf._grad._data = leaf._grad._data + g.astype(leaf._grad.dtype)
+
+    # 5. free the residuals unless retained; _tape_node stays set so reuse of
+    # the freed graph raises a clear error (parity with reference behavior)
+    if not retain_graph:
+        for node in nodes:
+            node.vjp_fn = None
+            node.inputs = []
+            node.outputs = []
+            node.freed = True
+
+
+def grad(heads, variables, head_grads=None, retain_graph=None,
+         create_graph=False, train_mode=True):
+    """Parity: autograd.grad — returns grads instead of writing .grad.
+
+    create_graph (higher-order) is supported by re-running through jax.grad
+    at the gluon/jit layer; imperative create_graph=True raises for now.
+    """
+    if create_graph:
+        raise NotImplementedError(
+            "create_graph=True: use jax.grad via hybridize/make_train_step")
+    from .ndarray import NDArray
+
+    if isinstance(variables, NDArray):
+        variables = [variables]
+    saved = [(getattr(v, "_grad_req", "null"), getattr(v, "_grad", None))
+             for v in variables]
+    for v in variables:
+        v._grad_req = "write"
+        v._grad = None
+    try:
+        backward(heads, head_grads,
+                 retain_graph=bool(retain_graph), train_mode=train_mode)
+        return [v._grad for v in variables]
+    finally:
+        for v, (req, g) in zip(variables, saved):
+            v._grad_req = req
+            if g is not None:
+                v._grad = g
+
+
+_SEQ = [0]
+
+
+def _next_seq() -> int:
+    _SEQ[0] += 1
+    return _SEQ[0]
+
+
+def record_node(vjp_fn, inputs, outputs, name="") -> TapeNode:
+    node = TapeNode(vjp_fn, inputs, outputs, name)
+    node._seq = _next_seq()
+    for i, o in enumerate(outputs):
+        o._tape_node = (node, i)
+    return node
+
+
+class Function:
+    """Customizable differentiable function (parity: autograd.Function).
+
+    Subclass and implement forward(self, *inputs) and backward(self,
+    *output_grads), both over NDArrays.  Used via ``f = MyFunc(); y = f(x)``.
+    """
+
+    def __init__(self):
+        self.saved_tensors = ()
+
+    def save_for_backward(self, *args):
+        self.saved_tensors = args
+
+    def forward(self, *inputs):
+        raise NotImplementedError
+
+    def backward(self, *output_grads):
+        raise NotImplementedError
+
+    def __call__(self, *inputs):
+        from .ndarray import NDArray
+
+        rec = is_recording()
+        with pause():
+            outputs = self.forward(*inputs)
+        single = not isinstance(outputs, (list, tuple))
+        outs = [outputs] if single else list(outputs)
+        if rec and any(_on_tape(i) for i in inputs
+                       if isinstance(i, NDArray)):
+            nd_inputs = [i for i in inputs if isinstance(i, NDArray)]
+
+            def vjp_fn(out_cots):
+                cots = (out_cots,) if single else tuple(out_cots)
+                with pause():
+                    grads = self.backward(*[NDArray(c) for c in cots])
+                if not isinstance(grads, (list, tuple)):
+                    grads = [grads]
+                return [g.data if isinstance(g, NDArray) else g
+                        for g in grads]
+
+            record_node(vjp_fn, nd_inputs, outs, type(self).__name__)
+        return outputs
+
+
+def get_symbol(x):
+    """Parity stub: the reference returns the recorded Symbol; jaxpr here."""
+    raise NotImplementedError(
+        "get_symbol: inspect jax.make_jaxpr of a hybridized block instead")

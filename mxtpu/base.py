@@ -1,0 +1,113 @@
+"""Core plumbing for mxtpu: errors, the operator registry, env-var config.
+
+TPU-native rebuild of MXNet's base layer.  In the reference the op registry
+lives in C++ (NNVM ``NNVM_REGISTER_OP``, surfaced through the flat C ABI in
+``src/c_api/c_api.cc`` and re-synthesised into Python functions at import time
+by ``python/mxnet/ndarray/register.py``).  Here the registry is pure Python:
+``name -> jax-level callable`` plus metadata, and the ``mx.nd.*`` namespace is
+generated from it (see mxtpu/ndarray/__init__.py).  There is no C ABI because
+there is no second language boundary: JAX/XLA is the executor.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Callable, Dict, NamedTuple, Optional, Sequence
+
+__all__ = [
+    "MXTPUError",
+    "MXNetError",
+    "register_op",
+    "get_op",
+    "list_ops",
+    "env_bool",
+    "env_int",
+    "string_types",
+    "numeric_types",
+]
+
+
+class MXTPUError(RuntimeError):
+    """Default error type for mxtpu (parity: ``MXNetError`` in base.py)."""
+
+
+# Alias kept so user code catching mx.base.MXNetError keeps working.
+MXNetError = MXTPUError
+
+string_types = (str,)
+numeric_types = (float, int)
+
+
+class OpSpec(NamedTuple):
+    """Metadata for one registered operator.
+
+    fn: callable taking positional jax arrays + keyword params, returning a
+        jax array or tuple of arrays.
+    differentiable: whether autograd should record this op (e.g. ``argmax``
+        is not differentiable; recording it would fail in jax.vjp).
+    num_outputs: static output count hint (None = infer from return value).
+    """
+
+    name: str
+    fn: Callable[..., Any]
+    differentiable: bool = True
+    aliases: Sequence[str] = ()
+
+
+_OP_REGISTRY: Dict[str, OpSpec] = {}
+
+
+def register_op(
+    name: Optional[str] = None,
+    differentiable: bool = True,
+    aliases: Sequence[str] = (),
+):
+    """Decorator registering a jax-level function as an mxtpu operator.
+
+    Parity: replaces the NNVM op registry + dmlc::Parameter reflection
+    (reference: src/operator/** NNVM_REGISTER_OP, 3rdparty/dmlc-core
+    parameter.h).  Op parameters are plain Python keyword arguments; their
+    defaults/docs live in the function signature instead of DMLC_DECLARE_FIELD.
+    """
+
+    def wrap(fn: Callable[..., Any]) -> Callable[..., Any]:
+        opname = name or fn.__name__
+        spec = OpSpec(opname, fn, differentiable, tuple(aliases))
+        if opname in _OP_REGISTRY:
+            raise ValueError(f"operator {opname!r} registered twice")
+        _OP_REGISTRY[opname] = spec
+        for a in aliases:
+            if a in _OP_REGISTRY:
+                raise ValueError(f"operator alias {a!r} registered twice")
+            _OP_REGISTRY[a] = spec
+        return fn
+
+    return wrap
+
+
+def get_op(name: str) -> OpSpec:
+    try:
+        return _OP_REGISTRY[name]
+    except KeyError:
+        raise MXTPUError(f"operator {name!r} is not registered") from None
+
+
+def list_ops():
+    return sorted(_OP_REGISTRY)
+
+
+def env_bool(name: str, default: bool = False) -> bool:
+    v = os.environ.get(name)
+    if v is None:
+        return default
+    return v.lower() not in ("0", "false", "off", "")
+
+
+def env_int(name: str, default: int = 0) -> int:
+    v = os.environ.get(name)
+    if v is None:
+        return default
+    try:
+        return int(v)
+    except ValueError:
+        return default

@@ -1,0 +1,65 @@
+"""Engine facade (parity: src/engine/ — ThreadedEnginePerDevice etc.).
+
+The reference's dependency engine schedules every op asynchronously with
+read/write variable tracking (ThreadedVar serializing writers).  On TPU this
+entire ~6k-LoC subsystem is absorbed by PJRT: `jax` dispatch is already
+async (the Python thread enqueues, XLA executes in order on the device), and
+data dependencies are exact because arrays are immutable values.  What
+remains useful from the reference API:
+
+ - ``wait_all()``  <- MXNDArrayWaitAll: barrier on all outstanding work.
+ - NaiveEngine sync-debug mode  <- MXNET_ENGINE_TYPE=NaiveEngine: here
+   ``MXTPU_ENGINE_TYPE=NaiveEngine`` (or ``MXTPU_SYNC=1``) makes every op
+   block_until_ready, giving deterministic, exception-at-callsite behavior
+   for debugging (async exception propagation otherwise surfaces late, the
+   exact issue tests/python/unittest/test_exc_handling.py covers).
+ - ``bulk`` context manager  <- engine op bulking: a no-op here because XLA
+   fusion under jit is the real bulking mechanism; kept for API compat.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+
+from .base import env_bool
+
+__all__ = ["is_sync", "set_sync", "wait_all", "bulk"]
+
+_SYNC = env_bool("MXTPU_SYNC") or os.environ.get(
+    "MXTPU_ENGINE_TYPE", os.environ.get("MXNET_ENGINE_TYPE", "")
+) == "NaiveEngine"
+
+
+def is_sync() -> bool:
+    return _SYNC
+
+
+def set_sync(flag: bool):
+    global _SYNC
+    _SYNC = bool(flag)
+
+
+def wait_all():
+    """Block until all enqueued device work is complete (parity:
+    MXNDArrayWaitAll).  PJRT executes per-device in submission order, so
+    blocking on every live array is a sufficient barrier; it also surfaces
+    any deferred device error here, matching the reference's semantics of
+    async exceptions raising at the wait point."""
+    import jax
+
+    try:
+        jax.effects_barrier()
+    except Exception:
+        pass
+    for arr in jax.live_arrays():
+        try:
+            arr.block_until_ready()
+        except Exception:
+            raise
+
+
+@contextlib.contextmanager
+def bulk(size: int = 15):
+    """Parity shim for mx.engine.bulk — XLA fusion supersedes op bulking."""
+    yield

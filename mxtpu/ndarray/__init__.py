@@ -1,0 +1,75 @@
+"""The generated ``mx.nd`` namespace.
+
+Parity: python/mxnet/ndarray/register.py _init_ndarray_module — the
+reference synthesises Python functions for every op in the C registry at
+import time; we do the same from the mxtpu registry (populated by importing
+mxtpu.ops).  ``mx.nd.<op>(*ndarrays, **params)`` for every registered op.
+"""
+
+from __future__ import annotations
+
+import sys as _sys
+
+from .. import ops as _ops  # populates the registry  # noqa: F401
+from ..base import _OP_REGISTRY
+from .ndarray import NDArray, array, invoke_op, waitall
+from . import random  # noqa: F401
+from .serialization import save, load  # noqa: F401
+
+__all__ = ["NDArray", "array", "waitall", "save", "load", "random"]
+
+
+def _make_op_fn(name):
+    def op_fn(*args, **kwargs):
+        return invoke_op(name, args, kwargs)
+
+    op_fn.__name__ = name
+    spec = _OP_REGISTRY[name]
+    op_fn.__doc__ = spec.fn.__doc__ or f"Generated op {name!r} (jax-backed)."
+    return op_fn
+
+
+_mod = _sys.modules[__name__]
+for _name in list(_OP_REGISTRY):
+    if not hasattr(_mod, _name):
+        setattr(_mod, _name, _make_op_fn(_name))
+        __all__.append(_name)
+
+
+# legacy flat random-op names (mx.nd.random_uniform etc.)
+def random_uniform(low=0.0, high=1.0, shape=None, dtype="float32", ctx=None):
+    return random.uniform(low, high, shape, dtype, ctx)
+
+
+def random_normal(loc=0.0, scale=1.0, shape=None, dtype="float32", ctx=None):
+    return random.normal(loc, scale, shape, dtype, ctx)
+
+
+def sample_multinomial(data, shape=None, get_prob=False, dtype="int32"):
+    return random.multinomial(data, shape, get_prob, dtype)
+
+
+def empty(shape, ctx=None, dtype="float32"):
+    """Parity: mx.nd.empty (deferred-alloc in reference; zeros here — XLA
+    has no uninitialised buffers)."""
+    return invoke_op("zeros", (), {"shape": shape, "dtype": dtype, "ctx": ctx})
+
+
+def moveaxis(a, source, destination):
+    import jax.numpy as jnp
+
+    return NDArray(jnp.moveaxis(a.data, source, destination))
+
+
+def concatenate(arrays, axis=0):
+    return invoke_op("concat", tuple(arrays), {"dim": axis})
+
+
+def add_n(*arrays):
+    out = arrays[0]
+    for a in arrays[1:]:
+        out = out + a
+    return out
+
+
+ElementWiseSum = add_n

@@ -1,0 +1,570 @@
+"""NDArray: the imperative tensor (parity: src/ndarray/ndarray.cc +
+python/mxnet/ndarray/ndarray.py).
+
+Reference design: NDArray::Chunk = engine variable + Storage handle;
+mutation goes through the dependency engine, reads block via WaitToRead.
+TPU design: an NDArray is a mutable *slot* holding an immutable jax.Array.
+"Mutation" (+=, [:]=, set_data) rebinds the slot to a new functional value —
+old buffers stay valid for any recorded autograd residuals, which is exactly
+the guarantee the reference's VersionedVarBlock write-serialisation provides,
+delivered here for free by value semantics.  Async execution is PJRT's
+native dispatch; ``wait_to_read`` = block_until_ready.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as onp
+
+from .. import autograd, engine
+from ..base import MXTPUError, get_op
+from ..context import Context, current_context
+
+__all__ = ["NDArray", "invoke_op", "array", "waitall"]
+
+
+_PY_SCALARS = (int, float, bool)
+
+
+def _place(arr, ctx: Optional[Context]):
+    if ctx is None:
+        return arr
+    dev = ctx.to_jax_device()
+    if dev is None:
+        return arr
+    return jax.device_put(arr, dev)
+
+
+class NDArray:
+    """Imperative tensor wrapping a jax.Array (or tracer, under hybridize)."""
+
+    __slots__ = ("_data", "_ctx", "_grad", "_grad_req", "_tape_node",
+                 "__weakref__")
+
+    # numpy interop priority (parity: __array_priority__ in reference)
+    __array_priority__ = 1000.0
+
+    def __init__(self, data, ctx: Optional[Context] = None, dtype=None):
+        if isinstance(data, NDArray):
+            data = data._data
+        if not isinstance(data, jax.Array) or dtype is not None:
+            data = jnp.asarray(data, dtype=jnp.dtype(dtype) if dtype else None)
+        self._data = _place(data, ctx)
+        self._ctx = ctx
+        self._grad = None
+        self._grad_req = "null"
+        self._tape_node = None
+
+    # -- raw access ------------------------------------------------------
+    @property
+    def data(self):
+        return self._data
+
+    @property
+    def shape(self):
+        return tuple(self._data.shape)
+
+    @property
+    def dtype(self):
+        return onp.dtype(str(self._data.dtype)) if not hasattr(
+            self._data.dtype, "type") else self._data.dtype
+
+    @property
+    def ndim(self):
+        return self._data.ndim
+
+    @property
+    def size(self):
+        return int(self._data.size)
+
+    @property
+    def context(self) -> Context:
+        if self._ctx is not None:
+            return self._ctx
+        try:
+            dev = list(self._data.devices())[0]
+            if dev.platform == "cpu":
+                return Context("cpu", dev.id)
+            return Context("tpu", dev.id)
+        except Exception:  # tracers have no device
+            return current_context()
+
+    ctx = context
+
+    @property
+    def stype(self):
+        return "default"  # sparse storage descoped v1 (SURVEY §7 hard-part 6)
+
+    # -- host transfer ---------------------------------------------------
+    def asnumpy(self) -> onp.ndarray:
+        return onp.asarray(self._data)
+
+    def asscalar(self):
+        if self.size != 1:
+            raise ValueError("the array is not scalar-sized")
+        return self.asnumpy().reshape(())[()]
+
+    def item(self):
+        return self.asscalar()
+
+    def __float__(self):
+        return float(self.asscalar())
+
+    def __int__(self):
+        return int(self.asscalar())
+
+    def __bool__(self):
+        if self.size == 0:
+            return False
+        if self.size == 1:
+            return bool(self.asscalar())
+        raise ValueError("ambiguous truth value of multi-element NDArray")
+
+    def __len__(self):
+        if self.ndim == 0:
+            raise TypeError("len() of 0-d NDArray")
+        return self.shape[0]
+
+    def __array__(self, dtype=None):
+        a = self.asnumpy()
+        return a.astype(dtype) if dtype is not None else a
+
+    def wait_to_read(self):
+        self._data.block_until_ready()
+
+    def wait_to_write(self):
+        self._data.block_until_ready()
+
+    # -- autograd --------------------------------------------------------
+    def attach_grad(self, grad_req: str = "write", stype=None):
+        # Parity: attach_grad detaches the array from any recorded graph,
+        # making it a fresh autograd leaf.
+        self._tape_node = None
+        self._grad = NDArray(jnp.zeros(self.shape, self._data.dtype))
+        self._grad_req = grad_req
+
+    @property
+    def grad(self) -> Optional["NDArray"]:
+        return self._grad
+
+    def detach(self) -> "NDArray":
+        out = NDArray(self._data)
+        return out
+
+    def backward(self, out_grad=None, retain_graph=False, train_mode=True):
+        autograd.backward([self], [out_grad] if out_grad is not None else None,
+                          retain_graph=retain_graph, train_mode=train_mode)
+
+    # -- placement -------------------------------------------------------
+    def as_in_context(self, ctx: Context) -> "NDArray":
+        if ctx == self.context:
+            return self
+        return NDArray(self._data, ctx=ctx)
+
+    as_in_ctx = as_in_context
+
+    def copyto(self, other):
+        if isinstance(other, Context):
+            return NDArray(self._data + 0, ctx=other)
+        other._data = _place(self._data + 0, other._ctx)
+        return other
+
+    def copy(self) -> "NDArray":
+        return NDArray(self._data + 0, ctx=self._ctx)
+
+    def astype(self, dtype, copy=True) -> "NDArray":
+        return NDArray(self._data.astype(jnp.dtype(dtype)), ctx=self._ctx)
+
+    def tostype(self, stype):
+        if stype != "default":
+            raise MXTPUError("sparse storage is descoped in mxtpu v1 "
+                             "(dense fallback; see SURVEY.md §7)")
+        return self
+
+    # -- mutation --------------------------------------------------------
+    def _check_inplace_record(self):
+        # Parity: the reference raises when an array in the autograd graph
+        # is mutated while recording (would corrupt the gradient graph).
+        if autograd.is_recording() and autograd._on_tape(self):
+            raise MXTPUError(
+                "in-place mutation of an NDArray that is part of the "
+                "recorded autograd graph is not allowed inside "
+                "autograd.record(); use functional ops instead")
+
+    def _rebind(self, new_data):
+        """In-place semantic: swap the buffer in the slot."""
+        self._data = new_data
+        if engine.is_sync():
+            try:
+                new_data.block_until_ready()
+            except AttributeError:
+                pass
+        return self
+
+    def __setitem__(self, key, value):
+        self._check_inplace_record()
+        key = _translate_index(key)
+        if isinstance(value, NDArray):
+            value = value._data
+        self._rebind(self._data.at[key].set(value))
+
+    def __getitem__(self, key):
+        key = _translate_index(key)
+        return _wrap_result(self._data[key], None)
+
+    # -- shape ops (method forms) ---------------------------------------
+    def reshape(self, *shape, **kwargs):
+        if not shape and "shape" in kwargs:
+            shape = tuple(kwargs.pop("shape"))
+        elif len(shape) == 1 and isinstance(shape[0], (tuple, list)):
+            shape = tuple(shape[0])
+        return invoke_op("reshape", (self,), {"shape": shape})
+
+    def reshape_like(self, other):
+        return invoke_op("reshape_like", (self, other), {})
+
+    def flatten(self):
+        return invoke_op("flatten", (self,), {})
+
+    def expand_dims(self, axis):
+        return invoke_op("expand_dims", (self,), {"axis": axis})
+
+    def squeeze(self, axis=None):
+        return invoke_op("squeeze", (self,), {"axis": axis})
+
+    def transpose(self, *axes):
+        if len(axes) == 1 and isinstance(axes[0], (tuple, list)):
+            axes = tuple(axes[0])
+        return invoke_op("transpose", (self,), {"axes": axes or None})
+
+    @property
+    def T(self):
+        return invoke_op("transpose", (self,), {"axes": None})
+
+    def swapaxes(self, dim1, dim2):
+        return invoke_op("swapaxes", (self,), {"dim1": dim1, "dim2": dim2})
+
+    def broadcast_to(self, shape):
+        return invoke_op("broadcast_to", (self,), {"shape": shape})
+
+    def broadcast_like(self, other):
+        return invoke_op("broadcast_like", (self, other), {})
+
+    def tile(self, reps):
+        return invoke_op("tile", (self,), {"reps": reps})
+
+    def repeat(self, repeats, axis=None):
+        return invoke_op("repeat", (self,), {"repeats": repeats, "axis": axis})
+
+    def flip(self, axis):
+        return invoke_op("flip", (self,), {"axis": axis})
+
+    def slice_axis(self, axis, begin, end):
+        return invoke_op("slice_axis", (self,),
+                         {"axis": axis, "begin": begin, "end": end})
+
+    def take(self, indices, axis=0, mode="clip"):
+        return invoke_op("take", (self, indices), {"axis": axis, "mode": mode})
+
+    def one_hot(self, depth, **kw):
+        return invoke_op("one_hot", (self,), dict(depth=depth, **kw))
+
+    # -- reductions ------------------------------------------------------
+    def sum(self, axis=None, keepdims=False):
+        return invoke_op("sum", (self,), {"axis": axis, "keepdims": keepdims})
+
+    def mean(self, axis=None, keepdims=False):
+        return invoke_op("mean", (self,), {"axis": axis, "keepdims": keepdims})
+
+    def max(self, axis=None, keepdims=False):
+        return invoke_op("max", (self,), {"axis": axis, "keepdims": keepdims})
+
+    def min(self, axis=None, keepdims=False):
+        return invoke_op("min", (self,), {"axis": axis, "keepdims": keepdims})
+
+    def prod(self, axis=None, keepdims=False):
+        return invoke_op("prod", (self,), {"axis": axis, "keepdims": keepdims})
+
+    def norm(self, ord=2, axis=None, keepdims=False):
+        return invoke_op("norm", (self,),
+                         {"ord": ord, "axis": axis, "keepdims": keepdims})
+
+    def argmax(self, axis=None, keepdims=False):
+        return invoke_op("argmax", (self,), {"axis": axis, "keepdims": keepdims})
+
+    def argmin(self, axis=None, keepdims=False):
+        return invoke_op("argmin", (self,), {"axis": axis, "keepdims": keepdims})
+
+    def argsort(self, axis=-1, is_ascend=True):
+        return invoke_op("argsort", (self,), {"axis": axis, "is_ascend": is_ascend})
+
+    def topk(self, axis=-1, k=1, **kw):
+        return invoke_op("topk", (self,), dict(axis=axis, k=k, **kw))
+
+    # -- elementwise method forms ---------------------------------------
+    def abs(self):
+        return invoke_op("abs", (self,), {})
+
+    def sqrt(self):
+        return invoke_op("sqrt", (self,), {})
+
+    def square(self):
+        return invoke_op("square", (self,), {})
+
+    def exp(self):
+        return invoke_op("exp", (self,), {})
+
+    def log(self):
+        return invoke_op("log", (self,), {})
+
+    def relu(self):
+        return invoke_op("relu", (self,), {})
+
+    def sigmoid(self):
+        return invoke_op("sigmoid", (self,), {})
+
+    def tanh(self):
+        return invoke_op("tanh", (self,), {})
+
+    def clip(self, a_min=None, a_max=None):
+        return invoke_op("clip", (self,), {"a_min": a_min, "a_max": a_max})
+
+    def round(self):
+        return invoke_op("round", (self,), {})
+
+    def sign(self):
+        return invoke_op("sign", (self,), {})
+
+    def softmax(self, axis=-1):
+        return invoke_op("softmax", (self,), {"axis": axis})
+
+    def log_softmax(self, axis=-1):
+        return invoke_op("log_softmax", (self,), {"axis": axis})
+
+    def dot(self, other, **kw):
+        return invoke_op("dot", (self, other), kw)
+
+    def zeros_like(self):
+        return invoke_op("zeros_like", (self,), {})
+
+    def ones_like(self):
+        return invoke_op("ones_like", (self,), {})
+
+    # -- arithmetic dunders ---------------------------------------------
+    def __add__(self, other):
+        return invoke_op("add", (self, other), {})
+
+    def __radd__(self, other):
+        return invoke_op("add", (other, self), {})
+
+    def __sub__(self, other):
+        return invoke_op("subtract", (self, other), {})
+
+    def __rsub__(self, other):
+        return invoke_op("subtract", (other, self), {})
+
+    def __mul__(self, other):
+        return invoke_op("multiply", (self, other), {})
+
+    def __rmul__(self, other):
+        return invoke_op("multiply", (other, self), {})
+
+    def __truediv__(self, other):
+        return invoke_op("divide", (self, other), {})
+
+    def __rtruediv__(self, other):
+        return invoke_op("divide", (other, self), {})
+
+    def __mod__(self, other):
+        return invoke_op("mod", (self, other), {})
+
+    def __rmod__(self, other):
+        return invoke_op("mod", (other, self), {})
+
+    def __pow__(self, other):
+        return invoke_op("power", (self, other), {})
+
+    def __rpow__(self, other):
+        return invoke_op("power", (other, self), {})
+
+    def __neg__(self):
+        return invoke_op("negative", (self,), {})
+
+    def __abs__(self):
+        return invoke_op("abs", (self,), {})
+
+    def __matmul__(self, other):
+        return invoke_op("dot", (self, other), {})
+
+    def __iadd__(self, other):
+        self._check_inplace_record()
+        o = other._data if isinstance(other, NDArray) else other
+        return self._rebind(self._data + o)
+
+    def __isub__(self, other):
+        self._check_inplace_record()
+        o = other._data if isinstance(other, NDArray) else other
+        return self._rebind(self._data - o)
+
+    def __imul__(self, other):
+        self._check_inplace_record()
+        o = other._data if isinstance(other, NDArray) else other
+        return self._rebind(self._data * o)
+
+    def __itruediv__(self, other):
+        self._check_inplace_record()
+        o = other._data if isinstance(other, NDArray) else other
+        return self._rebind(self._data / o)
+
+    def __eq__(self, other):
+        if other is None:
+            return False
+        return invoke_op("equal", (self, other), {})
+
+    def __ne__(self, other):
+        if other is None:
+            return True
+        return invoke_op("not_equal", (self, other), {})
+
+    def __gt__(self, other):
+        return invoke_op("greater", (self, other), {})
+
+    def __ge__(self, other):
+        return invoke_op("greater_equal", (self, other), {})
+
+    def __lt__(self, other):
+        return invoke_op("lesser", (self, other), {})
+
+    def __le__(self, other):
+        return invoke_op("lesser_equal", (self, other), {})
+
+    def __hash__(self):
+        return id(self)
+
+    def __repr__(self):
+        try:
+            arr = self.asnumpy()
+            return f"{arr}\n<NDArray {self.shape} @{self.context}>"
+        except Exception:
+            return f"<NDArray {self.shape} {self._data.dtype} (traced)>"
+
+    def __iter__(self):
+        for i in range(len(self)):
+            yield self[i]
+
+
+def _translate_index(key):
+    if isinstance(key, NDArray):
+        return key._data
+    if isinstance(key, tuple):
+        return tuple(k._data if isinstance(k, NDArray) else k for k in key)
+    return key
+
+
+def _wrap_result(res, ctx):
+    if isinstance(res, (tuple, list)):
+        return tuple(NDArray(r, ctx=ctx) for r in res)
+    return NDArray(res, ctx=ctx)
+
+
+def invoke_op(name: str, args: tuple, kwargs: dict):
+    """The imperative dispatch path (parity: MXImperativeInvokeEx →
+    Imperative::Invoke → PushFCompute → Engine::PushAsync; see SURVEY.md
+    §3.1).  Here: unwrap → jax op (PJRT async dispatch) → wrap; when the
+    autograd tape is recording, compute through jax.vjp and record a
+    TapeNode (parity: Imperative::RecordOp).
+    """
+    spec = get_op(name)
+    out = kwargs.pop("out", None)
+    ctx = kwargs.pop("ctx", None)
+
+    nd_args = []
+    raw_args = []
+    for a in args:
+        if isinstance(a, NDArray):
+            nd_args.append(a)
+            raw_args.append(a._data)
+        else:
+            raw_args.append(a)
+    # array-valued keyword params (e.g. sequence_length) are non-diff inputs
+    kwargs = {k: (v._data if isinstance(v, NDArray) else v)
+              for k, v in kwargs.items()}
+
+    recording = (autograd.is_recording() and spec.differentiable
+                 and any(autograd._on_tape(a) for a in nd_args))
+
+    # inject runtime-state kwargs some ops need
+    fn = spec.fn
+    if name in _NEEDS_TRAIN_FLAG:
+        kwargs.setdefault("_training", autograd.is_training())
+    if name in _NEEDS_KEY:
+        from .. import random as _rnd
+        if kwargs.get("_key") is None and (
+                kwargs.get("_training") or kwargs.get("mode") == "always"):
+            kwargs["_key"] = _rnd.next_key()
+
+    if recording:
+        # differentiate wrt the NDArray positional args only
+        diff_idx = [i for i, a in enumerate(args) if isinstance(a, NDArray)]
+
+        def f(*diff_arrays):
+            call = list(raw_args)
+            for i, arr in zip(diff_idx, diff_arrays):
+                call[i] = arr
+            return fn(*call, **kwargs)
+
+        primals = tuple(a._data for a in nd_args)
+        res, vjp_fn = jax.vjp(f, *primals)
+        outs = _wrap_result(res, ctx)
+        out_list = list(outs) if isinstance(outs, tuple) else [outs]
+        autograd.record_node(vjp_fn, nd_args, out_list, name)
+    else:
+        res = fn(*raw_args, **kwargs)
+        outs = _wrap_result(res, ctx)
+        out_list = list(outs) if isinstance(outs, tuple) else [outs]
+
+    if engine.is_sync():
+        for o in out_list:
+            try:
+                o._data.block_until_ready()
+            except AttributeError:
+                pass  # tracer
+
+    if out is not None:
+        if isinstance(outs, tuple):
+            raise MXTPUError("out= with multi-output op unsupported")
+        if recording:
+            raise MXTPUError(
+                "out= is not supported inside autograd.record() (the tape "
+                "tracks functional outputs only; parity with reference)")
+        out._rebind(outs._data)
+        return out
+    return outs
+
+
+# ops whose behavior depends on autograd train/predict mode or RNG
+_NEEDS_TRAIN_FLAG = {"Dropout", "dropout", "BatchNorm", "batch_norm"}
+_NEEDS_KEY = {"Dropout", "dropout"}
+
+
+def array(source, ctx: Optional[Context] = None, dtype=None) -> NDArray:
+    """Parity: mx.nd.array."""
+    if isinstance(source, NDArray):
+        # always a copy (parity: mx.nd.array never aliases its source)
+        data = source._data.astype(jnp.dtype(dtype)) if dtype else (
+            source._data + 0)
+        return NDArray(data, ctx=ctx)
+    keep_dtype = isinstance(source, onp.ndarray) or hasattr(source, "dtype")
+    a = onp.asarray(source, dtype=dtype)
+    if dtype is None and not keep_dtype:
+        a = a.astype(onp.float32)  # MXNet default dtype for python lists
+    elif dtype is None and a.dtype == onp.float64:
+        a = a.astype(onp.float32)
+    return NDArray(jnp.asarray(a), ctx=ctx)
+
+
+def waitall():
+    engine.wait_all()

@@ -1,0 +1,75 @@
+"""mx.nd.random namespace (parity: python/mxnet/ndarray/random.py,
+src/operator/random/sample_op.cc).  Draws flow from the global key-ring in
+mxtpu/random.py, so ``mx.random.seed`` makes them reproducible."""
+
+from __future__ import annotations
+
+from .. import random as _rnd
+from .ndarray import NDArray
+
+__all__ = ["uniform", "normal", "randn", "randint", "exponential", "gamma",
+           "poisson", "multinomial", "shuffle", "bernoulli"]
+
+
+def _wrap(raw, ctx):
+    out = NDArray(raw, ctx=ctx)
+    return out
+
+
+def uniform(low=0.0, high=1.0, shape=None, dtype="float32", ctx=None, out=None):
+    res = _wrap(_rnd.uniform(low, high, shape, dtype), ctx)
+    if out is not None:
+        out._rebind(res._data)
+        return out
+    return res
+
+
+def normal(loc=0.0, scale=1.0, shape=None, dtype="float32", ctx=None, out=None):
+    res = _wrap(_rnd.normal(loc, scale, shape, dtype), ctx)
+    if out is not None:
+        out._rebind(res._data)
+        return out
+    return res
+
+
+def randn(*shape, loc=0.0, scale=1.0, dtype="float32", ctx=None):
+    return _wrap(_rnd.normal(loc, scale, shape, dtype), ctx)
+
+
+def randint(low=0, high=None, shape=None, dtype="int32", ctx=None, out=None):
+    res = _wrap(_rnd.randint(low, high, shape, dtype), ctx)
+    if out is not None:
+        out._rebind(res._data)
+        return out
+    return res
+
+
+def exponential(scale=1.0, shape=None, dtype="float32", ctx=None):
+    return _wrap(_rnd.exponential(scale, shape, dtype), ctx)
+
+
+def gamma(alpha=1.0, beta=1.0, shape=None, dtype="float32", ctx=None):
+    return _wrap(_rnd.gamma(alpha, beta, shape, dtype), ctx)
+
+
+def poisson(lam=1.0, shape=None, dtype="float32", ctx=None):
+    return _wrap(_rnd.poisson(lam, shape, dtype), ctx)
+
+
+def multinomial(data, shape=None, get_prob=False, dtype="int32"):
+    res = _rnd.multinomial(data.data, shape, get_prob, dtype)
+    if get_prob:
+        return _wrap(res[0], None), _wrap(res[1], None)
+    return _wrap(res, None)
+
+
+def shuffle(data):
+    return _wrap(_rnd.shuffle(data.data), None)
+
+
+def bernoulli(prob=0.5, shape=None, dtype="float32", ctx=None):
+    import jax
+
+    k = _rnd.next_key()
+    return _wrap(
+        jax.random.bernoulli(k, prob, _rnd._shape(shape)).astype(dtype), ctx)

@@ -1,0 +1,136 @@
+"""mx.nd.save / mx.nd.load (parity: src/ndarray/ndarray.cc NDArray::Save/
+Load via MXNDArraySave/MXNDArrayLoad — the container format behind
+``.params`` checkpoints).
+
+Two formats:
+ - native "MXTP" container (written by default): 16-byte header, JSON index,
+   raw little-endian buffers.  Self-describing and mmap-friendly.
+ - legacy MXNet 1.x binary (magic 0x112 list header + per-array V2 blocks):
+   best-effort *reader* for interop with reference-produced .params files.
+   The exact reference layout could not be verified against the mount
+   (SURVEY.md §0); the reader fails with a clear error rather than
+   misparsing.
+"""
+
+from __future__ import annotations
+
+import json
+import struct
+from typing import Dict, List, Union
+
+import numpy as onp
+
+from .ndarray import NDArray, array
+
+_MAGIC = b"MXTP0001"
+_LEGACY_LIST_MAGIC = 0x112
+_LEGACY_ND_MAGIC = 0xF993FAC9
+
+_DTYPE_FLAG = {0: "float32", 1: "float64", 2: "float16", 3: "uint8",
+               4: "int32", 5: "int8", 6: "int64"}
+
+
+def save(fname: str, data):
+    """Save NDArrays: list -> unnamed, dict -> named (parity mx.nd.save)."""
+    if isinstance(data, NDArray):
+        data = [data]
+    if isinstance(data, dict):
+        names = list(data.keys())
+        arrays = [data[k] for k in names]
+    else:
+        names = []
+        arrays = list(data)
+    np_arrays = [a.asnumpy() if isinstance(a, NDArray) else onp.asarray(a)
+                 for a in arrays]
+    index = {
+        "names": names,
+        "arrays": [{"shape": list(a.shape), "dtype": str(a.dtype)}
+                   for a in np_arrays],
+    }
+    blob = json.dumps(index).encode()
+    with open(fname, "wb") as f:
+        f.write(_MAGIC)
+        f.write(struct.pack("<Q", len(blob)))
+        f.write(blob)
+        for a in np_arrays:
+            f.write(onp.ascontiguousarray(a).tobytes())
+
+
+def load(fname: str) -> Union[List[NDArray], Dict[str, NDArray]]:
+    with open(fname, "rb") as f:
+        head = f.read(8)
+        if head == _MAGIC:
+            (n,) = struct.unpack("<Q", f.read(8))
+            index = json.loads(f.read(n))
+            out = []
+            for meta in index["arrays"]:
+                dt = onp.dtype(meta["dtype"])
+                count = int(onp.prod(meta["shape"])) if meta["shape"] else 1
+                buf = f.read(count * dt.itemsize)
+                out.append(array(onp.frombuffer(buf, dtype=dt).reshape(
+                    meta["shape"])))
+            if index["names"]:
+                return dict(zip(index["names"], out))
+            return out
+        # legacy path
+        f.seek(0)
+        return _load_legacy(f.read())
+
+
+def _load_legacy(buf: bytes):
+    off = 0
+
+    def u64():
+        nonlocal off
+        (v,) = struct.unpack_from("<Q", buf, off)
+        off += 8
+        return v
+
+    def u32():
+        nonlocal off
+        (v,) = struct.unpack_from("<I", buf, off)
+        off += 4
+        return v
+
+    def i32():
+        nonlocal off
+        (v,) = struct.unpack_from("<i", buf, off)
+        off += 4
+        return v
+
+    magic = u64()
+    if magic != _LEGACY_LIST_MAGIC:
+        raise ValueError(
+            f"unrecognised NDArray file (magic {magic:#x}); neither MXTP "
+            "nor legacy MXNet format")
+    u64()  # reserved
+    n = u64()
+    arrays = []
+    for _ in range(n):
+        m = u32()
+        if m != _LEGACY_ND_MAGIC:
+            raise ValueError(
+                "legacy NDArray block magic mismatch — reference layout "
+                "differs from the documented V2 format; cannot load")
+        stype = i32()
+        if stype not in (-1, 0):  # kDefaultStorage / dense marker
+            raise ValueError("sparse legacy arrays unsupported (descoped)")
+        ndim = i32()
+        shape = [i32() for _ in range(ndim)]
+        i32()  # dev_type
+        i32()  # dev_id
+        dtype_flag = i32()
+        dt = onp.dtype(_DTYPE_FLAG.get(dtype_flag, "float32"))
+        count = int(onp.prod(shape)) if shape else 1
+        a = onp.frombuffer(buf, dtype=dt, count=count, offset=off).reshape(shape)
+        off += count * dt.itemsize
+        arrays.append(array(a))
+    nk = u64()
+    names = []
+    for _ in range(nk):
+        ln = u64()
+        names.append(buf[off:off + ln].decode())
+        off += ln
+    if names:
+        return dict(zip(names, arrays))
+    return arrays

@@ -1,0 +1,432 @@
+"""Neural-net ops (parity: src/operator/nn/ — Convolution, FullyConnected,
+BatchNorm, LayerNorm, Pooling, Activation, Dropout, softmax*, Embedding —
+where the reference dispatches to cuDNN/oneDNN kernels).
+
+On TPU all of these lower to XLA HLO that the compiler tiles onto the MXU
+(conv/matmul) or fuses into elementwise chains (activations/norms), so the
+cuDNN wrapper layer (src/operator/nn/cudnn/*) has no analogue: `lax.conv_
+general_dilated` and `jnp.dot` ARE the tuned kernels.  Layout: the MXNet API
+default NCHW is preserved at the op boundary; XLA:TPU internally re-lays out
+to its preferred tiling, so no user-visible NHWC migration is required.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..base import register_op
+
+# ---------------------------------------------------------------------------
+# dense / conv — MXU ops
+# ---------------------------------------------------------------------------
+
+@register_op("FullyConnected", aliases=("fully_connected",))
+def fully_connected(x, weight, bias=None, num_hidden=0, no_bias=False,
+                    flatten=True):
+    if flatten and x.ndim > 2:
+        x = jnp.reshape(x, (x.shape[0], -1))
+    # weight layout (num_hidden, in_units) as in the reference
+    from .tensor import matmul_precision
+
+    y = jnp.matmul(x, weight.T, precision=matmul_precision(x, weight))
+    if bias is not None and not no_bias:
+        y = y + bias
+    return y
+
+
+def _conv_dn(ndim, layout):
+    if ndim == 1:
+        return ("NCW", "OIW", "NCW")
+    if ndim == 2:
+        if layout == "NHWC":
+            return ("NHWC", "HWIO", "NHWC")
+        return ("NCHW", "OIHW", "NCHW")
+    return ("NCDHW", "OIDHW", "NCDHW")
+
+
+@register_op("Convolution", aliases=("convolution",))
+def convolution(x, weight, bias=None, kernel=(), stride=(), dilate=(),
+                pad=(), num_filter=0, num_group=1, no_bias=False,
+                layout=None, cudnn_tune=None, cudnn_off=False,
+                workspace=1024):
+    """N-D convolution (1/2/3D by kernel length). Weight layout OIHW (MXNet)."""
+    ndim = len(kernel) if kernel else x.ndim - 2
+    stride = tuple(stride) if stride else (1,) * ndim
+    dilate = tuple(dilate) if dilate else (1,) * ndim
+    pad = tuple(pad) if pad else (0,) * ndim
+    dn = _conv_dn(ndim, layout or "NCHW")
+    from .tensor import matmul_precision
+
+    y = lax.conv_general_dilated(
+        x, weight,
+        window_strides=stride,
+        padding=[(p, p) for p in pad],
+        rhs_dilation=dilate,
+        dimension_numbers=dn,
+        feature_group_count=num_group,
+        precision=matmul_precision(x, weight),
+    )
+    if bias is not None and not no_bias:
+        y = y + bias.reshape((1, -1) + (1,) * ndim)
+    return y
+
+
+@register_op("Deconvolution", aliases=("deconvolution",))
+def deconvolution(x, weight, bias=None, kernel=(), stride=(), dilate=(),
+                  pad=(), adj=(), num_filter=0, num_group=1, no_bias=False,
+                  layout=None, target_shape=None, cudnn_tune=None,
+                  cudnn_off=False, workspace=1024):
+    """Transposed conv = gradient of conv wrt its input: lhs-dilate by
+    stride, spatially flip the kernel, swap I/O filter axes.
+    out = (in-1)*stride - 2*pad + (kernel-1)*dilate + 1 + adj
+    (adj derived from target_shape when given, as in the reference).
+    """
+    ndim = len(kernel) if kernel else x.ndim - 2
+    stride = tuple(stride) if stride else (1,) * ndim
+    dilate = tuple(dilate) if dilate else (1,) * ndim
+    pad = tuple(pad) if pad else (0,) * ndim
+    ke = tuple((k - 1) * d + 1 for k, d in zip(kernel, dilate))
+    if target_shape:
+        adj = tuple(
+            t - ((x.shape[2 + i] - 1) * stride[i] - 2 * pad[i] + ke[i])
+            for i, t in enumerate(target_shape))
+    else:
+        adj = tuple(adj) if adj else (0,) * ndim
+    dn = _conv_dn(ndim, layout or "NCHW")
+    padding = [(k - 1 - p, k - 1 - p + a) for k, p, a in zip(ke, pad, adj)]
+
+    from .tensor import matmul_precision
+
+    def one_group(xi, wi):
+        return lax.conv_general_dilated(
+            xi, jnp.flip(jnp.swapaxes(wi, 0, 1), axis=tuple(range(2, 2 + ndim))),
+            window_strides=(1,) * ndim,
+            padding=padding,
+            lhs_dilation=stride,
+            rhs_dilation=dilate,
+            dimension_numbers=dn,
+            precision=matmul_precision(xi, wi),
+        )
+
+    if num_group == 1:
+        y = one_group(x, weight)
+    else:
+        xs = jnp.split(x, num_group, axis=1)
+        ws = jnp.split(weight, num_group, axis=0)
+        y = jnp.concatenate([one_group(xi, wi) for xi, wi in zip(xs, ws)],
+                            axis=1)
+    if bias is not None and not no_bias:
+        y = y + bias.reshape((1, -1) + (1,) * ndim)
+    return y
+
+
+# ---------------------------------------------------------------------------
+# pooling
+# ---------------------------------------------------------------------------
+
+@register_op("Pooling", aliases=("pooling",))
+def pooling(x, kernel=(), pool_type="max", global_pool=False, stride=(),
+            pad=(), pooling_convention="valid", count_include_pad=True,
+            cudnn_off=False, layout=None):
+    sdims = x.ndim - 2  # spatial dims, layout NC + spatial
+    if global_pool:
+        axes = tuple(range(2, x.ndim))
+        if pool_type == "max":
+            return jnp.max(x, axis=axes, keepdims=True)
+        return jnp.mean(x, axis=axes, keepdims=True)
+    kernel = tuple(kernel)
+    stride = tuple(stride) if stride else (1,) * sdims
+    pad = tuple(pad) if pad else (0,) * sdims
+    window = (1, 1) + kernel
+    strides = (1, 1) + stride
+    # 'full' convention (reference: ceil output sizing) = extra right-pad
+    extra = [0] * sdims
+    if pooling_convention == "full":
+        for i in range(sdims):
+            in_sz = x.shape[2 + i]
+            valid_out = (in_sz + 2 * pad[i] - kernel[i]) // stride[i] + 1
+            full_out = -(-(in_sz + 2 * pad[i] - kernel[i]) // stride[i]) + 1
+            extra[i] = (full_out - valid_out) * stride[i]
+    padding = ((0, 0), (0, 0)) + tuple(
+        (p, p + e) for p, e in zip(pad, extra))
+    if pool_type == "max":
+        init = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else jnp.iinfo(x.dtype).min
+        return lax.reduce_window(x, jnp.asarray(init, x.dtype), lax.max,
+                                 window, strides, padding)
+    if pool_type in ("avg", "sum"):
+        summed = lax.reduce_window(x, jnp.asarray(0, x.dtype), lax.add,
+                                   window, strides, padding)
+        if pool_type == "sum":
+            return summed
+        if count_include_pad:
+            denom = 1
+            for k in kernel:
+                denom *= k
+            return summed / denom
+        ones = jnp.ones_like(x)
+        counts = lax.reduce_window(ones, jnp.asarray(0, x.dtype), lax.add,
+                                   window, strides, padding)
+        return summed / counts
+    if pool_type == "lp":
+        p2 = lax.reduce_window(jnp.square(x), jnp.asarray(0, x.dtype), lax.add,
+                               window, strides, padding)
+        return jnp.sqrt(p2)
+    raise ValueError(f"unknown pool_type {pool_type}")
+
+
+# ---------------------------------------------------------------------------
+# activations
+# ---------------------------------------------------------------------------
+
+@register_op("Activation", aliases=("activation",))
+def activation_op(x, act_type="relu"):
+    if act_type == "relu":
+        return jnp.maximum(x, 0)
+    if act_type == "sigmoid":
+        return jax.nn.sigmoid(x)
+    if act_type == "tanh":
+        return jnp.tanh(x)
+    if act_type == "softrelu":
+        return jax.nn.softplus(x)
+    if act_type == "softsign":
+        return jax.nn.soft_sign(x)
+    raise ValueError(f"unknown act_type {act_type}")
+
+
+@register_op("LeakyReLU")
+def leaky_relu(x, gamma=None, act_type="leaky", slope=0.25,
+               lower_bound=0.125, upper_bound=0.334):
+    if act_type == "leaky":
+        return jnp.where(x >= 0, x, slope * x)
+    if act_type == "elu":
+        return jnp.where(x >= 0, x, slope * jnp.expm1(x))
+    if act_type == "selu":
+        return 1.0507009873554805 * jnp.where(
+            x >= 0, x, 1.6732632423543772 * jnp.expm1(x))
+    if act_type == "gelu":
+        return jax.nn.gelu(x, approximate=False)
+    if act_type == "prelu":
+        g = gamma
+        shape = [1] * x.ndim
+        if g.ndim == 1 and x.ndim > 1:
+            shape[1] = g.shape[0]
+            g = g.reshape(shape)
+        return jnp.where(x >= 0, x, g * x)
+    if act_type == "rrelu":
+        mid = (lower_bound + upper_bound) / 2.0
+        return jnp.where(x >= 0, x, mid * x)
+    raise ValueError(f"unknown act_type {act_type}")
+
+
+@register_op("gelu_tanh")
+def gelu_tanh(x):
+    return jax.nn.gelu(x, approximate=True)
+
+
+@register_op("swish", aliases=("silu",))
+def swish(x, beta=1.0):
+    return x * jax.nn.sigmoid(beta * x)
+
+
+@register_op("hard_sigmoid")
+def hard_sigmoid(x, alpha=0.2, beta=0.5):
+    return jnp.clip(alpha * x + beta, 0.0, 1.0)
+
+
+@register_op("softmax")
+def softmax(x, axis=-1, temperature=None, length=None):
+    if temperature is not None and temperature != 1.0:
+        x = x / temperature
+    if length is not None:
+        steps = jnp.arange(x.shape[axis])
+        shape = [1] * x.ndim
+        shape[axis] = x.shape[axis]
+        mask = steps.reshape(shape) < length.reshape(
+            (-1,) + (1,) * (x.ndim - 1))
+        x = jnp.where(mask, x, -jnp.inf)
+    return jax.nn.softmax(x, axis=axis)
+
+
+@register_op("log_softmax")
+def log_softmax(x, axis=-1, temperature=None):
+    if temperature is not None and temperature != 1.0:
+        x = x / temperature
+    return jax.nn.log_softmax(x, axis=axis)
+
+
+@register_op("softmin")
+def softmin(x, axis=-1):
+    return jax.nn.softmax(-x, axis=axis)
+
+
+@register_op("softmax_cross_entropy")
+def softmax_cross_entropy(data, label):
+    """Fused softmax + CE (parity: src/operator/loss_binary_op.cc).
+    label is class indices; returns scalar sum loss."""
+    logp = jax.nn.log_softmax(data, axis=-1)
+    nll = -jnp.take_along_axis(
+        logp, label.astype(jnp.int32)[..., None], axis=-1)[..., 0]
+    return jnp.sum(nll)
+
+
+# ---------------------------------------------------------------------------
+# normalisation
+# ---------------------------------------------------------------------------
+
+@register_op("LayerNorm", aliases=("layer_norm",))
+def layer_norm(x, gamma, beta, axis=-1, eps=1e-5):
+    mean = jnp.mean(x, axis=axis, keepdims=True)
+    var = jnp.mean(jnp.square(x - mean), axis=axis, keepdims=True)
+    inv = lax.rsqrt(var + eps)
+    shape = [1] * x.ndim
+    shape[axis] = x.shape[axis]
+    return (x - mean) * inv * gamma.reshape(shape) + beta.reshape(shape)
+
+
+@register_op("BatchNorm", aliases=("batch_norm",), differentiable=True)
+def batch_norm(x, gamma, beta, moving_mean, moving_var, eps=1e-5,
+               momentum=0.9, fix_gamma=True, use_global_stats=False,
+               axis=1, output_mean_var=False, _training=False):
+    """BatchNorm forward.  Stats selection follows the reference
+    (src/operator/nn/batch_norm.cc): batch stats when training and not
+    use_global_stats, else moving stats.  The moving-stat update is done by
+    the Gluon layer (aux-state write-back), not inside this pure op.
+    """
+    if fix_gamma:
+        gamma = jnp.ones_like(gamma)
+    red = tuple(i for i in range(x.ndim) if i != axis)
+    shape = [1] * x.ndim
+    shape[axis] = x.shape[axis]
+    if _training and not use_global_stats:
+        mean = jnp.mean(x, axis=red)
+        var = jnp.mean(jnp.square(x - mean.reshape(shape)), axis=red)
+    else:
+        mean, var = moving_mean, moving_var
+    inv = lax.rsqrt(var.reshape(shape) + eps)
+    out = (x - mean.reshape(shape)) * inv * gamma.reshape(shape) + beta.reshape(shape)
+    if output_mean_var:
+        return out, mean, var
+    return out
+
+
+@register_op("InstanceNorm")
+def instance_norm(x, gamma, beta, eps=1e-3):
+    red = tuple(range(2, x.ndim))
+    mean = jnp.mean(x, axis=red, keepdims=True)
+    var = jnp.mean(jnp.square(x - mean), axis=red, keepdims=True)
+    shape = (1, -1) + (1,) * (x.ndim - 2)
+    return (x - mean) * lax.rsqrt(var + eps) * gamma.reshape(shape) + beta.reshape(shape)
+
+
+@register_op("GroupNorm")
+def group_norm(x, gamma, beta, num_groups=1, eps=1e-5):
+    b, c = x.shape[0], x.shape[1]
+    spatial = x.shape[2:]
+    xg = x.reshape((b, num_groups, c // num_groups) + spatial)
+    red = tuple(range(2, xg.ndim))
+    mean = jnp.mean(xg, axis=red, keepdims=True)
+    var = jnp.mean(jnp.square(xg - mean), axis=red, keepdims=True)
+    xg = (xg - mean) * lax.rsqrt(var + eps)
+    out = xg.reshape(x.shape)
+    shape = (1, -1) + (1,) * (x.ndim - 2)
+    return out * gamma.reshape(shape) + beta.reshape(shape)
+
+
+@register_op("L2Normalization", aliases=("l2_normalization",))
+def l2_normalization(x, eps=1e-10, mode="instance"):
+    if mode == "instance":
+        red = tuple(range(1, x.ndim))
+        nrm = jnp.sqrt(jnp.sum(jnp.square(x), axis=red, keepdims=True) + eps)
+    elif mode == "channel":
+        nrm = jnp.sqrt(jnp.sum(jnp.square(x), axis=1, keepdims=True) + eps)
+    else:  # spatial
+        red = tuple(range(2, x.ndim))
+        nrm = jnp.sqrt(jnp.sum(jnp.square(x), axis=red, keepdims=True) + eps)
+    return x / nrm
+
+
+# ---------------------------------------------------------------------------
+# dropout / embedding
+# ---------------------------------------------------------------------------
+
+@register_op("Dropout", aliases=("dropout",))
+def dropout_op(x, p=0.5, mode="training", axes=(), _training=False, _key=None):
+    """Dropout.  _training/_key are injected by the NDArray wrapper: the key
+    comes from the global key-ring (eager) or the traced per-call key under
+    hybridize (see mxtpu/random.py), so compiled nets get fresh randomness
+    each step — the TPU answer to the reference's per-device cuDNN dropout
+    state (src/operator/nn/dropout-inl.h).
+    """
+    if (not _training and mode != "always") or p == 0 or _key is None:
+        return x
+    shape = list(x.shape)
+    for ax in axes or ():
+        shape[ax] = 1
+    keep = 1.0 - p
+    mask = jax.random.bernoulli(_key, keep, tuple(shape)).astype(x.dtype)
+    return x * mask / keep
+
+
+@register_op("Embedding", aliases=("embedding",))
+def embedding(data, weight, input_dim=0, output_dim=0, dtype="float32",
+              sparse_grad=False):
+    return jnp.take(weight, data.astype(jnp.int32), axis=0)
+
+
+# ---------------------------------------------------------------------------
+# legacy symbolic-loss heads
+# ---------------------------------------------------------------------------
+
+@register_op("SoftmaxOutput", aliases=("softmax_output",))
+def softmax_output(data, label=None, grad_scale=1.0, ignore_label=-1,
+                   multi_output=False, use_ignore=False, preserve_shape=False,
+                   normalization="null", out_grad=False, smooth_alpha=0.0):
+    # Forward is softmax; the implicit-CE-gradient trick of the reference is
+    # realised by gluon.loss.SoftmaxCrossEntropyLoss instead.
+    return jax.nn.softmax(data, axis=-1 if not multi_output else 1)
+
+
+@register_op("LinearRegressionOutput")
+def linear_regression_output(data, label=None, grad_scale=1.0):
+    return data
+
+
+@register_op("MAERegressionOutput")
+def mae_regression_output(data, label=None, grad_scale=1.0):
+    return data
+
+
+@register_op("LogisticRegressionOutput")
+def logistic_regression_output(data, label=None, grad_scale=1.0):
+    return jax.nn.sigmoid(data)
+
+
+@register_op("BilinearSampler")
+def bilinear_sampler(data, grid):
+    # data: (B, C, H, W); grid: (B, 2, Ho, Wo) in [-1, 1]
+    B, C, H, W = data.shape
+    gx = (grid[:, 0] + 1) * (W - 1) / 2
+    gy = (grid[:, 1] + 1) * (H - 1) / 2
+    x0 = jnp.floor(gx).astype(jnp.int32)
+    y0 = jnp.floor(gy).astype(jnp.int32)
+    x1, y1 = x0 + 1, y0 + 1
+    wx = gx - x0
+    wy = gy - y0
+
+    def gather(y, x):
+        yc = jnp.clip(y, 0, H - 1)
+        xc = jnp.clip(x, 0, W - 1)
+        idx = yc * W + xc  # (B, Ho, Wo)
+        flat = data.reshape(B, C, H * W)
+        g = jnp.take_along_axis(
+            flat, idx.reshape(B, 1, -1).repeat(C, axis=1), axis=2)
+        valid = ((y >= 0) & (y <= H - 1) & (x >= 0) & (x <= W - 1))
+        return g.reshape(B, C, *idx.shape[1:]) * valid[:, None].astype(data.dtype)
+
+    out = (gather(y0, x0) * ((1 - wx) * (1 - wy))[:, None]
+           + gather(y0, x1) * (wx * (1 - wy))[:, None]
+           + gather(y1, x0) * ((1 - wx) * wy)[:, None]
+           + gather(y1, x1) * (wx * wy)[:, None])
+    return out

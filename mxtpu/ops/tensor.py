@@ -1,0 +1,659 @@
+"""Tensor ops: elementwise, broadcast, reduce, matrix, indexing, init.
+
+Parity: src/operator/tensor/ (elemwise_unary_op*, elemwise_binary_op*,
+broadcast_reduce_op*, matrix_op*, indexing_op*, init_op*, ordering_op*,
+dot*) — reimplemented as jax.numpy/lax expressions.  XLA fuses elementwise
+chains into single kernels, which is what the reference's mshadow expression
+templates and (1.6+) pointwise RTC fusion (src/operator/fusion/fused_op)
+were hand-building; here the compiler does it.
+
+MXNet semantic notes preserved where they differ from numpy:
+ - ``dot`` contracts last axis of lhs with first axis of rhs (tensordot-1).
+ - ``flatten`` collapses all but the leading axis.
+ - reductions default keepdims=False, axis=None means all axes.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..base import register_op
+
+# ---------------------------------------------------------------------------
+# elementwise unary
+# ---------------------------------------------------------------------------
+
+_UNARY = {
+    "abs": jnp.abs,
+    "sign": jnp.sign,
+    "negative": jnp.negative,
+    "reciprocal": jnp.reciprocal,
+    "square": jnp.square,
+    "sqrt": jnp.sqrt,
+    "rsqrt": lambda x: lax.rsqrt(x),
+    "cbrt": jnp.cbrt,
+    "rcbrt": lambda x: 1.0 / jnp.cbrt(x),
+    "exp": jnp.exp,
+    "expm1": jnp.expm1,
+    "log": jnp.log,
+    "log10": jnp.log10,
+    "log2": jnp.log2,
+    "log1p": jnp.log1p,
+    "sin": jnp.sin,
+    "cos": jnp.cos,
+    "tan": jnp.tan,
+    "arcsin": jnp.arcsin,
+    "arccos": jnp.arccos,
+    "arctan": jnp.arctan,
+    "sinh": jnp.sinh,
+    "cosh": jnp.cosh,
+    "tanh": jnp.tanh,
+    "arcsinh": jnp.arcsinh,
+    "arccosh": jnp.arccosh,
+    "arctanh": jnp.arctanh,
+    "erf": lambda x: jax.scipy.special.erf(x),
+    "erfinv": lambda x: jax.scipy.special.erfinv(x),
+    "gamma": lambda x: jnp.exp(jax.scipy.special.gammaln(x)),
+    "gammaln": lambda x: jax.scipy.special.gammaln(x),
+    "sigmoid": jax.nn.sigmoid,
+    "softsign": jax.nn.soft_sign,
+    "relu": lambda x: jnp.maximum(x, 0),
+    "logical_not": lambda x: (x == 0).astype(x.dtype),
+}
+
+for _name, _fn in _UNARY.items():
+    register_op(_name)(_fn)
+
+_UNARY_NONDIFF = {
+    "floor": jnp.floor,
+    "ceil": jnp.ceil,
+    "round": jnp.round,
+    "rint": jnp.rint,
+    "trunc": jnp.trunc,
+    "fix": jnp.trunc,
+}
+for _name, _fn in _UNARY_NONDIFF.items():
+    register_op(_name, differentiable=False)(_fn)
+
+
+@register_op("cast", aliases=("Cast",))
+def cast(x, dtype="float32"):
+    return x.astype(jnp.dtype(dtype))
+
+
+@register_op("clip")
+def clip(x, a_min=None, a_max=None):
+    return jnp.clip(x, a_min, a_max)
+
+
+# ---------------------------------------------------------------------------
+# elementwise binary (+ broadcast_* aliases: in MXNet elemwise_add requires
+# identical shapes while broadcast_add broadcasts; jnp broadcasts always, so
+# one implementation serves both names)
+# ---------------------------------------------------------------------------
+
+_BINARY = {
+    "add": jnp.add,
+    "subtract": jnp.subtract,
+    "multiply": jnp.multiply,
+    "divide": jnp.divide,
+    "mod": jnp.mod,
+    "power": jnp.power,
+    "maximum": jnp.maximum,
+    "minimum": jnp.minimum,
+    "hypot": jnp.hypot,
+    "arctan2": jnp.arctan2,
+}
+for _name, _fn in _BINARY.items():
+    register_op(_name, aliases=("broadcast_" + _name, "elemwise_" + _name))(_fn)
+
+_BINARY_ALIAS = {  # mxnet legacy short names
+    "broadcast_sub": jnp.subtract,
+    "broadcast_mul": jnp.multiply,
+    "broadcast_div": jnp.divide,
+    "broadcast_plus": jnp.add,
+    "broadcast_minus": jnp.subtract,
+    "elemwise_sub": jnp.subtract,
+    "elemwise_mul": jnp.multiply,
+    "elemwise_div": jnp.divide,
+}
+for _name, _fn in _BINARY_ALIAS.items():
+    register_op(_name)(_fn)
+
+_CMP = {
+    "equal": jnp.equal,
+    "not_equal": jnp.not_equal,
+    "greater": jnp.greater,
+    "greater_equal": jnp.greater_equal,
+    "lesser": jnp.less,
+    "lesser_equal": jnp.less_equal,
+    "logical_and": jnp.logical_and,
+    "logical_or": jnp.logical_or,
+    "logical_xor": jnp.logical_xor,
+}
+for _name, _fn in _CMP.items():
+    # MXNet comparison ops return float arrays (not bool)
+    register_op(
+        _name,
+        differentiable=False,
+        aliases=("broadcast_" + _name,),
+    )(lambda a, b, _f=_fn: _f(a, b).astype(jnp.result_type(a, b)))
+
+
+# ---------------------------------------------------------------------------
+# reductions
+# ---------------------------------------------------------------------------
+
+def _norm_axis(axis):
+    if axis is None or isinstance(axis, int):
+        return axis
+    axis = tuple(axis)
+    return axis if axis else None
+
+
+@register_op("sum", aliases=("sum_axis",))
+def sum_(x, axis=None, keepdims=False):
+    return jnp.sum(x, axis=_norm_axis(axis), keepdims=keepdims)
+
+
+@register_op("mean")
+def mean(x, axis=None, keepdims=False):
+    return jnp.mean(x, axis=_norm_axis(axis), keepdims=keepdims)
+
+
+@register_op("prod")
+def prod(x, axis=None, keepdims=False):
+    return jnp.prod(x, axis=_norm_axis(axis), keepdims=keepdims)
+
+
+@register_op("max", aliases=("max_axis",))
+def max_(x, axis=None, keepdims=False):
+    return jnp.max(x, axis=_norm_axis(axis), keepdims=keepdims)
+
+
+@register_op("min", aliases=("min_axis",))
+def min_(x, axis=None, keepdims=False):
+    return jnp.min(x, axis=_norm_axis(axis), keepdims=keepdims)
+
+
+@register_op("nansum")
+def nansum(x, axis=None, keepdims=False):
+    return jnp.nansum(x, axis=_norm_axis(axis), keepdims=keepdims)
+
+
+@register_op("norm")
+def norm(x, ord=2, axis=None, keepdims=False):
+    axis = _norm_axis(axis)
+    if ord == 1:
+        return jnp.sum(jnp.abs(x), axis=axis, keepdims=keepdims)
+    return jnp.sqrt(jnp.sum(jnp.square(x), axis=axis, keepdims=keepdims))
+
+
+@register_op("argmax", differentiable=False)
+def argmax(x, axis=None, keepdims=False):
+    out = jnp.argmax(x, axis=axis)
+    if keepdims and axis is not None:
+        out = jnp.expand_dims(out, axis)
+    return out.astype(jnp.float32)
+
+
+@register_op("argmin", differentiable=False)
+def argmin(x, axis=None, keepdims=False):
+    out = jnp.argmin(x, axis=axis)
+    if keepdims and axis is not None:
+        out = jnp.expand_dims(out, axis)
+    return out.astype(jnp.float32)
+
+
+@register_op("argsort", differentiable=False)
+def argsort(x, axis=-1, is_ascend=True, dtype="float32"):
+    idx = jnp.argsort(x, axis=axis)
+    if not is_ascend:
+        idx = jnp.flip(idx, axis=axis)
+    return idx.astype(jnp.dtype(dtype))
+
+
+@register_op("sort")
+def sort(x, axis=-1, is_ascend=True):
+    out = jnp.sort(x, axis=axis)
+    if not is_ascend:
+        out = jnp.flip(out, axis=axis)
+    return out
+
+
+@register_op("topk", differentiable=False)
+def topk(x, axis=-1, k=1, ret_typ="indices", is_ascend=False, dtype="float32"):
+    # lax.top_k works on the last axis; move target axis there.
+    xm = jnp.moveaxis(x, axis, -1)
+    if is_ascend:
+        vals, idx = lax.top_k(-xm, k)
+        vals = -vals
+    else:
+        vals, idx = lax.top_k(xm, k)
+    vals = jnp.moveaxis(vals, -1, axis)
+    idx = jnp.moveaxis(idx, -1, axis).astype(jnp.dtype(dtype))
+    if ret_typ == "value":
+        return vals
+    if ret_typ == "both":
+        return (vals, idx)
+    return idx
+
+
+# ---------------------------------------------------------------------------
+# matrix / contraction — the MXU path.  Large batched matmuls; bf16-friendly.
+# fp32 inputs use full-precision accumulation (MXNet numeric parity); the
+# perf path feeds bf16, which takes the MXU's native fast path.
+# ---------------------------------------------------------------------------
+
+def matmul_precision(*arrays):
+    if all(a.dtype == jnp.float32 for a in arrays):
+        return lax.Precision.HIGHEST
+    return None
+
+
+@register_op("dot")
+def dot(a, b, transpose_a=False, transpose_b=False):
+    """MXNet dot: contract last axis of a with first axis of b (tensordot-1)."""
+    if transpose_a:
+        a = jnp.moveaxis(a, 0, -1) if a.ndim > 1 else a
+    if transpose_b:
+        b = jnp.moveaxis(b, -1, 0) if b.ndim > 1 else b
+    if a.ndim == 1 and b.ndim == 1:
+        return jnp.dot(a, b, precision=matmul_precision(a, b))
+    return jnp.tensordot(a, b, axes=1, precision=matmul_precision(a, b))
+
+
+@register_op("batch_dot")
+def batch_dot(a, b, transpose_a=False, transpose_b=False):
+    if transpose_a:
+        a = jnp.swapaxes(a, -1, -2)
+    if transpose_b:
+        b = jnp.swapaxes(b, -1, -2)
+    return jnp.matmul(a, b, precision=matmul_precision(a, b))
+
+
+@register_op("linalg_gemm2")
+def linalg_gemm2(a, b, transpose_a=False, transpose_b=False, alpha=1.0):
+    if transpose_a:
+        a = jnp.swapaxes(a, -1, -2)
+    if transpose_b:
+        b = jnp.swapaxes(b, -1, -2)
+    return alpha * jnp.matmul(a, b, precision=matmul_precision(a, b))
+
+
+@register_op("khatri_rao")
+def khatri_rao(*mats):
+    out = mats[0]
+    for m in mats[1:]:
+        out = jnp.einsum("i...,j...->ij...", out, m).reshape(-1, out.shape[-1])
+    return out
+
+
+# ---------------------------------------------------------------------------
+# shape manipulation
+# ---------------------------------------------------------------------------
+
+@register_op("reshape", aliases=("Reshape",))
+def reshape(x, shape=None, reverse=False):
+    # Supports MXNet special codes 0 (keep dim) and -1 (infer); -2/-3/-4
+    # codes are rare and unsupported (raise).
+    shape = tuple(shape)
+    out = []
+    for i, s in enumerate(shape):
+        if s == 0:
+            out.append(x.shape[i])
+        elif s in (-2, -3, -4):
+            raise NotImplementedError(f"reshape code {s} not supported")
+        else:
+            out.append(s)
+    return jnp.reshape(x, tuple(out))
+
+
+@register_op("reshape_like")
+def reshape_like(x, y):
+    return jnp.reshape(x, y.shape)
+
+
+@register_op("shape_array", differentiable=False)
+def shape_array(x):
+    return jnp.array(x.shape, dtype=jnp.int64)
+
+
+@register_op("size_array", differentiable=False)
+def size_array(x):
+    return jnp.array([x.size], dtype=jnp.int64)
+
+
+@register_op("transpose")
+def transpose(x, axes=None):
+    return jnp.transpose(x, axes=tuple(axes) if axes else None)
+
+
+@register_op("swapaxes", aliases=("SwapAxis",))
+def swapaxes(x, dim1=0, dim2=0):
+    return jnp.swapaxes(x, dim1, dim2)
+
+
+@register_op("expand_dims")
+def expand_dims(x, axis=0):
+    return jnp.expand_dims(x, axis)
+
+
+@register_op("squeeze")
+def squeeze(x, axis=None):
+    return jnp.squeeze(x, axis=axis)
+
+
+@register_op("flatten", aliases=("Flatten",))
+def flatten(x):
+    return jnp.reshape(x, (x.shape[0], -1))
+
+
+@register_op("concat", aliases=("Concat",))
+def concat(*xs, dim=1):
+    return jnp.concatenate(xs, axis=dim)
+
+
+@register_op("stack")
+def stack(*xs, axis=0):
+    return jnp.stack(xs, axis=axis)
+
+
+@register_op("split", aliases=("SliceChannel",))
+def split(x, num_outputs=1, axis=1, squeeze_axis=False):
+    parts = jnp.split(x, num_outputs, axis=axis)
+    if squeeze_axis:
+        parts = [jnp.squeeze(p, axis=axis) for p in parts]
+    return tuple(parts) if len(parts) > 1 else parts[0]
+
+
+@register_op("slice")
+def slice_(x, begin=None, end=None, step=None):
+    nd = x.ndim
+    begin = list(begin or []) + [None] * (nd - len(begin or []))
+    end = list(end or []) + [None] * (nd - len(end or []))
+    step = list(step or []) + [None] * (nd - len(step or []))
+    idx = tuple(
+        slice(b, e, s) for b, e, s in zip(begin, end, step)
+    )
+    return x[idx]
+
+
+@register_op("slice_axis")
+def slice_axis(x, axis=0, begin=0, end=None):
+    idx = [slice(None)] * x.ndim
+    idx[axis] = slice(begin, end)
+    return x[tuple(idx)]
+
+
+@register_op("slice_like")
+def slice_like(x, shape_like, axes=None):
+    axes = range(x.ndim) if axes is None else axes
+    idx = [slice(None)] * x.ndim
+    for ax in axes:
+        idx[ax] = slice(0, shape_like.shape[ax])
+    return x[tuple(idx)]
+
+
+@register_op("tile")
+def tile(x, reps=()):
+    return jnp.tile(x, tuple(reps))
+
+
+@register_op("repeat")
+def repeat(x, repeats=1, axis=None):
+    return jnp.repeat(x, repeats, axis=axis)
+
+
+@register_op("pad", aliases=("Pad",))
+def pad(x, mode="constant", pad_width=(), constant_value=0.0):
+    pw = list(pad_width)
+    pairs = [(pw[i], pw[i + 1]) for i in range(0, len(pw), 2)]
+    if mode == "constant":
+        return jnp.pad(x, pairs, constant_values=constant_value)
+    if mode == "edge":
+        return jnp.pad(x, pairs, mode="edge")
+    if mode == "reflect":
+        return jnp.pad(x, pairs, mode="reflect")
+    raise ValueError(f"unknown pad mode {mode}")
+
+
+@register_op("flip", aliases=("reverse",))
+def flip(x, axis=0):
+    return jnp.flip(x, axis=axis)
+
+
+@register_op("broadcast_to")
+def broadcast_to(x, shape=()):
+    shape = tuple(
+        x.shape[i] if s == 0 else s for i, s in enumerate(shape)
+    )
+    return jnp.broadcast_to(x, shape)
+
+
+@register_op("broadcast_like")
+def broadcast_like(x, y):
+    return jnp.broadcast_to(x, y.shape)
+
+
+@register_op("broadcast_axis", aliases=("broadcast_axes",))
+def broadcast_axis(x, axis=(), size=()):
+    axis = (axis,) if isinstance(axis, int) else tuple(axis)
+    size = (size,) if isinstance(size, int) else tuple(size)
+    shape = list(x.shape)
+    for a, s in zip(axis, size):
+        shape[a] = s
+    return jnp.broadcast_to(x, tuple(shape))
+
+
+@register_op("depth_to_space")
+def depth_to_space(x, block_size=1):
+    b, c, h, w = x.shape
+    bs = block_size
+    y = x.reshape(b, bs, bs, c // (bs * bs), h, w)
+    y = y.transpose(0, 3, 4, 1, 5, 2)
+    return y.reshape(b, c // (bs * bs), h * bs, w * bs)
+
+
+@register_op("space_to_depth")
+def space_to_depth(x, block_size=1):
+    b, c, h, w = x.shape
+    bs = block_size
+    y = x.reshape(b, c, h // bs, bs, w // bs, bs)
+    y = y.transpose(0, 3, 5, 1, 2, 4)
+    return y.reshape(b, c * bs * bs, h // bs, w // bs)
+
+
+# ---------------------------------------------------------------------------
+# indexing / gather
+# ---------------------------------------------------------------------------
+
+@register_op("take")
+def take(a, indices, axis=0, mode="clip"):
+    idx = indices.astype(jnp.int32)
+    return jnp.take(a, idx, axis=axis, mode=mode if mode != "raise" else "clip")
+
+
+@register_op("pick")
+def pick(data, index, axis=-1, keepdims=False, mode="clip"):
+    idx = jnp.clip(index.astype(jnp.int32), 0, data.shape[axis] - 1)
+    picked = jnp.take_along_axis(
+        data, jnp.expand_dims(idx, axis), axis=axis
+    )
+    return picked if keepdims else jnp.squeeze(picked, axis=axis)
+
+
+@register_op("gather_nd")
+def gather_nd(data, indices):
+    idx = tuple(indices.astype(jnp.int32))
+    return data[idx]
+
+
+@register_op("scatter_nd")
+def scatter_nd(data, indices, shape=()):
+    idx = tuple(indices.astype(jnp.int32))
+    out = jnp.zeros(tuple(shape), dtype=data.dtype)
+    return out.at[idx].add(data)
+
+
+@register_op("one_hot", differentiable=False)
+def one_hot(indices, depth=1, on_value=1.0, off_value=0.0, dtype="float32"):
+    oh = jax.nn.one_hot(indices.astype(jnp.int32), depth, dtype=jnp.dtype(dtype))
+    return oh * (on_value - off_value) + off_value
+
+
+@register_op("where")
+def where(condition, x, y):
+    return jnp.where(condition != 0 if condition.dtype != jnp.bool_ else condition, x, y)
+
+
+@register_op("boolean_mask")
+def boolean_mask(data, index, axis=0):
+    # Dynamic-shape op in the reference; on TPU we cannot produce a
+    # data-dependent shape under jit.  Eager-mode only (documented gap).
+    mask = jnp.asarray(index) != 0
+    return jnp.compress(mask, data, axis=axis)
+
+
+@register_op("sequence_mask", aliases=("SequenceMask",))
+def sequence_mask(data, sequence_length=None, use_sequence_length=False,
+                  value=0.0, axis=0):
+    if not use_sequence_length or sequence_length is None:
+        return data
+    maxlen = data.shape[axis]
+    steps = jnp.arange(maxlen)
+    # mask shape: broadcast steps along `axis` against batch on axis 1-axis
+    mask = steps[:, None] < sequence_length[None, :]  # (T, B)
+    if axis == 1:
+        mask = mask.T
+    extra = data.ndim - 2
+    mask = mask.reshape(mask.shape + (1,) * extra)
+    return jnp.where(mask, data, jnp.asarray(value, dtype=data.dtype))
+
+
+@register_op("sequence_last", aliases=("SequenceLast",))
+def sequence_last(data, sequence_length=None, use_sequence_length=False, axis=0):
+    if not use_sequence_length or sequence_length is None:
+        idx = [slice(None)] * data.ndim
+        idx[axis] = -1
+        return data[tuple(idx)]
+    last = (sequence_length.astype(jnp.int32) - 1)
+    return jnp.take_along_axis(
+        jnp.moveaxis(data, axis, 0), last[None, :, None], axis=0
+    )[0] if data.ndim == 3 else jnp.take_along_axis(
+        jnp.moveaxis(data, axis, 0), last[None, :], axis=0)[0]
+
+
+@register_op("sequence_reverse", aliases=("SequenceReverse",))
+def sequence_reverse(data, sequence_length=None, use_sequence_length=False, axis=0):
+    if not use_sequence_length or sequence_length is None:
+        return jnp.flip(data, axis=axis)
+    T = data.shape[axis]
+    steps = jnp.arange(T)
+    d = jnp.moveaxis(data, axis, 0)
+    L = sequence_length.astype(jnp.int32)
+    rev_idx = jnp.where(steps[:, None] < L[None, :],
+                        L[None, :] - 1 - steps[:, None], steps[:, None])
+    out = jnp.take_along_axis(d, rev_idx.reshape(rev_idx.shape + (1,) * (d.ndim - 2)), axis=0)
+    return jnp.moveaxis(out, 0, axis)
+
+
+# ---------------------------------------------------------------------------
+# init ops (creation) — called with explicit shape, no array inputs
+# ---------------------------------------------------------------------------
+
+@register_op("zeros", differentiable=False)
+def zeros(shape=(), dtype="float32"):
+    return jnp.zeros(shape, dtype=jnp.dtype(dtype))
+
+
+@register_op("ones", differentiable=False)
+def ones(shape=(), dtype="float32"):
+    return jnp.ones(shape, dtype=jnp.dtype(dtype))
+
+
+@register_op("full", differentiable=False)
+def full(shape=(), val=0.0, dtype="float32"):
+    return jnp.full(shape, val, dtype=jnp.dtype(dtype))
+
+
+@register_op("arange", differentiable=False)
+def arange(start=0, stop=None, step=1.0, repeat=1, dtype="float32"):
+    out = jnp.arange(start, stop, step, dtype=jnp.dtype(dtype))
+    if repeat > 1:
+        out = jnp.repeat(out, repeat)
+    return out
+
+
+@register_op("linspace", differentiable=False)
+def linspace(start=0, stop=1, num=50, endpoint=True, dtype="float32"):
+    return jnp.linspace(start, stop, num, endpoint=endpoint, dtype=jnp.dtype(dtype))
+
+
+@register_op("eye", differentiable=False)
+def eye(N=1, M=0, k=0, dtype="float32"):
+    return jnp.eye(int(N), int(M) if M else None, k=int(k), dtype=jnp.dtype(dtype))
+
+
+@register_op("zeros_like")
+def zeros_like(x):
+    return jnp.zeros_like(x)
+
+
+@register_op("ones_like")
+def ones_like(x):
+    return jnp.ones_like(x)
+
+
+@register_op("full_like")
+def full_like(x, fill_value=0.0):
+    return jnp.full_like(x, fill_value)
+
+
+@register_op("identity", aliases=("copy", "_copy"))
+def identity(x):
+    return x + 0  # force a new buffer (copy semantics)
+
+
+@register_op("stop_gradient", aliases=("BlockGrad", "block_grad"))
+def stop_gradient(x):
+    return lax.stop_gradient(x)
+
+
+@register_op("smooth_l1")
+def smooth_l1(x, scalar=1.0):
+    s2 = scalar * scalar
+    return jnp.where(jnp.abs(x) < 1.0 / s2,
+                     0.5 * s2 * jnp.square(x),
+                     jnp.abs(x) - 0.5 / s2)
+
+
+# ---------------------------------------------------------------------------
+# cumulative / misc
+# ---------------------------------------------------------------------------
+
+@register_op("cumsum")
+def cumsum(x, axis=None, dtype=None):
+    return jnp.cumsum(x, axis=axis, dtype=jnp.dtype(dtype) if dtype else None)
+
+
+@register_op("diag")
+def diag(x, k=0):
+    return jnp.diag(x, k=k) if x.ndim <= 2 else jnp.diagonal(x, offset=k)
+
+
+@register_op("isnan", differentiable=False)
+def isnan(x):
+    return jnp.isnan(x).astype(jnp.float32)
+
+
+@register_op("isinf", differentiable=False)
+def isinf(x):
+    return jnp.isinf(x).astype(jnp.float32)
+
+
+@register_op("isfinite", differentiable=False)
+def isfinite(x):
+    return jnp.isfinite(x).astype(jnp.float32)

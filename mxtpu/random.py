@@ -1,0 +1,170 @@
+"""RNG state (parity: python/mxnet/random.py + src/resource.cc kRandom).
+
+The reference keeps stateful per-device Philox/MT generators owned by the
+ResourceManager; ops draw from them imperatively.  JAX is functional: all
+randomness flows from explicit keys.  We bridge the two with a global
+key-ring: ``mx.random.seed(s)`` resets it, each random draw folds a counter
+into the root key.  Under a jit trace (hybridize / make_train_step) the
+active trace pushes a _TraceKeyCtx so that the *traced* key is threaded in
+as an argument — compiled steps get fresh randomness per call without
+retracing (the TPU answer to cuDNN dropout states).
+
+Numeric parity with the reference's Philox streams is impossible and not a
+goal (SURVEY.md §7 hard-part 5): API parity + statistical behavior only.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as onp
+
+__all__ = ["seed", "uniform", "normal", "randint", "randn", "shuffle",
+           "multinomial", "gamma", "exponential", "poisson",
+           "generator", "next_key"]
+
+
+class _KeyRing:
+    """Root key is created lazily so `import mxtpu` never initialises the
+    JAX backend (the context module makes the same promise)."""
+
+    def __init__(self, s: int = 0):
+        self._seed = s
+        self._root = None
+        self._counter = 0
+
+    def seed(self, s: int):
+        self._seed = s
+        self._root = None
+        self._counter = 0
+
+    def next_key(self):
+        if self._root is None:
+            self._root = jax.random.key(self._seed)
+        k = jax.random.fold_in(self._root, self._counter)
+        self._counter += 1
+        return k
+
+
+class _TraceKeyCtx:
+    """Deterministic per-trace key derivation; pushed while tracing."""
+
+    def __init__(self, key):
+        self.key = key
+        self.n = 0
+
+    def next_key(self):
+        k = jax.random.fold_in(self.key, self.n)
+        self.n += 1
+        return k
+
+
+_GLOBAL = _KeyRing(int(onp.random.randint(0, 2**31 - 1)))
+_TRACE_STACK: List[_TraceKeyCtx] = []
+
+
+def generator() -> _KeyRing:
+    return _GLOBAL
+
+
+def push_trace_key(key) -> _TraceKeyCtx:
+    ctx = _TraceKeyCtx(key)
+    _TRACE_STACK.append(ctx)
+    return ctx
+
+
+def pop_trace_key():
+    _TRACE_STACK.pop()
+
+
+def in_trace() -> bool:
+    return bool(_TRACE_STACK)
+
+
+def next_key():
+    if _TRACE_STACK:
+        return _TRACE_STACK[-1].next_key()
+    return _GLOBAL.next_key()
+
+
+def seed(seed_state: int, ctx: str = "all"):
+    """Parity: mx.random.seed.  ctx arg accepted and ignored (single key-ring
+    drives all devices; per-device streams come from fold_in of device id
+    inside sharded computations)."""
+    _GLOBAL.seed(int(seed_state))
+    onp.random.seed(int(seed_state) % (2**32))
+
+
+# -- raw draws returning jax arrays (the nd/gluon layers wrap these) --------
+
+def _shape(shape):
+    if shape is None:
+        return ()
+    if isinstance(shape, int):
+        return (shape,)
+    return tuple(shape)
+
+
+def uniform(low=0.0, high=1.0, shape=None, dtype="float32", key=None):
+    k = key if key is not None else next_key()
+    return jax.random.uniform(k, _shape(shape), dtype=jnp.dtype(dtype),
+                              minval=low, maxval=high)
+
+
+def normal(loc=0.0, scale=1.0, shape=None, dtype="float32", key=None):
+    k = key if key is not None else next_key()
+    return loc + scale * jax.random.normal(k, _shape(shape), dtype=jnp.dtype(dtype))
+
+
+def randn(*shape, loc=0.0, scale=1.0, dtype="float32", key=None):
+    return normal(loc, scale, shape, dtype, key)
+
+
+def randint(low=0, high=None, shape=None, dtype="int32", key=None):
+    k = key if key is not None else next_key()
+    return jax.random.randint(k, _shape(shape), low, high, dtype=jnp.dtype(dtype))
+
+
+def gamma(alpha=1.0, beta=1.0, shape=None, dtype="float32", key=None):
+    k = key if key is not None else next_key()
+    return jax.random.gamma(k, alpha, _shape(shape), dtype=jnp.dtype(dtype)) * beta
+
+
+def exponential(scale=1.0, shape=None, dtype="float32", key=None):
+    k = key if key is not None else next_key()
+    return jax.random.exponential(k, _shape(shape), dtype=jnp.dtype(dtype)) * scale
+
+
+def poisson(lam=1.0, shape=None, dtype="float32", key=None):
+    k = key if key is not None else next_key()
+    return jax.random.poisson(k, lam, _shape(shape)).astype(jnp.dtype(dtype))
+
+
+def shuffle(data, key=None):
+    k = key if key is not None else next_key()
+    return jax.random.permutation(k, data, axis=0)
+
+
+def multinomial(data, shape=None, get_prob=False, dtype="int32", key=None):
+    k = key if key is not None else next_key()
+    n = 1 if shape is None else (shape if isinstance(shape, int) else shape[0])
+    logp_full = jnp.log(jnp.maximum(data, 1e-30))
+    logp_full = logp_full - jax.scipy.special.logsumexp(
+        logp_full, axis=-1, keepdims=True)
+    if data.ndim == 1:
+        out = jax.random.categorical(k, logp_full, shape=(n,))
+        out = out if n > 1 else out[0]
+    else:
+        out = jax.random.categorical(k, logp_full, axis=-1,
+                                     shape=(n,) + data.shape[:-1]).T
+        if n == 1:
+            out = out[..., 0]
+    samples = out.astype(jnp.dtype(dtype))
+    if get_prob:
+        logp = jnp.take_along_axis(
+            jnp.broadcast_to(logp_full, out.shape + logp_full.shape[-1:]),
+            out[..., None].astype(jnp.int32), axis=-1)[..., 0]
+        return samples, logp
+    return samples

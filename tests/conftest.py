@@ -1,0 +1,35 @@
+"""Test config: run on a virtual 8-device CPU mesh (the standard JAX trick
+— SURVEY.md §4 fixture 5) so multi-chip sharding logic is exercised without
+TPU hardware.  Must set env before jax initialises."""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture
+def rnd_seed():
+    """Parity: tests/python/unittest/common.py with_seed() — deterministic
+    per-test reseed, seed logged on failure for repro."""
+    import mxtpu as mx
+
+    seed = np.random.randint(0, 2**31)
+    mx.random.seed(seed)
+    yield seed
+
+
+def assert_almost_equal(a, b, rtol=1e-5, atol=1e-6):
+    import mxtpu as mx
+
+    if isinstance(a, mx.NDArray):
+        a = a.asnumpy()
+    if isinstance(b, mx.NDArray):
+        b = b.asnumpy()
+    np.testing.assert_allclose(a, b, rtol=rtol, atol=atol)

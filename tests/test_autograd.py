@@ -1,0 +1,184 @@
+"""Autograd tests (parity model: tests/python/unittest/test_autograd.py)."""
+
+import numpy as np
+import pytest
+
+import mxtpu as mx
+from mxtpu import autograd, nd
+
+
+def test_simple_backward():
+    x = nd.array([1.0, 2.0, 3.0])
+    x.attach_grad()
+    with autograd.record():
+        y = (x * x).sum()
+    y.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), [2, 4, 6])
+
+
+def test_chain():
+    x = nd.array([2.0])
+    x.attach_grad()
+    with autograd.record():
+        y = nd.exp(nd.log(x) * 3)  # x^3
+    y.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), [12.0], rtol=1e-5)
+
+
+def test_multi_input():
+    a = nd.array([1.0, 2.0])
+    b = nd.array([3.0, 4.0])
+    a.attach_grad()
+    b.attach_grad()
+    with autograd.record():
+        c = (a * b + a).sum()
+    c.backward()
+    np.testing.assert_allclose(a.grad.asnumpy(), [4, 5])
+    np.testing.assert_allclose(b.grad.asnumpy(), [1, 2])
+
+
+def test_reuse_variable():
+    x = nd.array([3.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * x + x * 2  # dy/dx = 2x + 2
+    y.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), [8.0])
+
+
+def test_head_grad():
+    x = nd.array([1.0, 1.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * 3
+    y.backward(nd.array([1.0, 2.0]))
+    np.testing.assert_allclose(x.grad.asnumpy(), [3, 6])
+
+
+def test_grad_req_add():
+    x = nd.array([1.0])
+    x.attach_grad(grad_req="add")
+    for _ in range(3):
+        with autograd.record():
+            y = x * 2
+        y.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), [6.0])
+    # zero then write-mode overwrite
+    x.attach_grad()  # re-attach resets
+    with autograd.record():
+        y = x * 5
+    y.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), [5.0])
+
+
+def test_pause_stops_recording():
+    x = nd.array([1.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * 2
+        with autograd.pause():
+            z = y * 10  # not recorded
+        w = y + 1
+    w.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), [2.0])
+    assert not autograd.is_recording()
+
+
+def test_train_mode_flags():
+    assert not autograd.is_training()
+    with autograd.record():
+        assert autograd.is_training() and autograd.is_recording()
+        with autograd.predict_mode():
+            assert not autograd.is_training()
+    with autograd.train_mode():
+        assert autograd.is_training() and not autograd.is_recording()
+
+
+def test_detach():
+    x = nd.array([2.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * x
+        z = y.detach() * x  # grad flows only through the second factor
+    z.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), [4.0])
+
+
+def test_stop_gradient_op():
+    x = nd.array([2.0])
+    x.attach_grad()
+    with autograd.record():
+        y = nd.stop_gradient(x * x) + x
+    y.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), [1.0])
+
+
+def test_backward_through_conv():
+    x = nd.random.normal(shape=(1, 2, 5, 5))
+    w = nd.random.normal(shape=(3, 2, 3, 3))
+    x.attach_grad()
+    w.attach_grad()
+    with autograd.record():
+        y = nd.Convolution(x, w, kernel=(3, 3), num_filter=3, no_bias=True)
+        loss = (y * y).sum()
+    loss.backward()
+    assert x.grad.shape == x.shape
+    assert w.grad.shape == w.shape
+    assert float(nd.abs(w.grad).sum().asscalar()) > 0
+
+
+def test_numeric_gradient_check():
+    """Finite difference vs tape (parity: check_numeric_gradient)."""
+    x = nd.array(np.random.rand(4).astype(np.float32) + 0.5)
+    x.attach_grad()
+    with autograd.record():
+        y = (nd.tanh(x) * x).sum()
+    y.backward()
+    eps = 1e-3
+    xn = x.asnumpy()
+    num = np.zeros_like(xn)
+    for i in range(xn.size):
+        xp, xm = xn.copy(), xn.copy()
+        xp[i] += eps
+        xm[i] -= eps
+        num[i] = ((np.tanh(xp) * xp).sum() - (np.tanh(xm) * xm).sum()) / (2 * eps)
+    np.testing.assert_allclose(x.grad.asnumpy(), num, rtol=1e-2, atol=1e-3)
+
+
+def test_grad_function_api():
+    x = nd.array([3.0])
+    with autograd.record():
+        x.attach_grad()
+        y = x * x
+    (g,) = autograd.grad([y], [x])
+    np.testing.assert_allclose(g.asnumpy(), [6.0])
+
+
+def test_custom_function():
+    class Square(autograd.Function):
+        def forward(self, x):
+            self.save_for_backward(x)
+            return x * x
+
+        def backward(self, dy):
+            (x,) = self.saved_tensors
+            return 2 * x * dy
+
+    x = nd.array([4.0])
+    x.attach_grad()
+    sq = Square()
+    with autograd.record():
+        y = sq(x)
+    y.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), [8.0])
+
+
+def test_multi_output_op_backward():
+    x = nd.array(np.arange(8, dtype=np.float32).reshape(2, 4))
+    x.attach_grad()
+    with autograd.record():
+        a, b = nd.split(x, num_outputs=2, axis=1)
+        loss = (a * 2 + b * 3).sum()
+    loss.backward()
+    np.testing.assert_allclose(
+        x.grad.asnumpy(), [[2, 2, 3, 3], [2, 2, 3, 3]])
