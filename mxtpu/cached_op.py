@@ -1,0 +1,178 @@
+"""CachedOp: compiled execution of a HybridBlock (parity:
+src/imperative/cached_op.cc — CachedOp::Forward / StaticForward /
+DynamicForward and CachedOpConfig).
+
+Reference: hybridize() traces hybrid_forward into an NNVM symbol graph, then
+CachedOp executes it with pre-planned memory (static_alloc) and bulked engine
+segments (static_shape); backward caches the gradient graph.
+
+TPU design (SURVEY §3.2 "this single stack is ~the whole north star"): the
+block is functionalized over (diff_params, aux_params, rng_key, *inputs) and
+handed to ``jax.jit``; XLA does the memory planning and op bulking that
+static_alloc/static_shape hand-rolled, so those flags are accepted no-ops.
+The jit cache is keyed on input shapes/dtypes + train flag (the reference
+keys its GraphInfo on the same).  Under ``autograd.record`` the whole
+compiled forward becomes ONE tape node whose vjp is the XLA-compiled
+backward — the nnvm Gradient pass is jax.vjp here.
+
+Aux states (BatchNorm running stats — grad_req='null' params) are threaded
+as explicit inputs AND outputs of the functional program, then rebound into
+their parameter slots after each call: the functional answer to the
+reference's mutable aux-state arrays.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from . import autograd, random as _random
+from .base import MXTPUError
+from .ndarray import NDArray
+
+__all__ = ["CachedOp", "export_block"]
+
+
+class CachedOp:
+    def __init__(self, block, flags: Optional[dict] = None):
+        self._block = block
+        self._flags = dict(flags or {})
+        self._jit_cache: Dict[Any, Any] = {}
+        self._diff_params: Optional[List] = None
+        self._aux_params: Optional[List] = None
+        self._warm = False
+
+    # -- parameter collection -------------------------------------------
+    def _collect_params(self):
+        params = sorted(self._block.collect_params().values(),
+                        key=lambda p: p.name)
+        self._diff_params = [p for p in params if p.grad_req != "null"]
+        self._aux_params = [p for p in params if p.grad_req == "null"]
+
+    # -- the functional program -----------------------------------------
+    def _make_fn(self, training: bool, static_args: tuple,
+                 nd_positions: tuple):
+        block = self._block
+        diff_params = self._diff_params
+        aux_params = self._aux_params
+
+        def fn(diff_leaves, aux_leaves, key, *input_datas):
+            ctx = None
+            saved = []
+            for p, leaf in list(zip(diff_params, diff_leaves)) + list(
+                    zip(aux_params, aux_leaves)):
+                holder = p.data(ctx)
+                saved.append((holder, holder._data))
+                holder._data = leaf
+            _random.push_trace_key(key)
+            try:
+                # reconstruct the positional args: NDArray slots get traced
+                # wrappers, static slots get their recorded Python values
+                call_args = list(static_args)
+                for pos, data in zip(nd_positions, input_datas):
+                    call_args[pos] = NDArray(data)
+                with autograd.pause(train_mode=training):
+                    out = block._imperative_forward(*call_args)
+                outs = out if isinstance(out, tuple) else (out,)
+                out_datas = tuple(o._data for o in outs)
+                new_aux = tuple(p.data(ctx)._data for p in aux_params)
+            finally:
+                _random.pop_trace_key()
+                for holder, data in saved:
+                    holder._data = data
+            return out_datas, new_aux
+
+        return fn
+
+    # -- call ------------------------------------------------------------
+    def __call__(self, *args):
+        # First call runs imperatively: resolves deferred-shape params and
+        # records eagerly if needed (parity: CachedOp's first-call graph
+        # build + shape inference happens on call 1).
+        if not self._warm:
+            out = self._block._imperative_forward(*args)
+            self._collect_params()
+            self._warm = True
+            return out
+
+        ctx = args[0].context if isinstance(args[0], NDArray) else None
+        nd_positions = tuple(i for i, a in enumerate(args)
+                             if isinstance(a, NDArray))
+        static_args = tuple(None if isinstance(a, NDArray) else a
+                            for a in args)
+        input_datas = [args[i]._data for i in nd_positions]
+        training = autograd.is_training()
+
+        sig = (tuple((tuple(d.shape), str(d.dtype)) for d in input_datas),
+               nd_positions, static_args, training)
+        jitted = self._jit_cache.get(sig)
+        if jitted is None:
+            fn = self._make_fn(training, static_args, nd_positions)
+            jitted = jax.jit(fn)
+            self._jit_cache[sig] = jitted
+
+        diff_leaves = tuple(p.data(ctx)._data for p in self._diff_params)
+        aux_leaves = tuple(p.data(ctx)._data for p in self._aux_params)
+        key = _random.next_key()
+
+        recording = autograd.is_recording() and (
+            self._diff_params or any(
+                autograd._on_tape(args[i]) for i in nd_positions))
+
+        if recording:
+            (out_datas, new_aux), vjp_fn = jax.vjp(
+                jitted, diff_leaves, aux_leaves, key, *input_datas)
+            outs = [NDArray(d, ctx=ctx) for d in out_datas]
+            aux_shapes = [(a.shape, a.dtype) for a in new_aux]
+
+            def node_vjp(out_cots):
+                cots = (out_cots if isinstance(out_cots, tuple)
+                        else (out_cots,))
+                aux_zeros = tuple(jnp.zeros(s, d) for s, d in aux_shapes)
+                grads = vjp_fn((tuple(cots), aux_zeros))
+                gdiff = grads[0]
+                ginputs = grads[3:]
+                return list(gdiff) + list(ginputs)
+
+            node_inputs = ([p.data(ctx) for p in self._diff_params]
+                           + [args[i] for i in nd_positions])
+            autograd.record_node(node_vjp, node_inputs, outs,
+                                 f"CachedOp({self._block.name})")
+        else:
+            out_datas, new_aux = jitted(diff_leaves, aux_leaves, key,
+                                        *input_datas)
+            outs = [NDArray(d, ctx=ctx) for d in out_datas]
+
+        # write updated aux states back into their slots (real arrays)
+        for p, new in zip(self._aux_params, new_aux):
+            p.data(ctx)._rebind(new)
+
+        return outs[0] if len(outs) == 1 else tuple(outs)
+
+
+def export_block(block, path, epoch=0):
+    """HybridBlock.export (parity: block.py export → prefix-symbol.json +
+    prefix-%04d.params).  The params file holds full parameter names; the
+    symbol json is produced by the mxtpu.symbol tracer so SymbolBlock.imports
+    can rebuild the graph."""
+    from .ndarray import serialization
+    from . import symbol as _sym
+
+    params = {}
+    for name, p in block.collect_params().items():
+        if p._data is not None:
+            prefix = "aux:" if p.grad_req == "null" else "arg:"
+            params[prefix + name] = p.data()
+    param_path = f"{path}-{epoch:04d}.params"
+    serialization.save(param_path, params)
+    sym_path = f"{path}-symbol.json"
+    try:
+        sym = _sym.trace_block(block)
+        sym.save(sym_path)
+    except Exception as e:  # symbol tracing best-effort until stage 9 lands
+        raise MXTPUError(
+            f"export: symbol tracing failed ({e}); parameters were saved to "
+            f"{param_path}") from e
+    return sym_path, param_path

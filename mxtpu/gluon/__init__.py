@@ -1,0 +1,24 @@
+"""Gluon: the imperative neural-network API (parity: python/mxnet/gluon/)."""
+
+from . import parameter
+from .parameter import Parameter, Constant, ParameterDict
+from . import block
+from .block import Block, HybridBlock, SymbolBlock
+from . import nn
+from . import loss
+from .loss import Loss
+from . import trainer
+from .trainer import Trainer
+from . import utils
+
+
+def __getattr__(name):
+    # heavier subpackages (data pulls multiprocessing, rnn pulls scan paths,
+    # model_zoo pulls every architecture) load lazily
+    import importlib
+
+    if name in ("data", "rnn", "model_zoo", "contrib"):
+        mod = importlib.import_module("." + name, __name__)
+        globals()[name] = mod
+        return mod
+    raise AttributeError(f"module 'mxtpu.gluon' has no attribute {name!r}")

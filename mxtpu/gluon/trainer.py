@@ -1,0 +1,272 @@
+"""Trainer (parity: python/mxnet/gluon/trainer.py).
+
+Binds a set of Parameters to an Optimizer and (optionally) a KVStore:
+``step(batch_size)`` = allreduce grads → apply updates, exactly the
+reference's flow (SURVEY §3.3).  On TPU the kvstore reduce is an in-process
+sum for ``local``/``device`` and an XLA psum across processes for
+``dist_tpu_sync``; ``update_on_kvstore`` keeps its observable semantics
+(optimizer runs inside the store) even though there are no server processes.
+"""
+
+from __future__ import annotations
+
+import warnings
+
+from .. import optimizer as opt
+from ..base import MXTPUError
+from ..kvstore import KVStore, create as kv_create
+from .parameter import Parameter, ParameterDict
+
+__all__ = ["Trainer"]
+
+
+class Trainer:
+    def __init__(self, params, optimizer, optimizer_params=None,
+                 kvstore="device", compression_params=None,
+                 update_on_kvstore=None):
+        param_list = []
+        if isinstance(params, (dict, ParameterDict)):
+            for key in sorted(list(params.keys())):
+                param_list.append(params[key])
+            params = param_list
+        if not isinstance(params, (list, tuple)):
+            raise ValueError(
+                "First argument must be a list or dict of Parameters, "
+                f"got {type(params)}.")
+        self._params = []
+        self._param2idx = {}
+        for i, param in enumerate(params):
+            if not isinstance(param, Parameter):
+                raise ValueError(
+                    "First argument must be a list or dict of Parameters, "
+                    f"got list of {type(param)}.")
+            self._param2idx[param.name] = i
+            self._params.append(param)
+        self._compression_params = compression_params
+        optimizer_params = optimizer_params if optimizer_params else {}
+        self._scale = float(optimizer_params.get("rescale_grad", 1.0))
+        self._contexts = self._check_contexts()
+        self._init_optimizer(optimizer, optimizer_params)
+        self._kvstore_params = {
+            "kvstore": kvstore, "update_on_kvstore": update_on_kvstore}
+        self._kv_initialized = False
+        self._kvstore = None
+        self._update_on_kvstore = None
+        self._distributed = None
+        self._params_to_init = []
+        self._reset_kvstore()
+
+    def _check_contexts(self):
+        contexts = None
+        for param in self._params:
+            ctx = param.list_ctx() if param._data or param._deferred_init \
+                else [None]
+            assert contexts is None or contexts == ctx, (
+                "All Parameters must be initialized on the same set of "
+                f"contexts, but Parameter {param.name} is initialized on "
+                f"{ctx} while previous Parameters are initialized on "
+                f"{contexts}.")
+            contexts = ctx
+        return contexts or []
+
+    def _init_optimizer(self, optimizer, optimizer_params):
+        param_dict = {i: param for i, param in enumerate(self._params)}
+        if isinstance(optimizer, opt.Optimizer):
+            assert not optimizer_params, \
+                "optimizer_params must be None if optimizer is an " \
+                "Optimizer instance"
+            self._optimizer = optimizer
+            self._optimizer.param_dict = param_dict
+        else:
+            self._optimizer = opt.create(optimizer, param_dict=param_dict,
+                                         **optimizer_params)
+        self._updaters = [opt.get_updater(self._optimizer)
+                          for _ in self._contexts]
+
+    def _reset_kvstore(self):
+        if self._kvstore and isinstance(self._kvstore, KVStore) and \
+                "dist" in self._kvstore.type:
+            raise RuntimeError(
+                "Cannot reset distributed KVStore.")
+        self._kv_initialized = False
+        self._kvstore = None
+        self._update_on_kvstore = None
+        self._params_to_init = [param for param in self._params]
+
+    def _init_kvstore(self):
+        config = self._kvstore_params
+        kvstore = config["kvstore"]
+        update_on_kvstore = config["update_on_kvstore"]
+        if isinstance(kvstore, str):
+            # parity with _create_kvstore: no kvstore for a single device
+            # unless a dist type is requested
+            if "dist" in kvstore:
+                kvstore = kv_create(kvstore)
+            elif len(self._contexts) > 1:
+                kvstore = kv_create(kvstore)
+            else:
+                kvstore = None
+        if kvstore is not None:
+            self._distributed = "dist" in kvstore.type
+            if update_on_kvstore is None:
+                update_on_kvstore = True
+            if self._compression_params:
+                kvstore.set_gradient_compression(self._compression_params)
+            if update_on_kvstore:
+                kvstore.set_optimizer(self._optimizer)
+            self._kvstore = kvstore
+            self._update_on_kvstore = update_on_kvstore
+        else:
+            self._distributed = False
+            self._kvstore = None
+            self._update_on_kvstore = False
+        self._kv_initialized = True
+
+    def _init_params(self):
+        assert self._kv_initialized, \
+            "Cannot initialize parameters in KVStore when KVStore is not " \
+            "initialized."
+        params_to_init = []
+        if self._kvstore:
+            for param in self._params_to_init:
+                if param._deferred_init:
+                    params_to_init.append(param)
+                else:
+                    idx = self._param2idx[param.name]
+                    self._kvstore.init(idx, param.data(self._contexts[0]))
+        self._params_to_init = params_to_init
+
+    @property
+    def learning_rate(self):
+        if not isinstance(self._optimizer, opt.Optimizer):
+            raise UserWarning(
+                "Optimizer has to be defined before its learning rate can "
+                "be accessed.")
+        return self._optimizer.learning_rate if hasattr(
+            self._optimizer, "learning_rate") else self._optimizer.lr
+
+    @property
+    def optimizer(self):
+        return self._optimizer
+
+    def set_learning_rate(self, lr):
+        if not isinstance(self._optimizer, opt.Optimizer):
+            raise UserWarning(
+                "Optimizer has to be defined before its learning rate is "
+                "mutated.")
+        self._optimizer.set_learning_rate(lr)
+
+    def _row_sparse_pull(self, parameter, out, row_id, full_idx=False):
+        # sparse descoped v1: dense pull
+        if self._kvstore:
+            self._kvstore.pull(self._param2idx[parameter.name], out=out)
+
+    def step(self, batch_size, ignore_stale_grad=False):
+        """allreduce grads, then apply optimizer updates scaled by
+        1/batch_size (parity: Trainer.step)."""
+        rescale_grad = self._scale / batch_size
+        self._check_and_rescale_grad(rescale_grad)
+        if not self._kv_initialized:
+            self._init_kvstore()
+        if self._params_to_init:
+            self._init_params()
+        self._allreduce_grads()
+        self._update(ignore_stale_grad)
+
+    def _check_and_rescale_grad(self, scale):
+        if self._update_on_kvstore and self._distributed and \
+                self._kv_initialized:
+            if self._optimizer.rescale_grad != scale:
+                raise UserWarning(
+                    "Possible change in the `batch_size` from previous "
+                    "`step` detected. Optimizer gradient normalizing "
+                    "factor will not change.")
+        self._optimizer.rescale_grad = scale
+
+    def allreduce_grads(self):
+        """Reduce gradients across devices/workers without updating
+        (parity: allreduce_grads; for use with update())."""
+        if not self._kv_initialized:
+            self._init_kvstore()
+        if self._params_to_init:
+            self._init_params()
+        assert not (self._kvstore and self._update_on_kvstore), \
+            "allreduce_grads() when parameters are updated on kvstore " \
+            "is not supported. Try setting `update_on_kvstore` to False " \
+            "when creating trainer."
+        self._allreduce_grads()
+
+    def _allreduce_grads(self):
+        if not self._kvstore:
+            return
+        for i, param in enumerate(self._params):
+            if param.grad_req != "null":
+                idx = self._param2idx[param.name]
+                if not self._update_on_kvstore:
+                    self._kvstore.pushpull(idx, param.list_grad(),
+                                           out=param.list_grad(),
+                                           priority=-i)
+                else:
+                    self._kvstore.push(idx, param.list_grad(), priority=-i)
+
+    def update(self, batch_size, ignore_stale_grad=False):
+        """Apply updates only (parity: update; requires allreduce_grads
+        first in kvstore mode)."""
+        if not self._kv_initialized:
+            self._init_kvstore()
+        if self._params_to_init:
+            self._init_params()
+        assert not (self._kvstore and self._update_on_kvstore), \
+            "update() when parameters are updated on kvstore is not " \
+            "supported. Try setting `update_on_kvstore` to False when " \
+            "creating trainer."
+        self._check_and_rescale_grad(self._scale / batch_size)
+        self._update(ignore_stale_grad)
+
+    def _update(self, ignore_stale_grad=False):
+        for i, param in enumerate(self._params):
+            if param.grad_req == "null":
+                continue
+            if self._kvstore and self._update_on_kvstore:
+                # weights live in the store; pull them back
+                idx = self._param2idx[param.name]
+                self._kvstore.pull(idx, out=param.list_data(), priority=-i)
+                continue
+            for upd, arr, grad in zip(self._updaters, param.list_data(),
+                                      param.list_grad()):
+                upd(i, grad, arr)
+
+    def save_states(self, fname):
+        """Save optimizer/updater states (parity: save_states)."""
+        assert self._optimizer is not None
+        if not self._kv_initialized:
+            self._init_kvstore()
+        if self._params_to_init:
+            self._init_params()
+        if self._update_on_kvstore:
+            assert not self._params_to_init, \
+                "Cannot save trainer states when some parameters are not " \
+                "yet initialized in kvstore."
+            self._kvstore.save_optimizer_states(fname, dump_optimizer=True)
+        else:
+            with open(fname, "wb") as fout:
+                fout.write(self._updaters[0].get_states(dump_optimizer=True))
+
+    def load_states(self, fname):
+        """Load optimizer/updater states (parity: load_states)."""
+        if not self._kv_initialized:
+            self._init_kvstore()
+        if self._params_to_init:
+            self._init_params()
+        if self._update_on_kvstore:
+            self._kvstore.load_optimizer_states(fname)
+            self._optimizer = self._kvstore._updater.optimizer
+        else:
+            with open(fname, "rb") as f:
+                states = f.read()
+            for updater in self._updaters:
+                updater.set_states(states)
+                updater.optimizer = self._updaters[0].optimizer
+            self._optimizer = self._updaters[0].optimizer
+        param_dict = {i: param for i, param in enumerate(self._params)}
+        self._optimizer.param_dict = param_dict

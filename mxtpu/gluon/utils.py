@@ -1,0 +1,122 @@
+"""Gluon utilities (parity: python/mxnet/gluon/utils.py): split_and_load,
+clip_global_norm, check_sha1, download."""
+
+from __future__ import annotations
+
+import hashlib
+import os
+from typing import List
+
+import jax.numpy as jnp
+import numpy as onp
+
+from ..base import MXTPUError
+from ..context import Context
+from ..ndarray import NDArray, array as nd_array
+
+__all__ = ["split_data", "split_and_load", "clip_global_norm", "check_sha1",
+           "download"]
+
+
+def split_data(data, num_slice, batch_axis=0, even_split=True):
+    """Split along batch_axis into num_slice slices (parity: split_data)."""
+    size = data.shape[batch_axis]
+    if even_split and size % num_slice != 0:
+        raise ValueError(
+            f"data with shape {data.shape} cannot be evenly split into "
+            f"{num_slice} slices along axis {batch_axis}. Use a batch size "
+            f"that's multiple of {num_slice} or set even_split=False to "
+            "allow uneven partitioning of data.")
+    if num_slice == 1:
+        return [data]
+    step = size // num_slice
+    if even_split:
+        slices = [data.slice_axis(batch_axis, i * step, (i + 1) * step)
+                  for i in range(num_slice)]
+    else:
+        slices = [data.slice_axis(batch_axis, i * step,
+                                  (i + 1) * step if i < num_slice - 1
+                                  else size)
+                  for i in range(num_slice)]
+    return slices
+
+
+def split_and_load(data, ctx_list, batch_axis=0, even_split=True):
+    """Split data and load each slice onto one context
+    (parity: split_and_load — the Gluon multi-device data-parallel entry)."""
+    if not isinstance(data, NDArray):
+        data = nd_array(data)
+    if len(ctx_list) == 1:
+        return [data.as_in_context(ctx_list[0])]
+    slices = split_data(data, len(ctx_list), batch_axis, even_split)
+    return [s.as_in_context(ctx) for s, ctx in zip(slices, ctx_list)]
+
+
+def clip_global_norm(arrays, max_norm, check_isfinite=True):
+    """Rescale arrays so that the global 2-norm ≤ max_norm
+    (parity: clip_global_norm; in-place like the reference)."""
+    def _norm(a):
+        return jnp.sum(jnp.square(a.data.astype(jnp.float32)))
+
+    assert len(arrays) > 0
+    total = jnp.sqrt(sum(_norm(a) for a in arrays))
+    total_norm = float(total)
+    if check_isfinite and not onp.isfinite(total_norm):
+        import warnings
+        warnings.warn(
+            UserWarning("nan or inf is detected. Clipping results will be "
+                        "undefined."), stacklevel=2)
+    scale = max_norm / (total_norm + 1e-8)
+    if scale < 1.0:
+        for arr in arrays:
+            arr._rebind(arr.data * scale)
+    return total_norm
+
+
+def check_sha1(filename, sha1_hash):
+    """Parity: check_sha1."""
+    sha1 = hashlib.sha1()
+    with open(filename, "rb") as f:
+        while True:
+            data = f.read(1048576)
+            if not data:
+                break
+            sha1.update(data)
+    return sha1.hexdigest() == sha1_hash
+
+
+def download(url, path=None, overwrite=False, sha1_hash=None,
+             retries=5, verify_ssl=True):
+    """Parity: gluon.utils.download.  This build runs with zero egress, so
+    the function only succeeds for file:// URLs or already-downloaded
+    targets; otherwise it raises with a clear message."""
+    if path is None:
+        fname = url.split("/")[-1]
+    elif os.path.isdir(path):
+        fname = os.path.join(path, url.split("/")[-1])
+    else:
+        fname = path
+    if os.path.exists(fname) and not overwrite and (
+            sha1_hash is None or check_sha1(fname, sha1_hash)):
+        return fname
+    if url.startswith("file://"):
+        import shutil
+        shutil.copyfile(url[len("file://"):], fname)
+        return fname
+    raise MXTPUError(
+        f"download({url!r}): network access is unavailable in this "
+        "environment; place the file at {fname!r} manually")
+
+
+def _brief_print_list(lst, limit=7):
+    lst = list(lst)
+    if len(lst) > limit:
+        return _brief_print_list(lst[:limit // 2], limit) + ", ..., " + \
+            _brief_print_list(lst[-limit // 2:], limit)
+    return ", ".join(f"'{str(i)}'" for i in lst)
+
+
+def shape_is_known(shape):
+    if shape is None:
+        return False
+    return all(s > 0 for s in shape)

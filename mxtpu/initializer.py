@@ -1,0 +1,341 @@
+"""Weight initializers (parity: python/mxnet/initializer.py).
+
+The reference dispatches by parameter-name pattern through ``InitDesc`` and a
+string-registry; initializers mutate NDArrays in place.  TPU design: each
+initializer is a pure function of (jax PRNG key, shape, dtype) so the whole
+init can run inside jit / under a mesh, but the imperative entry point
+``init(desc, arr)`` mutates the NDArray slot exactly like the reference.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as onp
+
+from .base import MXTPUError
+from . import random as _random
+
+__all__ = [
+    "InitDesc", "Initializer", "register", "create",
+    "Zero", "One", "Constant", "Uniform", "Normal", "Orthogonal",
+    "Xavier", "MSRAPrelu", "Bilinear", "LSTMBias", "Mixed", "Load",
+]
+
+_INIT_REGISTRY = {}
+
+
+def register(klass):
+    """Parity: @mx.init.register — registers under lowercased class name."""
+    _INIT_REGISTRY[klass.__name__.lower()] = klass
+    return klass
+
+
+def create(name, **kwargs):
+    if isinstance(name, Initializer):
+        return name
+    if callable(name):
+        return name
+    try:
+        return _INIT_REGISTRY[name.lower()](**kwargs)
+    except KeyError:
+        raise MXTPUError(f"unknown initializer {name!r}") from None
+
+
+class InitDesc(str):
+    """Parameter-name string carrying init attrs (parity: InitDesc)."""
+
+    def __new__(cls, name, attrs=None, global_init=None):
+        ret = super().__new__(cls, name)
+        ret.attrs = attrs or {}
+        ret.global_init = global_init
+        return ret
+
+
+class Initializer:
+    """Base initializer.  Callable on (InitDesc, NDArray) like the reference;
+    also exposes ``generate(key, shape, dtype)`` — the pure functional form.
+    """
+
+    def __init__(self, **kwargs):
+        self._kwargs = kwargs
+
+    # -- functional core (override _init_weight_fn or generate) ----------
+    def generate(self, key, shape, dtype=jnp.float32):
+        return self._init_weight_fn(key, shape, dtype)
+
+    def _init_weight_fn(self, key, shape, dtype):
+        raise NotImplementedError
+
+    # -- imperative / name-dispatch entry (parity: __call__) -------------
+    def __call__(self, desc, arr):
+        if not isinstance(desc, str):
+            raise TypeError("first argument must be a name string/InitDesc")
+        init = getattr(desc, "attrs", {}).get("__init__", "")
+        if init:
+            create_from = json.loads(init) if init.startswith("[") else init
+            if isinstance(create_from, list):
+                create(create_from[0].lower(), **create_from[1])._init(
+                    desc, arr)
+                return
+            create(create_from)._init(desc, arr)
+            return
+        self._init(desc, arr)
+
+    def _init(self, desc, arr):
+        name = str(desc)
+        if name.endswith("bias"):
+            self._init_bias(desc, arr)
+        elif name.endswith("gamma"):
+            self._init_one(desc, arr)
+        elif name.endswith("beta"):
+            self._init_zero(desc, arr)
+        elif name.endswith("running_mean") or name.endswith("moving_mean"):
+            self._init_zero(desc, arr)
+        elif name.endswith("running_var") or name.endswith("moving_var"):
+            self._init_one(desc, arr)
+        else:
+            self._init_weight(desc, arr)
+
+    def _set(self, arr, value):
+        arr._rebind(jnp.asarray(value, dtype=arr.data.dtype))
+
+    def _init_zero(self, desc, arr):
+        self._set(arr, jnp.zeros(arr.shape))
+
+    def _init_one(self, desc, arr):
+        self._set(arr, jnp.ones(arr.shape))
+
+    def _init_bias(self, desc, arr):
+        self._set(arr, jnp.zeros(arr.shape))
+
+    def _init_weight(self, desc, arr):
+        key = _random.next_key()
+        self._set(arr, self.generate(key, arr.shape, arr.data.dtype))
+
+    def dumps(self):
+        """Parity: serialize as [name, kwargs] JSON."""
+        return json.dumps([type(self).__name__.lower(), self._kwargs])
+
+    def __repr__(self):
+        return f"{type(self).__name__}({self._kwargs})"
+
+
+@register
+class Zero(Initializer):
+    def _init_weight_fn(self, key, shape, dtype):
+        return jnp.zeros(shape, dtype)
+
+
+# reference registers Zero under alias "zeros" and One under "ones"
+_INIT_REGISTRY["zeros"] = Zero
+
+
+@register
+class One(Initializer):
+    def _init_weight_fn(self, key, shape, dtype):
+        return jnp.ones(shape, dtype)
+
+
+_INIT_REGISTRY["ones"] = One
+
+
+@register
+class Constant(Initializer):
+    def __init__(self, value=0.0):
+        super().__init__(value=value)
+        self.value = value
+
+    def _init_weight_fn(self, key, shape, dtype):
+        return jnp.full(shape, self.value, dtype)
+
+
+@register
+class Uniform(Initializer):
+    """U(-scale, scale) (parity: mx.init.Uniform, default scale 0.07)."""
+
+    def __init__(self, scale=0.07):
+        super().__init__(scale=scale)
+        self.scale = scale
+
+    def _init_weight_fn(self, key, shape, dtype):
+        return jax.random.uniform(
+            key, shape, jnp.float32, -self.scale, self.scale).astype(dtype)
+
+
+@register
+class Normal(Initializer):
+    """N(0, sigma^2) (parity: mx.init.Normal, default sigma 0.01)."""
+
+    def __init__(self, sigma=0.01):
+        super().__init__(sigma=sigma)
+        self.sigma = sigma
+
+    def _init_weight_fn(self, key, shape, dtype):
+        return (jax.random.normal(key, shape, jnp.float32)
+                * self.sigma).astype(dtype)
+
+
+@register
+class Orthogonal(Initializer):
+    """Orthogonal matrix init (parity: mx.init.Orthogonal; Saxe et al.)."""
+
+    def __init__(self, scale=1.414, rand_type="uniform"):
+        super().__init__(scale=scale, rand_type=rand_type)
+        self.scale = scale
+        self.rand_type = rand_type
+
+    def _init_weight_fn(self, key, shape, dtype):
+        nout = shape[0]
+        nin = int(onp.prod(shape[1:])) if len(shape) > 1 else 1
+        if self.rand_type == "uniform":
+            tmp = jax.random.uniform(key, (nout, nin), jnp.float32, -1., 1.)
+        else:
+            tmp = jax.random.normal(key, (nout, nin), jnp.float32)
+        u, _, v = jnp.linalg.svd(tmp, full_matrices=False)
+        q = u if u.shape == (nout, nin) else v
+        return (self.scale * q).reshape(shape).astype(dtype)
+
+
+@register
+class Xavier(Initializer):
+    """Glorot init (parity: mx.init.Xavier).
+
+    factor_type in {avg, in, out}; rnd_type in {uniform, gaussian}.
+    fan computed as in the reference: fan_in = prod(shape[1:]),
+    fan_out = shape[0] * prod(shape[2:]) (conv receptive field folded in).
+    """
+
+    def __init__(self, rnd_type="uniform", factor_type="avg", magnitude=3):
+        super().__init__(rnd_type=rnd_type, factor_type=factor_type,
+                         magnitude=magnitude)
+        self.rnd_type = rnd_type
+        self.factor_type = factor_type
+        self.magnitude = float(magnitude)
+
+    def _factor(self, shape):
+        if len(shape) < 2:
+            raise MXTPUError(
+                f"Xavier requires at least 2D weight, got shape {shape}")
+        hw_scale = float(onp.prod(shape[2:])) if len(shape) > 2 else 1.0
+        fan_in = shape[1] * hw_scale
+        fan_out = shape[0] * hw_scale
+        if self.factor_type == "avg":
+            return (fan_in + fan_out) / 2.0
+        if self.factor_type == "in":
+            return fan_in
+        if self.factor_type == "out":
+            return fan_out
+        raise MXTPUError(f"invalid factor_type {self.factor_type!r}")
+
+    def _init_weight_fn(self, key, shape, dtype):
+        scale = math.sqrt(self.magnitude / self._factor(shape))
+        if self.rnd_type == "uniform":
+            w = jax.random.uniform(key, shape, jnp.float32, -scale, scale)
+        elif self.rnd_type == "gaussian":
+            w = jax.random.normal(key, shape, jnp.float32) * scale
+        else:
+            raise MXTPUError(f"invalid rnd_type {self.rnd_type!r}")
+        return w.astype(dtype)
+
+
+@register
+class MSRAPrelu(Xavier):
+    """He init for PReLU nets (parity: mx.init.MSRAPrelu)."""
+
+    def __init__(self, factor_type="avg", slope=0.25):
+        magnitude = 2.0 / (1 + slope ** 2)
+        Xavier.__init__(self, "gaussian", factor_type, magnitude)
+        self._kwargs = {"factor_type": factor_type, "slope": slope}
+
+
+@register
+class Bilinear(Initializer):
+    """Bilinear upsampling kernel for Deconvolution (parity: Bilinear)."""
+
+    def _init_weight_fn(self, key, shape, dtype):
+        weight = onp.zeros(int(onp.prod(shape)), dtype=onp.float32)
+        f = onp.ceil(shape[3] / 2.0)
+        c = (2 * f - 1 - f % 2) / (2.0 * f)
+        for i in range(int(onp.prod(shape))):
+            x = i % shape[3]
+            y = (i // shape[3]) % shape[2]
+            weight[i] = (1 - abs(x / f - c)) * (1 - abs(y / f - c))
+        return jnp.asarray(weight.reshape(shape), dtype)
+
+
+@register
+class LSTMBias(Initializer):
+    """Forget-gate bias = forget_bias, rest 0 (parity: LSTMBias).
+
+    Assumes the i,f,c,o gate layout of the fused LSTM (bias len = 4*H).
+    """
+
+    def __init__(self, forget_bias=1.0):
+        super().__init__(forget_bias=forget_bias)
+        self.forget_bias = forget_bias
+
+    def _init_weight_fn(self, key, shape, dtype):
+        b = onp.zeros(shape, dtype=onp.float32)
+        num_hidden = shape[0] // 4
+        b[num_hidden:2 * num_hidden] = self.forget_bias
+        return jnp.asarray(b, dtype)
+
+    def _init_bias(self, desc, arr):
+        self._set(arr, self._init_weight_fn(None, arr.shape, arr.data.dtype))
+
+
+class Mixed:
+    """Name-pattern dispatch over several initializers (parity: Mixed)."""
+
+    def __init__(self, patterns, initializers):
+        import re
+
+        if len(patterns) != len(initializers):
+            raise MXTPUError("patterns and initializers length mismatch")
+        self.map = [(re.compile(p), create(i) if isinstance(i, str) else i)
+                    for p, i in zip(patterns, initializers)]
+
+    def __call__(self, desc, arr):
+        for prog, init in self.map:
+            if prog.match(str(desc)):
+                init(desc, arr)
+                return
+        raise MXTPUError(
+            f"parameter {desc} did not match any Mixed pattern; add a "
+            "'.*' catch-all")
+
+
+class Load:
+    """Init from a dict of arrays, falling back to default_init
+    (parity: mx.init.Load used for checkpoint warm-start)."""
+
+    def __init__(self, param, default_init=None, verbose=False):
+        self.param = {
+            (k[4:] if k.startswith("arg:") or k.startswith("aux:") else k): v
+            for k, v in param.items()}
+        self.default_init = default_init
+        self.verbose = verbose
+
+    def __call__(self, desc, arr):
+        name = str(desc)
+        if name in self.param:
+            src = self.param[name]
+            src_shape = tuple(src.shape)
+            if src_shape != tuple(arr.shape):
+                raise MXTPUError(
+                    f"shape mismatch loading {name}: {src_shape} vs "
+                    f"{tuple(arr.shape)}")
+            arr._rebind(jnp.asarray(
+                src.data if hasattr(src, "data") else src,
+                dtype=arr.data.dtype))
+        else:
+            if self.default_init is None:
+                raise MXTPUError(
+                    f"cannot init {name}: not found in loaded params and no "
+                    "default_init given")
+            self.default_init(desc, arr)

@@ -1,0 +1,242 @@
+"""KVStore: key-value parameter aggregation (parity: python/mxnet/kvstore.py
++ src/kvstore/kvstore.cc factory, kvstore_local.h, comm.h, kvstore_dist.h).
+
+Reference architecture: push gradients (possibly one per GPU) → reduce
+(CommCPU/CommDevice/ncclAllReduce, or ps-lite ZPush to servers) → optionally
+run the optimizer where the reduce happened (update_on_kvstore) → pull.
+
+TPU architecture: a single process drives all local TPU chips and XLA
+collectives ride ICI, so the reduce is a `jax.tree` sum (device-local arrays
+arrive through PJRT async dispatch and XLA fuses the adds), and the
+distributed type ``dist_tpu_sync`` performs a cross-process psum through
+``mxtpu.parallel.collectives.all_reduce`` (jax.distributed + shard_map).
+There are no server processes: `update_on_kvstore` runs the Updater in the
+worker after the global reduce — observably identical to the reference's
+server-side optimizer from the Trainer's perspective (SURVEY §7 hard-part 4).
+
+ps-lite's async mode (`dist_async`) has no TPU-native analogue; it is aliased
+to sync with a warning (documented divergence).
+"""
+
+from __future__ import annotations
+
+import pickle
+import warnings
+from typing import Any, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+
+from .base import MXTPUError
+from .ndarray import NDArray
+
+__all__ = ["KVStore", "create"]
+
+
+def _key2str(key):
+    return str(key)
+
+
+class KVStore:
+    """Single-process key-value store (types: local, device, nccl).
+
+    Holds the canonical value per key; push aggregates a list of NDArrays
+    (one per device) by summation; pull writes the canonical value into the
+    provided output arrays.
+    """
+
+    def __init__(self, kv_type="local"):
+        self.type = kv_type
+        self._store: Dict[str, Any] = {}
+        self._updater = None
+        self._optimizer = None
+        self._compression_params = None
+
+    # -- identity --------------------------------------------------------
+    @property
+    def rank(self) -> int:
+        return 0
+
+    @property
+    def num_workers(self) -> int:
+        return 1
+
+    # -- data path -------------------------------------------------------
+    def init(self, key, value):
+        keys, values = _pairs(key, value)
+        for k, v in zip(keys, values):
+            k = _key2str(k)
+            if k in self._store:
+                raise MXTPUError(f"key {k} already initialized")
+            self._store[k] = v.data + 0  # copy: store owns its buffer
+
+    def _reduce(self, values: List[NDArray]):
+        """Sum a per-device gradient list (parity: CommDevice::Reduce —
+        gathers onto the first value's device, where XLA fuses the adds and
+        ICI moves the bytes)."""
+        acc = values[0].data
+        try:
+            target = list(acc.devices())[0]
+        except Exception:
+            target = None
+        for v in values[1:]:
+            d = v.data
+            if target is not None:
+                d = jax.device_put(d, target)
+            acc = acc + d
+        return self._cross_worker_reduce(acc)
+
+    def _cross_worker_reduce(self, arr):
+        """Hook for dist types; identity for single-worker stores."""
+        return arr
+
+    def push(self, key, value, priority=0):
+        keys, values = _pairs(key, value, allow_list_of_lists=True)
+        for k, vlist in zip(keys, values):
+            k = _key2str(k)
+            if k not in self._store:
+                raise MXTPUError(f"key {k} has not been initialized")
+            if not isinstance(vlist, (list, tuple)):
+                vlist = [vlist]
+            reduced = self._reduce(list(vlist))
+            if self._updater is not None:
+                # update_on_kvstore: stored value is the weight; run updater
+                # (parity: KVStoreLocal::PushImpl with updater_ set)
+                w = NDArray(self._store[k])
+                self._updater(_updater_key(k), NDArray(reduced), w)
+                self._store[k] = w.data
+            else:
+                # no updater: reduce replaces the stored value (parity:
+                # KVStoreLocal CopyFromTo(merged, &local))
+                self._store[k] = reduced
+
+    def pull(self, key, out=None, priority=0, ignore_sparse=True):
+        if out is None:
+            raise MXTPUError("pull requires out=")
+        keys, outs = _pairs(key, out, allow_list_of_lists=True)
+        for k, olist in zip(keys, outs):
+            k = _key2str(k)
+            if k not in self._store:
+                raise MXTPUError(f"key {k} has not been initialized")
+            if not isinstance(olist, (list, tuple)):
+                olist = [olist]
+            for o in olist:
+                val = self._store[k].astype(o.data.dtype)
+                try:
+                    dev = list(o.data.devices())[0]
+                    val = jax.device_put(val, dev)
+                except Exception:
+                    pass
+                o._rebind(val)
+
+    def pushpull(self, key, value, out=None, priority=0):
+        """Fused push+pull (parity: MXKVStorePushPullEx)."""
+        self.push(key, value, priority)
+        if out is not None:
+            self.pull(key, out=out, priority=priority)
+
+    def row_sparse_pull(self, key, out=None, priority=0, row_ids=None):
+        # sparse storage descoped v1 (SURVEY §7 hard-part 6): dense fallback
+        warnings.warn("row_sparse_pull: sparse descoped; dense pull instead")
+        self.pull(key, out=out, priority=priority)
+
+    def broadcast(self, key, value, out, priority=0):
+        self.init(key, value)
+        if out is not None:
+            self.pull(key, out=out, priority=priority)
+
+    # -- optimizer placement ---------------------------------------------
+    def set_optimizer(self, optimizer):
+        """Run this optimizer inside the store on push (parity:
+        update_on_kvstore=True; the reference pickles the optimizer to the
+        ps-lite servers — here the store lives in-process)."""
+        from . import optimizer as opt
+
+        self._optimizer = optimizer
+        self._updater = opt.get_updater(optimizer)
+
+    def _set_updater(self, updater):
+        self._updater = updater
+
+    def set_gradient_compression(self, compression_params):
+        # 2-bit compression (gradient_compression.cc) — API kept, descoped:
+        # XLA all-reduce over ICI is not bandwidth-bound at v1 scales.
+        self._compression_params = compression_params
+        warnings.warn("gradient compression is accepted but inactive in "
+                      "mxtpu v1 (documented descope)")
+
+    def save_optimizer_states(self, fname, dump_optimizer=False):
+        if self._updater is None:
+            raise MXTPUError("there is no optimizer in the kvstore")
+        with open(fname, "wb") as f:
+            f.write(self._updater.get_states(dump_optimizer))
+
+    def load_optimizer_states(self, fname):
+        if self._updater is None:
+            raise MXTPUError("there is no optimizer in the kvstore")
+        with open(fname, "rb") as f:
+            self._updater.set_states(f.read())
+
+
+class DistTPUSyncKVStore(KVStore):
+    """Synchronous data-parallel store over jax.distributed
+    (parity target: KVStoreDist 'dist_sync'/'dist_device_sync'; transport is
+    XLA psum over ICI/DCN instead of ps-lite ZMQ — SURVEY §2.3).
+    """
+
+    def __init__(self, kv_type="dist_tpu_sync"):
+        super().__init__(kv_type)
+        from .parallel import collectives
+        self._coll = collectives
+
+    @property
+    def rank(self) -> int:
+        return jax.process_index()
+
+    @property
+    def num_workers(self) -> int:
+        return jax.process_count()
+
+    def _cross_worker_reduce(self, arr):
+        if jax.process_count() == 1:
+            return arr
+        return self._coll.all_reduce_across_processes(arr)
+
+
+def _updater_key(k):
+    try:
+        return int(k)
+    except ValueError:
+        return k
+
+
+def _pairs(key, value, allow_list_of_lists=False):
+    single = isinstance(key, (str, int))
+    if single:
+        return [key], [value]
+    if not isinstance(value, (list, tuple)) or len(key) != len(value):
+        # value may be a flat per-device list for a single key list entry
+        raise MXTPUError("key/value length mismatch")
+    return list(key), list(value)
+
+
+def create(name="local"):
+    """Factory (parity: kvstore.cc KVStore::Create).
+
+    local/device/nccl → in-process sum (XLA fuses; ICI moves the bytes).
+    dist_sync/dist_device_sync/dist_tpu_sync → cross-process psum store.
+    dist_async → aliased to sync with a warning (no TPU-native analogue).
+    """
+    if not isinstance(name, str):
+        raise TypeError("name must be a string")
+    name = name.lower()
+    if name in ("local", "local_allreduce_cpu", "local_allreduce_device",
+                "device", "nccl"):
+        return KVStore(name)
+    if name in ("dist_sync", "dist_device_sync", "dist_tpu_sync", "dist"):
+        return DistTPUSyncKVStore(name)
+    if name == "dist_async":
+        warnings.warn("dist_async has no TPU-native analogue; using "
+                      "synchronous dist_tpu_sync (documented divergence)")
+        return DistTPUSyncKVStore("dist_async")
+    raise MXTPUError(f"unknown KVStore type {name!r}")

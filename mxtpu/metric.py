@@ -1,0 +1,675 @@
+"""Evaluation metrics (parity: python/mxnet/metric.py).
+
+Metrics are host-side accumulators updated on (labels, preds) NDArray lists,
+matching the reference's EvalMetric protocol (update / update_dict / get /
+get_name_value / reset).  Array math runs through numpy after a single device
+fetch per batch — the reference likewise computes metrics on CPU.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as onp
+
+from .base import MXTPUError
+
+__all__ = [
+    "EvalMetric", "CompositeEvalMetric", "register", "create", "np",
+    "Accuracy", "TopKAccuracy", "F1", "MCC", "Perplexity",
+    "MAE", "MSE", "RMSE", "CrossEntropy", "NegativeLogLikelihood",
+    "PearsonCorrelation", "Loss", "Torch", "Caffe", "CustomMetric",
+]
+
+_METRIC_REGISTRY: Dict[str, type] = {}
+
+
+def register(klass):
+    _METRIC_REGISTRY[klass.__name__.lower()] = klass
+    return klass
+
+
+def _as_numpy(x):
+    if hasattr(x, "asnumpy"):
+        return x.asnumpy()
+    return onp.asarray(x)
+
+
+def create(metric, *args, **kwargs):
+    """Parity: mx.metric.create — accepts name, callable, instance, or list."""
+    if callable(metric) and not isinstance(metric, type):
+        return CustomMetric(metric, *args, **kwargs)
+    if isinstance(metric, CompositeEvalMetric):
+        return metric
+    if isinstance(metric, EvalMetric):
+        return metric
+    if isinstance(metric, list):
+        composite = CompositeEvalMetric()
+        for child in metric:
+            composite.add(create(child, *args, **kwargs))
+        return composite
+    if isinstance(metric, str):
+        try:
+            return _METRIC_REGISTRY[metric.lower()](*args, **kwargs)
+        except KeyError:
+            raise MXTPUError(f"unknown metric {metric!r}") from None
+    raise MXTPUError(f"cannot create metric from {metric!r}")
+
+
+class EvalMetric:
+    """Base metric (parity: mx.metric.EvalMetric)."""
+
+    def __init__(self, name, output_names=None, label_names=None, **kwargs):
+        self.name = str(name)
+        self.output_names = output_names
+        self.label_names = label_names
+        self._has_global_stats = kwargs.pop("has_global_stats", False)
+        self._kwargs = kwargs
+        self.reset()
+
+    def __str__(self):
+        return f"EvalMetric: {dict(self.get_name_value())}"
+
+    def get_config(self):
+        config = self._kwargs.copy()
+        config.update({
+            "metric": self.__class__.__name__,
+            "name": self.name,
+            "output_names": self.output_names,
+            "label_names": self.label_names})
+        return config
+
+    def update_dict(self, label, pred):
+        if self.output_names is not None:
+            pred = [pred[name] for name in self.output_names if name in pred]
+        else:
+            pred = list(pred.values())
+        if self.label_names is not None:
+            label = [label[name] for name in self.label_names if name in label]
+        else:
+            label = list(label.values())
+        self.update(label, pred)
+
+    def update(self, labels, preds):
+        raise NotImplementedError
+
+    def reset(self):
+        self.num_inst = 0
+        self.sum_metric = 0.0
+        self.global_num_inst = 0
+        self.global_sum_metric = 0.0
+
+    def reset_local(self):
+        self.num_inst = 0
+        self.sum_metric = 0.0
+
+    def get(self):
+        if self.num_inst == 0:
+            return (self.name, float("nan"))
+        return (self.name, self.sum_metric / self.num_inst)
+
+    def get_global(self):
+        if self._has_global_stats:
+            if self.global_num_inst == 0:
+                return (self.name, float("nan"))
+            return (self.name, self.global_sum_metric / self.global_num_inst)
+        return self.get()
+
+    def get_name_value(self):
+        name, value = self.get()
+        if not isinstance(name, list):
+            name = [name]
+        if not isinstance(value, list):
+            value = [value]
+        return list(zip(name, value))
+
+    def get_global_name_value(self):
+        if self._has_global_stats:
+            name, value = self.get_global()
+            if not isinstance(name, list):
+                name = [name]
+            if not isinstance(value, list):
+                value = [value]
+            return list(zip(name, value))
+        return self.get_name_value()
+
+    def _update(self, metric, inst):
+        self.sum_metric += metric
+        self.num_inst += inst
+        self.global_sum_metric += metric
+        self.global_num_inst += inst
+
+
+def check_label_shapes(labels, preds, shape=False):
+    """Parity: mx.metric.check_label_shapes."""
+    if not shape:
+        label_shape, pred_shape = len(labels), len(preds)
+    else:
+        label_shape, pred_shape = labels.shape, preds.shape
+    if label_shape != pred_shape:
+        raise ValueError(
+            f"Shape of labels {label_shape} does not match shape of "
+            f"predictions {pred_shape}")
+
+
+class CompositeEvalMetric(EvalMetric):
+    """Manage multiple metrics as one (parity: CompositeEvalMetric)."""
+
+    def __init__(self, metrics=None, name="composite",
+                 output_names=None, label_names=None):
+        super().__init__(name, output_names, label_names)
+        if metrics is None:
+            metrics = []
+        self.metrics = [create(i) for i in metrics]
+
+    def add(self, metric):
+        self.metrics.append(create(metric))
+
+    def get_metric(self, index):
+        try:
+            return self.metrics[index]
+        except IndexError:
+            return ValueError(f"Metric index {index} is out of range 0 and "
+                              f"{len(self.metrics)}")
+
+    def update_dict(self, labels, preds):
+        for metric in self.metrics:
+            metric.update_dict(labels, preds)
+
+    def update(self, labels, preds):
+        for metric in self.metrics:
+            metric.update(labels, preds)
+
+    def reset(self):
+        try:
+            for metric in self.metrics:
+                metric.reset()
+        except AttributeError:
+            pass
+
+    def reset_local(self):
+        try:
+            for metric in self.metrics:
+                metric.reset_local()
+        except AttributeError:
+            pass
+
+    def get(self):
+        names = []
+        values = []
+        for metric in self.metrics:
+            name, value = metric.get()
+            if isinstance(name, str):
+                name = [name]
+            if isinstance(value, (float, int)):
+                value = [value]
+            names.extend(name)
+            values.extend(value)
+        return (names, values)
+
+    def get_global(self):
+        names = []
+        values = []
+        for metric in self.metrics:
+            name, value = metric.get_global()
+            if isinstance(name, str):
+                name = [name]
+            if isinstance(value, (float, int)):
+                value = [value]
+            names.extend(name)
+            values.extend(value)
+        return (names, values)
+
+
+@register
+class Accuracy(EvalMetric):
+    """Classification accuracy (parity: mx.metric.Accuracy)."""
+
+    def __init__(self, axis=1, name="accuracy",
+                 output_names=None, label_names=None):
+        super().__init__(name, output_names, label_names,
+                         axis=axis, has_global_stats=True)
+        self.axis = axis
+
+    def update(self, labels, preds):
+        labels, preds = _tolist(labels), _tolist(preds)
+        check_label_shapes(labels, preds)
+        for label, pred_label in zip(labels, preds):
+            pred_label = _as_numpy(pred_label)
+            label = _as_numpy(label)
+            if pred_label.ndim > label.ndim:
+                pred_label = onp.argmax(pred_label, axis=self.axis)
+            pred_label = pred_label.astype(onp.int32).ravel()
+            label = label.astype(onp.int32).ravel()
+            check_label_shapes(label, pred_label, shape=True)
+            correct = (pred_label == label).sum()
+            self._update(float(correct), len(pred_label))
+
+
+@register
+class TopKAccuracy(EvalMetric):
+    """Top-k accuracy (parity: TopKAccuracy)."""
+
+    def __init__(self, top_k=1, name="top_k_accuracy",
+                 output_names=None, label_names=None):
+        super().__init__(name, output_names, label_names,
+                         top_k=top_k, has_global_stats=True)
+        self.top_k = top_k
+        assert self.top_k > 1, "Please use Accuracy if top_k is no more than 1"
+        self.name += f"_{self.top_k}"
+
+    def update(self, labels, preds):
+        labels, preds = _tolist(labels), _tolist(preds)
+        check_label_shapes(labels, preds)
+        for label, pred_label in zip(labels, preds):
+            pred_label = _as_numpy(pred_label).astype(onp.float32)
+            label = _as_numpy(label).astype(onp.int32)
+            assert pred_label.ndim == 2, "Predictions should be 2 dims"
+            pred_label = onp.argsort(pred_label, axis=1)
+            num_samples = pred_label.shape[0]
+            num_dims = pred_label.shape[1]
+            if num_dims == 1:
+                self._update(float((pred_label.ravel() == label.ravel()).sum()),
+                             num_samples)
+            else:
+                num_classes = pred_label.shape[1]
+                top_k = min(num_classes, self.top_k)
+                correct = 0.0
+                for j in range(top_k):
+                    correct += float(
+                        (pred_label[:, num_classes - 1 - j].ravel()
+                         == label.ravel()).sum())
+                self._update(correct, num_samples)
+
+
+class _BinaryClassificationMetrics:
+    """Confusion-matrix accumulator shared by F1/MCC (parity: same helper)."""
+
+    def __init__(self):
+        self.reset_stats()
+
+    def update_binary_stats(self, label, pred):
+        pred_label = onp.argmax(pred, axis=1)
+        check_label_shapes(label, pred)
+        if len(onp.unique(label)) > 2:
+            raise ValueError("%s currently only supports binary "
+                             "classification." % type(self).__name__)
+        pred_true = (pred_label == 1)
+        pred_false = 1 - pred_true
+        label_true = (label == 1)
+        label_false = 1 - label_true
+        true_pos = (pred_true * label_true).sum()
+        false_pos = (pred_true * label_false).sum()
+        false_neg = (pred_false * label_true).sum()
+        true_neg = (pred_false * label_false).sum()
+        self.true_positives += true_pos
+        self.false_positives += false_pos
+        self.false_negatives += false_neg
+        self.true_negatives += true_neg
+
+    @property
+    def precision(self):
+        if self.true_positives + self.false_positives > 0:
+            return float(self.true_positives) / (
+                self.true_positives + self.false_positives)
+        return 0.0
+
+    @property
+    def recall(self):
+        if self.true_positives + self.false_negatives > 0:
+            return float(self.true_positives) / (
+                self.true_positives + self.false_negatives)
+        return 0.0
+
+    @property
+    def fscore(self):
+        if self.precision + self.recall > 0:
+            return 2 * self.precision * self.recall / (
+                self.precision + self.recall)
+        return 0.0
+
+    @property
+    def matthewscc(self):
+        if not self.total_examples:
+            return 0.0
+        true_pos = float(self.true_positives)
+        false_pos = float(self.false_positives)
+        false_neg = float(self.false_negatives)
+        true_neg = float(self.true_negatives)
+        terms = [(true_pos + false_pos), (true_pos + false_neg),
+                 (true_neg + false_pos), (true_neg + false_neg)]
+        denom = 1.0
+        for t in filter(lambda t: t != 0.0, terms):
+            denom *= t
+        return ((true_pos * true_neg) - (false_pos * false_neg)) / math.sqrt(
+            denom)
+
+    @property
+    def total_examples(self):
+        return (self.false_negatives + self.false_positives
+                + self.true_negatives + self.true_positives)
+
+    def reset_stats(self):
+        self.false_positives = 0
+        self.false_negatives = 0
+        self.true_positives = 0
+        self.true_negatives = 0
+
+
+@register
+class F1(EvalMetric):
+    """Binary F1 (parity: mx.metric.F1; average in {'macro','micro'})."""
+
+    def __init__(self, name="f1", output_names=None, label_names=None,
+                 average="macro"):
+        self.average = average
+        self.metrics = _BinaryClassificationMetrics()
+        super().__init__(name, output_names, label_names,
+                         has_global_stats=True)
+
+    def update(self, labels, preds):
+        labels, preds = _tolist(labels), _tolist(preds)
+        for label, pred in zip(labels, preds):
+            self.metrics.update_binary_stats(_as_numpy(label), _as_numpy(pred))
+        if self.average == "macro":
+            self._update(self.metrics.fscore, 1)
+            self.metrics.reset_stats()
+        else:
+            self.sum_metric = self.metrics.fscore * self.metrics.total_examples
+            self.global_sum_metric = self.sum_metric
+            self.num_inst = self.metrics.total_examples
+            self.global_num_inst = self.num_inst
+
+    def reset(self):
+        self.sum_metric = 0.0
+        self.num_inst = 0
+        self.global_sum_metric = 0.0
+        self.global_num_inst = 0
+        if hasattr(self, "metrics"):
+            self.metrics.reset_stats()
+
+
+@register
+class MCC(EvalMetric):
+    """Matthews correlation coefficient (parity: mx.metric.MCC)."""
+
+    def __init__(self, name="mcc", output_names=None, label_names=None,
+                 average="macro"):
+        self._average = average
+        self._metrics = _BinaryClassificationMetrics()
+        super().__init__(name, output_names, label_names,
+                         has_global_stats=True)
+
+    def update(self, labels, preds):
+        labels, preds = _tolist(labels), _tolist(preds)
+        for label, pred in zip(labels, preds):
+            self._metrics.update_binary_stats(_as_numpy(label),
+                                              _as_numpy(pred))
+        if self._average == "macro":
+            self._update(self._metrics.matthewscc, 1)
+            self._metrics.reset_stats()
+        else:
+            self.sum_metric = (self._metrics.matthewscc
+                               * self._metrics.total_examples)
+            self.global_sum_metric = self.sum_metric
+            self.num_inst = self._metrics.total_examples
+            self.global_num_inst = self.num_inst
+
+    def reset(self):
+        self.sum_metric = 0.0
+        self.num_inst = 0.0
+        self.global_sum_metric = 0.0
+        self.global_num_inst = 0.0
+        if hasattr(self, "_metrics"):
+            self._metrics.reset_stats()
+
+
+@register
+class Perplexity(EvalMetric):
+    """Perplexity (parity: mx.metric.Perplexity)."""
+
+    def __init__(self, ignore_label=None, axis=-1, name="perplexity",
+                 output_names=None, label_names=None):
+        super().__init__(name, output_names, label_names,
+                         ignore_label=ignore_label, axis=axis,
+                         has_global_stats=True)
+        self.ignore_label = ignore_label
+        self.axis = axis
+
+    def update(self, labels, preds):
+        labels, preds = _tolist(labels), _tolist(preds)
+        assert len(labels) == len(preds)
+        loss = 0.0
+        num = 0
+        for label, pred in zip(labels, preds):
+            label = _as_numpy(label).astype(onp.int64)
+            pred = _as_numpy(pred)
+            flat_label = label.ravel()
+            probs = pred.reshape(-1, pred.shape[-1])[
+                onp.arange(flat_label.size), flat_label]
+            if self.ignore_label is not None:
+                ignore = (flat_label == self.ignore_label)
+                probs = onp.where(ignore, 1.0, probs)
+                num -= int(ignore.sum())
+            loss -= float(onp.sum(onp.log(onp.maximum(1e-10, probs))))
+            num += flat_label.size
+        self._update(loss, num)
+
+    def get(self):
+        if self.num_inst == 0:
+            return (self.name, float("nan"))
+        return (self.name, math.exp(self.sum_metric / self.num_inst))
+
+    def get_global(self):
+        if self.global_num_inst == 0:
+            return (self.name, float("nan"))
+        return (self.name, math.exp(self.global_sum_metric
+                                    / self.global_num_inst))
+
+
+@register
+class MAE(EvalMetric):
+    """Mean absolute error (parity: mx.metric.MAE)."""
+
+    def __init__(self, name="mae", output_names=None, label_names=None):
+        super().__init__(name, output_names, label_names,
+                         has_global_stats=True)
+
+    def update(self, labels, preds):
+        labels, preds = _tolist(labels), _tolist(preds)
+        check_label_shapes(labels, preds)
+        for label, pred in zip(labels, preds):
+            label = _as_numpy(label)
+            pred = _as_numpy(pred)
+            if len(label.shape) == 1:
+                label = label.reshape(label.shape[0], 1)
+            if len(pred.shape) == 1:
+                pred = pred.reshape(pred.shape[0], 1)
+            self._update(float(onp.abs(label - pred).mean()), 1)
+
+
+@register
+class MSE(EvalMetric):
+    """Mean squared error (parity: mx.metric.MSE)."""
+
+    def __init__(self, name="mse", output_names=None, label_names=None):
+        super().__init__(name, output_names, label_names,
+                         has_global_stats=True)
+
+    def update(self, labels, preds):
+        labels, preds = _tolist(labels), _tolist(preds)
+        check_label_shapes(labels, preds)
+        for label, pred in zip(labels, preds):
+            label = _as_numpy(label)
+            pred = _as_numpy(pred)
+            if len(label.shape) == 1:
+                label = label.reshape(label.shape[0], 1)
+            if len(pred.shape) == 1:
+                pred = pred.reshape(pred.shape[0], 1)
+            self._update(float(((label - pred) ** 2.0).mean()), 1)
+
+
+@register
+class RMSE(EvalMetric):
+    """Root mean squared error (parity: mx.metric.RMSE)."""
+
+    def __init__(self, name="rmse", output_names=None, label_names=None):
+        super().__init__(name, output_names, label_names,
+                         has_global_stats=True)
+
+    def update(self, labels, preds):
+        labels, preds = _tolist(labels), _tolist(preds)
+        check_label_shapes(labels, preds)
+        for label, pred in zip(labels, preds):
+            label = _as_numpy(label)
+            pred = _as_numpy(pred)
+            if len(label.shape) == 1:
+                label = label.reshape(label.shape[0], 1)
+            if len(pred.shape) == 1:
+                pred = pred.reshape(pred.shape[0], 1)
+            self._update(float(onp.sqrt(((label - pred) ** 2.0).mean())), 1)
+
+
+@register
+class CrossEntropy(EvalMetric):
+    """Cross entropy over class probabilities (parity: CrossEntropy)."""
+
+    def __init__(self, eps=1e-12, name="cross-entropy",
+                 output_names=None, label_names=None):
+        super().__init__(name, output_names, label_names, eps=eps,
+                         has_global_stats=True)
+        self.eps = eps
+
+    def update(self, labels, preds):
+        labels, preds = _tolist(labels), _tolist(preds)
+        check_label_shapes(labels, preds)
+        for label, pred in zip(labels, preds):
+            label = _as_numpy(label).ravel()
+            pred = _as_numpy(pred)
+            assert label.shape[0] == pred.shape[0]
+            prob = pred[onp.arange(label.shape[0]), label.astype(onp.int64)]
+            cross_entropy = (-onp.log(prob + self.eps)).sum()
+            self._update(float(cross_entropy), label.shape[0])
+
+
+@register
+class NegativeLogLikelihood(EvalMetric):
+    """NLL (parity: NegativeLogLikelihood)."""
+
+    def __init__(self, eps=1e-12, name="nll-loss",
+                 output_names=None, label_names=None):
+        super().__init__(name, output_names, label_names, eps=eps,
+                         has_global_stats=True)
+        self.eps = eps
+
+    def update(self, labels, preds):
+        labels, preds = _tolist(labels), _tolist(preds)
+        check_label_shapes(labels, preds)
+        for label, pred in zip(labels, preds):
+            label = _as_numpy(label).ravel()
+            pred = _as_numpy(pred)
+            num_examples = pred.shape[0]
+            assert label.shape[0] == num_examples
+            prob = pred[onp.arange(num_examples), label.astype(onp.int64)]
+            nll = (-onp.log(prob + self.eps)).sum()
+            self._update(float(nll), num_examples)
+
+
+@register
+class PearsonCorrelation(EvalMetric):
+    """Pearson correlation (parity: PearsonCorrelation)."""
+
+    def __init__(self, name="pearsonr", output_names=None, label_names=None):
+        super().__init__(name, output_names, label_names,
+                         has_global_stats=True)
+
+    def update(self, labels, preds):
+        labels, preds = _tolist(labels), _tolist(preds)
+        check_label_shapes(labels, preds)
+        for label, pred in zip(labels, preds):
+            label = _as_numpy(label).ravel()
+            pred = _as_numpy(pred).ravel()
+            self._update(float(onp.corrcoef(label, pred)[0, 1]), 1)
+
+
+@register
+class Loss(EvalMetric):
+    """Mean of a loss output (parity: mx.metric.Loss)."""
+
+    def __init__(self, name="loss", output_names=None, label_names=None):
+        super().__init__(name, output_names, label_names,
+                         has_global_stats=True)
+
+    def update(self, _, preds):
+        preds = _tolist(preds)
+        for pred in preds:
+            pred = _as_numpy(pred)
+            loss = float(pred.sum())
+            self._update(loss, pred.size)
+
+
+@register
+class Torch(Loss):
+    """Legacy alias (parity: mx.metric.Torch)."""
+
+    def __init__(self, name="torch", output_names=None, label_names=None):
+        super().__init__(name, output_names, label_names)
+
+
+@register
+class Caffe(Loss):
+    """Legacy alias (parity: mx.metric.Caffe)."""
+
+    def __init__(self, name="caffe", output_names=None, label_names=None):
+        super().__init__(name, output_names, label_names)
+
+
+@register
+class CustomMetric(EvalMetric):
+    """Wrap a feval(label, pred) function (parity: CustomMetric)."""
+
+    def __init__(self, feval, name=None, allow_extra_outputs=False,
+                 output_names=None, label_names=None):
+        if name is None:
+            name = feval.__name__
+            if name.find("<") != -1:
+                name = "custom(%s)" % name
+        super().__init__(name, output_names, label_names,
+                         feval=feval, allow_extra_outputs=allow_extra_outputs,
+                         has_global_stats=True)
+        self._feval = feval
+        self._allow_extra_outputs = allow_extra_outputs
+
+    def update(self, labels, preds):
+        labels, preds = _tolist(labels), _tolist(preds)
+        if not self._allow_extra_outputs:
+            check_label_shapes(labels, preds)
+        for pred, label in zip(preds, labels):
+            label = _as_numpy(label)
+            pred = _as_numpy(pred)
+            reval = self._feval(label, pred)
+            if isinstance(reval, tuple):
+                (sum_metric, num_inst) = reval
+                self._update(sum_metric, num_inst)
+            else:
+                self._update(reval, 1)
+
+    def get_config(self):
+        raise NotImplementedError("CustomMetric cannot be serialized")
+
+
+def np(numpy_feval, name=None, allow_extra_outputs=False):
+    """Parity: mx.metric.np — make a CustomMetric from a numpy feval."""
+
+    def feval(label, pred):
+        return numpy_feval(label, pred)
+
+    feval.__name__ = numpy_feval.__name__
+    return CustomMetric(feval, name, allow_extra_outputs)
+
+
+def _tolist(x):
+    if isinstance(x, (list, tuple)):
+        return list(x)
+    return [x]

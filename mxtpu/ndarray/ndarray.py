@@ -169,8 +169,8 @@ class NDArray:
     def copyto(self, other):
         if isinstance(other, Context):
             return NDArray(self._data + 0, ctx=other)
-        other._data = _place(self._data + 0, other._ctx)
-        return other
+        other._check_inplace_record()
+        return other._rebind(_place(self._data + 0, other._ctx))
 
     def copy(self) -> "NDArray":
         return NDArray(self._data + 0, ctx=self._ctx)

@@ -78,11 +78,16 @@ def interleaved_matmul_encdec_valatt(keys_values, attention, heads=1):
              differentiable=False)
 def arange_like(data, start=0.0, step=1.0, repeat=1, axis=None):
     if axis is None:
-        n = data.size
+        n = -(-data.size // repeat)
         out = jnp.arange(start, start + step * n, step, dtype=data.dtype)
+        if repeat > 1:
+            out = jnp.repeat(out, repeat)[:data.size]
         return out.reshape(data.shape)
-    n = data.shape[axis]
-    return jnp.arange(start, start + step * n, step, dtype=data.dtype)
+    n = -(-data.shape[axis] // repeat)
+    out = jnp.arange(start, start + step * n, step, dtype=data.dtype)
+    if repeat > 1:
+        out = jnp.repeat(out, repeat)[:data.shape[axis]]
+    return out
 
 
 @register_op("div_sqrt_dim", aliases=("_contrib_div_sqrt_dim",))

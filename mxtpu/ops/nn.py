@@ -430,3 +430,38 @@ def bilinear_sampler(data, grid):
            + gather(y1, x0) * ((1 - wx) * wy)[:, None]
            + gather(y1, x1) * (wx * wy)[:, None])
     return out
+
+
+@register_op("ctc_loss", aliases=("CTCLoss", "_contrib_ctc_loss"))
+def ctc_loss(data, label=None, data_lengths=None, label_lengths=None,
+             use_data_lengths=False, use_label_lengths=False,
+             blank_label="first", _layout="TNC"):
+    """CTC loss per sequence (parity: src/operator/nn/ctc_loss.cc which binds
+    warp-ctc/cuDNN; here optax's XLA-native lattice implementation).
+
+    MXNet op semantics: data (T, B, V) [the reference op's layout], label
+    (B, L) int, labels < 1 treated as padding when use_label_lengths=False
+    (blank index 0 = blank_label='first').  _layout='NTC' is an internal
+    escape used by gluon.loss.CTCLoss to skip the transpose."""
+    import optax
+
+    if blank_label != "first":
+        raise ValueError("mxtpu ctc_loss supports blank_label='first' only")
+    logits = jnp.swapaxes(data, 0, 1) if _layout == "TNC" else data  # (B,T,V)
+    B, T, _ = logits.shape
+    labels = label.astype(jnp.int32)
+    if use_data_lengths and data_lengths is not None:
+        logit_paddings = (jnp.arange(T)[None, :]
+                          >= data_lengths.astype(jnp.int32)[:, None]
+                          ).astype(jnp.float32)
+    else:
+        logit_paddings = jnp.zeros((B, T), jnp.float32)
+    L = labels.shape[1]
+    if use_label_lengths and label_lengths is not None:
+        label_paddings = (jnp.arange(L)[None, :]
+                          >= label_lengths.astype(jnp.int32)[:, None]
+                          ).astype(jnp.float32)
+    else:
+        label_paddings = (labels < 1).astype(jnp.float32)
+    return optax.ctc_loss(logits, logit_paddings, labels, label_paddings,
+                          blank_id=0)

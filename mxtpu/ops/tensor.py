@@ -153,41 +153,59 @@ def _norm_axis(axis):
     return axis if axis else None
 
 
+def _resolve_axis(x, axis, exclude):
+    """MXNet reduce-axis semantics incl. exclude=True (reduce over the
+    complement of the given axes — reference: broadcast_reduce_op.h)."""
+    axis = _norm_axis(axis)
+    if not exclude:
+        return axis
+    if axis is None:
+        return None
+    if isinstance(axis, int):
+        axis = (axis,)
+    axis = tuple(a % x.ndim for a in axis)
+    return tuple(i for i in range(x.ndim) if i not in axis)
+
+
 @register_op("sum", aliases=("sum_axis",))
-def sum_(x, axis=None, keepdims=False):
-    return jnp.sum(x, axis=_norm_axis(axis), keepdims=keepdims)
+def sum_(x, axis=None, keepdims=False, exclude=False):
+    return jnp.sum(x, axis=_resolve_axis(x, axis, exclude), keepdims=keepdims)
 
 
 @register_op("mean")
-def mean(x, axis=None, keepdims=False):
-    return jnp.mean(x, axis=_norm_axis(axis), keepdims=keepdims)
+def mean(x, axis=None, keepdims=False, exclude=False):
+    return jnp.mean(x, axis=_resolve_axis(x, axis, exclude), keepdims=keepdims)
 
 
 @register_op("prod")
-def prod(x, axis=None, keepdims=False):
-    return jnp.prod(x, axis=_norm_axis(axis), keepdims=keepdims)
+def prod(x, axis=None, keepdims=False, exclude=False):
+    return jnp.prod(x, axis=_resolve_axis(x, axis, exclude), keepdims=keepdims)
 
 
 @register_op("max", aliases=("max_axis",))
-def max_(x, axis=None, keepdims=False):
-    return jnp.max(x, axis=_norm_axis(axis), keepdims=keepdims)
+def max_(x, axis=None, keepdims=False, exclude=False):
+    return jnp.max(x, axis=_resolve_axis(x, axis, exclude), keepdims=keepdims)
 
 
 @register_op("min", aliases=("min_axis",))
-def min_(x, axis=None, keepdims=False):
-    return jnp.min(x, axis=_norm_axis(axis), keepdims=keepdims)
+def min_(x, axis=None, keepdims=False, exclude=False):
+    return jnp.min(x, axis=_resolve_axis(x, axis, exclude), keepdims=keepdims)
 
 
 @register_op("nansum")
-def nansum(x, axis=None, keepdims=False):
-    return jnp.nansum(x, axis=_norm_axis(axis), keepdims=keepdims)
+def nansum(x, axis=None, keepdims=False, exclude=False):
+    return jnp.nansum(x, axis=_resolve_axis(x, axis, exclude),
+                      keepdims=keepdims)
 
 
 @register_op("norm")
-def norm(x, ord=2, axis=None, keepdims=False):
-    axis = _norm_axis(axis)
+def norm(x, ord=2, axis=None, keepdims=False, exclude=False):
+    axis = _resolve_axis(x, axis, exclude)
     if ord == 1:
         return jnp.sum(jnp.abs(x), axis=axis, keepdims=keepdims)
+    if ord != 2:
+        raise ValueError(f"norm: only ord=1 and ord=2 are supported "
+                         f"(parity with reference), got {ord}")
     return jnp.sqrt(jnp.sum(jnp.square(x), axis=axis, keepdims=keepdims))
 
 
@@ -238,6 +256,12 @@ def topk(x, axis=-1, k=1, ret_typ="indices", is_ascend=False, dtype="float32"):
         return vals
     if ret_typ == "both":
         return (vals, idx)
+    if ret_typ == "mask":
+        onehot = jax.nn.one_hot(jnp.moveaxis(idx, axis, -1).astype(jnp.int32),
+                                xm.shape[-1], dtype=jnp.dtype(dtype))
+        return jnp.moveaxis(onehot.sum(-2), -1, axis)
+    if ret_typ != "indices":
+        raise ValueError(f"topk: unknown ret_typ {ret_typ!r}")
     return idx
 
 
@@ -298,16 +322,23 @@ def khatri_rao(*mats):
 @register_op("reshape", aliases=("Reshape",))
 def reshape(x, shape=None, reverse=False):
     # Supports MXNet special codes 0 (keep dim) and -1 (infer); -2/-3/-4
-    # codes are rare and unsupported (raise).
+    # codes are rare and unsupported (raise).  reverse=True aligns the
+    # special codes from the right (reference: matrix_op reshape).
     shape = tuple(shape)
+    in_shape = tuple(x.shape)
+    if reverse:
+        shape = shape[::-1]
+        in_shape = in_shape[::-1]
     out = []
     for i, s in enumerate(shape):
         if s == 0:
-            out.append(x.shape[i])
+            out.append(in_shape[i])
         elif s in (-2, -3, -4):
             raise NotImplementedError(f"reshape code {s} not supported")
         else:
             out.append(s)
+    if reverse:
+        out = out[::-1]
     return jnp.reshape(x, tuple(out))
 
 
@@ -540,10 +571,9 @@ def sequence_last(data, sequence_length=None, use_sequence_length=False, axis=0)
         idx[axis] = -1
         return data[tuple(idx)]
     last = (sequence_length.astype(jnp.int32) - 1)
-    return jnp.take_along_axis(
-        jnp.moveaxis(data, axis, 0), last[None, :, None], axis=0
-    )[0] if data.ndim == 3 else jnp.take_along_axis(
-        jnp.moveaxis(data, axis, 0), last[None, :], axis=0)[0]
+    d = jnp.moveaxis(data, axis, 0)  # (T, B, ...)
+    idx = last.reshape((1,) + last.shape + (1,) * (d.ndim - 2))
+    return jnp.take_along_axis(d, idx, axis=0)[0]
 
 
 @register_op("sequence_reverse", aliases=("SequenceReverse",))

@@ -1,0 +1,8 @@
+"""Optimizer API (parity: python/mxnet/optimizer/)."""
+
+from . import lr_scheduler
+from .lr_scheduler import *
+from .optimizer import *
+from .optimizer import Optimizer, register, create, get_updater, Updater
+
+opt = create  # parity alias: mx.optimizer.opt

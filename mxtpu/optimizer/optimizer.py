@@ -1,0 +1,596 @@
+"""Optimizers (parity: python/mxnet/optimizer/optimizer.py + the fused update
+ops in src/operator/optimizer_op.cc: sgd_update, sgd_mom_update, adam_update,
+lamb_update_phase1/2, signsgd_update, ...).
+
+TPU design: the reference fuses each update rule into a single CUDA kernel;
+here each rule is a pure function ``_step(weight, grad, state, lr, wd) ->
+(new_weight, new_state)`` jitted once per (shape, dtype) — XLA fuses the whole
+rule into one kernel and the scalar hyperparameters (lr, wd) are passed as
+device scalars so changing them never recompiles.  The imperative ``update``
+API (index-keyed, mutating) matches the reference exactly so Trainer/Module
+and the kvstore updater work unchanged.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+import pickle
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as onp
+
+from ..base import MXTPUError
+from ..ndarray import NDArray
+from .lr_scheduler import LRScheduler
+
+__all__ = [
+    "Optimizer", "register", "create", "get_updater", "Updater",
+    "SGD", "NAG", "Signum", "SGLD", "Adam", "AdamW", "AdaGrad", "AdaDelta",
+    "RMSProp", "Ftrl", "LAMB", "LARS", "Test",
+]
+
+
+def _clip(x, bound):
+    return jnp.clip(x, -bound, bound) if bound is not None and bound > 0 else x
+
+
+class Optimizer:
+    """Base optimizer (parity: mx.optimizer.Optimizer).
+
+    Subclasses implement ``create_state`` and ``_step``; the base handles
+    lr/wd multipliers, gradient rescale/clip, update counting and schedulers.
+    """
+
+    opt_registry: Dict[str, type] = {}
+
+    def __init__(self, rescale_grad=1.0, param_idx2name=None, wd=0.0,
+                 clip_gradient=None, learning_rate=0.01, lr_scheduler=None,
+                 sym=None, begin_num_update=0, multi_precision=False,
+                 param_dict=None):
+        self.rescale_grad = rescale_grad
+        self.lr = learning_rate
+        self.lr_scheduler = lr_scheduler
+        if lr_scheduler is not None:
+            self.lr_scheduler.base_lr = learning_rate
+        self.wd = wd
+        self.lr_mult = {}
+        self.wd_mult = {}
+        self.begin_num_update = begin_num_update
+        self.num_update = begin_num_update
+        self._index_update_count = {}
+        self.clip_gradient = clip_gradient
+        self.multi_precision = multi_precision
+        if param_idx2name is None:
+            param_idx2name = {}
+        if not isinstance(param_idx2name, dict):
+            raise MXTPUError("param_idx2name should be a dict of param indexes to names.")
+        self.idx2name = param_idx2name.copy()
+        self.sym_info = ()
+        self.param_dict = param_dict if param_dict else {}
+        self.set_lr_mult({})
+        self.set_wd_mult({})
+
+    # -- registry --------------------------------------------------------
+    @staticmethod
+    def register(klass):
+        name = klass.__name__.lower()
+        Optimizer.opt_registry[name] = klass
+        return klass
+
+    @staticmethod
+    def create_optimizer(name, **kwargs):
+        if name.lower() in Optimizer.opt_registry:
+            return Optimizer.opt_registry[name.lower()](**kwargs)
+        raise ValueError(f"Cannot find optimizer {name}")
+
+    # -- state -----------------------------------------------------------
+    def create_state(self, index, weight):
+        """Return the state pytree of jax arrays for one parameter."""
+        return None
+
+    def create_state_multi_precision(self, index, weight):
+        if self.multi_precision and weight.data.dtype == jnp.bfloat16:
+            w32 = weight.data.astype(jnp.float32)
+            return (w32, self.create_state(index, NDArray(w32)))
+        return self.create_state(index, weight)
+
+    # -- rule ------------------------------------------------------------
+    def _step(self, weight, grad, state, lr, wd):
+        """Pure update rule over jax arrays; override in subclasses."""
+        raise NotImplementedError
+
+    @functools.lru_cache(maxsize=None)
+    def _jit_step(self):
+        # donate weight and state buffers: the old values die with the update,
+        # matching the reference's in-place fused optimizer ops.
+        return jax.jit(self._step, donate_argnums=(0, 2))
+
+    def update(self, index, weight, grad, state):
+        """Imperative entry (parity: Optimizer.update).  Mutates weight/state."""
+        self._update_count(index)
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        new_w, new_state = self._jit_step()(
+            weight.data, grad.data, state,
+            jnp.float32(lr), jnp.float32(wd))
+        weight._rebind(new_w)
+        return new_state
+
+    def update_multi_precision(self, index, weight, grad, state):
+        if self.multi_precision and weight.data.dtype == jnp.bfloat16:
+            w32, inner = state
+            g32 = grad.data.astype(jnp.float32)
+            self._update_count(index)
+            lr = self._get_lr(index)
+            wd = self._get_wd(index)
+            new_w32, new_inner = self._jit_step()(
+                w32, g32, inner, jnp.float32(lr), jnp.float32(wd))
+            weight._rebind(new_w32.astype(jnp.bfloat16))
+            return (new_w32, new_inner)
+        return self.update(index, weight, grad, state)
+
+    # -- hyper-parameter plumbing (parity with reference) ----------------
+    def set_learning_rate(self, lr):
+        if self.lr_scheduler is not None:
+            raise MXTPUError("LRScheduler of the optimizer has already been "
+                             "defined.")
+        self.lr = lr
+
+    def set_lr_mult(self, args_lr_mult):
+        self.lr_mult = {}
+        if self.sym_info:
+            attr, arg_names = self.sym_info
+            for name in arg_names:
+                if name in attr and "__lr_mult__" in attr[name]:
+                    self.lr_mult[name] = float(attr[name]["__lr_mult__"])
+        self.lr_mult.update(args_lr_mult)
+
+    def set_wd_mult(self, args_wd_mult):
+        self.wd_mult = {}
+        for n in self.idx2name.values():
+            is_weight = n.endswith("_weight")
+            if not is_weight:
+                self.wd_mult[n] = 0.0
+        if self.sym_info:
+            attr, arg_names = self.sym_info
+            for name in arg_names:
+                if name in attr and "__wd_mult__" in attr[name]:
+                    self.wd_mult[name] = float(attr[name]["__wd_mult__"])
+        self.wd_mult.update(args_wd_mult)
+
+    def _update_count(self, index):
+        if not isinstance(index, (list, tuple)):
+            index = [index]
+        for idx in index:
+            if idx not in self._index_update_count:
+                self._index_update_count[idx] = self.begin_num_update
+            self._index_update_count[idx] += 1
+            self.num_update = max(self._index_update_count[idx],
+                                  self.num_update)
+
+    def _get_lrs(self, indices):
+        if self.lr_scheduler is not None:
+            lr = self.lr_scheduler(self.num_update)
+        else:
+            lr = self.lr
+        lrs = [lr] * len(indices)
+        for i, index in enumerate(indices):
+            if index in self.param_dict:
+                lrs[i] *= self.param_dict[index].lr_mult
+            elif index in self.lr_mult:
+                lrs[i] *= self.lr_mult[index]
+            elif index in self.idx2name:
+                lrs[i] *= self.lr_mult.get(self.idx2name[index], 1.0)
+        return lrs
+
+    def _get_lr(self, index):
+        return self._get_lrs([index])[0]
+
+    def _get_wds(self, indices):
+        wds = [self.wd] * len(indices)
+        for i, index in enumerate(indices):
+            if index in self.param_dict:
+                wds[i] *= self.param_dict[index].wd_mult
+            elif index in self.wd_mult:
+                wds[i] *= self.wd_mult[index]
+            elif index in self.idx2name:
+                wds[i] *= self.wd_mult.get(self.idx2name[index], 1.0)
+        return wds
+
+    def _get_wd(self, index):
+        return self._get_wds([index])[0]
+
+    def __getstate__(self):
+        ret = self.__dict__.copy()
+        return ret
+
+
+register = Optimizer.register
+create = Optimizer.create_optimizer
+
+
+@register
+class SGD(Optimizer):
+    """SGD with momentum and weight decay (parity: sgd_update /
+    sgd_mom_update in src/operator/optimizer_op.cc):
+
+        grad = rescale_grad * clip(grad) + wd * weight
+        mom  = momentum * mom - lr * grad
+        weight += mom
+    """
+
+    def __init__(self, momentum=0.0, lazy_update=True, **kwargs):
+        super().__init__(**kwargs)
+        self.momentum = momentum
+        self.lazy_update = lazy_update  # sparse-only knob; dense ignores
+
+    def create_state(self, index, weight):
+        if self.momentum == 0.0:
+            return None
+        return jnp.zeros(weight.shape, weight.data.dtype)
+
+    def _step(self, weight, grad, state, lr, wd):
+        g = _clip(grad * self.rescale_grad, self.clip_gradient)
+        g = g + wd * weight
+        if self.momentum == 0.0:
+            return weight - lr * g, None
+        mom = self.momentum * state - lr * g
+        return weight + mom, mom
+
+
+@register
+class NAG(SGD):
+    """Nesterov accelerated SGD (parity: nag_mom_update)."""
+
+    def _step(self, weight, grad, state, lr, wd):
+        g = _clip(grad * self.rescale_grad, self.clip_gradient)
+        g = g + wd * weight
+        if self.momentum == 0.0:
+            return weight - lr * g, None
+        mom = self.momentum * state - lr * g
+        return weight + self.momentum * mom - lr * g, mom
+
+
+@register
+class Signum(Optimizer):
+    """signSGD / Signum (parity: signsgd_update / signum_update)."""
+
+    def __init__(self, learning_rate=0.01, momentum=0.9, wd_lh=0.0, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.momentum = momentum
+        self.wd_lh = wd_lh
+
+    def create_state(self, index, weight):
+        if self.momentum == 0.0:
+            return None
+        return jnp.zeros(weight.shape, weight.data.dtype)
+
+    def _step(self, weight, grad, state, lr, wd):
+        if self.momentum == 0.0:
+            g = _clip(grad * self.rescale_grad, self.clip_gradient)
+            return weight * (1 - lr * wd) - lr * jnp.sign(g), None
+        g = _clip(grad * self.rescale_grad, self.clip_gradient)
+        mom = self.momentum * state - (1 - self.momentum) * (g + wd * weight)
+        return weight * (1 - lr * self.wd_lh) + lr * jnp.sign(mom), mom
+
+
+@register
+class SGLD(Optimizer):
+    """Stochastic Gradient Langevin Dynamics (parity: SGLD)."""
+
+    def create_state(self, index, weight):
+        from .. import random as _rnd
+        return None
+
+    def update(self, index, weight, grad, state):
+        from .. import random as _rnd
+        self._update_count(index)
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        key = _rnd.next_key()
+        g = _clip(grad.data * self.rescale_grad, self.clip_gradient)
+        g = g + wd * weight.data
+        noise = jax.random.normal(key, weight.shape, jnp.float32) * math.sqrt(lr)
+        weight._rebind(weight.data - lr / 2 * g
+                       + noise.astype(weight.data.dtype))
+        return state
+
+
+@register
+class Adam(Optimizer):
+    """Adam (parity: adam_update; bias correction folded into lr like the
+    reference's coef computation in the Python layer)."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, lazy_update=True, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1 = beta1
+        self.beta2 = beta2
+        self.epsilon = epsilon
+
+    def create_state(self, index, weight):
+        return (jnp.zeros(weight.shape, weight.data.dtype),
+                jnp.zeros(weight.shape, weight.data.dtype))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        t = self._index_update_count[index]
+        coef1 = 1. - self.beta1 ** t
+        coef2 = 1. - self.beta2 ** t
+        lr = self._get_lr(index) * math.sqrt(coef2) / coef1
+        wd = self._get_wd(index)
+        new_w, new_state = self._jit_step()(
+            weight.data, grad.data, state, jnp.float32(lr), jnp.float32(wd))
+        weight._rebind(new_w)
+        return new_state
+
+    def _step(self, weight, grad, state, lr, wd):
+        mean, var = state
+        g = _clip(grad * self.rescale_grad, self.clip_gradient) + wd * weight
+        mean = self.beta1 * mean + (1. - self.beta1) * g
+        var = self.beta2 * var + (1. - self.beta2) * g * g
+        w = weight - lr * mean / (jnp.sqrt(var) + self.epsilon)
+        return w, (mean, var)
+
+
+@register
+class AdamW(Adam):
+    """Adam with decoupled weight decay (parity: contrib adamw_update)."""
+
+    def _step(self, weight, grad, state, lr, wd):
+        mean, var = state
+        g = _clip(grad * self.rescale_grad, self.clip_gradient)
+        mean = self.beta1 * mean + (1. - self.beta1) * g
+        var = self.beta2 * var + (1. - self.beta2) * g * g
+        w = weight - lr * (mean / (jnp.sqrt(var) + self.epsilon) + wd * weight)
+        return w, (mean, var)
+
+
+@register
+class AdaGrad(Optimizer):
+    """AdaGrad (parity: AdaGrad in optimizer.py; history += g^2)."""
+
+    def __init__(self, eps=1e-7, **kwargs):
+        super().__init__(**kwargs)
+        self.float_stable_eps = eps
+
+    def create_state(self, index, weight):
+        return jnp.zeros(weight.shape, weight.data.dtype)
+
+    def _step(self, weight, grad, state, lr, wd):
+        g = _clip(grad * self.rescale_grad, self.clip_gradient)
+        g = g + wd * weight
+        history = state + g * g
+        w = weight - lr * g / (jnp.sqrt(history) + self.float_stable_eps)
+        return w, history
+
+
+@register
+class AdaDelta(Optimizer):
+    """AdaDelta (parity: AdaDelta)."""
+
+    def __init__(self, rho=0.90, epsilon=1e-5, **kwargs):
+        super().__init__(**kwargs)
+        self.rho = rho
+        self.epsilon = epsilon
+
+    def create_state(self, index, weight):
+        return (jnp.zeros(weight.shape, weight.data.dtype),
+                jnp.zeros(weight.shape, weight.data.dtype))
+
+    def _step(self, weight, grad, state, lr, wd):
+        acc_g, acc_delta = state
+        g = _clip(grad * self.rescale_grad, self.clip_gradient)
+        g = g + wd * weight
+        acc_g = self.rho * acc_g + (1. - self.rho) * g * g
+        delta = (jnp.sqrt(acc_delta + self.epsilon)
+                 / jnp.sqrt(acc_g + self.epsilon)) * g
+        acc_delta = self.rho * acc_delta + (1. - self.rho) * delta * delta
+        return weight - delta, (acc_g, acc_delta)
+
+
+@register
+class RMSProp(Optimizer):
+    """RMSProp (parity: rmsprop_update / rmspropalex_update; centered=True
+    uses Graves' variant like the reference)."""
+
+    def __init__(self, learning_rate=0.001, gamma1=0.9, gamma2=0.9,
+                 epsilon=1e-8, centered=False, clip_weights=None, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.gamma1 = gamma1
+        self.gamma2 = gamma2
+        self.centered = centered
+        self.epsilon = epsilon
+        self.clip_weights = clip_weights
+
+    def create_state(self, index, weight):
+        z = jnp.zeros(weight.shape, weight.data.dtype)
+        if self.centered:
+            return (z, z, z)  # n, g, delta
+        return z  # n
+
+    def _step(self, weight, grad, state, lr, wd):
+        grad = _clip(grad * self.rescale_grad, self.clip_gradient)
+        grad = grad + wd * weight
+        if not self.centered:
+            n = state
+            n = (1. - self.gamma1) * grad * grad + self.gamma1 * n
+            w = weight - lr * grad / jnp.sqrt(n + self.epsilon)
+            w = _clip(w, self.clip_weights)
+            return w, n
+        n, g, delta = state
+        n = (1. - self.gamma1) * grad * grad + self.gamma1 * n
+        g = (1. - self.gamma1) * grad + self.gamma1 * g
+        delta = self.gamma2 * delta - lr * grad / jnp.sqrt(
+            n - g * g + self.epsilon)
+        w = _clip(weight + delta, self.clip_weights)
+        return w, (n, g, delta)
+
+
+@register
+class Ftrl(Optimizer):
+    """FTRL-proximal (parity: ftrl_update)."""
+
+    def __init__(self, lamda1=0.01, learning_rate=0.1, beta=1, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.lamda1 = lamda1
+        self.beta = beta
+
+    def create_state(self, index, weight):
+        return (jnp.zeros(weight.shape, weight.data.dtype),  # z
+                jnp.zeros(weight.shape, weight.data.dtype))  # n
+
+    def _step(self, weight, grad, state, lr, wd):
+        z, n = state
+        g = _clip(grad * self.rescale_grad, self.clip_gradient)
+        sigma = (jnp.sqrt(n + g * g) - jnp.sqrt(n)) / lr
+        z = z + g - sigma * weight
+        n = n + g * g
+        w = ((jnp.sign(z) * self.lamda1 - z)
+             / ((self.beta + jnp.sqrt(n)) / lr + wd)
+             * (jnp.abs(z) > self.lamda1))
+        return w, (z, n)
+
+
+@register
+class LAMB(Optimizer):
+    """LAMB layer-wise adaptive optimizer for large-batch BERT training
+    (parity: lamb_update_phase1/phase2, 1.6+)."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-6, lower_bound=None, upper_bound=None,
+                 bias_correction=True, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1 = beta1
+        self.beta2 = beta2
+        self.epsilon = epsilon
+        self.lower_bound = lower_bound
+        self.upper_bound = upper_bound
+        self.bias_correction = bias_correction
+
+    def create_state(self, index, weight):
+        return (jnp.zeros(weight.shape, weight.data.dtype),
+                jnp.zeros(weight.shape, weight.data.dtype))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        t = self._index_update_count[index]
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        new_w, new_state = self._jit_t_step()(
+            weight.data, grad.data, state, jnp.float32(lr), jnp.float32(wd),
+            jnp.float32(t))
+        weight._rebind(new_w)
+        return new_state
+
+    @functools.lru_cache(maxsize=None)
+    def _jit_t_step(self):
+        return jax.jit(self._t_step, donate_argnums=(0, 2))
+
+    def _t_step(self, weight, grad, state, lr, wd, t):
+        mean, var = state
+        g = _clip(grad * self.rescale_grad, self.clip_gradient)
+        mean = self.beta1 * mean + (1. - self.beta1) * g
+        var = self.beta2 * var + (1. - self.beta2) * g * g
+        if self.bias_correction:
+            mean_hat = mean / (1. - self.beta1 ** t)
+            var_hat = var / (1. - self.beta2 ** t)
+        else:
+            mean_hat, var_hat = mean, var
+        update = mean_hat / (jnp.sqrt(var_hat) + self.epsilon) + wd * weight
+        w_norm = jnp.linalg.norm(weight)
+        u_norm = jnp.linalg.norm(update)
+        if self.lower_bound is not None:
+            w_norm = jnp.maximum(w_norm, self.lower_bound)
+        if self.upper_bound is not None:
+            w_norm = jnp.minimum(w_norm, self.upper_bound)
+        ratio = jnp.where((w_norm > 0) & (u_norm > 0), w_norm / u_norm, 1.0)
+        return weight - lr * ratio * update, (mean, var)
+
+
+@register
+class LARS(Optimizer):
+    """LARS layer-wise adaptive rate scaling (parity: LARS, 1.6+)."""
+
+    def __init__(self, momentum=0.9, eta=0.001, epsilon=1e-8, **kwargs):
+        super().__init__(**kwargs)
+        self.momentum = momentum
+        self.eta = eta
+        self.epsilon = epsilon
+
+    def create_state(self, index, weight):
+        return jnp.zeros(weight.shape, weight.data.dtype)
+
+    def _step(self, weight, grad, state, lr, wd):
+        g = _clip(grad * self.rescale_grad, self.clip_gradient)
+        w_norm = jnp.linalg.norm(weight)
+        g_norm = jnp.linalg.norm(g)
+        trust = jnp.where(
+            (w_norm > 0) & (g_norm > 0),
+            self.eta * w_norm / (g_norm + wd * w_norm + self.epsilon), 1.0)
+        g = g + wd * weight
+        mom = self.momentum * state - lr * trust * g
+        return weight + mom, mom
+
+
+@register
+class Test(Optimizer):
+    """Trivial optimizer for tests (parity: mx.optimizer.Test)."""
+
+    def create_state(self, index, weight):
+        return jnp.zeros(weight.shape, weight.data.dtype)
+
+    def _step(self, weight, grad, state, lr, wd):
+        return weight + grad * self.rescale_grad, state
+
+
+class Updater:
+    """Applies an optimizer keyed by integer index, holding per-index state
+    (parity: mx.optimizer.Updater / get_updater; this is the object the
+    KVStore runs server-side when update_on_kvstore=True)."""
+
+    def __init__(self, optimizer):
+        self.optimizer = optimizer
+        self.states = {}
+        self.states_synced = {}
+        self.aggregate_updates = False
+
+    def __call__(self, index, grad, weight):
+        if not isinstance(index, (list, tuple)):
+            indices, grads, weights = [index], [grad], [weight]
+        else:
+            indices, grads, weights = index, grad, weight
+        for i, g, w in zip(indices, grads, weights):
+            if i not in self.states:
+                self.states[i] = self.optimizer.create_state_multi_precision(
+                    i, w)
+                self.states_synced[i] = True
+            self.states[i] = self.optimizer.update_multi_precision(
+                i, w, g, self.states[i])
+
+    def get_states(self, dump_optimizer=False):
+        states = {k: jax.tree_util.tree_map(onp.asarray, v)
+                  for k, v in self.states.items()}
+        if dump_optimizer:
+            return pickle.dumps((states, self.optimizer))
+        return pickle.dumps(states)
+
+    def set_states(self, states):
+        states = pickle.loads(states)
+        if isinstance(states, tuple) and len(states) == 2 and not isinstance(
+                states[0], onp.ndarray):
+            try:
+                states, self.optimizer = states
+            except Exception:
+                pass
+        self.states = {
+            k: jax.tree_util.tree_map(jnp.asarray, v)
+            for k, v in states.items()}
+        self.states_synced = dict.fromkeys(self.states.keys(), False)
+
+
+def get_updater(optimizer):
+    return Updater(optimizer)

