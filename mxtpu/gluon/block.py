@@ -461,11 +461,18 @@ class HybridBlock(Block):
     def forward(self, x, *args):
         """Dispatch: cached-op path when hybridized, imperative otherwise."""
         if not isinstance(x, NDArray):
-            from ..symbol import Symbol
-            if isinstance(x, Symbol):
-                return self._symbolic_forward(x, *args)
-            raise TypeError(
-                f"HybridBlock input must be NDArray, got {type(x)}")
+            import numpy as _onp
+            if isinstance(x, (_onp.ndarray, _onp.generic)):
+                x = ndarray.array(x)
+            else:
+                from ..symbol import Symbol
+                if isinstance(x, Symbol):
+                    return self._symbolic_forward(x, *args)
+                raise TypeError(
+                    f"HybridBlock input must be NDArray, got {type(x)}")
+        if args and any(isinstance(a, _np_types()) for a in args):
+            args = tuple(ndarray.array(a) if isinstance(a, _np_types())
+                         else a for a in args)
         if self._active:
             if self._cached_op is None:
                 from ..cached_op import CachedOp
@@ -580,3 +587,8 @@ def _brief_print(d):
     if len(keys) > 10:
         keys = keys[:10] + ["..."]
     return ", ".join(keys)
+
+
+def _np_types():
+    import numpy as _onp
+    return (_onp.ndarray, _onp.generic)

@@ -1,0 +1,14 @@
+"""Gluon data API (parity: python/mxnet/gluon/data/).
+
+Dataset / Sampler / DataLoader with host-side worker processes and a
+device-prefetch double buffer — the TPU-native replacement for the
+reference's C++ threaded prefetching iterators (src/io/iter_prefetcher.h).
+"""
+
+from . import dataset
+from .dataset import Dataset, SimpleDataset, ArrayDataset, RecordFileDataset
+from . import sampler
+from .sampler import Sampler, SequentialSampler, RandomSampler, BatchSampler
+from . import dataloader
+from .dataloader import DataLoader
+from . import vision

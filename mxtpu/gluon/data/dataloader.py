@@ -1,0 +1,232 @@
+"""DataLoader (parity: python/mxnet/gluon/data/dataloader.py).
+
+TPU-native design: decode/augment on host (optionally in worker processes,
+like the reference's _MultiWorkerIter over multiprocessing), batchify to
+numpy, then a background prefetch thread keeps a bounded queue of ready
+batches and (optionally) stages them onto device ahead of the consumer —
+replacing the reference's C++ PrefetcherIter double buffer
+(src/io/iter_prefetcher.h) with an equivalent host-thread pipeline that
+overlaps input processing with TPU compute via JAX async dispatch.
+"""
+
+import multiprocessing
+import queue as _queue
+import threading
+
+import numpy as np
+
+from ... import ndarray as nd
+from ...ndarray import NDArray
+from .sampler import SequentialSampler, RandomSampler, BatchSampler
+
+__all__ = ["DataLoader", "default_batchify_fn", "default_mp_batchify_fn"]
+
+
+def default_batchify_fn(data):
+    """Stack samples into a batch (recursively for tuples/lists/dicts)."""
+    if isinstance(data[0], NDArray):
+        return nd.stack(*data, axis=0)
+    if isinstance(data[0], (tuple, list)):
+        data = zip(*data)
+        return [default_batchify_fn(i) for i in data]
+    if isinstance(data[0], dict):
+        return {k: default_batchify_fn([d[k] for d in data]) for k in data[0]}
+    data = np.asarray(data)
+    return data
+
+
+# Worker processes return numpy (cheap to pickle); conversion to device
+# arrays happens in the main process during prefetch.
+def default_mp_batchify_fn(data):
+    if isinstance(data[0], NDArray):
+        return np.stack([d.asnumpy() for d in data], axis=0)
+    if isinstance(data[0], (tuple, list)):
+        data = zip(*data)
+        return [default_mp_batchify_fn(i) for i in data]
+    if isinstance(data[0], dict):
+        return {k: default_mp_batchify_fn([d[k] for d in data]) for k in data[0]}
+    return np.asarray(data)
+
+
+_worker_dataset = None
+_worker_batchify = None
+
+
+def _worker_init(dataset, batchify_fn):
+    """Process-pool initializer: each fork-worker gets its own copy of the
+    dataset in its own process globals."""
+    global _worker_dataset, _worker_batchify
+    _worker_dataset = dataset
+    _worker_batchify = batchify_fn
+
+
+def _worker_fn(samples):
+    return _worker_batchify([_worker_dataset[i] for i in samples])
+
+
+def _thread_worker_fn(dataset, batchify_fn, samples):
+    """Thread-pool task: dataset passed explicitly — threads share the
+    parent's globals, so per-loader state must not live there."""
+    return batchify_fn([dataset[i] for i in samples])
+
+
+def _as_device(data, pin_device):
+    """Move a batchified (possibly nested) numpy batch onto device."""
+    if isinstance(data, (list, tuple)):
+        return type(data)(_as_device(d, pin_device) for d in data)
+    if isinstance(data, dict):
+        return {k: _as_device(v, pin_device) for k, v in data.items()}
+    if isinstance(data, NDArray):
+        return data
+    return nd.array(data)
+
+
+class _PrefetchIter:
+    """Background thread pulls batches from `source_iter`, converts to
+    device arrays, and keeps up to `prefetch` ready ahead of the consumer."""
+
+    _SENTINEL = object()
+
+    def __init__(self, source_iter, prefetch, pin_memory):
+        self._queue = _queue.Queue(maxsize=max(1, prefetch))
+        self._pin = pin_memory
+        self._exc = None
+        self._closed = threading.Event()
+
+        def _put(item):
+            # bounded put that gives up when the consumer abandoned us
+            while not self._closed.is_set():
+                try:
+                    self._queue.put(item, timeout=0.1)
+                    return True
+                except _queue.Full:
+                    continue
+            return False
+
+        def _run():
+            try:
+                for batch in source_iter:
+                    if not _put(_as_device(batch, pin_memory)):
+                        return  # consumer gone; stop staging batches
+            except Exception as e:  # propagate to consumer thread
+                self._exc = e
+            finally:
+                _put(self._SENTINEL)
+
+        self._thread = threading.Thread(target=_run, daemon=True)
+        self._thread.start()
+
+    def close(self):
+        self._closed.set()
+
+    def __del__(self):
+        self._closed.set()
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        item = self._queue.get()
+        if item is self._SENTINEL:
+            if self._exc is not None:
+                raise self._exc
+            raise StopIteration
+        return item
+
+
+class DataLoader:
+    """Loads batches from a Dataset.
+
+    Parameters mirror the reference: dataset, batch_size, shuffle, sampler,
+    last_batch, batch_sampler, batchify_fn, num_workers, pin_memory,
+    prefetch, thread_pool.
+    """
+
+    def __init__(self, dataset, batch_size=None, shuffle=False, sampler=None,
+                 last_batch=None, batch_sampler=None, batchify_fn=None,
+                 num_workers=0, pin_memory=False, prefetch=None,
+                 thread_pool=False):
+        self._dataset = dataset
+        self._pin_memory = pin_memory
+        self._thread_pool = thread_pool
+        self._num_workers = max(0, num_workers)
+        self._prefetch = max(0, 2 * self._num_workers if prefetch is None
+                             else prefetch)
+
+        if batch_sampler is None:
+            if batch_size is None:
+                raise ValueError(
+                    "batch_size must be specified unless batch_sampler is")
+            if sampler is None:
+                sampler = RandomSampler(len(dataset)) if shuffle else \
+                    SequentialSampler(len(dataset))
+            elif shuffle:
+                raise ValueError(
+                    "shuffle must not be specified if sampler is specified")
+            batch_sampler = BatchSampler(sampler, batch_size,
+                                         last_batch or "keep")
+        elif (batch_size is not None or shuffle or sampler is not None
+              or last_batch is not None):
+            raise ValueError(
+                "batch_size, shuffle, sampler and last_batch must not be "
+                "specified if batch_sampler is specified.")
+        self._batch_sampler = batch_sampler
+
+        if batchify_fn is None:
+            self._batchify_fn = (default_mp_batchify_fn if self._num_workers
+                                 else default_batchify_fn)
+        else:
+            self._batchify_fn = batchify_fn
+
+        self._pool = None
+        if self._num_workers > 0:
+            if thread_pool:
+                from multiprocessing.pool import ThreadPool
+                self._pool = ThreadPool(self._num_workers)
+            else:
+                ctx = multiprocessing.get_context("fork")
+                self._pool = ctx.Pool(
+                    self._num_workers,
+                    initializer=_worker_init,
+                    initargs=(self._dataset, self._batchify_fn))
+
+    def _single_process_iter(self):
+        for batch_idx in self._batch_sampler:
+            yield self._batchify_fn([self._dataset[i] for i in batch_idx])
+
+    def _submit(self, batch_idx):
+        if self._thread_pool:
+            return self._pool.apply_async(
+                _thread_worker_fn,
+                (self._dataset, self._batchify_fn, batch_idx))
+        return self._pool.apply_async(_worker_fn, (batch_idx,))
+
+    def _multi_worker_iter(self):
+        # keep up to prefetch async results in flight, in order
+        it = iter(self._batch_sampler)
+        pending = []
+        try:
+            for _ in range(max(1, self._prefetch)):
+                pending.append(self._submit(next(it)))
+        except StopIteration:
+            pass
+        while pending:
+            res = pending.pop(0)
+            try:
+                pending.append(self._submit(next(it)))
+            except StopIteration:
+                pass
+            yield res.get()
+
+    def __iter__(self):
+        source = (self._multi_worker_iter() if self._pool is not None
+                  else self._single_process_iter())
+        return iter(_PrefetchIter(source, prefetch=max(1, self._prefetch),
+                                  pin_memory=self._pin_memory))
+
+    def __len__(self):
+        return len(self._batch_sampler)
+
+    def __del__(self):
+        if self._pool is not None:
+            self._pool.terminate()

@@ -1,0 +1,130 @@
+"""Datasets (parity: python/mxnet/gluon/data/dataset.py — Dataset,
+SimpleDataset, ArrayDataset, RecordFileDataset)."""
+
+from ... import ndarray as nd
+from ...ndarray import NDArray
+
+__all__ = ["Dataset", "SimpleDataset", "ArrayDataset", "RecordFileDataset"]
+
+
+class Dataset:
+    """Abstract dataset: random access by index + length."""
+
+    def __getitem__(self, idx):
+        raise NotImplementedError
+
+    def __len__(self):
+        raise NotImplementedError
+
+    def filter(self, fn):
+        return SimpleDataset([s for s in self if fn(s)])
+
+    def shard(self, num_shards, index):
+        """Even contiguous shard — used for per-host data splits in
+        multi-host data parallel (each process loads its own shard)."""
+        assert 0 <= index < num_shards
+        n = len(self)
+        base = n // num_shards
+        rem = n % num_shards
+        start = base * index + min(index, rem)
+        stop = start + base + (1 if index < rem else 0)
+        return SimpleDataset([self[i] for i in range(start, stop)])
+
+    def take(self, count):
+        if count is None or count >= len(self):
+            return self
+        return SimpleDataset([self[i] for i in range(count)])
+
+    def transform(self, fn, lazy=True):
+        trans = _LazyTransformDataset(self, fn)
+        if lazy:
+            return trans
+        return SimpleDataset([trans[i] for i in range(len(trans))])
+
+    def transform_first(self, fn, lazy=True):
+        return self.transform(_TransformFirstClosure(fn), lazy)
+
+    def __iter__(self):
+        for i in range(len(self)):
+            yield self[i]
+
+
+class SimpleDataset(Dataset):
+    """Wraps any list/array-like exposing __getitem__/__len__."""
+
+    def __init__(self, data):
+        self._data = data
+
+    def __len__(self):
+        return len(self._data)
+
+    def __getitem__(self, idx):
+        return self._data[idx]
+
+
+class _LazyTransformDataset(Dataset):
+    def __init__(self, data, fn):
+        self._data = data
+        self._fn = fn
+
+    def __len__(self):
+        return len(self._data)
+
+    def __getitem__(self, idx):
+        item = self._data[idx]
+        if isinstance(item, tuple):
+            return self._fn(*item)
+        return self._fn(item)
+
+
+class _TransformFirstClosure:
+    """Picklable closure applying fn to the first element only."""
+
+    def __init__(self, fn):
+        self._fn = fn
+
+    def __call__(self, x, *args):
+        if args:
+            return (self._fn(x),) + args
+        return self._fn(x)
+
+
+class ArrayDataset(Dataset):
+    """Zip of equal-length arrays; single array yields scalar samples."""
+
+    def __init__(self, *args):
+        assert len(args) > 0
+        self._length = len(args[0])
+        self._data = []
+        for i, data in enumerate(args):
+            assert len(data) == self._length, (
+                "All arrays must have the same length; array[0] has %d "
+                "while array[%d] has %d." % (self._length, i, len(data)))
+            if isinstance(data, NDArray) and data.ndim == 1:
+                data = data.asnumpy()
+            self._data.append(data)
+
+    def __len__(self):
+        return self._length
+
+    def __getitem__(self, idx):
+        if len(self._data) == 1:
+            return self._data[0][idx]
+        return tuple(d[idx] for d in self._data)
+
+
+class RecordFileDataset(Dataset):
+    """Dataset over a RecordIO (.rec) file with .idx index
+    (parity: RecordFileDataset over MXIndexedRecordIO)."""
+
+    def __init__(self, filename):
+        from ... import recordio
+        idx_file = filename[:filename.rindex(".")] + ".idx"
+        self._record = recordio.MXIndexedRecordIO(idx_file, filename, "r")
+        self._filename = filename
+
+    def __len__(self):
+        return len(self._record.keys)
+
+    def __getitem__(self, idx):
+        return self._record.read_idx(self._record.keys[idx])

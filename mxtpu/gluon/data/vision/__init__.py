@@ -1,0 +1,7 @@
+"""Vision datasets + transforms (parity: gluon/data/vision/)."""
+
+from . import datasets
+from .datasets import (MNIST, FashionMNIST, CIFAR10, CIFAR100,
+                       ImageRecordDataset, ImageFolderDataset,
+                       ImageListDataset)
+from . import transforms

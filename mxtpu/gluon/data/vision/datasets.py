@@ -1,0 +1,260 @@
+"""Vision datasets (parity: python/mxnet/gluon/data/vision/datasets.py).
+
+File formats are parsed natively (MNIST idx-gzip, CIFAR pickle batches) so
+on-disk datasets produced for the reference load unchanged. Downloads
+require network; in air-gapped environments point `root` at pre-fetched
+files.
+"""
+
+import gzip
+import os
+import pickle
+import struct
+import tarfile
+import warnings
+
+import numpy as onp
+
+from .... import ndarray as nd
+from ..dataset import Dataset, ArrayDataset, RecordFileDataset
+
+__all__ = ["MNIST", "FashionMNIST", "CIFAR10", "CIFAR100",
+           "ImageRecordDataset", "ImageFolderDataset", "ImageListDataset"]
+
+
+class _DownloadedDataset(Dataset):
+    def __init__(self, root, transform):
+        self._transform = transform
+        self._data = None
+        self._label = None
+        root = os.path.expanduser(root)
+        self._root = root
+        if not os.path.isdir(root):
+            os.makedirs(root, exist_ok=True)
+        self._get_data()
+
+    def __getitem__(self, idx):
+        if self._transform is not None:
+            return self._transform(self._data[idx], self._label[idx])
+        return self._data[idx], self._label[idx]
+
+    def __len__(self):
+        return len(self._label)
+
+    def _get_data(self):
+        raise NotImplementedError
+
+
+class MNIST(_DownloadedDataset):
+    """MNIST handwritten digits; reads the standard idx-gzip files."""
+
+    _namespace = "mnist"
+    _train_data = ("train-images-idx3-ubyte.gz", None)
+    _train_label = ("train-labels-idx1-ubyte.gz", None)
+    _test_data = ("t10k-images-idx3-ubyte.gz", None)
+    _test_label = ("t10k-labels-idx1-ubyte.gz", None)
+
+    def __init__(self, root=os.path.join("~", ".mxtpu", "datasets", "mnist"),
+                 train=True, transform=None):
+        self._train = train
+        super().__init__(root, transform)
+
+    def _fetch(self, fname):
+        path = os.path.join(self._root, fname)
+        if not os.path.exists(path):
+            # try non-gz sibling
+            alt = path[:-3]
+            if os.path.exists(alt):
+                return alt
+            from ...utils import download
+            url = ("https://ossci-datasets.s3.amazonaws.com/mnist/" + fname)
+            download(url, path=path)
+        return path
+
+    @staticmethod
+    def _read_idx(path):
+        opener = gzip.open if path.endswith(".gz") else open
+        with opener(path, "rb") as f:
+            data = f.read()
+        magic = struct.unpack(">I", data[:4])[0]
+        ndim = magic & 0xFF
+        dims = struct.unpack(">" + "I" * ndim, data[4:4 + 4 * ndim])
+        arr = onp.frombuffer(data, dtype=onp.uint8, offset=4 + 4 * ndim)
+        return arr.reshape(dims)
+
+    def _get_data(self):
+        data_f, label_f = ((self._train_data[0], self._train_label[0])
+                           if self._train else
+                           (self._test_data[0], self._test_label[0]))
+        images = self._read_idx(self._fetch(data_f))
+        labels = self._read_idx(self._fetch(label_f))
+        self._data = images.reshape(-1, 28, 28, 1)
+        self._label = labels.astype(onp.int32)
+
+
+class FashionMNIST(MNIST):
+    _namespace = "fashion-mnist"
+
+    def __init__(self, root=os.path.join("~", ".mxtpu", "datasets",
+                                         "fashion-mnist"),
+                 train=True, transform=None):
+        super().__init__(root=root, train=train, transform=transform)
+
+    def _fetch(self, fname):
+        path = os.path.join(self._root, fname)
+        if not os.path.exists(path):
+            alt = path[:-3]
+            if os.path.exists(alt):
+                return alt
+            from ...utils import download
+            url = ("http://fashion-mnist.s3-website.eu-central-1.amazonaws"
+                   ".com/" + fname)
+            download(url, path=path)
+        return path
+
+
+class CIFAR10(_DownloadedDataset):
+    """CIFAR-10; reads the python-pickle batch files."""
+
+    _archive = "cifar-10-python.tar.gz"
+    _dirname = "cifar-10-batches-py"
+    _train_batches = ["data_batch_%d" % i for i in range(1, 6)]
+    _test_batches = ["test_batch"]
+    _label_key = b"labels"
+
+    def __init__(self, root=os.path.join("~", ".mxtpu", "datasets", "cifar10"),
+                 train=True, transform=None):
+        self._train = train
+        super().__init__(root, transform)
+
+    def _extract(self):
+        d = os.path.join(self._root, self._dirname)
+        if os.path.isdir(d):
+            return d
+        archive = os.path.join(self._root, self._archive)
+        if not os.path.exists(archive):
+            from ...utils import download
+            download("https://www.cs.toronto.edu/~kriz/" + self._archive,
+                     path=archive)
+        with tarfile.open(archive) as tar:
+            tar.extractall(self._root)
+        return d
+
+    def _get_data(self):
+        d = self._extract()
+        batches = self._train_batches if self._train else self._test_batches
+        data, labels = [], []
+        for b in batches:
+            with open(os.path.join(d, b), "rb") as f:
+                entry = pickle.load(f, encoding="bytes")
+            data.append(entry[b"data"])
+            labels.extend(entry[self._label_key])
+        data = onp.concatenate(data).reshape(-1, 3, 32, 32)
+        self._data = data.transpose(0, 2, 3, 1)  # HWC like the reference
+        self._label = onp.asarray(labels, dtype=onp.int32)
+
+
+class CIFAR100(CIFAR10):
+    _archive = "cifar-100-python.tar.gz"
+    _dirname = "cifar-100-python"
+    _train_batches = ["train"]
+    _test_batches = ["test"]
+
+    def __init__(self, root=os.path.join("~", ".mxtpu", "datasets",
+                                         "cifar100"),
+                 fine_label=False, train=True, transform=None):
+        self._label_key = b"fine_labels" if fine_label else b"coarse_labels"
+        super().__init__(root=root, train=train, transform=transform)
+
+
+class ImageRecordDataset(RecordFileDataset):
+    """Images packed in a RecordIO file by im2rec (parity:
+    ImageRecordDataset): each record is IRHeader(label) + encoded image."""
+
+    def __init__(self, filename, flag=1, transform=None):
+        super().__init__(filename)
+        self._flag = flag
+        self._transform = transform
+
+    def __getitem__(self, idx):
+        from .... import image, recordio
+        record = super().__getitem__(idx)
+        header, img = recordio.unpack(record)
+        img = image.imdecode(img, self._flag)
+        label = header.label
+        if self._transform is not None:
+            return self._transform(img, label)
+        return img, label
+
+
+class ImageFolderDataset(Dataset):
+    """root/<class-name>/<image> layout (parity: ImageFolderDataset)."""
+
+    def __init__(self, root, flag=1, transform=None):
+        self._root = os.path.expanduser(root)
+        self._flag = flag
+        self._transform = transform
+        self._exts = [".jpg", ".jpeg", ".png", ".bmp"]
+        self._list_images(self._root)
+
+    def _list_images(self, root):
+        self.synsets = []
+        self.items = []
+        for folder in sorted(os.listdir(root)):
+            path = os.path.join(root, folder)
+            if not os.path.isdir(path):
+                warnings.warn("Ignoring %s, which is not a directory." % path)
+                continue
+            label = len(self.synsets)
+            self.synsets.append(folder)
+            for filename in sorted(os.listdir(path)):
+                filepath = os.path.join(path, filename)
+                ext = os.path.splitext(filename)[1].lower()
+                if ext not in self._exts:
+                    warnings.warn(
+                        "Ignoring %s of type %s. Only support %s" % (
+                            filepath, ext, ", ".join(self._exts)))
+                    continue
+                self.items.append((filepath, label))
+
+    def __getitem__(self, idx):
+        from .... import image
+        img = image.imread(self.items[idx][0], self._flag)
+        label = self.items[idx][1]
+        if self._transform is not None:
+            return self._transform(img, label)
+        return img, label
+
+    def __len__(self):
+        return len(self.items)
+
+
+class ImageListDataset(Dataset):
+    """Images given by an explicit (path, label) list file or list."""
+
+    def __init__(self, root=".", imglist=None, flag=1):
+        self._root = os.path.expanduser(root)
+        self._flag = flag
+        self.items = []
+        if isinstance(imglist, str):
+            with open(imglist) as f:
+                for line in f:
+                    parts = line.strip().split("\t")
+                    # .lst format: index \t label... \t path
+                    label = [float(x) for x in parts[1:-1]]
+                    self.items.append((parts[-1], onp.asarray(
+                        label if len(label) > 1 else label[0])))
+        else:
+            for item in imglist or []:
+                path, label = item[-1], item[:-1]
+                if len(label) == 1:
+                    label = label[0]
+                self.items.append((path, onp.asarray(label)))
+
+    def __getitem__(self, idx):
+        from .... import image
+        path = os.path.join(self._root, self.items[idx][0])
+        return image.imread(path, self._flag), self.items[idx][1]
+
+    def __len__(self):
+        return len(self.items)

@@ -1,0 +1,297 @@
+"""Vision transforms (parity: python/mxnet/gluon/data/vision/transforms.py).
+
+Transforms are Blocks operating on HWC images (uint8 NDArray or numpy).
+Deterministic tensor transforms (ToTensor, Normalize, Cast) are
+HybridBlocks — they run on-device and fuse into the jitted step; random
+augmentations run host-side in DataLoader workers (numpy), which is the
+right split for TPU: cheap branchy pixel work on host, dense math on chip.
+"""
+
+import numbers
+
+import numpy as onp
+
+from .... import ndarray as nd
+from ....ndarray import NDArray
+from ...block import Block, HybridBlock
+from ...nn import Sequential, HybridSequential
+
+__all__ = ["Compose", "Cast", "ToTensor", "Normalize", "Resize",
+           "CenterCrop", "RandomCrop", "RandomResizedCrop",
+           "RandomFlipLeftRight", "RandomFlipTopBottom", "RandomBrightness",
+           "RandomContrast", "RandomSaturation", "RandomHue",
+           "RandomColorJitter", "RandomLighting", "RandomGray"]
+
+
+def _to_np(x):
+    return x.asnumpy() if isinstance(x, NDArray) else onp.asarray(x)
+
+
+class Compose(Sequential):
+    """Sequentially compose transforms (parity: transforms.Compose)."""
+
+    def __init__(self, transforms):
+        super().__init__()
+        for t in transforms:
+            self.add(t)
+
+
+class Cast(HybridBlock):
+    def __init__(self, dtype="float32"):
+        super().__init__()
+        self._dtype = dtype
+
+    def hybrid_forward(self, F, x):
+        return x.astype(self._dtype)
+
+
+class ToTensor(HybridBlock):
+    """HWC uint8 [0,255] → CHW float32 [0,1] (parity: ToTensor)."""
+
+    def hybrid_forward(self, F, x):
+        x = x.astype("float32") / 255.0
+        if x.ndim == 3:
+            return F.transpose(x, (2, 0, 1))
+        return F.transpose(x, (0, 3, 1, 2))
+
+
+class Normalize(HybridBlock):
+    """Channel-wise (x - mean) / std on CHW float input."""
+
+    def __init__(self, mean=0.0, std=1.0):
+        super().__init__()
+        self._mean = mean
+        self._std = std
+
+    def hybrid_forward(self, F, x):
+        mean = onp.asarray(self._mean, dtype="float32").reshape(-1, 1, 1)
+        std = onp.asarray(self._std, dtype="float32").reshape(-1, 1, 1)
+        return (x - nd.array(mean, ctx=x.context)) / \
+            nd.array(std, ctx=x.context)
+
+
+class Resize(Block):
+    """Resize HWC image to `size` (w, h) or short-edge int."""
+
+    def __init__(self, size, keep_ratio=False, interpolation=1):
+        super().__init__()
+        self._size = size
+        self._keep = keep_ratio
+        self._interpolation = interpolation
+
+    def forward(self, x):
+        from .... import image
+        a = _to_np(x)
+        if isinstance(self._size, numbers.Number):
+            if self._keep:
+                h, w = a.shape[:2]
+                if w < h:
+                    new_w, new_h = self._size, int(h * self._size / w)
+                else:
+                    new_w, new_h = int(w * self._size / h), self._size
+            else:
+                new_w = new_h = self._size
+        else:
+            new_w, new_h = self._size
+        return nd.array(image.imresize_np(a, new_w, new_h,
+                                          self._interpolation))
+
+
+def _crop(a, x0, y0, w, h):
+    return a[y0:y0 + h, x0:x0 + w]
+
+
+class CenterCrop(Block):
+    def __init__(self, size, interpolation=1):
+        super().__init__()
+        self._size = (size, size) if isinstance(size, numbers.Number) else size
+        self._interpolation = interpolation
+
+    def forward(self, x):
+        from .... import image
+        a = _to_np(x)
+        w, h = self._size
+        H, W = a.shape[:2]
+        if W < w or H < h:
+            a = image.imresize_np(a, max(w, W), max(h, H),
+                                  self._interpolation)
+            H, W = a.shape[:2]
+        x0, y0 = (W - w) // 2, (H - h) // 2
+        return nd.array(_crop(a, x0, y0, w, h))
+
+
+class RandomCrop(Block):
+    def __init__(self, size, pad=None, interpolation=1):
+        super().__init__()
+        self._size = (size, size) if isinstance(size, numbers.Number) else size
+        self._pad = pad
+        self._interpolation = interpolation
+
+    def forward(self, x):
+        a = _to_np(x)
+        if self._pad:
+            p = self._pad
+            a = onp.pad(a, ((p, p), (p, p), (0, 0)), mode="constant")
+        w, h = self._size
+        H, W = a.shape[:2]
+        x0 = onp.random.randint(0, max(1, W - w + 1))
+        y0 = onp.random.randint(0, max(1, H - h + 1))
+        return nd.array(_crop(a, x0, y0, w, h))
+
+
+class RandomResizedCrop(Block):
+    """Random area+aspect crop then resize (the ImageNet train transform)."""
+
+    def __init__(self, size, scale=(0.08, 1.0), ratio=(3 / 4, 4 / 3),
+                 interpolation=1):
+        super().__init__()
+        self._size = (size, size) if isinstance(size, numbers.Number) else size
+        self._scale = scale
+        self._ratio = ratio
+        self._interpolation = interpolation
+
+    def forward(self, x):
+        from .... import image
+        a = _to_np(x)
+        H, W = a.shape[:2]
+        area = H * W
+        for _ in range(10):
+            target_area = onp.random.uniform(*self._scale) * area
+            log_ratio = (onp.log(self._ratio[0]), onp.log(self._ratio[1]))
+            aspect = onp.exp(onp.random.uniform(*log_ratio))
+            w = int(round(onp.sqrt(target_area * aspect)))
+            h = int(round(onp.sqrt(target_area / aspect)))
+            if w <= W and h <= H:
+                x0 = onp.random.randint(0, W - w + 1)
+                y0 = onp.random.randint(0, H - h + 1)
+                a = _crop(a, x0, y0, w, h)
+                return nd.array(image.imresize_np(
+                    a, self._size[0], self._size[1], self._interpolation))
+        # fallback: center crop
+        return CenterCrop(self._size, self._interpolation)(nd.array(a))
+
+
+class RandomFlipLeftRight(Block):
+    def __init__(self, p=0.5):
+        super().__init__()
+        self._p = p
+
+    def forward(self, x):
+        if onp.random.rand() < self._p:
+            return nd.array(_to_np(x)[:, ::-1])
+        return x if isinstance(x, NDArray) else nd.array(x)
+
+
+class RandomFlipTopBottom(Block):
+    def __init__(self, p=0.5):
+        super().__init__()
+        self._p = p
+
+    def forward(self, x):
+        if onp.random.rand() < self._p:
+            return nd.array(_to_np(x)[::-1])
+        return x if isinstance(x, NDArray) else nd.array(x)
+
+
+class _RandomPixelJitter(Block):
+    def __init__(self, factor):
+        super().__init__()
+        self._factor = factor
+
+    def _alpha(self):
+        return 1.0 + onp.random.uniform(-self._factor, self._factor)
+
+
+class RandomBrightness(_RandomPixelJitter):
+    def forward(self, x):
+        a = _to_np(x).astype("float32") * self._alpha()
+        return nd.array(onp.clip(a, 0, 255))
+
+
+class RandomContrast(_RandomPixelJitter):
+    def forward(self, x):
+        a = _to_np(x).astype("float32")
+        alpha = self._alpha()
+        gray = (a * _GRAY_COEF).sum(axis=-1).mean()
+        return nd.array(onp.clip(a * alpha + gray * (1 - alpha), 0, 255))
+
+
+_GRAY_COEF = onp.array([0.299, 0.587, 0.114], dtype="float32")
+
+
+class RandomSaturation(_RandomPixelJitter):
+    def forward(self, x):
+        a = _to_np(x).astype("float32")
+        alpha = self._alpha()
+        gray = (a * _GRAY_COEF).sum(axis=-1, keepdims=True)
+        return nd.array(onp.clip(a * alpha + gray * (1 - alpha), 0, 255))
+
+
+class RandomHue(_RandomPixelJitter):
+    def forward(self, x):
+        a = _to_np(x).astype("float32")
+        alpha = onp.random.uniform(-self._factor, self._factor)
+        u, w = onp.cos(alpha * onp.pi), onp.sin(alpha * onp.pi)
+        bt = onp.array([[1.0, 0.0, 0.0],
+                        [0.0, u, -w],
+                        [0.0, w, u]], dtype="float32")
+        t_yiq = onp.array([[0.299, 0.587, 0.114],
+                           [0.596, -0.274, -0.321],
+                           [0.211, -0.523, 0.311]], dtype="float32")
+        t_rgb = onp.array([[1.0, 0.956, 0.621],
+                           [1.0, -0.272, -0.647],
+                           [1.0, -1.107, 1.705]], dtype="float32")
+        m = t_rgb @ bt @ t_yiq
+        return nd.array(onp.clip(a @ m.T, 0, 255))
+
+
+class RandomColorJitter(Block):
+    def __init__(self, brightness=0, contrast=0, saturation=0, hue=0):
+        super().__init__()
+        self._ts = []
+        if brightness:
+            self._ts.append(RandomBrightness(brightness))
+        if contrast:
+            self._ts.append(RandomContrast(contrast))
+        if saturation:
+            self._ts.append(RandomSaturation(saturation))
+        if hue:
+            self._ts.append(RandomHue(hue))
+
+    def forward(self, x):
+        order = onp.random.permutation(len(self._ts))
+        for i in order:
+            x = self._ts[i](x)
+        return x
+
+
+class RandomLighting(Block):
+    """AlexNet-style PCA lighting noise."""
+
+    _eigval = onp.array([55.46, 4.794, 1.148], dtype="float32")
+    _eigvec = onp.array([[-0.5675, 0.7192, 0.4009],
+                         [-0.5808, -0.0045, -0.8140],
+                         [-0.5836, -0.6948, 0.4203]], dtype="float32")
+
+    def __init__(self, alpha):
+        super().__init__()
+        self._alpha = alpha
+
+    def forward(self, x):
+        a = _to_np(x).astype("float32")
+        alpha = onp.random.normal(0, self._alpha, size=(3,)).astype("float32")
+        rgb = (self._eigvec * alpha * self._eigval).sum(axis=1)
+        return nd.array(onp.clip(a + rgb, 0, 255))
+
+
+class RandomGray(Block):
+    def __init__(self, p=0.5):
+        super().__init__()
+        self._p = p
+
+    def forward(self, x):
+        if onp.random.rand() < self._p:
+            a = _to_np(x).astype("float32")
+            gray = (a * _GRAY_COEF).sum(axis=-1, keepdims=True)
+            return nd.array(onp.broadcast_to(gray, a.shape).copy())
+        return x if isinstance(x, NDArray) else nd.array(x)

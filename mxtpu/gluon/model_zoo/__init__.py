@@ -1,0 +1,4 @@
+"""Model zoo (parity: python/mxnet/gluon/model_zoo/)."""
+
+from . import vision
+from .vision import get_model

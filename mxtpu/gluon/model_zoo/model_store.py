@@ -1,0 +1,30 @@
+"""Pretrained model store (parity: gluon/model_zoo/model_store.py).
+
+Weights download requires network access; in air-gapped environments place
+``<name>.params`` files under the root directory and they load directly.
+"""
+
+import os
+
+__all__ = ["get_model_file", "purge"]
+
+_DEFAULT_ROOT = os.path.join("~", ".mxtpu", "models")
+
+
+def get_model_file(name, root=None):
+    root = os.path.expanduser(root or _DEFAULT_ROOT)
+    path = os.path.join(root, "%s.params" % name)
+    if os.path.exists(path):
+        return path
+    raise FileNotFoundError(
+        "Pretrained weights %s.params not found under %s. Download "
+        "requires network access; place the file there manually in "
+        "air-gapped environments." % (name, root))
+
+
+def purge(root=None):
+    root = os.path.expanduser(root or _DEFAULT_ROOT)
+    if os.path.isdir(root):
+        for f in os.listdir(root):
+            if f.endswith(".params"):
+                os.remove(os.path.join(root, f))

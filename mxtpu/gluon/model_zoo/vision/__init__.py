@@ -1,0 +1,38 @@
+"""Vision model zoo (parity: gluon/model_zoo/vision/__init__.py).
+
+All architectures of the reference zoo: ResNet v1/v2 (18-152), VGG
+(11-19, +BN), AlexNet, DenseNet (121-201), Inception-V3, MobileNet
+v1/v2 (multiplier variants), SqueezeNet (1.0/1.1).
+"""
+
+from .resnet import *
+from .vgg import *
+from .alexnet import *
+from .densenet import *
+from .inception import *
+from .mobilenet import *
+from .squeezenet import *
+from .resnet import get_resnet
+from .vgg import get_vgg
+from .mobilenet import get_mobilenet, get_mobilenet_v2
+
+
+def get_model(name, **kwargs):
+    """Look up a model by zoo name (parity: vision.get_model)."""
+    from . import resnet, vgg, alexnet, densenet, inception, mobilenet, \
+        squeezenet
+    models = {}
+    for mod in (resnet, vgg, alexnet, densenet, inception, mobilenet,
+                squeezenet):
+        for fname in mod.__all__:
+            if fname.startswith(("get_", "Basic", "Bottleneck", "ResNet",
+                                 "VGG", "AlexNet", "DenseNet", "Inception",
+                                 "MobileNet", "SqueezeNet")):
+                continue
+            models[fname] = getattr(mod, fname)
+    name = name.lower()
+    if name not in models:
+        raise ValueError(
+            "Model %s is not supported. Available: %s" % (
+                name, sorted(models.keys())))
+    return models[name](**kwargs)

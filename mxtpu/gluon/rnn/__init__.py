@@ -1,0 +1,7 @@
+"""Gluon RNN API (parity: python/mxnet/gluon/rnn/)."""
+
+from .rnn_cell import (RecurrentCell, HybridRecurrentCell, RNNCell, LSTMCell,
+                       GRUCell, SequentialRNNCell, HybridSequentialRNNCell,
+                       DropoutCell, ZoneoutCell, ResidualCell,
+                       BidirectionalCell)
+from .rnn_layer import RNN, LSTM, GRU

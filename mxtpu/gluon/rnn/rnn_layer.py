@@ -1,0 +1,218 @@
+"""Fused RNN layers (parity: python/mxnet/gluon/rnn/rnn_layer.py — RNN,
+LSTM, GRU backed by the fused ``rnn`` op; reference backend
+src/operator/rnn.cc + cudnn_rnn-inl.h, here ops.nn.rnn over lax.scan)."""
+
+from ... import ndarray as nd
+from ...ndarray import NDArray
+from ..block import HybridBlock
+from ..parameter import tensor_types
+
+__all__ = ["RNN", "LSTM", "GRU"]
+
+
+class _RNNLayer(HybridBlock):
+    """Base for fused recurrent layers."""
+
+    def __init__(self, hidden_size, num_layers, layout, dropout,
+                 bidirectional, input_size, i2h_weight_initializer,
+                 h2h_weight_initializer, i2h_bias_initializer,
+                 h2h_bias_initializer, mode, projection_size=None, **kwargs):
+        super().__init__(**kwargs)
+        assert layout in ("TNC", "NTC"), \
+            "Invalid layout %s; must be one of ['TNC', 'NTC']" % layout
+        self._hidden_size = hidden_size
+        self._projection_size = projection_size
+        self._num_layers = num_layers
+        self._mode = mode
+        self._layout = layout
+        self._dropout = dropout
+        self._dir = 2 if bidirectional else 1
+        self._input_size = input_size
+        self._i2h_weight_initializer = i2h_weight_initializer
+        self._h2h_weight_initializer = h2h_weight_initializer
+        self._i2h_bias_initializer = i2h_bias_initializer
+        self._h2h_bias_initializer = h2h_bias_initializer
+        self._gates = {"rnn_relu": 1, "rnn_tanh": 1, "lstm": 4, "gru": 3}[mode]
+        ng, ni, nh = self._gates, input_size, hidden_size
+        nout = projection_size if projection_size else hidden_size
+        for i in range(num_layers):
+            for j in ["l", "r"][:self._dir]:
+                self._register_param(
+                    "{}{}_i2h_weight".format(j, i), (ng * nh, ni),
+                    i2h_weight_initializer)
+                self._register_param(
+                    "{}{}_h2h_weight".format(j, i), (ng * nh, nout),
+                    h2h_weight_initializer)
+                self._register_param(
+                    "{}{}_i2h_bias".format(j, i), (ng * nh,),
+                    i2h_bias_initializer)
+                self._register_param(
+                    "{}{}_h2h_bias".format(j, i), (ng * nh,),
+                    h2h_bias_initializer)
+                if projection_size:
+                    self._register_param(
+                        "{}{}_h2r_weight".format(j, i), (projection_size, nh),
+                        h2h_weight_initializer)
+            ni = nout * self._dir
+
+    def _register_param(self, name, shape, init):
+        p = self.params.get(name, shape=shape, init=init,
+                            allow_deferred_init=True)
+        setattr(self, name, p)
+
+    def __repr__(self):
+        s = "{name}({mapping}, {_layout}"
+        if self._num_layers != 1:
+            s += ", num_layers={_num_layers}"
+        if self._dropout != 0:
+            s += ", dropout={_dropout}"
+        if self._dir == 2:
+            s += ", bidirectional"
+        s += ")"
+        shape = self.l0_i2h_weight.shape
+        mapping = "{0} -> {1}".format(
+            shape[1] if shape[1] else None, shape[0] // self._gates)
+        return s.format(name=self.__class__.__name__, mapping=mapping,
+                        **self.__dict__)
+
+    def _collect_params_with_prefix(self, prefix=""):
+        # parity quirk: fused-layer params serialize without the lN_ grouping
+        return super()._collect_params_with_prefix(prefix)
+
+    def state_info(self, batch_size=0):
+        raise NotImplementedError
+
+    def begin_state(self, batch_size=0, func=None, **kwargs):
+        """Initial recurrent states (parity: _RNNLayer.begin_state)."""
+        if func is None:
+            func = nd.zeros
+        states = []
+        for i, info in enumerate(self.state_info(batch_size)):
+            if info is not None:
+                info = dict(info)
+                info.update(kwargs)
+            else:
+                info = dict(kwargs)
+            info.pop("__layout__", None)
+            if info.get("ctx") is None:
+                info.pop("ctx", None)
+            states.append(func(**info))
+        return states
+
+    def infer_shape(self, inputs, *args):
+        assert inputs.ndim == 3, \
+            "Input data should be rank-3 tensor of dim [T, N, C] or [N, T, C]"
+        ch = inputs.shape[2]
+        ni = ch
+        nout = self._projection_size or self._hidden_size
+        for i in range(self._num_layers):
+            for j in ["l", "r"][:self._dir]:
+                p = getattr(self, "{}{}_i2h_weight".format(j, i))
+                if 0 in p.shape:
+                    p.shape = (self._gates * self._hidden_size, ni)
+            ni = nout * self._dir
+
+    def hybrid_forward(self, F, inputs, states=None, sequence_length=None,
+                       **params):
+        if self._layout == "NTC":
+            inputs = F.swapaxes(inputs, 0, 1)
+        batch_size = inputs.shape[1]
+        skip_states = states is None
+        if skip_states:
+            states = self.begin_state(batch_size,
+                                      ctx=getattr(inputs, "context", None),
+                                      dtype=inputs.dtype)
+        if isinstance(states, NDArray):
+            states = [states]
+        # pack params into the cuDNN-layout vector the fused op expects
+        flat = []
+        for i in range(self._num_layers):
+            for j in ["l", "r"][:self._dir]:
+                flat.append(params["{}{}_i2h_weight".format(j, i)].reshape(-1))
+                flat.append(params["{}{}_h2h_weight".format(j, i)].reshape(-1))
+        for i in range(self._num_layers):
+            for j in ["l", "r"][:self._dir]:
+                flat.append(params["{}{}_i2h_bias".format(j, i)].reshape(-1))
+                flat.append(params["{}{}_h2h_bias".format(j, i)].reshape(-1))
+        if self._projection_size:
+            for i in range(self._num_layers):
+                for j in ["l", "r"][:self._dir]:
+                    flat.append(
+                        params["{}{}_h2r_weight".format(j, i)].reshape(-1))
+        packed = F.concat(*flat, dim=0) if len(flat) > 1 else flat[0]
+        rnn_args = [packed] + list(states)
+        out = F.RNN(inputs, *rnn_args, state_size=self._hidden_size,
+                    num_layers=self._num_layers, mode=self._mode,
+                    bidirectional=self._dir == 2, p=self._dropout,
+                    state_outputs=True, sequence_length=sequence_length,
+                    use_sequence_length=sequence_length is not None,
+                    projection_size=self._projection_size)
+        outputs, states = out[0], list(out[1:])
+        if self._layout == "NTC":
+            outputs = nd.swapaxes(outputs, 0, 1)
+        if skip_states:
+            return outputs
+        return outputs, states
+
+    def forward(self, inputs, states=None, sequence_length=None):
+        return super().forward(inputs, states, sequence_length)
+
+
+class RNN(_RNNLayer):
+    """Multi-layer Elman RNN (relu or tanh) (parity: rnn.RNN)."""
+
+    def __init__(self, hidden_size, num_layers=1, activation="relu",
+                 layout="TNC", dropout=0, bidirectional=False,
+                 i2h_weight_initializer=None, h2h_weight_initializer=None,
+                 i2h_bias_initializer="zeros", h2h_bias_initializer="zeros",
+                 input_size=0, **kwargs):
+        super().__init__(hidden_size, num_layers, layout, dropout,
+                         bidirectional, input_size,
+                         i2h_weight_initializer, h2h_weight_initializer,
+                         i2h_bias_initializer, h2h_bias_initializer,
+                         "rnn_" + activation, **kwargs)
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (self._num_layers * self._dir, batch_size,
+                           self._hidden_size), "__layout__": "LNC"}]
+
+
+class LSTM(_RNNLayer):
+    """Multi-layer LSTM (parity: rnn.LSTM)."""
+
+    def __init__(self, hidden_size, num_layers=1, layout="TNC", dropout=0,
+                 bidirectional=False, input_size=0,
+                 i2h_weight_initializer=None, h2h_weight_initializer=None,
+                 i2h_bias_initializer="zeros", h2h_bias_initializer="zeros",
+                 projection_size=None, **kwargs):
+        super().__init__(hidden_size, num_layers, layout, dropout,
+                         bidirectional, input_size,
+                         i2h_weight_initializer, h2h_weight_initializer,
+                         i2h_bias_initializer, h2h_bias_initializer,
+                         "lstm", projection_size, **kwargs)
+
+    def state_info(self, batch_size=0):
+        h_size = self._projection_size or self._hidden_size
+        return [{"shape": (self._num_layers * self._dir, batch_size,
+                           h_size), "__layout__": "LNC"},
+                {"shape": (self._num_layers * self._dir, batch_size,
+                           self._hidden_size), "__layout__": "LNC"}]
+
+
+class GRU(_RNNLayer):
+    """Multi-layer GRU (parity: rnn.GRU)."""
+
+    def __init__(self, hidden_size, num_layers=1, layout="TNC", dropout=0,
+                 bidirectional=False, input_size=0,
+                 i2h_weight_initializer=None, h2h_weight_initializer=None,
+                 i2h_bias_initializer="zeros", h2h_bias_initializer="zeros",
+                 **kwargs):
+        super().__init__(hidden_size, num_layers, layout, dropout,
+                         bidirectional, input_size,
+                         i2h_weight_initializer, h2h_weight_initializer,
+                         i2h_bias_initializer, h2h_bias_initializer,
+                         "gru", **kwargs)
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (self._num_layers * self._dir, batch_size,
+                           self._hidden_size), "__layout__": "LNC"}]

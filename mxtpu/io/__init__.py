@@ -1,0 +1,15 @@
+"""Data iterators (parity: python/mxnet/io/io.py + src/io/ C++ iterators).
+
+The reference's C++ threaded iterators (MNISTIter, ImageRecordIter, CSVIter
+— src/io/iter_mnist.cc, iter_image_recordio_2.cc, iter_csv.cc) become
+Python iterators here; host-side threading for prefetch lives in
+PrefetchingIter and gluon.data.DataLoader.
+"""
+
+from .io import (DataDesc, DataBatch, DataIter, NDArrayIter, ResizeIter,
+                 PrefetchingIter, CSVIter, MNISTIter, ImageRecordIter,
+                 LibSVMIter)
+
+__all__ = ["DataDesc", "DataBatch", "DataIter", "NDArrayIter", "ResizeIter",
+           "PrefetchingIter", "CSVIter", "MNISTIter", "ImageRecordIter",
+           "LibSVMIter"]

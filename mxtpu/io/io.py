@@ -1,0 +1,487 @@
+"""DataIter implementations (parity: python/mxnet/io/io.py)."""
+
+import collections
+import queue as _queue
+import threading
+
+import numpy as onp
+
+from .. import ndarray as nd
+from ..ndarray import NDArray
+
+__all__ = ["DataDesc", "DataBatch", "DataIter", "NDArrayIter", "ResizeIter",
+           "PrefetchingIter", "CSVIter", "MNISTIter", "ImageRecordIter",
+           "LibSVMIter"]
+
+
+class DataDesc(collections.namedtuple("DataDesc", ["name", "shape"])):
+    """Name/shape/dtype/layout of one input (parity: io.DataDesc)."""
+
+    def __new__(cls, name, shape, dtype="float32", layout="NCHW"):
+        ret = super().__new__(cls, name, shape)
+        ret.dtype = dtype
+        ret.layout = layout
+        return ret
+
+    def __repr__(self):
+        return "DataDesc[%s,%s,%s,%s]" % (self.name, self.shape, self.dtype,
+                                          self.layout)
+
+    @staticmethod
+    def get_batch_axis(layout):
+        if layout is None:
+            return 0
+        return layout.find("N")
+
+
+class DataBatch:
+    """One minibatch: data list + label list (+ pad/index/bucket_key)."""
+
+    def __init__(self, data, label=None, pad=None, index=None,
+                 bucket_key=None, provide_data=None, provide_label=None):
+        if data is not None:
+            assert isinstance(data, (list, tuple)), "data must be a list"
+        if label is not None:
+            assert isinstance(label, (list, tuple)), "label must be a list"
+        self.data = data
+        self.label = label
+        self.pad = pad
+        self.index = index
+        self.bucket_key = bucket_key
+        self.provide_data = provide_data
+        self.provide_label = provide_label
+
+    def __str__(self):
+        data_shapes = [d.shape for d in self.data]
+        if self.label:
+            label_shapes = [l.shape for l in self.label]
+        else:
+            label_shapes = None
+        return "{}: data shapes: {} label shapes: {}".format(
+            self.__class__.__name__, data_shapes, label_shapes)
+
+
+class DataIter:
+    """Iterator base (parity: io.DataIter)."""
+
+    def __init__(self, batch_size=0):
+        self.batch_size = batch_size
+
+    def __iter__(self):
+        return self
+
+    def reset(self):
+        pass
+
+    def next(self):
+        if self.iter_next():
+            return DataBatch(data=self.getdata(), label=self.getlabel(),
+                             pad=self.getpad(), index=self.getindex())
+        raise StopIteration
+
+    def __next__(self):
+        return self.next()
+
+    def iter_next(self):
+        raise NotImplementedError
+
+    def getdata(self):
+        raise NotImplementedError
+
+    def getlabel(self):
+        raise NotImplementedError
+
+    def getindex(self):
+        return None
+
+    def getpad(self):
+        raise NotImplementedError
+
+
+def _init_data(data, allow_empty, default_name):
+    """Normalize input data to list of (name, NDArray)."""
+    assert data is not None or allow_empty
+    if data is None:
+        data = []
+    if isinstance(data, (onp.ndarray, NDArray)):
+        data = [data]
+    if isinstance(data, (list, tuple)):
+        if not allow_empty:
+            assert len(data) > 0
+        if len(data) == 1:
+            data = collections.OrderedDict([(default_name, data[0])])
+        else:
+            data = collections.OrderedDict(
+                [("_%d_%s" % (i, default_name), d)
+                 for i, d in enumerate(data)])
+    if not isinstance(data, dict):
+        raise TypeError("Input must be NDArray, numpy.ndarray, a list of "
+                        "them or dict with them as values")
+    out = collections.OrderedDict()
+    for k, v in data.items():
+        if not isinstance(v, NDArray):
+            try:
+                v = nd.array(v)
+            except Exception:
+                raise TypeError("Invalid type '%s' for %s" % (type(v), k))
+        out[k] = v
+    return list(out.items())
+
+
+class NDArrayIter(DataIter):
+    """Iterate over in-memory arrays (parity: io.NDArrayIter), incl.
+    last_batch_handle pad/discard/roll_over."""
+
+    def __init__(self, data, label=None, batch_size=1, shuffle=False,
+                 last_batch_handle="pad", data_name="data",
+                 label_name="softmax_label"):
+        super().__init__(batch_size)
+        self.data = _init_data(data, allow_empty=False,
+                               default_name=data_name)
+        self.label = _init_data(label, allow_empty=True,
+                                default_name=label_name)
+        self._base_idx = onp.arange(self.data[0][1].shape[0])
+        self.idx = self._base_idx
+        self.shuffle = shuffle
+        self.last_batch_handle = last_batch_handle
+        self.num_data = self._base_idx.shape[0]
+        assert self.num_data >= batch_size, \
+            "batch_size needs to be smaller than data size."
+        self.cursor = -batch_size
+        # roll_over: indices of the incomplete tail batch, replayed at the
+        # head of the next epoch (keeps every emitted batch full-sized —
+        # the static-shape-friendly choice for jitted TPU steps)
+        self._rollover_idx = None
+        self.reset()
+
+    @property
+    def provide_data(self):
+        return [DataDesc(k, (self.batch_size,) + tuple(v.shape[1:]),
+                         str(v.dtype)) for k, v in self.data]
+
+    @property
+    def provide_label(self):
+        return [DataDesc(k, (self.batch_size,) + tuple(v.shape[1:]),
+                         str(v.dtype)) for k, v in self.label]
+
+    def reset(self):
+        idx = self._base_idx.copy()
+        if self.shuffle:
+            onp.random.shuffle(idx)
+        if self.last_batch_handle == "roll_over" and \
+                self._rollover_idx is not None:
+            idx = onp.concatenate([self._rollover_idx, idx])
+            self._rollover_idx = None
+        self.idx = idx
+        self.num_data = idx.shape[0]
+        self.cursor = -self.batch_size
+
+    def iter_next(self):
+        self.cursor += self.batch_size
+        return self.cursor < self.num_data
+
+    def next(self):
+        if not self.iter_next():
+            raise StopIteration
+        if self.cursor + self.batch_size > self.num_data:
+            if self.last_batch_handle == "discard":
+                raise StopIteration
+            if self.last_batch_handle == "roll_over":
+                self._rollover_idx = self.idx[self.cursor:self.num_data]
+                raise StopIteration
+        return DataBatch(data=self.getdata(), label=self.getlabel(),
+                         pad=self.getpad(), index=None)
+
+    def _getdata(self, data_source):
+        end = min(self.cursor + self.batch_size, self.num_data)
+        sel = self.idx[self.cursor:end]
+        pad = self.cursor + self.batch_size - self.num_data
+        if pad > 0 and self.last_batch_handle == "pad":
+            sel = onp.concatenate([sel, self.idx[:pad]])
+        out = []
+        for _, v in data_source:
+            a = v.asnumpy()[sel]
+            out.append(nd.array(a, dtype=v.dtype))
+        return out
+
+    def getdata(self):
+        return self._getdata(self.data)
+
+    def getlabel(self):
+        return self._getdata(self.label)
+
+    def getpad(self):
+        if (self.last_batch_handle == "pad"
+                and self.cursor + self.batch_size > self.num_data):
+            return self.cursor + self.batch_size - self.num_data
+        return 0
+
+
+class ResizeIter(DataIter):
+    """Resize an iterator to `size` batches per epoch (parity: ResizeIter)."""
+
+    def __init__(self, data_iter, size, reset_internal=True):
+        super().__init__(data_iter.batch_size)
+        self.data_iter = data_iter
+        self.size = size
+        self.reset_internal = reset_internal
+        self.cur = 0
+        self.current_batch = None
+        self.provide_data = data_iter.provide_data
+        self.provide_label = data_iter.provide_label
+
+    def reset(self):
+        self.cur = 0
+        if self.reset_internal:
+            self.data_iter.reset()
+
+    def iter_next(self):
+        if self.cur == self.size:
+            return False
+        try:
+            self.current_batch = self.data_iter.next()
+        except StopIteration:
+            self.data_iter.reset()
+            self.current_batch = self.data_iter.next()
+        self.cur += 1
+        return True
+
+    def next(self):
+        if self.iter_next():
+            return self.current_batch
+        raise StopIteration
+
+    def getdata(self):
+        return self.current_batch.data
+
+    def getlabel(self):
+        return self.current_batch.label
+
+    def getindex(self):
+        return self.current_batch.index
+
+    def getpad(self):
+        return self.current_batch.pad
+
+
+class PrefetchingIter(DataIter):
+    """Background-thread prefetch over one or more iterators (parity:
+    io.PrefetchingIter; replaces src/io/iter_prefetcher.h)."""
+
+    def __init__(self, iters, rename_data=None, rename_label=None):
+        if not isinstance(iters, (list, tuple)):
+            iters = [iters]
+        super().__init__(iters[0].batch_size)
+        self.iters = iters
+        self.rename_data = rename_data
+        self.rename_label = rename_label
+        self._queue = _queue.Queue(maxsize=2)
+        self._stop = threading.Event()
+        self._exc = None
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+        self.current_batch = None
+
+    @property
+    def provide_data(self):
+        out = []
+        for i, it in enumerate(self.iters):
+            descs = it.provide_data
+            if self.rename_data:
+                descs = [DataDesc(self.rename_data[i].get(d.name, d.name),
+                                  d.shape, d.dtype) for d in descs]
+            out.extend(descs)
+        return out
+
+    @property
+    def provide_label(self):
+        out = []
+        for i, it in enumerate(self.iters):
+            descs = it.provide_label
+            if self.rename_label:
+                descs = [DataDesc(self.rename_label[i].get(d.name, d.name),
+                                  d.shape, d.dtype) for d in descs]
+            out.extend(descs)
+        return out
+
+    def _run(self):
+        try:
+            while not self._stop.is_set():
+                try:
+                    batches = [it.next() for it in self.iters]
+                except StopIteration:
+                    self._queue.put(None)
+                    return
+                # bounded put that stays responsive to reset()/shutdown
+                while not self._stop.is_set():
+                    try:
+                        self._queue.put(batches, timeout=0.1)
+                        break
+                    except _queue.Full:
+                        continue
+        except Exception as e:  # surface in the consumer, don't deadlock it
+            self._exc = e
+            self._queue.put(None)
+
+    def reset(self):
+        # drain until the worker actually exits — resetting the sources
+        # under a live worker's feet would interleave two readers
+        self._stop.set()
+        while self._thread.is_alive():
+            try:
+                self._queue.get_nowait()
+            except _queue.Empty:
+                pass
+            self._thread.join(timeout=0.05)
+        for it in self.iters:
+            it.reset()
+        self._queue = _queue.Queue(maxsize=2)
+        self._stop = threading.Event()
+        self._exc = None
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    def iter_next(self):
+        batches = self._queue.get()
+        if batches is None:
+            if self._exc is not None:
+                exc, self._exc = self._exc, None
+                raise exc
+            return False
+        if len(batches) == 1:
+            self.current_batch = batches[0]
+        else:
+            self.current_batch = DataBatch(
+                data=sum([b.data for b in batches], []),
+                label=sum([b.label for b in batches], []),
+                pad=batches[0].pad)
+        return True
+
+    def next(self):
+        if self.iter_next():
+            return self.current_batch
+        raise StopIteration
+
+    def getdata(self):
+        return self.current_batch.data
+
+    def getlabel(self):
+        return self.current_batch.label
+
+    def getpad(self):
+        return self.current_batch.pad
+
+
+class CSVIter(DataIter):
+    """CSV file iterator (parity: src/io/iter_csv.cc)."""
+
+    def __init__(self, data_csv, data_shape, label_csv=None, label_shape=(1,),
+                 batch_size=1, round_batch=True, dtype="float32", **kwargs):
+        super().__init__(batch_size)
+        data = onp.loadtxt(data_csv, delimiter=",",
+                           dtype=dtype).reshape((-1,) + tuple(data_shape))
+        if label_csv is not None:
+            label = onp.loadtxt(label_csv, delimiter=",", dtype="float32")
+            label = label.reshape((len(data),) + tuple(label_shape)).squeeze()
+        else:
+            label = onp.zeros((len(data),), dtype="float32")
+        self._inner = NDArrayIter(
+            data, label, batch_size,
+            last_batch_handle="roll_over" if round_batch else "pad")
+        self.provide_data = self._inner.provide_data
+        self.provide_label = self._inner.provide_label
+
+    def reset(self):
+        self._inner.reset()
+
+    def next(self):
+        return self._inner.next()
+
+
+class MNISTIter(DataIter):
+    """MNIST idx-file iterator (parity: src/io/iter_mnist.cc)."""
+
+    def __init__(self, image, label, batch_size=128, shuffle=True, flat=False,
+                 silent=False, seed=0, part_index=0, num_parts=1, **kwargs):
+        super().__init__(batch_size)
+        from ..gluon.data.vision.datasets import MNIST
+        imgs = MNIST._read_idx(image).astype("float32") / 255.0
+        lbls = MNIST._read_idx(label).astype("float32")
+        if num_parts > 1:
+            imgs = imgs[part_index::num_parts]
+            lbls = lbls[part_index::num_parts]
+        if flat:
+            imgs = imgs.reshape(len(imgs), -1)
+        else:
+            imgs = imgs.reshape(len(imgs), 1, 28, 28)
+        self._inner = NDArrayIter(imgs, lbls, batch_size, shuffle=shuffle)
+        self.provide_data = self._inner.provide_data
+        self.provide_label = self._inner.provide_label
+
+    def reset(self):
+        self._inner.reset()
+
+    def next(self):
+        return self._inner.next()
+
+
+def ImageRecordIter(path_imgrec=None, data_shape=(3, 224, 224),
+                    batch_size=128, label_width=1, preprocess_threads=4,
+                    **kwargs):
+    """RecordIO image iterator (parity: src/io/iter_image_recordio_2.cc).
+    Returns an ImageIter configured from ImageRecordIter-style kwargs."""
+    from ..image import ImageIter
+    aug_kwargs = {}
+    for k in ("resize", "rand_crop", "rand_mirror", "mean", "std",
+              "brightness", "contrast", "saturation", "hue", "pca_noise",
+              "inter_method", "rand_resize"):
+        if k in kwargs:
+            aug_kwargs[k] = kwargs.pop(k)
+    if kwargs.pop("rand_resize_crop", False):
+        aug_kwargs["rand_crop"] = aug_kwargs.get("rand_crop", True)
+        aug_kwargs["rand_resize"] = True
+    mean_rgb = [kwargs.pop("mean_r", None), kwargs.pop("mean_g", None),
+                kwargs.pop("mean_b", None)]
+    if any(v is not None for v in mean_rgb):
+        aug_kwargs["mean"] = onp.array([v or 0.0 for v in mean_rgb])
+    std_rgb = [kwargs.pop("std_r", None), kwargs.pop("std_g", None),
+               kwargs.pop("std_b", None)]
+    if any(v is not None for v in std_rgb):
+        aug_kwargs["std"] = onp.array([v or 1.0 for v in std_rgb])
+    shuffle = kwargs.pop("shuffle", False)
+    return ImageIter(batch_size=batch_size, data_shape=data_shape,
+                     label_width=label_width, path_imgrec=path_imgrec,
+                     shuffle=shuffle, **aug_kwargs)
+
+
+class LibSVMIter(DataIter):
+    """LibSVM sparse-format iterator; materializes dense (sparse NDArray is
+    dense-backed in v1 — SURVEY.md §7 hard-part 6)."""
+
+    def __init__(self, data_libsvm, data_shape, label_shape=(1,),
+                 batch_size=1, **kwargs):
+        super().__init__(batch_size)
+        num_features = int(onp.prod(data_shape))
+        rows, labels = [], []
+        with open(data_libsvm) as fin:
+            for line in fin:
+                parts = line.strip().split()
+                if not parts:
+                    continue
+                labels.append(float(parts[0]))
+                row = onp.zeros(num_features, dtype="float32")
+                for tok in parts[1:]:
+                    k, v = tok.split(":")
+                    row[int(k)] = float(v)
+                rows.append(row)
+        data = onp.stack(rows).reshape((-1,) + tuple(data_shape))
+        self._inner = NDArrayIter(data, onp.asarray(labels, dtype="float32"),
+                                  batch_size)
+        self.provide_data = self._inner.provide_data
+        self.provide_label = self._inner.provide_label
+
+    def reset(self):
+        self._inner.reset()
+
+    def next(self):
+        return self._inner.next()

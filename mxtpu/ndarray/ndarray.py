@@ -212,8 +212,10 @@ class NDArray:
         self._rebind(self._data.at[key].set(value))
 
     def __getitem__(self, key):
+        # routed through the op registry so the autograd tape records the
+        # gather (a bare self._data[key] would silently break the chain)
         key = _translate_index(key)
-        return _wrap_result(self._data[key], None)
+        return invoke_op("_internal_getitem", (self,), {"key": key})
 
     # -- shape ops (method forms) ---------------------------------------
     def reshape(self, *shape, **kwargs):
@@ -546,8 +548,9 @@ def invoke_op(name: str, args: tuple, kwargs: dict):
 
 
 # ops whose behavior depends on autograd train/predict mode or RNG
-_NEEDS_TRAIN_FLAG = {"Dropout", "dropout", "BatchNorm", "batch_norm"}
-_NEEDS_KEY = {"Dropout", "dropout"}
+_NEEDS_TRAIN_FLAG = {"Dropout", "dropout", "BatchNorm", "batch_norm",
+                     "RNN", "rnn"}
+_NEEDS_KEY = {"Dropout", "dropout", "RNN", "rnn"}
 
 
 def array(source, ctx: Optional[Context] = None, dtype=None) -> NDArray:

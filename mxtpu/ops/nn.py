@@ -465,3 +465,193 @@ def ctc_loss(data, label=None, data_lengths=None, label_lengths=None,
         label_paddings = (labels < 1).astype(jnp.float32)
     return optax.ctc_loss(logits, logit_paddings, labels, label_paddings,
                           blank_id=0)
+
+
+# ----------------------------------------------------------------- fused RNN
+
+def _rnn_param_sizes(mode, input_size, state_size, num_layers, bidirectional,
+                     projection_size=None):
+    """Per-(layer, direction) packed weight/bias shapes in cuDNN order
+    (parity: src/operator/rnn-inl.h GetRnnParamSize). With projection_size
+    (LSTMP), h2h consumes the projected state and per-cell h2r projection
+    weights are appended after all biases."""
+    ngates = {"rnn_relu": 1, "rnn_tanh": 1, "lstm": 4, "gru": 3}[mode]
+    dirs = 2 if bidirectional else 1
+    hid_out = projection_size if projection_size else state_size
+    shapes = []
+    for layer in range(num_layers):
+        in_size = input_size if layer == 0 else hid_out * dirs
+        for _ in range(dirs):
+            shapes.append(("i2h_w", (ngates * state_size, in_size)))
+            shapes.append(("h2h_w", (ngates * state_size, hid_out)))
+    for layer in range(num_layers):
+        for _ in range(dirs):
+            shapes.append(("i2h_b", (ngates * state_size,)))
+            shapes.append(("h2h_b", (ngates * state_size,)))
+    if projection_size:
+        for layer in range(num_layers):
+            for _ in range(dirs):
+                shapes.append(("h2r_w", (projection_size, state_size)))
+    return ngates, dirs, shapes
+
+
+def rnn_param_count(mode, input_size, state_size, num_layers, bidirectional,
+                    projection_size=None):
+    import math
+    _, _, shapes = _rnn_param_sizes(mode, input_size, state_size, num_layers,
+                                    bidirectional, projection_size)
+    return sum(math.prod(s) for _, s in shapes)
+
+
+def _unpack_rnn_params(params, mode, input_size, state_size, num_layers,
+                       bidirectional, projection_size=None):
+    ngates, dirs, shapes = _rnn_param_sizes(
+        mode, input_size, state_size, num_layers, bidirectional,
+        projection_size)
+    out = []
+    offset = 0
+    for _, shape in shapes:
+        size = 1
+        for d in shape:
+            size *= d
+        out.append(params[offset:offset + size].reshape(shape))
+        offset += size
+    # regroup: weights first (2 per layer-dir), then biases, then projections
+    n = num_layers * dirs
+    cells = []
+    for i in range(n):
+        i2h_w, h2h_w = out[2 * i], out[2 * i + 1]
+        i2h_b, h2h_b = out[2 * n + 2 * i], out[2 * n + 2 * i + 1]
+        h2r_w = out[4 * n + i] if projection_size else None
+        cells.append((i2h_w, h2h_w, i2h_b, h2h_b, h2r_w))
+    return cells
+
+
+def _rnn_cell_step(mode, w, carry, x):
+    """One timestep. carry: (h,) or (h, c). x: (B, in). Returns new carry +
+    output h."""
+    i2h_w, h2h_w, i2h_b, h2h_b, h2r_w = w
+    if mode in ("rnn_relu", "rnn_tanh"):
+        (h,) = carry
+        pre = x @ i2h_w.T + i2h_b + h @ h2h_w.T + h2h_b
+        h_new = jax.nn.relu(pre) if mode == "rnn_relu" else jnp.tanh(pre)
+        return (h_new,), h_new
+    if mode == "lstm":
+        h, c = carry
+        pre = x @ i2h_w.T + i2h_b + h @ h2h_w.T + h2h_b
+        i, f, g, o = jnp.split(pre, 4, axis=-1)
+        i = jax.nn.sigmoid(i)
+        f = jax.nn.sigmoid(f)
+        g = jnp.tanh(g)
+        o = jax.nn.sigmoid(o)
+        c_new = f * c + i * g
+        h_new = o * jnp.tanh(c_new)
+        if h2r_w is not None:  # LSTMP: project hidden before recurrence
+            h_new = h_new @ h2r_w.T
+        return (h_new, c_new), h_new
+    if mode == "gru":
+        (h,) = carry
+        gi = x @ i2h_w.T + i2h_b
+        gh = h @ h2h_w.T + h2h_b
+        ir, iz, inw = jnp.split(gi, 3, axis=-1)
+        hr, hz, hn = jnp.split(gh, 3, axis=-1)
+        r = jax.nn.sigmoid(ir + hr)
+        z = jax.nn.sigmoid(iz + hz)
+        n = jnp.tanh(inw + r * hn)
+        h_new = (1.0 - z) * n + z * h
+        return (h_new,), h_new
+    raise ValueError("unknown RNN mode %r" % mode)
+
+
+@register_op("RNN", aliases=("rnn",))
+def rnn(data, parameters, state, state_cell=None, state_size=0, num_layers=1,
+        mode="lstm", bidirectional=False, p=0.0, state_outputs=False,
+        projection_size=None, sequence_length=None,
+        use_sequence_length=False, _training=False, _key=None):
+    """Fused multi-layer (bi)RNN (parity: src/operator/rnn.cc backed by
+    cuDNN cudnnRNNForward; here a lax.scan over timesteps per layer — XLA
+    fuses the gate matmuls into MXU-sized batched GEMMs).
+
+    data: (T, B, I). parameters: packed 1-D vector in cuDNN layout.
+    state: (L*D, B, H); state_cell likewise for LSTM.
+    Returns output (T, B, H*D) or [output, h_n(, c_n)] when state_outputs.
+    """
+    if projection_size and mode != "lstm":
+        raise ValueError("projection_size is only supported for mode='lstm'")
+    T, B, _ = data.shape
+    input_size = data.shape[2]
+    cells = _unpack_rnn_params(parameters, mode, input_size, state_size,
+                               num_layers, bidirectional, projection_size)
+    dirs = 2 if bidirectional else 1
+    is_lstm = mode == "lstm"
+
+    lengths = None
+    if use_sequence_length and sequence_length is not None:
+        lengths = sequence_length.astype(jnp.int32)  # (B,)
+
+    h_states = []
+    c_states = []
+    x = data
+    for layer in range(num_layers):
+        outs = []
+        for d in range(dirs):
+            idx = layer * dirs + d
+            w = cells[idx]
+            h0 = state[idx]
+            carry = (h0, state_cell[idx]) if is_lstm else (h0,)
+            if lengths is None:
+                seq = x if d == 0 else x[::-1]
+
+                def step(carry, xt, w=w):
+                    return _rnn_cell_step(mode, w, carry, xt)
+
+                carry, ys = lax.scan(step, carry, seq)
+                if d == 1:
+                    ys = ys[::-1]
+            else:
+                # variable length: reverse only each row's valid prefix for
+                # the backward direction, freeze the carry past each row's
+                # length, and zero padded outputs — matches the reference's
+                # use_sequence_length cuDNN path observable semantics.
+                t_idx = jnp.arange(T)[:, None]  # (T, 1)
+                if d == 1:
+                    gather = jnp.where(t_idx < lengths[None, :],
+                                       lengths[None, :] - 1 - t_idx, t_idx)
+                    seq = jnp.take_along_axis(x, gather[:, :, None], axis=0)
+                else:
+                    seq = x
+
+                def step(carry, inp, w=w):
+                    xt, t = inp
+                    new_carry, y = _rnn_cell_step(mode, w, carry, xt)
+                    valid = (t < lengths)[:, None]
+                    new_carry = tuple(
+                        jnp.where(valid, n, o)
+                        for n, o in zip(new_carry, carry))
+                    y = jnp.where(valid, y, jnp.zeros_like(y))
+                    return new_carry, y
+
+                carry, ys = lax.scan(step, carry, (seq, jnp.arange(T)))
+                if d == 1:
+                    gather = jnp.where(t_idx < lengths[None, :],
+                                       lengths[None, :] - 1 - t_idx, t_idx)
+                    ys = jnp.take_along_axis(ys, gather[:, :, None], axis=0)
+                    valid = t_idx < lengths[None, :]
+                    ys = jnp.where(valid[:, :, None], ys,
+                                   jnp.zeros_like(ys))
+            outs.append(ys)
+            h_states.append(carry[0])
+            if is_lstm:
+                c_states.append(carry[1])
+        x = outs[0] if dirs == 1 else jnp.concatenate(outs, axis=-1)
+        if p > 0.0 and _training and layer < num_layers - 1 \
+                and _key is not None:
+            import jax.random as jrandom
+            keep = jrandom.bernoulli(jrandom.fold_in(_key, layer), 1.0 - p,
+                                     x.shape)
+            x = jnp.where(keep, x / (1.0 - p), 0.0)
+    if not state_outputs:
+        return x
+    if is_lstm:
+        return x, jnp.stack(h_states), jnp.stack(c_states)
+    return x, jnp.stack(h_states)

@@ -400,6 +400,19 @@ def split(x, num_outputs=1, axis=1, squeeze_axis=False):
     return tuple(parts) if len(parts) > 1 else parts[0]
 
 
+@register_op("split_v2")
+def split_v2(x, indices_or_sections=1, axis=0, squeeze_axis=False):
+    """Split into equal sections (int) or at indices (tuple) (parity:
+    mx.nd.split_v2 — src/operator/tensor/matrix_op.cc _split_v2)."""
+    if isinstance(indices_or_sections, (list, tuple)):
+        parts = jnp.split(x, list(indices_or_sections), axis=axis)
+    else:
+        parts = jnp.split(x, int(indices_or_sections), axis=axis)
+    if squeeze_axis:
+        parts = [jnp.squeeze(p, axis=axis) for p in parts]
+    return tuple(parts) if len(parts) > 1 else parts[0]
+
+
 @register_op("slice")
 def slice_(x, begin=None, end=None, step=None):
     nd = x.ndim
@@ -687,3 +700,11 @@ def isinf(x):
 @register_op("isfinite", differentiable=False)
 def isfinite(x):
     return jnp.isfinite(x).astype(jnp.float32)
+
+
+@register_op("_internal_getitem")
+def _internal_getitem(x, key=None):
+    """Basic/advanced indexing as a registered (taped) op — backs
+    NDArray.__getitem__ (parity: the reference records slice/gather ops
+    through Imperative::RecordOp the same way)."""
+    return x[key]

@@ -1,0 +1,246 @@
+"""RecordIO (parity: python/mxnet/recordio.py + dmlc-core recordio format).
+
+Pure-Python implementation of the dmlc RecordIO container so .rec/.idx
+datasets packed for the reference (tools/im2rec) read unchanged. The format:
+each record is ``magic(4B) | lrec(4B) | payload | pad-to-4``, where lrec's
+upper 3 bits are a continuation flag and lower 29 bits the payload length.
+Payloads containing the magic are escaped by splitting into multi-part
+records (cflag 1..3), mirroring dmlc-core's recordio writer.
+"""
+
+import collections
+import os
+import struct
+
+import numpy as onp
+
+__all__ = ["MXRecordIO", "MXIndexedRecordIO", "IRHeader",
+           "pack", "unpack", "unpack_img", "pack_img"]
+
+_MAGIC = 0xced7230a
+_MAGIC_BYTES = struct.pack("<I", _MAGIC)
+
+
+def _lrec(cflag, length):
+    return (cflag << 29) | length
+
+
+def _dec_lrec(lrec):
+    return lrec >> 29, lrec & ((1 << 29) - 1)
+
+
+class MXRecordIO:
+    """Sequential record reader/writer."""
+
+    def __init__(self, uri, flag):
+        self.uri = uri
+        self.flag = flag
+        self.record = None
+        self.is_open = False
+        self._pid = None
+        self.open()
+
+    def open(self):
+        if self.flag == "w":
+            self.record = open(self.uri, "wb")
+            self.writable = True
+        elif self.flag == "r":
+            self.record = open(self.uri, "rb")
+            self.writable = False
+        else:
+            raise ValueError("Invalid flag %s" % self.flag)
+        self.is_open = True
+        self._pid = os.getpid()
+
+    def _check_pid(self):
+        """Reopen after fork: a DataLoader fork-worker inherits the parent's
+        fd (shared file offset) — concurrent seeks would race. Each process
+        gets its own handle instead."""
+        if self._pid != os.getpid():
+            self.record = open(self.uri, "rb")
+            self._pid = os.getpid()
+
+    def close(self):
+        if self.is_open:
+            self.record.close()
+            self.is_open = False
+
+    def reset(self):
+        self.close()
+        self.open()
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+    def __getstate__(self):
+        """Reopen on unpickle (DataLoader worker fork support)."""
+        d = dict(self.__dict__)
+        d["record"] = None
+        d["is_open"] = False
+        d["_lock"] = None
+        return d
+
+    def __setstate__(self, d):
+        import threading
+        self.__dict__.update(d)
+        if "_lock" in d:
+            self._lock = threading.Lock()
+        if self.flag == "r":
+            self.open()
+
+    def write(self, buf):
+        assert self.writable
+        # escape embedded magics by splitting the record
+        pieces = []
+        start = 0
+        while True:
+            idx = buf.find(_MAGIC_BYTES, start)
+            if idx == -1:
+                pieces.append(buf[start:])
+                break
+            pieces.append(buf[start:idx])
+            start = idx + 4
+        n = len(pieces)
+        for i, piece in enumerate(pieces):
+            if n == 1:
+                cflag = 0
+            elif i == 0:
+                cflag = 1
+            elif i == n - 1:
+                cflag = 3
+            else:
+                cflag = 2
+            self.record.write(_MAGIC_BYTES)
+            self.record.write(struct.pack("<I", _lrec(cflag, len(piece))))
+            self.record.write(piece)
+            pad = (4 - len(piece) % 4) % 4
+            if pad:
+                self.record.write(b"\x00" * pad)
+
+    def read(self):
+        assert not self.writable
+        self._check_pid()
+        out = []
+        while True:
+            header = self.record.read(8)
+            if len(header) < 8:
+                return None if not out else b"".join(out)
+            magic, lrec = struct.unpack("<II", header)
+            assert magic == _MAGIC, "invalid record magic"
+            cflag, length = _dec_lrec(lrec)
+            data = self.record.read(length)
+            pad = (4 - length % 4) % 4
+            if pad:
+                self.record.read(pad)
+            if cflag == 0:
+                return data
+            out.append(data)
+            if cflag == 3:
+                return _MAGIC_BYTES.join(out)
+
+    def tell(self):
+        return self.record.tell()
+
+
+class MXIndexedRecordIO(MXRecordIO):
+    """Random-access reader/writer with an .idx sidecar (key\\toffset)."""
+
+    def __init__(self, idx_path, uri, flag, key_type=int):
+        import threading
+        self.idx_path = idx_path
+        self.idx = {}
+        self.keys = []
+        self.key_type = key_type
+        self._lock = threading.Lock()
+        super().__init__(uri, flag)
+
+    def open(self):
+        super().open()
+        self.idx = {}
+        self.keys = []
+        if not self.writable and os.path.isfile(self.idx_path):
+            with open(self.idx_path) as fin:
+                for line in fin:
+                    parts = line.strip().split("\t")
+                    key = self.key_type(parts[0])
+                    self.idx[key] = int(parts[1])
+                    self.keys.append(key)
+
+    def close(self):
+        if self.is_open and self.writable:
+            with open(self.idx_path, "w") as fout:
+                for key in self.keys:
+                    fout.write("%s\t%d\n" % (str(key), self.idx[key]))
+        super().close()
+
+    def seek(self, idx):
+        assert not self.writable
+        self._check_pid()
+        self.record.seek(self.idx[idx])
+
+    def read_idx(self, idx):
+        # seek+read must be atomic when threads share this reader
+        # (DataLoader thread_pool=True)
+        with self._lock:
+            self.seek(idx)
+            return self.read()
+
+    def write_idx(self, idx, buf):
+        assert self.writable
+        key = self.key_type(idx)
+        pos = self.tell()
+        self.write(buf)
+        self.idx[key] = pos
+        self.keys.append(key)
+
+
+# IRHeader: flag(uint32), label(float32), id(uint64), id2(uint64);
+# flag>0 means `flag` extra float labels follow the header.
+IRHeader = collections.namedtuple("HEADER", ["flag", "label", "id", "id2"])
+_IR_FORMAT = "IfQQ"
+_IR_SIZE = struct.calcsize(_IR_FORMAT)
+
+
+def pack(header, s):
+    header = IRHeader(*header)
+    if isinstance(header.label, (int, float)):
+        label = header.label
+        header = header._replace(flag=0)
+        payload = b""
+    else:
+        label = onp.asarray(header.label, dtype="float32")
+        header = header._replace(flag=label.size, label=0)
+        payload = label.tobytes()
+    return struct.pack(_IR_FORMAT, *header) + payload + s
+
+
+def unpack(s):
+    header = IRHeader(*struct.unpack(_IR_FORMAT, s[:_IR_SIZE]))
+    s = s[_IR_SIZE:]
+    if header.flag > 0:
+        label = onp.frombuffer(s[:header.flag * 4], dtype="float32")
+        s = s[header.flag * 4:]
+        header = header._replace(label=label)
+    return header, s
+
+
+def unpack_img(s, iscolor=1):
+    from . import image
+    header, s = unpack(s)
+    return header, image.imdecode(s, iscolor).asnumpy()
+
+
+def pack_img(header, img, quality=95, img_fmt=".jpg"):
+    import cv2
+    if img_fmt.lower() in (".jpg", ".jpeg"):
+        params = [cv2.IMWRITE_JPEG_QUALITY, quality]
+    elif img_fmt.lower() == ".png":
+        params = [cv2.IMWRITE_PNG_COMPRESSION, quality]
+    else:
+        params = []
+    ret, buf = cv2.imencode(img_fmt, img, params)
+    assert ret, "failed to encode image"
+    return pack(header, buf.tobytes())

@@ -182,3 +182,35 @@ def test_multi_output_op_backward():
     loss.backward()
     np.testing.assert_allclose(
         x.grad.asnumpy(), [[2, 2, 3, 3], [2, 2, 3, 3]])
+
+
+def test_getitem_gradient():
+    """Regression: indexing must be recorded on the tape (a silent zero-grad
+    bug here crippled any net using x[:, -1]-style selection)."""
+    import numpy as np
+    x = mx.nd.array(np.arange(12).reshape(3, 4).astype("float32"))
+    x.attach_grad()
+    with mx.autograd.record():
+        y = x[:, -1].sum()
+    y.backward()
+    expect = np.zeros((3, 4), dtype="float32")
+    expect[:, -1] = 1.0
+    np.testing.assert_array_equal(x.grad.asnumpy(), expect)
+
+    x.attach_grad()
+    with mx.autograd.record():
+        y = (x[1] * 2).sum()
+    y.backward()
+    expect = np.zeros((3, 4), dtype="float32")
+    expect[1] = 2.0
+    np.testing.assert_array_equal(x.grad.asnumpy(), expect)
+
+    # advanced (array) indexing
+    idx = mx.nd.array([0, 2], dtype="int32")
+    x.attach_grad()
+    with mx.autograd.record():
+        y = (x[idx] ** 2).sum()
+    y.backward()
+    g = x.grad.asnumpy()
+    assert np.abs(g[1]).max() == 0.0
+    assert np.abs(g[0]).max() > 0.0

@@ -1,0 +1,127 @@
+"""Tests for gluon.data (parity model: tests/python/unittest/test_gluon_data.py)."""
+
+import numpy as np
+import pytest
+
+import mxtpu as mx
+from mxtpu.gluon.data import (ArrayDataset, SimpleDataset, DataLoader,
+                              BatchSampler, SequentialSampler, RandomSampler)
+from mxtpu.gluon.data.vision import transforms
+
+
+def test_array_dataset():
+    X = np.random.uniform(size=(10, 20))
+    Y = np.random.uniform(size=(10,))
+    dataset = ArrayDataset(X, Y)
+    loader = DataLoader(dataset, 2)
+    for i, (x, y) in enumerate(loader):
+        assert x.shape == (2, 20)
+        assert y.shape == (2,)
+        np.testing.assert_allclose(x.asnumpy(), X[i * 2:(i + 1) * 2],
+                                   rtol=1e-6)
+    dataset = ArrayDataset(X)
+    loader = DataLoader(dataset, 2)
+    for i, x in enumerate(loader):
+        assert x.shape == (2, 20)
+
+
+def test_samplers():
+    assert list(SequentialSampler(5)) == [0, 1, 2, 3, 4]
+    assert sorted(RandomSampler(5)) == [0, 1, 2, 3, 4]
+    bs = BatchSampler(SequentialSampler(10), 3, "keep")
+    assert [len(b) for b in bs] == [3, 3, 3, 1]
+    assert len(bs) == 4
+    bs = BatchSampler(SequentialSampler(10), 3, "discard")
+    assert [len(b) for b in bs] == [3, 3, 3]
+    assert len(bs) == 3
+    bs = BatchSampler(SequentialSampler(10), 3, "rollover")
+    assert [len(b) for b in bs] == [3, 3, 3]
+    assert [len(b) for b in bs] == [3, 3, 3]  # 1 rolled + 10 = 11 -> 3 full
+
+
+def test_dataset_transform():
+    ds = SimpleDataset(list(range(8))).transform(lambda x: x * 2)
+    assert ds[3] == 6
+    ds2 = ArrayDataset(np.arange(6), np.arange(6)).transform_first(
+        lambda x: x * 10)
+    x, y = ds2[2]
+    assert x == 20 and y == 2
+
+
+def test_dataset_shard_take_filter():
+    ds = SimpleDataset(list(range(10)))
+    shards = [ds.shard(3, i) for i in range(3)]
+    assert sum(len(s) for s in shards) == 10
+    assert len(ds.take(4)) == 4
+    assert len(ds.filter(lambda x: x % 2 == 0)) == 5
+
+
+def test_multi_worker():
+    ds = ArrayDataset(np.arange(64).astype("float32").reshape(16, 4),
+                      np.arange(16))
+    for workers in (0, 2):
+        loader = DataLoader(ds, 4, num_workers=workers)
+        seen = []
+        for x, y in loader:
+            assert x.shape == (4, 4)
+            seen.extend(y.asnumpy().tolist())
+        assert sorted(seen) == list(range(16))
+
+
+def test_multi_worker_thread_pool():
+    ds = ArrayDataset(np.arange(32).astype("float32").reshape(8, 4),
+                      np.arange(8))
+    loader = DataLoader(ds, 2, num_workers=2, thread_pool=True)
+    assert sum(1 for _ in loader) == 4
+
+
+def test_transforms_totensor_normalize():
+    img = (np.random.rand(28, 26, 3) * 255).astype("uint8")
+    t = transforms.ToTensor()
+    out = t(mx.nd.array(img, dtype="uint8"))
+    assert out.shape == (3, 28, 26)
+    np.testing.assert_allclose(out.asnumpy(),
+                               img.transpose(2, 0, 1) / 255.0, rtol=1e-5)
+    norm = transforms.Normalize(mean=(0.5, 0.5, 0.5), std=(0.1, 0.2, 0.3))
+    out2 = norm(out)
+    expect = (img.transpose(2, 0, 1) / 255.0 -
+              np.array([0.5, 0.5, 0.5]).reshape(3, 1, 1)) / \
+        np.array([0.1, 0.2, 0.3]).reshape(3, 1, 1)
+    np.testing.assert_allclose(out2.asnumpy(), expect, rtol=1e-4)
+
+
+def test_transforms_geometry():
+    img = mx.nd.array((np.random.rand(48, 40, 3) * 255).astype("uint8"),
+                      dtype="uint8")
+    assert transforms.Resize(20)(img).shape == (20, 20, 3)
+    assert transforms.Resize((30, 20))(img).shape == (20, 30, 3)
+    assert transforms.CenterCrop(16)(img).shape == (16, 16, 3)
+    assert transforms.RandomCrop(16)(img).shape == (16, 16, 3)
+    assert transforms.RandomResizedCrop(24)(img).shape == (24, 24, 3)
+    assert transforms.RandomFlipLeftRight(1.0)(img).asnumpy().shape == \
+        (48, 40, 3)
+    np.testing.assert_array_equal(
+        transforms.RandomFlipLeftRight(1.0)(img).asnumpy(),
+        img.asnumpy()[:, ::-1])
+
+
+def test_transforms_color():
+    img = mx.nd.array((np.random.rand(8, 8, 3) * 255).astype("uint8"),
+                      dtype="uint8")
+    for t in (transforms.RandomBrightness(0.5), transforms.RandomContrast(0.5),
+              transforms.RandomSaturation(0.5), transforms.RandomHue(0.1),
+              transforms.RandomColorJitter(0.1, 0.1, 0.1, 0.1),
+              transforms.RandomLighting(0.1), transforms.RandomGray(1.0)):
+        out = t(img)
+        assert out.shape == (8, 8, 3)
+
+
+def test_transforms_compose_in_loader():
+    data = (np.random.rand(10, 16, 16, 3) * 255).astype("uint8")
+    label = np.arange(10)
+    t = transforms.Compose([transforms.ToTensor(),
+                            transforms.Normalize(0.5, 0.5)])
+    ds = ArrayDataset(data, label).transform_first(t)
+    loader = DataLoader(ds, 5)
+    for x, y in loader:
+        assert x.shape == (5, 3, 16, 16)

@@ -1,0 +1,162 @@
+"""Tests for gluon.rnn (parity model: tests/python/unittest/test_gluon_rnn.py)."""
+
+import numpy as np
+import pytest
+
+import mxtpu as mx
+from mxtpu import gluon
+from mxtpu.gluon import rnn
+
+
+@pytest.mark.parametrize("cls,nstates", [(rnn.LSTM, 2), (rnn.GRU, 1),
+                                         (rnn.RNN, 1)])
+def test_fused_layer_shapes(cls, nstates):
+    layer = cls(8, num_layers=2, bidirectional=True)
+    layer.initialize()
+    x = mx.nd.random.uniform(shape=(5, 3, 4))
+    out = layer(x)
+    assert out.shape == (5, 3, 16)
+    states = layer.begin_state(batch_size=3)
+    assert len(states) == nstates
+    out, st = layer(x, states)
+    assert out.shape == (5, 3, 16)
+    assert all(s.shape == (4, 3, 8) for s in st)
+
+
+def test_fused_layer_ntc():
+    layer = rnn.LSTM(8, layout="NTC")
+    layer.initialize()
+    out = layer(mx.nd.random.uniform(shape=(3, 5, 4)))
+    assert out.shape == (3, 5, 8)
+
+
+def test_lstm_cell_matches_fused():
+    """Unfused LSTMCell.unroll must match the fused LSTM layer numerically
+    (the reference checks cell-vs-fused consistency the same way)."""
+    T, B, I, H = 4, 2, 3, 5
+    x = mx.nd.random.uniform(shape=(T, B, I))
+    fused = rnn.LSTM(H, input_size=I)
+    fused.initialize()
+    states = fused.begin_state(batch_size=B)
+    fout, fstates = fused(x, states)
+
+    cell = rnn.LSTMCell(H, input_size=I)
+    cell.initialize()
+    # copy the fused params into the cell
+    fp = {k.split("_", 1)[1] if k.startswith(("l0_",)) else k: v
+          for k, v in fused.collect_params().items()}
+    cp = cell.collect_params()
+    for name in ("i2h_weight", "h2h_weight", "i2h_bias", "h2h_bias"):
+        src = [v for k, v in fused.collect_params().items()
+               if k.endswith("l0_" + name)][0]
+        dst = [v for k, v in cp.items() if k.endswith(name)][0]
+        dst.set_data(src.data())
+    couts, cstates = cell.unroll(T, x, layout="TNC", merge_outputs=True)
+    np.testing.assert_allclose(fout.asnumpy(), couts.asnumpy(), rtol=1e-5,
+                               atol=1e-5)
+    np.testing.assert_allclose(fstates[0].asnumpy()[0],
+                               cstates[0].asnumpy(), rtol=1e-5, atol=1e-5)
+
+
+def test_rnn_layer_backward():
+    layer = rnn.GRU(8, num_layers=1)
+    layer.initialize()
+    x = mx.nd.random.uniform(shape=(5, 3, 4))
+    x.attach_grad()
+    with mx.autograd.record():
+        out = layer(x)
+        loss = out.sum()
+    loss.backward()
+    assert x.grad.shape == x.shape
+    assert float(np.abs(x.grad.asnumpy()).sum()) > 0
+
+
+def test_rnn_varlen_masking():
+    layer = rnn.LSTM(6, bidirectional=True)
+    layer.initialize()
+    x = mx.nd.random.uniform(shape=(7, 2, 3))
+    out = layer(x, None, mx.nd.array([4, 7]))
+    o = out.asnumpy()
+    # batch row 0 has length 4: outputs at t>=4 must be zero
+    assert np.abs(o[4:, 0]).max() == 0.0
+    assert np.abs(o[4:, 1]).max() > 0.0
+
+
+def test_cell_unroll_merge_modes():
+    cell = rnn.GRUCell(8, input_size=4)
+    cell.initialize()
+    x = mx.nd.random.uniform(shape=(3, 5, 4))
+    merged, _ = cell.unroll(5, x, layout="NTC", merge_outputs=True)
+    assert merged.shape == (3, 5, 8)
+    listed, _ = cell.unroll(5, x, layout="NTC", merge_outputs=False)
+    assert len(listed) == 5 and listed[0].shape == (3, 8)
+    np.testing.assert_allclose(
+        merged.asnumpy()[:, 2], listed[2].asnumpy(), rtol=1e-6)
+
+
+def test_sequential_and_bidirectional_cells():
+    stack = rnn.SequentialRNNCell()
+    stack.add(rnn.LSTMCell(8))
+    stack.add(rnn.GRUCell(4))
+    stack.initialize()
+    out, states = stack.unroll(5, mx.nd.random.uniform(shape=(2, 5, 3)),
+                               layout="NTC")
+    assert out.shape == (2, 5, 4)
+    assert len(states) == 3  # lstm h,c + gru h
+    assert stack[0]._hidden_size == 8
+    assert len(stack) == 2
+
+    bi = rnn.BidirectionalCell(rnn.LSTMCell(4), rnn.LSTMCell(4))
+    bi.initialize()
+    out, states = bi.unroll(5, mx.nd.random.uniform(shape=(2, 5, 3)),
+                            layout="NTC")
+    assert out.shape == (2, 5, 8)
+    with pytest.raises(NotImplementedError):
+        bi(mx.nd.zeros((2, 3)), bi.begin_state(2))
+
+
+def test_modifier_cells():
+    r = rnn.ResidualCell(rnn.GRUCell(4, input_size=4))
+    r.initialize()
+    out, _ = r.unroll(5, mx.nd.random.uniform(shape=(2, 5, 4)), layout="NTC")
+    assert out.shape == (2, 5, 4)
+
+    d = rnn.DropoutCell(0.5)
+    x = mx.nd.ones((2, 4))
+    out, st = d(x, [])
+    np.testing.assert_array_equal(out.asnumpy(), x.asnumpy())  # not training
+
+    z = rnn.ZoneoutCell(rnn.LSTMCell(4), zoneout_outputs=0.3)
+    z.initialize()
+    out, st = z(mx.nd.random.uniform(shape=(2, 3)), z.begin_state(2))
+    assert out.shape == (2, 4)
+
+
+def test_rnn_cell_deferred_input_size():
+    cell = rnn.LSTMCell(8)  # input_size deferred
+    cell.initialize()
+    out, st = cell(mx.nd.random.uniform(shape=(2, 6)), cell.begin_state(2))
+    assert out.shape == (2, 8)
+    assert cell.i2h_weight.shape == (32, 6)
+
+
+def test_rnn_layer_in_sequential_net():
+    """RNN layer composes with other blocks in a trainable net."""
+    net = gluon.nn.Sequential()
+    lstm = rnn.LSTM(8, layout="NTC")
+    net.add(lstm)
+    net.add(gluon.nn.Dense(2))
+    net.initialize()
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.1})
+    lossfn = gluon.loss.SoftmaxCrossEntropyLoss()
+    x = mx.nd.random.uniform(shape=(4, 5, 3))
+    y = mx.nd.array([0, 1, 0, 1])
+    with mx.autograd.record():
+        out = net(x)
+        # take last timestep via dense on flattened output
+        l = lossfn(out, y)
+    l.backward()
+    trainer.step(4)
+    g = [p.grad() for p in lstm.collect_params().values()]
+    assert any(float(np.abs(gi.asnumpy()).sum()) > 0 for gi in g)

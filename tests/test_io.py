@@ -1,0 +1,182 @@
+"""Tests for mx.io / recordio / image (parity model:
+tests/python/unittest/test_io.py, test_recordio.py, test_image.py)."""
+
+import os
+import struct
+
+import numpy as np
+import pytest
+
+import mxtpu as mx
+from mxtpu import recordio, image
+from mxtpu.io import (NDArrayIter, ResizeIter, PrefetchingIter, CSVIter,
+                      DataBatch, DataDesc)
+
+
+def test_ndarray_iter_pad():
+    data = np.arange(70).reshape(10, 7).astype("float32")
+    label = np.arange(10)
+    it = NDArrayIter(data, label, batch_size=4, last_batch_handle="pad")
+    batches = list(it)
+    assert len(batches) == 3
+    assert batches[-1].pad == 2
+    # pad wraps to the beginning
+    np.testing.assert_array_equal(batches[-1].data[0].asnumpy()[-2:],
+                                  data[:2])
+
+
+def test_ndarray_iter_discard():
+    data = np.arange(70).reshape(10, 7).astype("float32")
+    it = NDArrayIter(data, np.arange(10), batch_size=4,
+                     last_batch_handle="discard")
+    assert len(list(it)) == 2
+
+
+def test_ndarray_iter_reset():
+    it = NDArrayIter(np.arange(12).reshape(6, 2), np.arange(6), batch_size=3)
+    a = [b.data[0].asnumpy() for b in it]
+    it.reset()
+    b = [b.data[0].asnumpy() for b in it]
+    for x, y in zip(a, b):
+        np.testing.assert_array_equal(x, y)
+
+
+def test_ndarray_iter_provide():
+    it = NDArrayIter(np.zeros((8, 3, 4)), np.zeros(8), batch_size=2)
+    d = it.provide_data[0]
+    assert d.name == "data" and d.shape == (2, 3, 4)
+    l = it.provide_label[0]
+    assert l.name == "softmax_label" and l.shape == (2,)
+
+
+def test_resize_iter():
+    it = ResizeIter(NDArrayIter(np.zeros((8, 2)), np.zeros(8), batch_size=4),
+                    size=5)
+    assert sum(1 for _ in it) == 5
+
+
+def test_prefetching_iter():
+    it = PrefetchingIter(NDArrayIter(np.arange(24).reshape(12, 2),
+                                     np.arange(12), batch_size=4))
+    assert sum(1 for _ in it) == 3
+    it.reset()
+    assert sum(1 for _ in it) == 3
+
+
+def test_csv_iter(tmp_path):
+    data = np.random.rand(10, 3).round(4)
+    fn = str(tmp_path / "d.csv")
+    np.savetxt(fn, data, delimiter=",")
+    it = CSVIter(data_csv=fn, data_shape=(3,), batch_size=5)
+    batches = list(it)
+    assert len(batches) == 2
+    np.testing.assert_allclose(batches[0].data[0].asnumpy(), data[:5],
+                               rtol=1e-3)
+
+
+def test_recordio_roundtrip(tmp_path):
+    fn = str(tmp_path / "t.rec")
+    w = recordio.MXRecordIO(fn, "w")
+    payloads = [b"hello", b"x" * 999,
+                struct.pack("<I", 0xced7230a) + b"mid" +
+                struct.pack("<I", 0xced7230a)]
+    for p in payloads:
+        w.write(p)
+    w.close()
+    r = recordio.MXRecordIO(fn, "r")
+    for p in payloads:
+        assert r.read() == p
+    assert r.read() is None
+
+
+def test_indexed_recordio(tmp_path):
+    fn = str(tmp_path / "t.rec")
+    idx = str(tmp_path / "t.idx")
+    w = recordio.MXIndexedRecordIO(idx, fn, "w")
+    for i in range(5):
+        w.write_idx(i, b"rec%d" % i)
+    w.close()
+    r = recordio.MXIndexedRecordIO(idx, fn, "r")
+    assert r.read_idx(3) == b"rec3"
+    assert r.read_idx(0) == b"rec0"
+    assert r.keys == [0, 1, 2, 3, 4]
+
+
+def test_pack_unpack():
+    s = recordio.pack(recordio.IRHeader(0, 5.0, 1, 0), b"payload")
+    h, data = recordio.unpack(s)
+    assert h.label == 5.0 and data == b"payload"
+    lab = np.array([1.0, 2.0, 3.0], dtype="float32")
+    s = recordio.pack(recordio.IRHeader(0, lab, 1, 0), b"xy")
+    h, data = recordio.unpack(s)
+    np.testing.assert_array_equal(h.label, lab)
+    assert data == b"xy"
+
+
+def test_image_ops(tmp_path):
+    import cv2
+    img = (np.random.rand(40, 30, 3) * 255).astype("uint8")
+    buf = cv2.imencode(".jpg", img)[1].tobytes()
+    d = image.imdecode(buf)
+    assert d.shape == (40, 30, 3) and str(d.dtype) == "uint8"
+    assert image.imresize(d, 15, 20).shape == (20, 15, 3)
+    assert image.resize_short(d, 20).shape[1] == 20
+    out, rect = image.center_crop(d, (16, 16))
+    assert out.shape == (16, 16, 3)
+    out, rect = image.random_crop(d, (16, 16))
+    assert out.shape == (16, 16, 3)
+    norm = image.color_normalize(d, np.array([100.0]), np.array([50.0]))
+    assert str(norm.dtype) == "float32"
+
+
+def test_image_record_dataset_end_to_end(tmp_path):
+    import cv2
+    from mxtpu.gluon.data.vision import ImageRecordDataset
+    fn = str(tmp_path / "d.rec")
+    idx = str(tmp_path / "d.idx")
+    w = recordio.MXIndexedRecordIO(idx, fn, "w")
+    for i in range(6):
+        img = (np.random.rand(24, 24, 3) * 255).astype("uint8")
+        w.write_idx(i, recordio.pack_img(
+            recordio.IRHeader(0, float(i), i, 0), img))
+    w.close()
+    ds = ImageRecordDataset(fn)
+    assert len(ds) == 6
+    img, label = ds[2]
+    assert img.shape == (24, 24, 3)
+    assert label == 2.0
+
+
+def test_image_iter(tmp_path):
+    import cv2
+    fn = str(tmp_path / "d.rec")
+    idx = str(tmp_path / "d.idx")
+    w = recordio.MXIndexedRecordIO(idx, fn, "w")
+    for i in range(10):
+        img = (np.random.rand(40, 40, 3) * 255).astype("uint8")
+        w.write_idx(i, recordio.pack_img(
+            recordio.IRHeader(0, float(i), i, 0), img))
+    w.close()
+    it = image.ImageIter(batch_size=4, data_shape=(3, 32, 32),
+                         path_imgrec=fn, rand_crop=True, rand_mirror=True)
+    batch = next(it)
+    assert batch.data[0].shape == (4, 3, 32, 32)
+    assert batch.label[0].shape == (4,)
+
+
+def test_mnist_iter(tmp_path):
+    # synthesize tiny idx files
+    import gzip
+    imgs = (np.random.rand(20, 28, 28) * 255).astype(np.uint8)
+    lbls = (np.arange(20) % 10).astype(np.uint8)
+    img_f = str(tmp_path / "img.gz")
+    lbl_f = str(tmp_path / "lbl.gz")
+    with gzip.open(img_f, "wb") as f:
+        f.write(struct.pack(">IIII", 0x803, 20, 28, 28) + imgs.tobytes())
+    with gzip.open(lbl_f, "wb") as f:
+        f.write(struct.pack(">II", 0x801, 20) + lbls.tobytes())
+    from mxtpu.io import MNISTIter
+    it = MNISTIter(image=img_f, label=lbl_f, batch_size=5, shuffle=False)
+    batch = next(it)
+    assert batch.data[0].shape == (5, 1, 28, 28)
+    np.testing.assert_array_equal(batch.label[0].asnumpy(), lbls[:5])
