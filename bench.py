@@ -1,0 +1,61 @@
+"""Headline benchmark: ResNet-50 training throughput, images/sec/chip
+(BASELINE metric 1 / config 2: GluonCV ResNet-50, hybridized train step).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+vs_baseline divides by 375 img/s — the commonly cited upstream MXNet 1.x
+fp32 ResNet-50 per-V100 figure (BASELINE.md records that the reference
+mount was empty and no published number could be extracted; 375 is the
+midpoint of the O(300-400) range noted there, to be replaced when the
+reference number lands).
+"""
+
+import json
+import time
+
+import numpy as np
+
+
+def main():
+    import mxtpu as mx
+    from mxtpu import gluon
+    from mxtpu.gluon.model_zoo import vision
+    from mxtpu.parallel import make_mesh, SPMDTrainer
+
+    batch = 64
+    net = vision.resnet50_v1()
+    net.initialize()
+    net.cast("bfloat16")  # MXU-native compute; fp32 master copies live in
+    # the optimizer path via _step's dtype cast-back
+
+    mesh = make_mesh(dp=1)
+    trainer = SPMDTrainer(net, gluon.loss.SoftmaxCrossEntropyLoss(),
+                          "sgd", mesh,
+                          optimizer_params={"learning_rate": 0.1,
+                                            "momentum": 0.9})
+    X = mx.nd.array(np.random.rand(batch, 3, 224, 224), dtype="bfloat16")
+    y = mx.nd.array(np.random.randint(0, 1000, (batch,)), dtype="int32")
+
+    # warmup (compile)
+    trainer.step(X, y).asnumpy()
+    trainer.step(X, y).asnumpy()
+
+    iters = 10
+    t0 = time.perf_counter()
+    loss = None
+    for _ in range(iters):
+        loss = trainer.step(X, y)
+    loss.asnumpy()  # drain the async queue
+    dt = time.perf_counter() - t0
+
+    ips = batch * iters / dt
+    print(json.dumps({
+        "metric": "resnet50_train_images_per_sec_per_chip",
+        "value": round(ips, 2),
+        "unit": "images/sec",
+        "vs_baseline": round(ips / 375.0, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,141 @@
+"""AMP — automatic mixed precision (parity: python/mxnet/contrib/amp/ —
+amp.init, init_trainer, scale_loss, unscale, convert_model over the C++
+low_precision_pass graph rewrite).
+
+TPU story: bf16 is the native mixed-precision dtype (MXU), its exponent
+range matches fp32, so dynamic loss scaling is unnecessary — `init()`
+installs a bf16 cast policy on subsequently created Gluon blocks (and
+`convert_model` casts an existing one), norms/softmax stay fp32 inside the
+ops (they cast internally). The loss-scaling API is kept for parity: with
+target_dtype='float16' it performs real dynamic scaling like the
+reference's LossScaler; with bf16 it is an identity with the same shape.
+"""
+
+from __future__ import annotations
+
+import warnings
+
+from . import ndarray as nd
+from .ndarray import NDArray
+
+__all__ = ["init", "init_trainer", "scale_loss", "unscale", "convert_model",
+           "convert_hybrid_block", "list_lp16_ops", "list_fp32_ops"]
+
+_amp_state = {"initialized": False, "target_dtype": None, "loss_scaler": None}
+
+# fp32-mandatory ops (parity: lists/symbol_fp16.py FP32_FUNCS — the ops the
+# reference always keeps in fp32; ours cast internally, listed for API
+# compat/introspection)
+_FP32_OPS = ["BatchNorm", "LayerNorm", "InstanceNorm", "GroupNorm",
+             "softmax", "log_softmax", "softmax_cross_entropy", "norm",
+             "mean", "sum"]
+_LP16_OPS = ["Convolution", "FullyConnected", "Deconvolution", "RNN",
+             "batch_dot", "dot"]
+
+
+class LossScaler:
+    """Dynamic loss scaling (parity: amp loss_scaler.py)."""
+
+    def __init__(self, init_scale=2 ** 16, scale_factor=2.0,
+                 scale_window=2000):
+        self.loss_scale = init_scale
+        self._scale_factor = scale_factor
+        self._scale_window = scale_window
+        self._unskipped = 0
+
+    def has_overflow(self, params):
+        import numpy as onp
+        for p in params:
+            g = p.grad() if callable(getattr(p, "grad", None)) else p
+            a = g.asnumpy()
+            if not onp.isfinite(a).all():
+                return True
+        return False
+
+    def update_scale(self, overflow):
+        if overflow:
+            self.loss_scale = max(1.0, self.loss_scale / self._scale_factor)
+            self._unskipped = 0
+        else:
+            self._unskipped += 1
+            if self._unskipped >= self._scale_window:
+                self.loss_scale *= self._scale_factor
+                self._unskipped = 0
+
+
+def init(target_dtype="bfloat16", target_precision_ops=None,
+         conditional_fp32_ops=None, fp32_ops=None):
+    """Enable AMP (parity: amp.init). On TPU target_dtype defaults to
+    bfloat16; float16 is accepted and enables real loss scaling."""
+    if _amp_state["initialized"]:
+        return
+    if target_dtype not in ("bfloat16", "float16"):
+        raise ValueError("target_dtype must be bfloat16 or float16")
+    _amp_state["initialized"] = True
+    _amp_state["target_dtype"] = target_dtype
+    if target_dtype == "float16":
+        _amp_state["loss_scaler"] = LossScaler()
+
+
+def init_trainer(trainer):
+    """Attach the loss scaler to a Trainer (parity: amp.init_trainer)."""
+    if not _amp_state["initialized"]:
+        raise RuntimeError("amp is not initialized; call amp.init() first")
+    trainer._amp_loss_scaler = _amp_state["loss_scaler"]
+
+
+class _ScaledLoss:
+    def __init__(self, loss, scaler):
+        self._loss = loss
+        self._scaler = scaler
+
+    def __enter__(self):
+        if self._scaler is None:
+            return self._loss
+        s = self._scaler.loss_scale
+        if isinstance(self._loss, (list, tuple)):
+            return [l * s for l in self._loss]
+        return self._loss * s
+
+    def __exit__(self, *a):
+        return False
+
+
+def scale_loss(loss, trainer):
+    """Context manager scaling the loss (parity: amp.scale_loss).  With
+    bf16 (no scaler) it yields the loss unchanged."""
+    scaler = getattr(trainer, "_amp_loss_scaler", None)
+    return _ScaledLoss(loss, scaler)
+
+
+def unscale(trainer):
+    """Divide accumulated grads by the loss scale (parity: amp.unscale)."""
+    scaler = getattr(trainer, "_amp_loss_scaler", None)
+    if scaler is None:
+        return
+    inv = 1.0 / scaler.loss_scale
+    for p in trainer._params:
+        if p.grad_req != "null":
+            g = p.grad()
+            g._rebind((g.data * inv).astype(g.data.dtype))
+
+
+def convert_model(block, target_dtype=None):
+    """Cast a model to the AMP dtype (parity: amp.convert_model; the
+    reference rewrote the symbol graph with amp_cast nodes — here the cast
+    policy is the block's dtype and norm ops keep fp32 internally)."""
+    target_dtype = target_dtype or _amp_state["target_dtype"] or "bfloat16"
+    block.cast(target_dtype)
+    return block
+
+
+def convert_hybrid_block(block, target_dtype=None, ctx=None):
+    return convert_model(block, target_dtype)
+
+
+def list_lp16_ops(target_dtype="bfloat16"):
+    return list(_LP16_OPS)
+
+
+def list_fp32_ops(target_dtype="bfloat16"):
+    return list(_FP32_OPS)

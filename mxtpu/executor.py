@@ -1,0 +1,185 @@
+"""Executor (parity: python/mxnet/executor.py over
+src/executor/graph_executor.cc).
+
+The reference's GraphExecutor ran nnvm passes (InferShape, PlanMemory,
+PlaceDevice) and pushed op segments to the engine. Here `forward` is a
+topological dispatch of the Symbol through the shared op registry under
+the autograd tape, and `backward` replays the tape — XLA does memory
+planning and fusion when the surrounding code jits (SURVEY §2.1 "Symbolic
+executor → absorbed by XLA").
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import numpy as onp
+
+from . import autograd
+from . import ndarray as nd
+from .base import MXTPUError
+from .ndarray import NDArray
+
+__all__ = ["Executor"]
+
+
+class Executor:
+    def __init__(self, symbol, ctx=None, args=None, args_grad=None,
+                 grad_req="write", aux_states=None):
+        self._symbol = symbol
+        self._ctx = ctx
+        self.arg_names = symbol.list_arguments()
+        self.aux_names = symbol.list_auxiliary_states()
+        self.output_names = symbol.list_outputs()
+
+        if isinstance(args, dict):
+            self.arg_dict = dict(args)
+        elif args is not None:
+            self.arg_dict = dict(zip(self.arg_names, args))
+        else:
+            raise MXTPUError("bind requires args")
+        missing = [n for n in self.arg_names if n not in self.arg_dict]
+        if missing:
+            raise MXTPUError(f"bind missing arguments: {missing}")
+
+        if isinstance(aux_states, dict):
+            self.aux_dict = dict(aux_states)
+        elif aux_states is not None:
+            self.aux_dict = dict(zip(self.aux_names, aux_states))
+        else:
+            self.aux_dict = {}
+
+        if isinstance(args_grad, dict):
+            self.grad_dict = dict(args_grad)
+        elif args_grad is not None:
+            self.grad_dict = dict(zip(self.arg_names, args_grad))
+        else:
+            self.grad_dict = {}
+
+        if isinstance(grad_req, str):
+            self._grad_req = {n: grad_req for n in self.arg_names}
+        elif isinstance(grad_req, (list, tuple)):
+            self._grad_req = dict(zip(self.arg_names, grad_req))
+        else:
+            self._grad_req = dict(grad_req)
+
+        # attach grads per grad_req so the tape accumulates into grad_dict
+        for name, arr in self.arg_dict.items():
+            req = self._grad_req.get(name, "null")
+            if req != "null":
+                g = self.grad_dict.get(name)
+                if g is None:
+                    g = nd.zeros(arr.shape, dtype=str(arr.dtype))
+                    self.grad_dict[name] = g
+                arr.attach_grad(grad_req=req, stype=None)
+                arr._grad = g
+        self.outputs: List[NDArray] = []
+        self._out_cache = None
+
+    # -- binding helpers --------------------------------------------------
+    @staticmethod
+    def _simple_bind(symbol, ctx, grad_req, shape_kwargs):
+        """Allocate args/auxes from shape inference (parity: simple_bind)."""
+        arg_names = symbol.list_arguments()
+        aux_names = symbol.list_auxiliary_states()
+        shapes = dict(shape_kwargs)
+        arg_shapes, out_shapes, aux_shapes = symbol.infer_shape(**shapes)
+        if arg_shapes is None:
+            raise MXTPUError(
+                "simple_bind: full shape information required; pass shapes "
+                "for all inputs")
+        args = {}
+        for name, shp in zip(arg_names, arg_shapes):
+            shp = shapes.get(name, shp)
+            if shp is None:
+                raise MXTPUError(f"cannot infer shape for {name}")
+            args[name] = nd.zeros(shp)
+        auxes = {}
+        for name, shp in zip(aux_names, aux_shapes or []):
+            shp = shapes.get(name, shp)
+            auxes[name] = nd.zeros(shp)
+        grads = {n: nd.zeros_like(a) for n, a in args.items()
+                 if (grad_req if isinstance(grad_req, str)
+                     else grad_req.get(n, "null")) != "null"}
+        return Executor(symbol, ctx, args, grads, grad_req, auxes)
+
+    # -- execution --------------------------------------------------------
+    def forward(self, is_train=False, **kwargs):
+        for k, v in kwargs.items():
+            if k not in self.arg_dict:
+                raise MXTPUError(f"unknown input {k}")
+            if isinstance(v, NDArray):
+                self.arg_dict[k]._rebind(v.data)
+            else:
+                self.arg_dict[k]._rebind(nd.array(v).data)
+        inputs = dict(self.arg_dict)
+        inputs.update(self.aux_dict)
+        if is_train:
+            with autograd.record():
+                self.outputs = self._symbol._execute(inputs)
+        else:
+            with autograd.pause():
+                self.outputs = self._symbol._execute(inputs)
+        return self.outputs
+
+    def backward(self, out_grads=None, is_train=True):
+        if not self.outputs:
+            raise MXTPUError("call forward(is_train=True) before backward")
+        if out_grads is None:
+            heads = self.outputs
+            head_grads = None
+        else:
+            if isinstance(out_grads, NDArray):
+                out_grads = [out_grads]
+            heads = self.outputs
+            head_grads = out_grads
+        autograd.backward(heads, head_grads)
+
+    @property
+    def grad_arrays(self):
+        return [self.grad_dict.get(n) for n in self.arg_names]
+
+    @property
+    def arg_arrays(self):
+        return [self.arg_dict[n] for n in self.arg_names]
+
+    @property
+    def aux_arrays(self):
+        return [self.aux_dict[n] for n in self.aux_names]
+
+    def copy_params_from(self, arg_params, aux_params=None,
+                         allow_extra_params=False):
+        for name, array in arg_params.items():
+            if name in self.arg_dict:
+                self.arg_dict[name]._rebind(
+                    array.data.astype(self.arg_dict[name].data.dtype))
+            elif not allow_extra_params:
+                raise MXTPUError(f"Found name \"{name}\" that is not in "
+                                 "the arguments")
+        if aux_params:
+            for name, array in aux_params.items():
+                if name in self.aux_dict:
+                    self.aux_dict[name]._rebind(
+                        array.data.astype(self.aux_dict[name].data.dtype))
+                elif not allow_extra_params:
+                    raise MXTPUError(f"Found name \"{name}\" that is not in "
+                                     "auxiliary states")
+
+    def reshape(self, partial_shaping=False, allow_up_sizing=False,
+                **kwargs):
+        """Rebind with new input shapes, SHARING current parameter values
+        (parity: Executor.reshape — only reshaped inputs get new buffers)."""
+        shapes = {n: kwargs.get(n, tuple(a.shape))
+                  for n, a in self.arg_dict.items()}
+        new_exec = Executor._simple_bind(self._symbol, self._ctx,
+                                         self._grad_req, shapes)
+        for name, arr in self.arg_dict.items():
+            if name not in kwargs:
+                new_exec.arg_dict[name]._rebind(arr.data)
+        for name, arr in self.aux_dict.items():
+            if name not in kwargs:
+                new_exec.aux_dict[name]._rebind(arr.data)
+        return new_exec
+
+    def __repr__(self):
+        return "<Executor of %s>" % self._symbol.name

@@ -155,6 +155,10 @@ class Parameter:
                 data = NDArray(jnp.zeros(self._shape, jnp.dtype(self.dtype)))
                 initializer.create(init if init is not None else default_init)(
                     initializer.InitDesc(self.name), data)
+            if str(data.dtype) != str(self.dtype):
+                # initializers fill in fp32; honor a cast() that happened
+                # before the deferred init resolved
+                data = NDArray(data.data.astype(jnp.dtype(self.dtype)))
             self._init_impl(data, ctx)
 
     def _init_impl(self, data, ctx_list):
@@ -184,6 +188,10 @@ class Parameter:
             for c, a in zip(self._ctx_list, arr_list):
                 if c == ctx:
                     return a
+            # a mesh-sharded parameter serves every device in its mesh
+            # (SPMD path: there is one logical copy, XLA owns placement)
+            if len(arr_list) == 1 and arr_list[0].is_sharded:
+                return arr_list[0]
             raise MXTPUError(
                 f"Parameter {self.name} was not initialized on context {ctx}; "
                 f"it is on {self._ctx_list}")

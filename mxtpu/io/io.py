@@ -278,6 +278,7 @@ class PrefetchingIter(DataIter):
         self._queue = _queue.Queue(maxsize=2)
         self._stop = threading.Event()
         self._exc = None
+        self._finished = False
         self._thread = threading.Thread(target=self._run, daemon=True)
         self._thread.start()
         self.current_batch = None
@@ -338,12 +339,16 @@ class PrefetchingIter(DataIter):
         self._queue = _queue.Queue(maxsize=2)
         self._stop = threading.Event()
         self._exc = None
+        self._finished = False
         self._thread = threading.Thread(target=self._run, daemon=True)
         self._thread.start()
 
     def iter_next(self):
+        if self._finished:
+            return False  # repeated next() after exhaustion must not hang
         batches = self._queue.get()
         if batches is None:
+            self._finished = True
             if self._exc is not None:
                 exc, self._exc = self._exc, None
                 raise exc

@@ -673,3 +673,17 @@ def _tolist(x):
     if isinstance(x, (list, tuple)):
         return list(x)
     return [x]
+
+
+# short aliases (parity: the reference registers these names too)
+_METRIC_ALIASES = {
+    "acc": "accuracy",
+    "ce": "crossentropy",
+    "nll_loss": "negativeloglikelihood",
+    "top_k_accuracy": "topkaccuracy",
+    "top_k_acc": "topkaccuracy",
+    "pearsonr": "pearsoncorrelation",
+}
+for _alias, _target in _METRIC_ALIASES.items():
+    if _target in _METRIC_REGISTRY and _alias not in _METRIC_REGISTRY:
+        _METRIC_REGISTRY[_alias] = _METRIC_REGISTRY[_target]

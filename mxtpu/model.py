@@ -1,0 +1,142 @@
+"""Checkpoint format + FeedForward (parity: python/mxnet/model.py).
+
+save_checkpoint writes ``prefix-symbol.json`` + ``prefix-%04d.params`` —
+the format every reference-era tool reads (SURVEY §5 checkpoint/resume,
+format (b)); params use the mx.nd.save container with arg:/aux: prefixes.
+"""
+
+from __future__ import annotations
+
+import logging
+import warnings
+from collections import namedtuple
+
+from . import ndarray as nd
+from .base import MXTPUError
+
+__all__ = ["save_checkpoint", "load_checkpoint", "load_params",
+           "BatchEndParam", "FeedForward"]
+
+BatchEndParam = namedtuple("BatchEndParams",
+                           ["epoch", "nbatch", "eval_metric", "locals"])
+
+
+def save_checkpoint(prefix, epoch, symbol, arg_params, aux_params,
+                    remove_amp_cast=True):
+    """(parity: model.save_checkpoint)"""
+    if symbol is not None:
+        symbol.save("%s-symbol.json" % prefix)
+    save_dict = {("arg:%s" % k): v for k, v in arg_params.items()}
+    save_dict.update({("aux:%s" % k): v for k, v in aux_params.items()})
+    param_name = "%s-%04d.params" % (prefix, epoch)
+    nd.save(param_name, save_dict)
+    logging.info("Saved checkpoint to \"%s\"", param_name)
+
+
+def load_params(prefix, epoch):
+    """(parity: model.load_params) → (arg_params, aux_params)"""
+    save_dict = nd.load("%s-%04d.params" % (prefix, epoch))
+    arg_params = {}
+    aux_params = {}
+    for k, v in save_dict.items():
+        tp, name = k.split(":", 1)
+        if tp == "arg":
+            arg_params[name] = v
+        elif tp == "aux":
+            aux_params[name] = v
+    return arg_params, aux_params
+
+
+def load_checkpoint(prefix, epoch):
+    """(parity: model.load_checkpoint) → (symbol, arg_params, aux_params)"""
+    from . import symbol as sym
+    symbol = sym.load("%s-symbol.json" % prefix)
+    arg_params, aux_params = load_params(prefix, epoch)
+    return symbol, arg_params, aux_params
+
+
+class FeedForward:
+    """Ancient pre-Module API (parity: model.FeedForward) — thin veneer
+    over Module kept for checkpoint-era scripts."""
+
+    def __init__(self, symbol, ctx=None, num_epoch=None, epoch_size=None,
+                 optimizer="sgd", initializer=None, numpy_batch_size=128,
+                 arg_params=None, aux_params=None, allow_extra_params=False,
+                 begin_epoch=0, **kwargs):
+        warnings.warn("FeedForward is deprecated; use mx.mod.Module or "
+                      "Gluon instead (parity: the reference deprecated it "
+                      "the same way)", DeprecationWarning)
+        self._symbol = symbol
+        self._ctx = ctx
+        self._num_epoch = num_epoch
+        self._optimizer = optimizer
+        self._initializer = initializer
+        self._arg_params = arg_params
+        self._aux_params = aux_params
+        self._begin_epoch = begin_epoch
+        self._kwargs = kwargs
+        self._module = None
+
+    @property
+    def symbol(self):
+        return self._symbol
+
+    @staticmethod
+    def load(prefix, epoch, ctx=None, **kwargs):
+        symbol, arg_params, aux_params = load_checkpoint(prefix, epoch)
+        return FeedForward(symbol, ctx=ctx, arg_params=arg_params,
+                           aux_params=aux_params, begin_epoch=epoch,
+                           **kwargs)
+
+    def save(self, prefix, epoch=None):
+        if epoch is None:
+            epoch = self._num_epoch or 0
+        save_checkpoint(prefix, epoch, self._symbol,
+                        self._arg_params or {}, self._aux_params or {})
+
+    def _make_module(self, data_iter):
+        from .module import Module
+        label_names = [d[0] for d in (data_iter.provide_label or [])]
+        data_names = [d[0] for d in data_iter.provide_data]
+        mod = Module(self._symbol, data_names=data_names,
+                     label_names=label_names, context=self._ctx)
+        return mod
+
+    def fit(self, X, y=None, eval_data=None, eval_metric="acc",
+            epoch_end_callback=None, batch_end_callback=None,
+            kvstore="local", logger=None, work_load_list=None, monitor=None,
+            eval_end_callback=None, eval_batch_end_callback=None):
+        data_iter = self._as_iter(X, y)
+        self._module = self._make_module(data_iter)
+        self._module.fit(data_iter, eval_data=eval_data,
+                         eval_metric=eval_metric,
+                         epoch_end_callback=epoch_end_callback,
+                         batch_end_callback=batch_end_callback,
+                         kvstore=kvstore, optimizer=self._optimizer,
+                         optimizer_params=self._kwargs.get(
+                             "optimizer_params",
+                             (("learning_rate", 0.01),)),
+                         initializer=self._initializer,
+                         arg_params=self._arg_params,
+                         aux_params=self._aux_params,
+                         begin_epoch=self._begin_epoch,
+                         num_epoch=self._num_epoch, monitor=monitor)
+        self._arg_params, self._aux_params = self._module.get_params()
+
+    def predict(self, X, num_batch=None, return_data=False, reset=True):
+        data_iter = self._as_iter(X, None)
+        if self._module is None:
+            self._module = self._make_module(data_iter)
+            self._module.bind(data_shapes=data_iter.provide_data,
+                              label_shapes=None, for_training=False)
+            self._module.set_params(self._arg_params or {},
+                                    self._aux_params or {})
+        return self._module.predict(data_iter, num_batch=num_batch,
+                                    reset=reset)
+
+    @staticmethod
+    def _as_iter(X, y):
+        from .io import NDArrayIter, DataIter
+        if isinstance(X, DataIter):
+            return X
+        return NDArrayIter(X, y, batch_size=128)

@@ -1,0 +1,17 @@
+"""Flagship model families built tpu-first (transformer encoder/decoder).
+
+The reference's transformer story is GluonNLP BERT riding the fused
+interleaved-MHA kernels in src/operator/contrib/transformer.cc (SURVEY §2.1
+operator library row); its decoder-era models don't exist in MXNet 1.x.
+Here both live in-tree: BERT-style encoders (north-star config 3) and a
+Llama-style decoder (stretch config 5) designed for SPMD execution —
+sharding rules for tensor parallel, ring attention for sequence parallel,
+bf16-first compute.
+"""
+
+from . import transformer
+from .transformer import (MultiHeadAttention, TransformerEncoderLayer,
+                          TransformerEncoder, BERTModel, bert_base,
+                          LlamaDecoderLayer, TransformerLM, llama_tiny,
+                          llama_3_8b, transformer_lm_sharding_rules,
+                          bert_sharding_rules)

@@ -1,0 +1,253 @@
+"""Module (parity: python/mxnet/module/module.py).
+
+The reference's Module split batches across per-GPU executors
+(DataParallelExecutorGroup). On TPU a single Executor runs the graph and
+SPMD sharding is XLA's job, so the executor-group machinery collapses to
+one executor; the ctx list is accepted for API parity.
+"""
+
+from __future__ import annotations
+
+import logging
+
+import numpy as onp
+
+from .. import initializer as init_mod
+from .. import ndarray as nd
+from .. import optimizer as opt_mod
+from ..base import MXTPUError
+from ..context import cpu
+from ..ndarray import NDArray
+from .base_module import BaseModule
+
+__all__ = ["Module"]
+
+
+class Module(BaseModule):
+    def __init__(self, symbol, data_names=("data",), label_names=("softmax_label",),
+                 logger=logging, context=None, work_load_list=None,
+                 fixed_param_names=None, state_names=None,
+                 group2ctxs=None, compression_params=None):
+        super().__init__(logger=logger)
+        self._symbol = symbol
+        self._data_names = list(data_names or [])
+        self._label_names = list(label_names or [])
+        self._fixed_param_names = list(fixed_param_names or [])
+        self._state_names = list(state_names or [])
+        self._context = context if context is not None else cpu()
+
+        arg_names = symbol.list_arguments()
+        input_names = self._data_names + self._label_names + \
+            self._state_names
+        self._param_names = [n for n in arg_names if n not in input_names]
+        self._aux_names = symbol.list_auxiliary_states()
+        self._arg_params = None
+        self._aux_params = None
+        self._exec = None
+        self._optimizer = None
+        self._updater = None
+        self._kvstore = None
+        self._data_shapes = None
+        self._label_shapes = None
+        self._inputs_need_grad = False
+
+    @staticmethod
+    def load(prefix, epoch, load_optimizer_states=False, **kwargs):
+        """(parity: Module.load over save_checkpoint files)"""
+        from ..model import load_checkpoint
+        sym, args, auxs = load_checkpoint(prefix, epoch)
+        mod = Module(symbol=sym, **kwargs)
+        mod._arg_params = args
+        mod._aux_params = auxs
+        mod.params_initialized = True
+        if load_optimizer_states:
+            mod._preload_opt_states = "%s-%04d.states" % (prefix, epoch)
+        return mod
+
+    def save_checkpoint(self, prefix, epoch, save_optimizer_states=False):
+        from ..model import save_checkpoint
+        arg_params, aux_params = self.get_params()
+        save_checkpoint(prefix, epoch, self.symbol, arg_params, aux_params)
+        if save_optimizer_states and self._updater is not None:
+            with open("%s-%04d.states" % (prefix, epoch), "wb") as f:
+                f.write(self._updater.get_states())
+
+    # -- binding ----------------------------------------------------------
+    @property
+    def data_names(self):
+        return self._data_names
+
+    @property
+    def label_names(self):
+        return self._label_names
+
+    @property
+    def output_names(self):
+        return self._symbol.list_outputs()
+
+    @property
+    def data_shapes(self):
+        return self._data_shapes
+
+    @property
+    def label_shapes(self):
+        return self._label_shapes
+
+    @property
+    def output_shapes(self):
+        assert self.binded
+        return [(n, tuple(o.shape)) for n, o in
+                zip(self.output_names, self._exec.outputs)]
+
+    def bind(self, data_shapes, label_shapes=None, for_training=True,
+             inputs_need_grad=False, force_rebind=False,
+             shared_module=None, grad_req="write"):
+        if self.binded and not force_rebind:
+            self.logger.warning("Already bound, ignoring bind()")
+            return
+        self.for_training = for_training
+        self._inputs_need_grad = inputs_need_grad
+        self._data_shapes = list(data_shapes)
+        self._label_shapes = list(label_shapes) if label_shapes else []
+
+        shapes = {}
+        for desc in self._data_shapes + self._label_shapes:
+            name, shape = desc[0], desc[1]
+            shapes[name] = tuple(shape)
+        arg_shapes, _, aux_shapes = self._symbol.infer_shape_partial(**shapes)
+        arg_names = self._symbol.list_arguments()
+        args = {}
+        grad_req_dict = {}
+        for name, shp in zip(arg_names, arg_shapes or [None] * len(arg_names)):
+            shp = shapes.get(name, shp)
+            if shp is None:
+                raise MXTPUError(
+                    f"bind: cannot infer shape of {name}; provide "
+                    "data/label shapes covering it")
+            args[name] = nd.zeros(shp)
+            if name in self._param_names and name not in \
+                    self._fixed_param_names and for_training:
+                grad_req_dict[name] = grad_req if isinstance(grad_req, str) \
+                    else grad_req.get(name, "write")
+            elif name in self._data_names and inputs_need_grad:
+                grad_req_dict[name] = "write"
+            else:
+                grad_req_dict[name] = "null"
+        auxes = {}
+        aux_names = self._aux_names
+        for name, shp in zip(aux_names, aux_shapes or [None] * len(aux_names)):
+            shp = shapes.get(name, shp)
+            auxes[name] = nd.zeros(shp) if shp else nd.zeros(())
+        from ..executor import Executor
+        self._exec = Executor(self._symbol, self._context, args, None,
+                              grad_req_dict, auxes)
+        self.binded = True
+        if shared_module is not None and shared_module.params_initialized:
+            self.set_params(*shared_module.get_params())
+        elif self.params_initialized:
+            # Module.load path: push loaded params into the executor
+            self._exec.copy_params_from(self._arg_params, self._aux_params,
+                                        allow_extra_params=True)
+
+    def init_params(self, initializer=None, arg_params=None, aux_params=None,
+                    allow_missing=False, force_init=False, allow_extra=False):
+        if self.params_initialized and not force_init:
+            return
+        assert self.binded, "call bind before init_params"
+        if initializer is None:
+            initializer = init_mod.Uniform(0.01)
+        elif isinstance(initializer, str):
+            initializer = init_mod.create(initializer)
+
+        for name in self._param_names:
+            arr = self._exec.arg_dict[name]
+            src = (arg_params or {}).get(name)
+            if src is not None:
+                arr._rebind(src.data.astype(arr.data.dtype))
+            else:
+                if arg_params is not None and not allow_missing and not \
+                        self.params_initialized:
+                    raise MXTPUError(f"arg_params missing {name}")
+                initializer(init_mod.InitDesc(name), arr)
+        for name in self._aux_names:
+            arr = self._exec.aux_dict[name]
+            src = (aux_params or {}).get(name)
+            if src is not None:
+                arr._rebind(src.data.astype(arr.data.dtype))
+            else:
+                initializer(init_mod.InitDesc(name), arr)
+        self.params_initialized = True
+
+    def get_params(self):
+        assert self.binded and self.params_initialized
+        arg = {n: self._exec.arg_dict[n].copy() for n in self._param_names}
+        aux = {n: self._exec.aux_dict[n].copy() for n in self._aux_names}
+        return arg, aux
+
+    # -- optimizer --------------------------------------------------------
+    def init_optimizer(self, kvstore="local", optimizer="sgd",
+                       optimizer_params=None, force_init=False):
+        assert self.binded and self.params_initialized
+        if self.optimizer_initialized and not force_init:
+            return
+        optimizer_params = dict(optimizer_params or {})
+        if isinstance(optimizer, str):
+            batch_size = self._data_shapes[0][1][0]
+            optimizer_params.setdefault("rescale_grad", 1.0 / batch_size)
+            optimizer = opt_mod.create(optimizer, **optimizer_params)
+        self._optimizer = optimizer
+        self._updater = opt_mod.get_updater(optimizer)
+        from .. import kvstore as kv_mod
+        if kvstore:
+            kv = kv_mod.create(kvstore) if isinstance(kvstore, str) else \
+                kvstore
+            self._kvstore = kv
+        self.optimizer_initialized = True
+        if hasattr(self, "_preload_opt_states"):
+            with open(self._preload_opt_states, "rb") as f:
+                self._updater.set_states(f.read())
+            del self._preload_opt_states
+
+    # -- execution --------------------------------------------------------
+    def forward(self, data_batch, is_train=None):
+        assert self.binded and self.params_initialized
+        if is_train is None:
+            is_train = self.for_training
+        feed = {}
+        for name, arr in zip(self._data_names, data_batch.data):
+            feed[name] = arr
+        if data_batch.label:
+            for name, arr in zip(self._label_names, data_batch.label):
+                feed[name] = arr
+        self._exec.forward(is_train=is_train, **feed)
+
+    def backward(self, out_grads=None):
+        assert self.binded and self.params_initialized
+        self._exec.backward(out_grads=out_grads)
+
+    def update(self):
+        assert self.binded and self.params_initialized and \
+            self.optimizer_initialized
+        for i, name in enumerate(self._param_names):
+            w = self._exec.arg_dict[name]
+            g = self._exec.grad_dict.get(name)
+            if g is None:
+                continue
+            self._updater(i, g, w)
+
+    def get_outputs(self, merge_multi_context=True):
+        assert self.binded and self.params_initialized
+        return list(self._exec.outputs)
+
+    def get_input_grads(self, merge_multi_context=True):
+        assert self.binded and self._inputs_need_grad
+        return [self._exec.grad_dict.get(n) for n in self._data_names]
+
+    def update_metric(self, eval_metric, labels, pre_sliced=False):
+        eval_metric.update_dict(
+            dict(zip(self._label_names, labels)),
+            dict(zip(self.output_names, self._exec.outputs)))
+
+    def install_monitor(self, mon):
+        assert self.binded
+        mon.install()

@@ -1,0 +1,92 @@
+"""Monitor: per-op output statistics for debugging (parity:
+python/mxnet/monitor.py — Monitor over the executor monitor callback).
+
+The reference installs a callback in the executor that taps every op's
+outputs; here the tap hooks the imperative dispatch path
+(ndarray.invoke_op) so both eager and Module-shim execution are covered.
+Inside jit nothing is tapped (XLA owns that program) — install before
+hybridize for full visibility, exactly like the reference's advice to
+monitor un-fused executions.
+"""
+
+from __future__ import annotations
+
+import logging
+import math
+import re
+
+from . import ndarray as nd
+from .ndarray import NDArray
+from .ndarray import ndarray as _ndmod
+
+__all__ = ["Monitor"]
+
+
+class Monitor:
+    def __init__(self, interval, stat_func=None, pattern=".*", sort=False):
+        if stat_func is None:
+            def asum_stat(x):
+                return nd.norm(x) / math.sqrt(x.size)
+            stat_func = asum_stat
+        self.stat_func = stat_func
+        self.interval = interval
+        self.activated = False
+        self.queue = []
+        self.step = 0
+        self.exes = []
+        self.re_prog = re.compile(pattern)
+        self.sort = sort
+
+    def install(self, exe=None):
+        """Register the tap (parity: Monitor.install(exe); exe optional —
+        the tap is global on the dispatch path)."""
+        self.exes.append(exe)
+
+    def _stat_helper(self, name, arr):
+        if not self.activated or not self.re_prog.match(name):
+            return
+        self.queue.append((self.step, name, self.stat_func(arr)))
+
+    def tic(self):
+        """Start collecting for this batch (parity: Monitor.tic)."""
+        if self.step % self.interval == 0:
+            self.queue = []
+            self.activated = True
+            if self._tap not in _ndmod._OUTPUT_MONITORS:
+                _ndmod._OUTPUT_MONITORS.append(self._tap)
+        self.step += 1
+
+    def _tap(self, op_name, out):
+        self._stat_helper(op_name, out)
+
+    def toc(self):
+        """Stop collecting, return list of (step, opname, stat)."""
+        if not self.activated:
+            return []
+        self.activated = False
+        if self._tap in _ndmod._OUTPUT_MONITORS:
+            _ndmod._OUTPUT_MONITORS.remove(self._tap)
+        res = []
+        queue = self.queue
+        if self.sort:
+            queue = sorted(queue, key=lambda x: x[1])
+        for n, k, v_list in queue:
+            if isinstance(v_list, NDArray):
+                v_list = [v_list]
+            assert isinstance(v_list, list)
+            s = ""
+            for v in v_list:
+                assert isinstance(v, NDArray)
+                if v.size == 1:
+                    s += str(v.asnumpy().reshape(-1)[0]) + "\t"
+                else:
+                    s += str(v.asnumpy()) + "\t"
+            res.append((n, k, s))
+        self.queue = []
+        return res
+
+    def toc_print(self):
+        """(parity: Monitor.toc_print)"""
+        res = self.toc()
+        for n, k, v in res:
+            logging.info("Batch: {:7d} {:30s} {:s}".format(n, k, v))

@@ -85,12 +85,21 @@ class NDArray:
         if self._ctx is not None:
             return self._ctx
         try:
-            dev = list(self._data.devices())[0]
+            # deterministic for sharded arrays: lowest device id
+            dev = min(self._data.devices(), key=lambda d: d.id)
             if dev.platform == "cpu":
                 return Context("cpu", dev.id)
             return Context("tpu", dev.id)
         except Exception:  # tracers have no device
             return current_context()
+
+    @property
+    def is_sharded(self) -> bool:
+        """True when the buffer spans multiple devices (SPMD array)."""
+        try:
+            return len(self._data.devices()) > 1
+        except Exception:
+            return False
 
     ctx = context
 
@@ -535,6 +544,11 @@ def invoke_op(name: str, args: tuple, kwargs: dict):
             except AttributeError:
                 pass  # tracer
 
+    if _OUTPUT_MONITORS:
+        for cb in list(_OUTPUT_MONITORS):
+            for o in out_list:
+                cb(name, o)
+
     if out is not None:
         if isinstance(outs, tuple):
             raise MXTPUError("out= with multi-output op unsupported")
@@ -551,6 +565,10 @@ def invoke_op(name: str, args: tuple, kwargs: dict):
 _NEEDS_TRAIN_FLAG = {"Dropout", "dropout", "BatchNorm", "batch_norm",
                      "RNN", "rnn"}
 _NEEDS_KEY = {"Dropout", "dropout", "RNN", "rnn"}
+
+# op-output taps installed by mx.monitor.Monitor (parity: executor monitor
+# callback — the reference taps op outputs in the engine)
+_OUTPUT_MONITORS: list = []
 
 
 def array(source, ctx: Optional[Context] = None, dtype=None) -> NDArray:

@@ -164,3 +164,92 @@ def quantize(data, min_range, max_range, out_type="uint8"):
 def dequantize(data, min_range, max_range, out_type="float32"):
     scale = (max_range - min_range) / 255.0
     return data.astype(jnp.float32) * scale + min_range
+
+
+@register_op("rms_norm", aliases=("_contrib_rms_norm",))
+def rms_norm(data, gamma, eps=1e-6):
+    """RMSNorm (no reference analogue — LayerNorm sans mean; the Llama-era
+    norm). Computed in fp32 for bf16 stability, cast back."""
+    dt = data.dtype
+    x = data.astype(jnp.float32)
+    var = jnp.mean(x * x, axis=-1, keepdims=True)
+    out = x * jax.lax.rsqrt(var + eps)
+    return (out * gamma.astype(jnp.float32)).astype(dt)
+
+
+@register_op("rope", aliases=("_contrib_rope",))
+def rope(data, base=10000.0, offset=0, scale=1.0):
+    """Rotary position embedding over the last dim of (B, H, T, D) or
+    (B, T, D). Pairs are (x[..., :D/2], x[..., D/2:]) — the Llama layout."""
+    dt = data.dtype
+    x = data.astype(jnp.float32)
+    D = x.shape[-1]
+    T = x.shape[-2]
+    half = D // 2
+    freqs = base ** (-jnp.arange(0, half, dtype=jnp.float32) / half)
+    pos = (jnp.arange(T, dtype=jnp.float32) + offset) * scale
+    ang = pos[:, None] * freqs[None, :]          # (T, D/2)
+    sin, cos = jnp.sin(ang), jnp.cos(ang)
+    shape = (1,) * (x.ndim - 2) + (T, half)
+    sin = sin.reshape(shape)
+    cos = cos.reshape(shape)
+    x1, x2 = x[..., :half], x[..., half:]
+    out = jnp.concatenate([x1 * cos - x2 * sin, x1 * sin + x2 * cos],
+                          axis=-1)
+    return out.astype(dt)
+
+
+@register_op("masked_softmax", aliases=("_contrib_masked_softmax",))
+def masked_softmax(data, mask=None, axis=-1, temperature=1.0):
+    """Softmax with additive/boolean mask (parity: masked_softmax in later
+    reference lines; fp32 accumulation)."""
+    dt = data.dtype
+    x = data.astype(jnp.float32)
+    if temperature != 1.0:
+        x = x / temperature
+    if mask is not None:
+        if mask.dtype == jnp.bool_:
+            x = jnp.where(mask, x, -jnp.inf)
+        else:
+            x = x + mask.astype(jnp.float32)
+    out = jax.nn.softmax(x, axis=axis)
+    return out.astype(dt)
+
+
+@register_op("batch_dot_attn")
+def batch_dot_attn(q, k):
+    """Attention scores q·kᵀ over (B, H, T, D) (parity: the qk half of
+    _contrib_interleaved_matmul_selfatt_qk, batch-major layout). fp32
+    accumulation on the MXU via preferred_element_type."""
+    return jnp.einsum("bhqd,bhkd->bhqk", q, k,
+                      preferred_element_type=jnp.float32).astype(q.dtype)
+
+
+@register_op("attn_value")
+def attn_value(attn, v):
+    """Attention-weighted values (parity: the valatt half of the fused
+    interleaved kernels, batch-major)."""
+    return jnp.einsum("bhqk,bhkd->bhqd", attn, v,
+                      preferred_element_type=jnp.float32).astype(v.dtype)
+
+
+@register_op("causal_mask_fill")
+def causal_mask_fill(scores, value=-1e9):
+    """Add a causal mask to (..., Tq, Tk) scores."""
+    Tq, Tk = scores.shape[-2], scores.shape[-1]
+    mask = jnp.tril(jnp.ones((Tq, Tk), jnp.bool_), Tk - Tq)
+    return jnp.where(mask, scores, jnp.asarray(value, scores.dtype))
+
+
+@register_op("ring_attention")
+def ring_attention_op(q, k, v, causal=False, scale=None, _mesh=None,
+                      seq_axis="sp", batch_axis="dp"):
+    """Sequence-parallel exact attention (shard_map + ppermute over the
+    mesh's sp axis). Registered as an op so the imperative autograd tape
+    records it like any other (no reference analogue — SURVEY §2.3 lists
+    SP as absent upstream)."""
+    from ..parallel.ring_attention import ring_self_attention
+    if _mesh is None:
+        raise ValueError("ring_attention requires _mesh=DeviceMesh")
+    return ring_self_attention(q, k, v, _mesh, causal=causal, scale=scale,
+                               batch_axis=batch_axis, seq_axis=seq_axis)

@@ -151,28 +151,38 @@ def pooling(x, kernel=(), pool_type="max", global_pool=False, stride=(),
             extra[i] = (full_out - valid_out) * stride[i]
     padding = ((0, 0), (0, 0)) + tuple(
         (p, p + e) for p, e in zip(pad, extra))
+    # reduce_window's reverse-mode (select_and_gather_add) rejects 16-bit
+    # floats on some backends; pool in fp32 and cast back (max is exact,
+    # avg/sum gain accuracy)
+    in_dtype = x.dtype
+    if in_dtype in (jnp.bfloat16, jnp.float16):
+        x = x.astype(jnp.float32)
+    # NOTE: init MUST be a python scalar literal — a traced array defeats
+    # jax's monoid recognition and reduce_window loses its autodiff rule
     if pool_type == "max":
-        init = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else jnp.iinfo(x.dtype).min
-        return lax.reduce_window(x, jnp.asarray(init, x.dtype), lax.max,
-                                 window, strides, padding)
+        init = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) \
+            else int(jnp.iinfo(x.dtype).min)
+        return lax.reduce_window(x, init, lax.max,
+                                 window, strides, padding).astype(in_dtype)
     if pool_type in ("avg", "sum"):
-        summed = lax.reduce_window(x, jnp.asarray(0, x.dtype), lax.add,
+        zero = 0.0 if jnp.issubdtype(x.dtype, jnp.floating) else 0
+        summed = lax.reduce_window(x, zero, lax.add,
                                    window, strides, padding)
         if pool_type == "sum":
-            return summed
+            return summed.astype(in_dtype)
         if count_include_pad:
             denom = 1
             for k in kernel:
                 denom *= k
-            return summed / denom
+            return (summed / denom).astype(in_dtype)
         ones = jnp.ones_like(x)
-        counts = lax.reduce_window(ones, jnp.asarray(0, x.dtype), lax.add,
+        counts = lax.reduce_window(ones, zero, lax.add,
                                    window, strides, padding)
-        return summed / counts
+        return (summed / counts).astype(in_dtype)
     if pool_type == "lp":
-        p2 = lax.reduce_window(jnp.square(x), jnp.asarray(0, x.dtype), lax.add,
+        p2 = lax.reduce_window(jnp.square(x), 0.0, lax.add,
                                window, strides, padding)
-        return jnp.sqrt(p2)
+        return jnp.sqrt(p2).astype(in_dtype)
     raise ValueError(f"unknown pool_type {pool_type}")
 
 
@@ -296,6 +306,8 @@ def batch_norm(x, gamma, beta, moving_mean, moving_var, eps=1e-5,
     """
     if fix_gamma:
         gamma = jnp.ones_like(gamma)
+    in_dtype = x.dtype
+    x = x.astype(jnp.float32)  # stats in fp32; output back in input dtype
     red = tuple(i for i in range(x.ndim) if i != axis)
     shape = [1] * x.ndim
     shape[axis] = x.shape[axis]
@@ -304,8 +316,10 @@ def batch_norm(x, gamma, beta, moving_mean, moving_var, eps=1e-5,
         var = jnp.mean(jnp.square(x - mean.reshape(shape)), axis=red)
     else:
         mean, var = moving_mean, moving_var
-    inv = lax.rsqrt(var.reshape(shape) + eps)
-    out = (x - mean.reshape(shape)) * inv * gamma.reshape(shape) + beta.reshape(shape)
+    inv = lax.rsqrt(var.reshape(shape).astype(jnp.float32) + eps)
+    out = (x - mean.reshape(shape)) * inv * gamma.reshape(shape) + \
+        beta.reshape(shape)
+    out = out.astype(in_dtype)
     if output_mean_var:
         return out, mean, var
     return out
@@ -379,28 +393,110 @@ def embedding(data, weight, input_dim=0, output_dim=0, dtype="float32",
 # legacy symbolic-loss heads
 # ---------------------------------------------------------------------------
 
+# The *Output heads carry the reference's implicit-loss-gradient semantics
+# (src/operator/softmax_output.cc, regression_output-inl.h): forward is the
+# prediction; backward wrt data is the LOSS gradient (the incoming cotangent
+# — ones from Executor.backward — is ignored), encoded via custom_vjp.
+
+import functools
+
+
+@functools.lru_cache(maxsize=64)
+def _softmax_output_cvjp(grad_scale, ignore_label, multi_output, use_ignore,
+                         normalization, smooth_alpha):
+    """custom_vjp softmax-output specialized on its static config."""
+
+    @jax.custom_vjp
+    def op(data, label):
+        return jax.nn.softmax(data, axis=1 if multi_output else -1)
+
+    def op_fwd(data, label):
+        return op(data, label), (op(data, label), label)
+
+    def op_bwd(res, g):
+        p, label = res
+        axis = 1 if multi_output else -1
+        nclass = p.shape[axis]
+        lab = label.astype(jnp.int32)
+        onehot = jax.nn.one_hot(lab, nclass, axis=axis, dtype=p.dtype)
+        if smooth_alpha:
+            onehot = onehot * (1.0 - smooth_alpha) + smooth_alpha / nclass
+        grad = p - onehot
+        if use_ignore:
+            valid = (lab != ignore_label)
+            grad = grad * jnp.expand_dims(valid, axis).astype(p.dtype)
+        if normalization == "batch":
+            grad = grad / p.shape[0]
+        elif normalization == "valid":
+            if use_ignore:
+                grad = grad / jnp.maximum(valid.sum(), 1).astype(p.dtype)
+            else:
+                grad = grad / p.shape[0]
+        return (grad * grad_scale, None)
+
+    op.defvjp(op_fwd, op_bwd)
+    return op
+
+
 @register_op("SoftmaxOutput", aliases=("softmax_output",))
 def softmax_output(data, label=None, grad_scale=1.0, ignore_label=-1,
                    multi_output=False, use_ignore=False, preserve_shape=False,
                    normalization="null", out_grad=False, smooth_alpha=0.0):
-    # Forward is softmax; the implicit-CE-gradient trick of the reference is
-    # realised by gluon.loss.SoftmaxCrossEntropyLoss instead.
-    return jax.nn.softmax(data, axis=-1 if not multi_output else 1)
+    if label is None:
+        return jax.nn.softmax(data, axis=1 if multi_output else -1)
+    return _softmax_output_cvjp(float(grad_scale), int(ignore_label),
+                                bool(multi_output), bool(use_ignore),
+                                str(normalization),
+                                float(smooth_alpha))(data, label)
+
+
+def _make_regression_output(grad_fn, pred_fn=lambda d: d):
+    @functools.lru_cache(maxsize=16)
+    def specialized(grad_scale):
+        @jax.custom_vjp
+        def op(data, label):
+            return pred_fn(data)
+
+        def op_fwd(data, label):
+            return pred_fn(data), (data, label)
+
+        def op_bwd(res, g):
+            data, label = res
+            lab = label.reshape(data.shape).astype(data.dtype)
+            return (grad_fn(data, lab) * grad_scale, None)
+
+        op.defvjp(op_fwd, op_bwd)
+        return op
+
+    return lambda data, label, grad_scale: \
+        specialized(float(grad_scale))(data, label)
+
+
+_linreg_cvjp = _make_regression_output(lambda d, l: d - l)
+_maereg_cvjp = _make_regression_output(lambda d, l: jnp.sign(d - l))
+_logreg_cvjp = _make_regression_output(
+    lambda d, l: jax.nn.sigmoid(d) - l, pred_fn=jax.nn.sigmoid)
 
 
 @register_op("LinearRegressionOutput")
 def linear_regression_output(data, label=None, grad_scale=1.0):
-    return data
+    if label is None:
+        return data
+    return _linreg_cvjp(data, label, grad_scale)
 
 
 @register_op("MAERegressionOutput")
 def mae_regression_output(data, label=None, grad_scale=1.0):
-    return data
+    if label is None:
+        return data
+    return _maereg_cvjp(data, label, grad_scale)
 
 
 @register_op("LogisticRegressionOutput")
 def logistic_regression_output(data, label=None, grad_scale=1.0):
-    return jax.nn.sigmoid(data)
+    if label is None:
+        return jax.nn.sigmoid(data)
+    return _logreg_cvjp(data, label, grad_scale)
 
 
 @register_op("BilinearSampler")

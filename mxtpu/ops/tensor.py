@@ -708,3 +708,39 @@ def _internal_getitem(x, key=None):
     NDArray.__getitem__ (parity: the reference records slice/gather ops
     through Imperative::RecordOp the same way)."""
     return x[key]
+
+
+# ---------------------------------------------------------------------------
+# scalar-operand ops (parity: elemwise_binary_scalar_op — the _*_scalar
+# family the reference generates for NDArray/Symbol scalar arithmetic)
+# ---------------------------------------------------------------------------
+
+_SCALAR_OPS = {
+    "_plus_scalar": lambda x, scalar: x + scalar,
+    "_minus_scalar": lambda x, scalar: x - scalar,
+    "_rminus_scalar": lambda x, scalar: scalar - x,
+    "_mul_scalar": lambda x, scalar: x * scalar,
+    "_div_scalar": lambda x, scalar: x / scalar,
+    "_rdiv_scalar": lambda x, scalar: scalar / x,
+    "_mod_scalar": lambda x, scalar: jnp.mod(x, scalar),
+    "_rmod_scalar": lambda x, scalar: jnp.mod(scalar, x),
+    "_power_scalar": lambda x, scalar: jnp.power(x, scalar),
+    "_rpower_scalar": lambda x, scalar: jnp.power(scalar, x),
+    "_maximum_scalar": lambda x, scalar: jnp.maximum(x, scalar),
+    "_minimum_scalar": lambda x, scalar: jnp.minimum(x, scalar),
+}
+for _sname, _sfn in _SCALAR_OPS.items():
+    register_op(_sname)(
+        lambda x, scalar=0.0, _f=_sfn: _f(x, scalar))
+
+_SCALAR_CMP = {
+    "_equal_scalar": jnp.equal,
+    "_not_equal_scalar": jnp.not_equal,
+    "_greater_scalar": jnp.greater,
+    "_greater_equal_scalar": jnp.greater_equal,
+    "_lesser_scalar": jnp.less,
+    "_lesser_equal_scalar": jnp.less_equal,
+}
+for _sname, _sfn in _SCALAR_CMP.items():
+    register_op(_sname, differentiable=False)(
+        lambda x, scalar=0.0, _f=_sfn: _f(x, scalar).astype(x.dtype))

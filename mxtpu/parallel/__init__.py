@@ -1,0 +1,31 @@
+"""Distributed & parallel execution (TPU-native replacement for the
+reference's src/kvstore/ + 3rdparty/ps-lite + NCCL stack).
+
+The reference scales by process-level machinery: ps-lite worker/server/
+scheduler processes over ZeroMQ (kvstore_dist.h), NCCL all-reduce
+(kvstore_nccl.h), per-GPU executor groups.  On TPU the same capabilities are
+compiler-level: pick a `jax.sharding.Mesh` over the device grid, annotate
+array shardings, and XLA inserts the collectives that ride ICI/DCN.
+
+Components:
+- mesh:        DeviceMesh construction (dp/tp/pp/sp axes) + process init
+               (`init_process_group` ≈ ps-lite rendezvous via
+               jax.distributed.initialize)
+- collectives: all_reduce/all_gather/reduce_scatter/ppermute wrappers
+               (the NCCL verbs, as XLA collectives)
+- sharding:    ShardingRules — parameter-name regex → PartitionSpec
+               (Megatron-style tensor parallel layouts as data)
+- trainer:     SPMDTrainer — jits a full train step (fwd+bwd+optimizer)
+               over the mesh; gradients sync via compiled psum, optimizer
+               runs sharded (ZeRO-style) or replicated
+- ring_attention: sequence-parallel blockwise attention via shard_map +
+               ppermute (long-context path; absent in the reference,
+               required for TPU scale)
+"""
+
+from .mesh import (DeviceMesh, make_mesh, init_process_group, rank,
+                   num_workers)
+from . import collectives
+from .sharding import ShardingRules, PartitionSpec
+from .trainer import SPMDTrainer
+from . import ring_attention

@@ -1,0 +1,112 @@
+"""Device mesh + multi-process rendezvous.
+
+Parity map: `init_process_group` replaces the ps-lite scheduler rendezvous
+(3rdparty/ps-lite Postoffice/Van over DMLC_* env); `make_mesh` replaces the
+device-placement machinery (executor PlaceDevice pass / kvstore comm
+topology) with an explicit named mesh that shardings refer to.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import jax
+import numpy as onp
+from jax.sharding import Mesh
+
+__all__ = ["DeviceMesh", "make_mesh", "init_process_group", "rank",
+           "num_workers"]
+
+_AXIS_ORDER = ("dp", "pp", "sp", "tp")  # tp innermost: highest-bandwidth ICI
+
+
+def init_process_group(coordinator_address: Optional[str] = None,
+                       num_processes: Optional[int] = None,
+                       process_id: Optional[int] = None):
+    """Multi-host rendezvous (parity: ps-lite scheduler + DMLC_* env).
+
+    Maps the reference's launcher env (DMLC_PS_ROOT_URI/DMLC_PS_ROOT_PORT,
+    DMLC_NUM_WORKER, DMLC_WORKER_ID) onto jax.distributed.initialize when
+    explicit arguments are not given; on TPU pods with the standard runtime
+    all three are auto-detected and this is a no-op wrapper.
+    """
+    if coordinator_address is None:
+        uri = os.environ.get("DMLC_PS_ROOT_URI")
+        port = os.environ.get("DMLC_PS_ROOT_PORT", "9000")
+        if uri:
+            coordinator_address = f"{uri}:{port}"
+    if num_processes is None and "DMLC_NUM_WORKER" in os.environ:
+        num_processes = int(os.environ["DMLC_NUM_WORKER"])
+    if process_id is None and "DMLC_WORKER_ID" in os.environ:
+        process_id = int(os.environ["DMLC_WORKER_ID"])
+    jax.distributed.initialize(coordinator_address=coordinator_address,
+                               num_processes=num_processes,
+                               process_id=process_id)
+
+
+def rank() -> int:
+    """This worker's rank (parity: kvstore.rank)."""
+    return jax.process_index()
+
+
+def num_workers() -> int:
+    """World size in processes (parity: kvstore.num_workers)."""
+    return jax.process_count()
+
+
+class DeviceMesh:
+    """A named device mesh with dp/pp/sp/tp axes.
+
+    Thin, picklable-spec wrapper over jax.sharding.Mesh; `mesh.jax_mesh` is
+    the object pjit consumes. Axis sizes of 1 are kept (harmless for
+    PartitionSpec) so sharding rules can always name every axis.
+    """
+
+    def __init__(self, dp: int = 1, tp: int = 1, sp: int = 1, pp: int = 1,
+                 devices=None):
+        if devices is None:
+            devices = jax.devices()
+        need = dp * tp * sp * pp
+        if need > len(devices):
+            raise ValueError(
+                f"mesh dp*tp*sp*pp={need} exceeds {len(devices)} devices")
+        devices = devices[:need]
+        sizes = {"dp": dp, "pp": pp, "sp": sp, "tp": tp}
+        shape = tuple(sizes[a] for a in _AXIS_ORDER)
+        arr = onp.asarray(devices).reshape(shape)
+        self.axis_sizes = sizes
+        self.jax_mesh = Mesh(arr, _AXIS_ORDER)
+
+    @property
+    def axis_names(self):
+        return _AXIS_ORDER
+
+    def size(self, axis: str) -> int:
+        return self.axis_sizes[axis]
+
+    @property
+    def num_devices(self) -> int:
+        n = 1
+        for v in self.axis_sizes.values():
+            n *= v
+        return n
+
+    def __enter__(self):
+        self._ctx = self.jax_mesh.__enter__()
+        return self
+
+    def __exit__(self, *a):
+        return self.jax_mesh.__exit__(*a)
+
+    def __repr__(self):
+        return "DeviceMesh(%s)" % ", ".join(
+            "%s=%d" % (a, self.axis_sizes[a]) for a in _AXIS_ORDER)
+
+
+def make_mesh(dp: int = 1, tp: int = 1, sp: int = 1, pp: int = 1,
+              devices=None) -> DeviceMesh:
+    """Build a DeviceMesh; with no arguments, all local devices go to dp."""
+    if dp == 1 and tp == 1 and sp == 1 and pp == 1 and devices is None:
+        dp = len(jax.devices())
+    return DeviceMesh(dp=dp, tp=tp, sp=sp, pp=pp, devices=devices)

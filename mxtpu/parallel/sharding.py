@@ -1,0 +1,58 @@
+"""Sharding rules: parameter-name patterns → PartitionSpec.
+
+The reference's model parallelism was coarse device placement (Symbol
+group2ctx + the PlaceDevice pass); tensor parallelism did not exist in
+MXNet 1.x (SURVEY §2.3). Here TP layouts are data: an ordered rule list
+`(regex, PartitionSpec)`, first match wins, default replicate. Megatron
+conventions: column-parallel weights shard the output dim on "tp",
+row-parallel shard the input dim.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Iterable, List, Optional, Tuple
+
+import jax
+from jax.sharding import NamedSharding, PartitionSpec
+
+__all__ = ["ShardingRules", "PartitionSpec"]
+
+
+class ShardingRules:
+    """Ordered (pattern → PartitionSpec) mapping for parameter pytrees."""
+
+    def __init__(self, rules: Optional[Iterable[Tuple[str, PartitionSpec]]]
+                 = None):
+        self._rules: List[Tuple[re.Pattern, PartitionSpec]] = [
+            (re.compile(pat), spec) for pat, spec in (rules or [])]
+
+    def add(self, pattern: str, spec: PartitionSpec):
+        self._rules.append((re.compile(pattern), spec))
+        return self
+
+    def spec_for(self, name: str, ndim: int) -> PartitionSpec:
+        for pat, spec in self._rules:
+            if pat.search(name):
+                if len(spec) > ndim:
+                    raise ValueError(
+                        f"rule {pat.pattern} spec {spec} has more axes than "
+                        f"param {name} (ndim={ndim})")
+                return spec
+        return PartitionSpec()  # replicate
+
+    def sharding_for(self, name: str, ndim: int, mesh) -> NamedSharding:
+        jm = getattr(mesh, "jax_mesh", mesh)
+        return NamedSharding(jm, self.spec_for(name, ndim))
+
+    def shard_params(self, named_arrays: dict, mesh) -> dict:
+        """device_put every array to its rule's NamedSharding."""
+        out = {}
+        for name, arr in named_arrays.items():
+            out[name] = jax.device_put(
+                arr, self.sharding_for(name, arr.ndim, mesh))
+        return out
+
+    def __repr__(self):
+        return "ShardingRules(%s)" % ", ".join(
+            f"{p.pattern!r}→{s}" for p, s in self._rules)

@@ -1,0 +1,233 @@
+"""SPMDTrainer: the whole training step as ONE compiled SPMD program.
+
+Parity map (SURVEY §3.3): the reference's Trainer.step pipeline —
+allreduce_grads through KVStore (engine ops → NCCL/ps-lite) then per-param
+optimizer update ops — becomes a single jitted function over the device
+mesh: forward + backward + gradient sync (XLA-inserted collectives over the
+"dp" axis) + optimizer update, with parameter/optimizer-state shardings
+given by ShardingRules (tp) and batch sharding over dp/sp.  The
+`update_on_kvstore` question dissolves: the update happens wherever XLA
+placed the shard (ZeRO-flavored when states are sharded).
+
+This is the TPU-native training path; gluon.Trainer + KVStore remains for
+API parity and single-chip use.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from .. import autograd, ndarray as nd, optimizer as opt_mod
+from .. import random as _random
+from ..ndarray import NDArray
+from .mesh import DeviceMesh
+from .sharding import ShardingRules
+
+__all__ = ["SPMDTrainer"]
+
+
+class SPMDTrainer:
+    """Compiles (block, loss, optimizer) into a sharded train step.
+
+    Parameters
+    ----------
+    block : gluon.Block — initialized (params must have shapes; run one
+        forward on a sample batch first if any shape is deferred).
+    loss_fn : gluon.loss.Loss or callable(NDArray pred, NDArray label) →
+        per-sample NDArray loss.
+    optimizer : str or mxtpu Optimizer.
+    mesh : DeviceMesh.
+    rules : ShardingRules for parameters (default: replicate everything —
+        pure data parallel).
+    batch_spec / label_spec : PartitionSpec for the data arrays (default
+        shard batch dim over "dp"; add "sp" on the sequence dim for
+        sequence parallelism).
+    remat : rematerialize the forward in backward (jax.checkpoint) to trade
+        FLOPs for HBM.
+    donate : donate old param/state buffers (in-place update on device).
+    """
+
+    def __init__(self, block, loss_fn, optimizer, mesh: DeviceMesh,
+                 rules: Optional[ShardingRules] = None,
+                 optimizer_params: Optional[dict] = None,
+                 batch_spec: P = P("dp"), label_spec: P = P("dp"),
+                 remat: bool = False, donate: bool = True):
+        self._block = block
+        self._loss_fn = loss_fn
+        self._mesh = mesh
+        self._rules = rules or ShardingRules()
+        self._batch_spec = batch_spec
+        self._label_spec = label_spec
+        self._remat = remat
+        self._donate = donate
+        if isinstance(optimizer, str):
+            optimizer = opt_mod.create(optimizer, **(optimizer_params or {}))
+        if type(optimizer)._step is opt_mod.Optimizer._step:
+            raise ValueError(
+                "SPMDTrainer requires an optimizer with a pure _step "
+                "(sgd/adam/adamw/...); %s updates statefully — use "
+                "gluon.Trainer for it" % type(optimizer).__name__)
+        self._optimizer = optimizer
+        self._num_update = 0
+        self._params_sharded = False
+        self._diff_params: List = []
+        self._aux_params: List = []
+        self._opt_states: List = []
+        self._jit_cache: Dict[Any, Any] = {}
+
+    # -- parameter staging ----------------------------------------------
+    def _stage_params(self):
+        """Collect block params, device_put per sharding rules, create
+        optimizer state with matching sharding."""
+        params = sorted(self._block.collect_params().values(),
+                        key=lambda p: p.name)
+        self._diff_params = [p for p in params if p.grad_req != "null"]
+        self._aux_params = [p for p in params if p.grad_req == "null"]
+        jm = self._mesh.jax_mesh
+        for p in self._diff_params + self._aux_params:
+            holder = p.data()
+            sh = self._rules.sharding_for(p.name, holder.ndim, self._mesh) \
+                if p in self._diff_params else NamedSharding(jm, P())
+            holder._rebind(jax.device_put(holder._data, sh))
+        self._opt_states = []
+        for i, p in enumerate(self._diff_params):
+            st = self._optimizer.create_state(i, p.data())
+            st = jax.tree_util.tree_map(
+                lambda a, _p=p: jax.device_put(
+                    a, NamedSharding(jm, self._rules.spec_for(
+                        _p.name, getattr(a, "ndim", 0)))), st)
+            self._opt_states.append(st)
+        self._params_sharded = True
+
+    # -- the compiled step ----------------------------------------------
+    def _build_step(self, batch_shape, batch_dtype, label_shape, label_dtype):
+        block = self._block
+        loss_fn = self._loss_fn
+        diff_params = self._diff_params
+        aux_params = self._aux_params
+        optimizer = self._optimizer
+        wds = [self._optimizer._get_wd(i)
+               for i in range(len(diff_params))]
+
+        def forward(diff_leaves, aux_leaves, key, batch, label):
+            saved = []
+            for p, leaf in list(zip(diff_params, diff_leaves)) + list(
+                    zip(aux_params, aux_leaves)):
+                holder = p.data()
+                saved.append((holder, holder._data))
+                holder._data = leaf
+            _random.push_trace_key(key)
+            try:
+                with autograd.pause(train_mode=True):
+                    out = block(NDArray(batch))
+                    out0 = out[0] if isinstance(out, tuple) else out
+                    loss = loss_fn(out0, NDArray(label))
+                    loss_scalar = loss.mean()._data
+                new_aux = tuple(p.data()._data for p in aux_params)
+            finally:
+                _random.pop_trace_key()
+                for holder, data in saved:
+                    holder._data = data
+            return loss_scalar, new_aux
+
+        if self._remat:
+            forward = jax.checkpoint(forward, static_argnums=())
+
+        def step(diff_leaves, aux_leaves, opt_states, lr, batch, label, key):
+            def loss_of(dl):
+                return forward(dl, aux_leaves, key, batch, label)
+
+            (loss, new_aux), grads = jax.value_and_grad(
+                loss_of, has_aux=True)(diff_leaves)
+            new_leaves = []
+            new_states = []
+            for leaf, g, st, wd in zip(diff_leaves, grads, opt_states, wds):
+                w, s = optimizer._step(leaf, g, st, lr, wd)
+                new_leaves.append(w.astype(leaf.dtype))
+                new_states.append(s)
+            return tuple(new_leaves), new_aux, tuple(new_states), loss
+
+        jm = self._mesh.jax_mesh
+        rep = NamedSharding(jm, P())
+        diff_sh = tuple(self._rules.sharding_for(p.name, p.data().ndim,
+                                                 self._mesh)
+                        for p in diff_params)
+        aux_sh = tuple(rep for _ in aux_params)
+        state_sh = tuple(
+            jax.tree_util.tree_map(
+                lambda a: NamedSharding(jm, self._rules.spec_for(
+                    p.name, getattr(a, "ndim", 0))), st)
+            for p, st in zip(diff_params, self._opt_states))
+        in_sh = (diff_sh, aux_sh, state_sh, rep,
+                 NamedSharding(jm, self._batch_spec),
+                 NamedSharding(jm, self._label_spec), rep)
+        out_sh = (diff_sh, aux_sh, state_sh, rep)
+        donate = (0, 1, 2) if self._donate else ()
+        return jax.jit(step, in_shardings=in_sh, out_shardings=out_sh,
+                       donate_argnums=donate)
+
+    # -- public API ------------------------------------------------------
+    def step(self, data, label):
+        """One optimization step on a global batch. Returns the (device)
+        scalar loss NDArray; no host sync — call .asnumpy() to block."""
+        if not self._params_sharded:
+            # resolve deferred shapes with one imperative forward
+            with autograd.pause(train_mode=False):
+                self._block(data if isinstance(data, NDArray)
+                            else nd.array(data))
+            self._stage_params()
+
+        data = data if isinstance(data, NDArray) else nd.array(data)
+        label = label if isinstance(label, NDArray) else nd.array(label)
+        jm = self._mesh.jax_mesh
+        batch = jax.device_put(data._data,
+                               NamedSharding(jm, self._batch_spec))
+        lab = jax.device_put(label._data,
+                             NamedSharding(jm, self._label_spec))
+
+        sig = (tuple(batch.shape), str(batch.dtype), tuple(lab.shape),
+               str(lab.dtype))
+        jitted = self._jit_cache.get(sig)
+        if jitted is None:
+            jitted = self._build_step(*sig)
+            self._jit_cache[sig] = jitted
+
+        self._num_update += 1
+        self._optimizer._index_update_count = {
+            i: self._num_update for i in range(len(self._diff_params))}
+        self._optimizer.num_update = self._num_update
+        lr = jnp.asarray(self._effective_lr(), jnp.float32)
+
+        diff_leaves = tuple(p.data()._data for p in self._diff_params)
+        aux_leaves = tuple(p.data()._data for p in self._aux_params)
+        new_leaves, new_aux, new_states, loss = jitted(
+            diff_leaves, aux_leaves, tuple(self._opt_states), lr, batch, lab,
+            _random.next_key())
+        for p, leaf in zip(self._diff_params, new_leaves):
+            p.data()._rebind(leaf)
+        for p, leaf in zip(self._aux_params, new_aux):
+            p.data()._rebind(leaf)
+        self._opt_states = list(new_states)
+        return NDArray(loss)
+
+    def _effective_lr(self):
+        """Per-step scalar lr with schedules and Adam-style bias correction
+        folded in on host (recompile-free: passed as a device scalar)."""
+        o = self._optimizer
+        lr = o._get_lr(0)
+        if isinstance(o, opt_mod.Adam):  # covers AdamW; folding matches
+            import math                  # Adam.update's own coef math
+            t = self._num_update
+            lr = lr * math.sqrt(1. - o.beta2 ** t) / (1. - o.beta1 ** t)
+        return lr
+
+    @property
+    def learning_rate(self):
+        return self._optimizer._get_lr(0)
+
+    def set_learning_rate(self, lr):
+        self._optimizer.lr = lr

@@ -1,0 +1,199 @@
+"""Profiler API (parity: python/mxnet/profiler.py over src/profiler/
+profiler.cc).
+
+The reference's engine-integrated profiler stamps every engine op and emits
+chrome://tracing JSON. Here the equivalent machinery is jax.profiler: XLA's
+own per-op tracing lands in a TensorBoard/perfetto trace, and the user-scope
+API (Task/Frame/Event/Counter/Marker, set_config/start/stop/dump) maps onto
+jax.profiler trace sessions + TraceAnnotation. `dumps()` returns an
+aggregate text summary like the reference's aggregate_stats.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+import warnings
+
+import jax
+
+__all__ = ["set_config", "start", "stop", "pause", "resume", "dump",
+           "dumps", "set_state", "state", "Task", "Frame", "Event",
+           "Counter", "Marker", "scope"]
+
+_config = {"profile_all": False, "profile_symbolic": False,
+           "profile_imperative": False, "profile_memory": False,
+           "profile_api": False,
+           "filename": "profile.json", "aggregate_stats": False}
+_state = "stop"
+_trace_dir = None
+_scope_stack = []
+_counters = {}
+_events = []
+
+
+def set_config(**kwargs):
+    """Configure (parity: profiler.set_config). `filename` selects the
+    trace output directory (its dirname; jax traces are directories)."""
+    _config.update(kwargs)
+
+
+def state():
+    return _state
+
+
+def set_state(new_state="stop", profile_process="worker"):
+    if new_state == "run":
+        start()
+    else:
+        stop()
+
+
+def start(profile_process="worker"):
+    """Begin a trace session (parity: profiler.set_state('run'))."""
+    global _state, _trace_dir
+    if _state == "run":
+        return
+    base = os.path.dirname(os.path.abspath(
+        _config.get("filename", "profile.json"))) or "."
+    _trace_dir = os.path.join(base, "mxtpu_profile")
+    os.makedirs(_trace_dir, exist_ok=True)
+    try:
+        jax.profiler.start_trace(_trace_dir)
+        _state = "run"
+    except Exception as e:  # double-start etc.
+        warnings.warn(f"profiler start failed: {e}")
+
+
+def stop(profile_process="worker"):
+    global _state
+    if _state != "run":
+        return
+    try:
+        jax.profiler.stop_trace()
+    finally:
+        _state = "stop"
+
+
+def pause(profile_process="worker"):
+    stop()
+
+
+def resume(profile_process="worker"):
+    start()
+
+
+def dump(finished=True, profile_process="worker"):
+    """Flush the trace (jax writes on stop_trace; stop if running)."""
+    if _state == "run":
+        stop()
+
+
+def dumps(reset=False, format="table", sort_by="total", ascending=False):
+    """Aggregate stats summary (parity: profiler.dumps → AggregateStats).
+    Returns a text table of user-scope events/counters recorded since
+    start; XLA per-op detail lives in the TensorBoard trace directory."""
+    lines = ["Profile Statistics (user scopes; XLA op detail in %s)"
+             % (_trace_dir or "<not started>"),
+             "%-40s %12s %12s" % ("Name", "Count", "Total(ms)")]
+    agg = {}
+    for name, dur in _events:
+        cnt, tot = agg.get(name, (0, 0.0))
+        agg[name] = (cnt + 1, tot + dur)
+    for name, (cnt, tot) in sorted(agg.items(),
+                                   key=lambda kv: -kv[1][1]):
+        lines.append("%-40s %12d %12.3f" % (name, cnt, tot * 1e3))
+    for name, val in _counters.items():
+        lines.append("%-40s %12s %12s" % (name, "counter", str(val)))
+    if reset:
+        _events.clear()
+    return "\n".join(lines)
+
+
+class _Scope:
+    """Named duration scope: shows up in the XLA trace via TraceAnnotation
+    and in dumps() aggregates."""
+
+    def __init__(self, name):
+        self.name = name
+        self._ann = None
+        self._t0 = None
+
+    def start(self):
+        self._ann = jax.profiler.TraceAnnotation(self.name)
+        self._ann.__enter__()
+        self._t0 = time.perf_counter()
+        return self
+
+    def stop(self):
+        if self._ann is not None:
+            _events.append((self.name, time.perf_counter() - self._t0))
+            self._ann.__exit__(None, None, None)
+            self._ann = None
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *a):
+        self.stop()
+
+
+class Task(_Scope):
+    """(parity: profiler.Task)"""
+
+    def __init__(self, name, domain=None):
+        super().__init__(name)
+        self.domain = domain
+
+
+class Frame(_Scope):
+    """(parity: profiler.Frame)"""
+
+    def __init__(self, name, domain=None):
+        super().__init__(name)
+        self.domain = domain
+
+
+class Event(_Scope):
+    """(parity: profiler.Event)"""
+
+
+class Counter:
+    """(parity: profiler.Counter)"""
+
+    def __init__(self, name, domain=None, value=None):
+        self.name = name
+        if value is not None:
+            _counters[name] = value
+
+    def set_value(self, value):
+        _counters[self.name] = value
+
+    def increment(self, delta=1):
+        _counters[self.name] = _counters.get(self.name, 0) + delta
+
+    def decrement(self, delta=1):
+        self.increment(-delta)
+
+    def __iadd__(self, v):
+        self.increment(v)
+        return self
+
+    def __isub__(self, v):
+        self.decrement(v)
+        return self
+
+
+class Marker:
+    """Instant marker (parity: profiler.Marker)."""
+
+    def __init__(self, name, domain=None):
+        self.name = name
+
+    def mark(self, scope="process"):
+        _events.append((self.name, 0.0))
+
+
+def scope(name="<unk>:", append_mode=False):
+    """Profiler scope context manager (parity: profiler.scope)."""
+    return _Scope(name)

@@ -1,0 +1,31 @@
+"""Symbol API (parity: python/mxnet/symbol/).
+
+The op namespace is generated from the same registry as mx.nd.* —
+mirroring how the reference generates both namespaces from the C registry
+(symbol/register.py)."""
+
+from .symbol import (Symbol, Variable, var, Group, load, load_json,
+                     zeros, ones, arange)
+from . import symbol as _sym_mod
+import sys as _sys
+
+# generated op namespace: every registered op becomes a graph-builder fn
+from ..base import _OP_REGISTRY as _REG
+
+
+def _make_sym_op(opname):
+    def sym_op(*args, **kwargs):
+        name = kwargs.pop("name", None)
+        attr = kwargs.pop("attr", None)
+        sym_inputs = [a for a in args if isinstance(a, Symbol)]
+        return Symbol._create(opname, sym_inputs, args, kwargs, name, attr)
+
+    sym_op.__name__ = opname
+    sym_op.__doc__ = "Symbolic %s (graph node builder)" % opname
+    return sym_op
+
+
+_mod = _sys.modules[__name__]
+for _name, _spec in list(_REG.items()):
+    if not hasattr(_mod, _name):
+        setattr(_mod, _name, _make_sym_op(_name))

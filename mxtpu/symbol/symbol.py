@@ -1,0 +1,680 @@
+"""Symbol: the declarative graph (parity: python/mxnet/symbol/symbol.py
+over the nnvm Graph IR — SURVEY §2.1 "NNVM graph IR").
+
+Design: a Symbol is an immutable DAG node (op, inputs, kwargs) plus an
+output index. Execution is a topological walk dispatching each node through
+the SAME op registry the imperative path uses — so `sym.bind().forward()`
+and `mx.nd.<op>` share kernels, and an executor forward can be jitted.
+Shape/type inference is `jax.eval_shape` over the graph — XLA's abstract
+interpretation replaces the reference's per-op FInferShape protocol.
+
+JSON save/load follows the reference's symbol.json layout (nodes /
+arg_nodes / heads) so checkpoints produced here round-trip, and
+model-zoo-style files with known ops import.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as onp
+
+from ..base import MXTPUError, get_op
+
+__all__ = ["Symbol", "Variable", "var", "Group", "load", "load_json",
+           "zeros", "ones", "arange"]
+
+_name_counter: Dict[str, int] = {}
+
+
+def _auto_name(hint):
+    n = _name_counter.get(hint, 0)
+    _name_counter[hint] = n + 1
+    return "%s%d" % (hint.lower(), n)
+
+
+class _Node:
+    """Graph node shared by the Symbols that select its outputs."""
+
+    __slots__ = ("op", "inputs", "arg_layout", "kwargs", "name", "attrs",
+                 "num_outputs", "kw_sym_names")
+
+    def __init__(self, op, inputs, arg_layout, kwargs, name, attrs,
+                 kw_sym_names=()):
+        self.op = op                  # None for variables
+        self.inputs = inputs          # list[Symbol]
+        self.arg_layout = arg_layout  # positional template w/ None at sym slots
+        self.kwargs = kwargs
+        self.name = name
+        self.attrs = attrs or {}
+        self.num_outputs = 1
+        # names for Symbol inputs that were passed as keywords; they sit at
+        # the END of self.inputs, after the positional ones
+        self.kw_sym_names = tuple(kw_sym_names)
+
+
+class Symbol:
+    """One output of a graph node."""
+
+    def __init__(self, node: _Node, index: int = 0):
+        self._node = node
+        self._index = index
+
+    # -- construction ----------------------------------------------------
+    @staticmethod
+    def _create(opname, sym_inputs, args, kwargs, name=None, attr=None):
+        import inspect
+
+        spec = get_op(opname)  # validates op exists
+        name = name or _auto_name(opname)
+        args = list(args)
+        # Symbols passed as keywords (the canonical MXNet calling style,
+        # e.g. FullyConnected(data=x, weight=w)): resolve to positional
+        # slots via the impl signature; "data" aliases the first parameter
+        # (our jax impls sometimes name it x).
+        try:
+            fn_params = [p for p in
+                         inspect.signature(spec.fn).parameters.values()
+                         if p.kind in (p.POSITIONAL_ONLY,
+                                       p.POSITIONAL_OR_KEYWORD)]
+            fn_names = [p.name for p in fn_params]
+        except (TypeError, ValueError):
+            fn_names = []
+        pos_extra = {}
+        for k in list(kwargs):
+            if not isinstance(kwargs[k], Symbol):
+                continue
+            if k in fn_names:
+                pos_extra[fn_names.index(k)] = kwargs.pop(k)
+            elif k == "data" and fn_names and not args and 0 not in pos_extra:
+                pos_extra[0] = kwargs.pop(k)
+        if pos_extra:
+            n = max(len(args), max(pos_extra) + 1)
+            while len(args) < n:
+                args.append(None)
+            for i, s in pos_extra.items():
+                if args[i] is not None:
+                    raise MXTPUError(
+                        f"{opname}: argument {i} given positionally and by "
+                        "keyword")
+                args[i] = s
+        layout = [None if isinstance(a, Symbol) else a for a in args]
+        sym_positional = [a for a in args if isinstance(a, Symbol)]
+        kw_syms = [(k, v) for k, v in kwargs.items()
+                   if isinstance(v, Symbol)]
+        static_kwargs = {k: v for k, v in kwargs.items()
+                         if not isinstance(v, Symbol)}
+        inputs = sym_positional + [v for _, v in kw_syms]
+        node = _Node(spec.name, inputs, layout, static_kwargs, name,
+                     attr, kw_sym_names=[k for k, _ in kw_syms])
+        return Symbol(node)
+
+    @property
+    def name(self):
+        if self._node.num_outputs > 1:
+            return "%s_output%d" % (self._node.name, self._index)
+        return self._node.name
+
+    def attr(self, key):
+        return self._node.attrs.get(key)
+
+    def list_attr(self):
+        return dict(self._node.attrs)
+
+    def _set_attr(self, **kwargs):
+        self._node.attrs.update(kwargs)
+
+    # -- graph walking ---------------------------------------------------
+    def _topo(self):
+        seen = {}
+        order = []
+
+        def visit(node):
+            if id(node) in seen:
+                return
+            seen[id(node)] = node
+            for s in node.inputs:
+                visit(s._node)
+            order.append(node)
+
+        for node in self._roots():
+            visit(node)
+        return order
+
+    def _roots(self):
+        return [self._node]
+
+    def list_arguments(self) -> List[str]:
+        args = []
+        for node in self._topo():
+            if node.op is None and not node.attrs.get("__aux__"):
+                args.append(node.name)
+        return args
+
+    def list_auxiliary_states(self) -> List[str]:
+        return [n.name for n in self._topo()
+                if n.op is None and n.attrs.get("__aux__")]
+
+    def list_inputs(self):
+        return [n.name for n in self._topo() if n.op is None]
+
+    def list_outputs(self) -> List[str]:
+        outs = []
+        for node, idx in self._output_entries():
+            if node.num_outputs > 1:
+                outs.append("%s_output%d" % (node.name, idx))
+            else:
+                outs.append("%s_output" % node.name)
+        return outs
+
+    def _output_entries(self):
+        return [(self._node, self._index)]
+
+    @property
+    def num_outputs(self):
+        return len(self._output_entries())
+
+    def __getitem__(self, idx):
+        if isinstance(idx, str):
+            names = self.list_outputs()
+            idx = names.index(idx)
+        entries = self._output_entries()
+        node, base = entries[idx]
+        return Symbol(node, base)
+
+    def __iter__(self):
+        return (self[i] for i in range(self.num_outputs))
+
+    def get_internals(self):
+        """Every node's outputs as a Group (parity: sym.get_internals)."""
+        syms = []
+        for node in self._topo():
+            for i in range(node.num_outputs):
+                syms.append(Symbol(node, i))
+        return Group(syms)
+
+    def get_children(self):
+        if not self._node.inputs:
+            return None
+        return Group(list(self._node.inputs))
+
+    def list_nodes(self):
+        """Introspection helper for visualization."""
+        order = self._topo()
+        index = {id(n): i for i, n in enumerate(order)}
+        return [{"name": n.name, "op": n.op or "null",
+                 "inputs": [index[id(s._node)] for s in n.inputs]}
+                for n in order]
+
+    # -- composition (parity: Symbol.__call__ / compose) ------------------
+    def __call__(self, *args, **kwargs):
+        out = self._compose(*args, **kwargs)
+        return out
+
+    def _compose(self, *args, **kwargs):
+        mapping = {}
+        arg_names = self.list_arguments()
+        if args:
+            for name, s in zip(arg_names, args):
+                mapping[name] = s
+        mapping.update({k: v for k, v in kwargs.items()
+                        if isinstance(v, Symbol)})
+        return self._substitute(mapping, {})
+
+    def _substitute(self, mapping, memo):
+        node = self._node
+        if id(node) in memo:
+            return Symbol(memo[id(node)], self._index)
+        if node.op is None:
+            repl = mapping.get(node.name)
+            if repl is not None:
+                memo[id(node)] = repl._node
+                return Symbol(repl._node, repl._index)
+            memo[id(node)] = node
+            return Symbol(node, self._index)
+        new_inputs = [s._substitute(mapping, memo) for s in node.inputs]
+        new_node = _Node(node.op, new_inputs, node.arg_layout, node.kwargs,
+                         node.name, dict(node.attrs),
+                         kw_sym_names=node.kw_sym_names)
+        new_node.num_outputs = node.num_outputs
+        memo[id(node)] = new_node
+        return Symbol(new_node, self._index)
+
+    # -- execution --------------------------------------------------------
+    def _eval_node_outputs(self, node, values):
+        """Dispatch one op node through the shared registry."""
+        from ..ndarray import ndarray as ndmod
+
+        call_args = []
+        sym_iter = iter(node.inputs)
+        for slot in node.arg_layout:
+            if slot is None:
+                s = next(sym_iter)
+                call_args.append(values[(id(s._node), s._index)])
+            else:
+                call_args.append(slot)
+        rest = list(sym_iter)
+        kwargs = dict(node.kwargs)
+        n_kw = len(node.kw_sym_names)
+        if n_kw:
+            for k, s in zip(node.kw_sym_names, rest[len(rest) - n_kw:]):
+                kwargs[k] = values[(id(s._node), s._index)]
+            rest = rest[:len(rest) - n_kw]
+        for s in rest:  # positional inputs beyond the recorded layout
+            call_args.append(values[(id(s._node), s._index)])
+        out = ndmod.invoke_op(node.op, tuple(call_args), kwargs)
+        outs = out if isinstance(out, tuple) else (out,)
+        node.num_outputs = len(outs)
+        for i, o in enumerate(outs):
+            values[(id(node), i)] = o
+        return outs
+
+    def _execute(self, input_arrays: Dict[str, Any]):
+        """Topological forward; returns list of output NDArrays."""
+        values = {}
+        for node in self._topo():
+            if node.op is None:
+                if node.name not in input_arrays:
+                    raise MXTPUError(
+                        f"missing input '{node.name}' for eval")
+                values[(id(node), 0)] = input_arrays[node.name]
+            else:
+                self._eval_node_outputs(node, values)
+        return [values[(id(n), i)] for n, i in self._output_entries()]
+
+    def eval(self, ctx=None, **kwargs):
+        """(parity: Symbol.eval)"""
+        return self._execute(kwargs)
+
+    # -- shape/type inference ---------------------------------------------
+    def infer_shape(self, **kwargs):
+        """Returns (arg_shapes, out_shapes, aux_shapes) (parity:
+        infer_shape). Implemented via jax.eval_shape abstract execution."""
+        try:
+            return self._infer_shape_impl(partial=False, **kwargs)
+        except MXTPUError:
+            raise
+        except Exception:
+            return None, None, None
+
+    def infer_shape_partial(self, **kwargs):
+        return self._infer_shape_impl(partial=True, **kwargs)
+
+    def _infer_shape_impl(self, partial=False, **kwargs):
+        """Forward shape propagation: topo walk, per-node jax.eval_shape,
+        with parameter-shape rules for weight-carrying ops (the eval_shape
+        equivalent of the reference's FInferShape protocol)."""
+        import jax
+        import jax.numpy as jnp
+        from .. import ndarray as ndpkg
+
+        arg_names = self.list_arguments()
+        aux_names = self.list_auxiliary_states()
+        known = {k: tuple(v) for k, v in kwargs.items() if v is not None}
+        # variables may declare __shape__ attrs
+        for node in self._topo():
+            if node.op is None and node.name not in known:
+                s = node.attrs.get("__shape__")
+                if s:
+                    known[node.name] = tuple(_parse_attr(s))
+
+        shapes = {}   # (id(node), idx) -> shape
+        dtypes = {}
+
+        def node_input_entries(node):
+            return [(s, shapes.get((id(s._node), s._index))) for s in
+                    node.inputs]
+
+        ok = True
+        for node in self._topo():
+            if node.op is None:
+                if node.name in known:
+                    shapes[(id(node), 0)] = tuple(known[node.name])
+                    dtypes[(id(node), 0)] = jnp.float32
+                continue
+            entries = node_input_entries(node)
+            unknown = [s for s, shp in entries if shp is None]
+            if unknown:
+                rule = _PARAM_SHAPE_RULES.get(node.op)
+                if rule is not None and entries[0][1] is not None:
+                    inferred = rule(entries[0][1], node.kwargs)
+                    for s, shp in zip(node.inputs[1:], inferred):
+                        key = (id(s._node), s._index)
+                        if shapes.get(key) is None and shp is not None \
+                                and s._node.op is None:
+                            shapes[key] = tuple(shp)
+                            known[s._node.name] = tuple(shp)
+                entries = node_input_entries(node)
+                unknown = [s for s, shp in entries if shp is None]
+            if unknown:
+                ok = False
+                continue  # downstream shapes stay unknown
+            # abstract-eval this single node
+            structs = []
+            for s, shp in entries:
+                structs.append(jax.ShapeDtypeStruct(
+                    shp, dtypes.get((id(s._node), s._index), jnp.float32)))
+
+            def run_node(*arrs, _node=node):
+                vals = {}
+                for s, a in zip(_node.inputs, arrs):
+                    vals[(id(s._node), s._index)] = ndpkg.NDArray(a)
+                outs = self._eval_node_outputs(_node, vals)
+                return tuple(o.data for o in outs)
+
+            try:
+                outs = jax.eval_shape(run_node, *structs)
+            except Exception:
+                ok = False
+                continue
+            for i, o in enumerate(outs):
+                shapes[(id(node), i)] = tuple(o.shape)
+                dtypes[(id(node), i)] = o.dtype
+
+        out_shapes = [shapes.get((id(n), i))
+                      for n, i in self._output_entries()]
+        if not partial and (not ok or any(o is None for o in out_shapes)):
+            return None, None, None
+        arg_shapes = [known.get(n) for n in arg_names]
+        aux_shapes = [known.get(n) for n in aux_names]
+        return arg_shapes, out_shapes, aux_shapes
+
+    def infer_type(self, **kwargs):
+        arg_names = self.list_arguments()
+        dt = onp.float32
+        return ([dt] * len(arg_names),
+                [dt] * self.num_outputs,
+                [dt] * len(self.list_auxiliary_states()))
+
+    # -- binding ----------------------------------------------------------
+    def simple_bind(self, ctx=None, grad_req="write", **shape_kwargs):
+        from ..executor import Executor
+        return Executor._simple_bind(self, ctx, grad_req, shape_kwargs)
+
+    def bind(self, ctx=None, args=None, args_grad=None, grad_req="write",
+             aux_states=None, **kwargs):
+        from ..executor import Executor
+        return Executor(self, ctx, args, args_grad, grad_req, aux_states)
+
+    # -- save/load --------------------------------------------------------
+    def tojson(self):
+        order = self._topo()
+        index = {id(n): i for i, n in enumerate(order)}
+        nodes = []
+        arg_nodes = []
+        for i, n in enumerate(order):
+            entry = {"op": n.op or "null", "name": n.name,
+                     "attrs": {k: str(v) for k, v in {
+                         **n.kwargs,
+                         "__arg_layout__": json.dumps(
+                             [s if s is None or _jsonable(s) else str(s)
+                              for s in n.arg_layout]),
+                         **({"__kw_inputs__": json.dumps(
+                             list(n.kw_sym_names))}
+                            if n.kw_sym_names else {}),
+                     }.items()},
+                     "inputs": [[index[id(s._node)], s._index, 0]
+                                for s in n.inputs]}
+            if n.op is None:
+                arg_nodes.append(i)
+                entry["attrs"] = {k: str(v) for k, v in n.attrs.items()}
+            nodes.append(entry)
+        heads = [[index[id(n)], i, 0] for n, i in self._output_entries()]
+        return json.dumps({"nodes": nodes, "arg_nodes": arg_nodes,
+                           "node_row_ptr": list(range(len(nodes) + 1)),
+                           "heads": heads,
+                           "attrs": {"mxnet_version": ["int", 10700],
+                                     "mxtpu": ["int", 1]}}, indent=2)
+
+    def save(self, fname):
+        with open(fname, "w") as f:
+            f.write(self.tojson())
+
+    # -- operators --------------------------------------------------------
+    def __add__(self, other):
+        return _binary("broadcast_add", "_plus_scalar", self, other)
+
+    def __radd__(self, other):
+        return self.__add__(other)
+
+    def __sub__(self, other):
+        return _binary("broadcast_sub", "_minus_scalar", self, other)
+
+    def __rsub__(self, other):
+        return _binary_r("broadcast_sub", "_rminus_scalar", self, other)
+
+    def __mul__(self, other):
+        return _binary("broadcast_mul", "_mul_scalar", self, other)
+
+    def __rmul__(self, other):
+        return self.__mul__(other)
+
+    def __truediv__(self, other):
+        return _binary("broadcast_div", "_div_scalar", self, other)
+
+    def __rtruediv__(self, other):
+        return _binary_r("broadcast_div", "_rdiv_scalar", self, other)
+
+    def __pow__(self, other):
+        return _binary("broadcast_power", "_power_scalar", self, other)
+
+    def __neg__(self):
+        return self.__mul__(-1.0)
+
+    def __repr__(self):
+        return "<Symbol %s>" % self.name
+
+    def __copy__(self):
+        return Symbol(self._node, self._index)
+
+    def __deepcopy__(self, memo):
+        return self._substitute({}, {})
+
+
+def _int_prod(t):
+    p = 1
+    for v in t:
+        p *= v
+    return p
+
+
+def _fc_param_shapes(in_shape, kw):
+    num_hidden = kw.get("num_hidden", 0)
+    flatten = kw.get("flatten", True)
+    in_units = _int_prod(in_shape[1:]) if flatten else in_shape[-1]
+    shapes = [(num_hidden, in_units)]
+    if not kw.get("no_bias", False):
+        shapes.append((num_hidden,))
+    return shapes
+
+
+def _conv_param_shapes(in_shape, kw):
+    kernel = tuple(kw.get("kernel", ()))
+    num_filter = kw.get("num_filter", 0)
+    num_group = kw.get("num_group", 1)
+    shapes = [(num_filter, in_shape[1] // num_group) + kernel]
+    if not kw.get("no_bias", False):
+        shapes.append((num_filter,))
+    return shapes
+
+
+def _deconv_param_shapes(in_shape, kw):
+    kernel = tuple(kw.get("kernel", ()))
+    num_filter = kw.get("num_filter", 0)
+    num_group = kw.get("num_group", 1)
+    shapes = [(in_shape[1], num_filter // num_group) + kernel]
+    if not kw.get("no_bias", True):
+        shapes.append((num_filter,))
+    return shapes
+
+
+def _bn_param_shapes(in_shape, kw):
+    c = in_shape[kw.get("axis", 1)]
+    return [(c,), (c,), (c,), (c,)]
+
+
+def _ln_param_shapes(in_shape, kw):
+    c = in_shape[kw.get("axis", -1)]
+    return [(c,), (c,)]
+
+
+def _embed_param_shapes(in_shape, kw):
+    return [(kw.get("input_dim", 0), kw.get("output_dim", 0))]
+
+
+# op name → fn(first_input_shape, kwargs) → shapes for remaining inputs
+# (parity: per-op FInferShape for the weight-carrying ops)
+_PARAM_SHAPE_RULES = {
+    "FullyConnected": _fc_param_shapes,
+    "Convolution": _conv_param_shapes,
+    "Deconvolution": _deconv_param_shapes,
+    "BatchNorm": _bn_param_shapes,
+    "LayerNorm": _ln_param_shapes,
+    "InstanceNorm": _ln_param_shapes,
+    "Embedding": _embed_param_shapes,
+}
+
+
+def _jsonable(v):
+    try:
+        json.dumps(v)
+        return True
+    except TypeError:
+        return False
+
+
+def _binary(broadcast_op, scalar_op, lhs, rhs):
+    from . import _make_sym_op
+    if isinstance(rhs, Symbol):
+        return Symbol._create(broadcast_op, [lhs, rhs], (lhs, rhs), {})
+    return Symbol._create(scalar_op, [lhs], (lhs,), {"scalar": float(rhs)})
+
+
+def _binary_r(broadcast_op, scalar_op, lhs, rhs):
+    if isinstance(rhs, Symbol):
+        return Symbol._create(broadcast_op, [rhs, lhs], (rhs, lhs), {})
+    return Symbol._create(scalar_op, [lhs], (lhs,), {"scalar": float(rhs)})
+
+
+class _GroupSymbol(Symbol):
+    def __init__(self, symbols):
+        self._symbols = symbols
+        self._node = symbols[0]._node if symbols else None
+        self._index = 0
+
+    def _roots(self):
+        return [s._node for s in self._symbols]
+
+    def _output_entries(self):
+        return [(s._node, s._index) for s in self._symbols]
+
+    def __repr__(self):
+        return "<Symbol group [%s]>" % ", ".join(
+            s.name for s in self._symbols)
+
+
+def Group(symbols):
+    """Group multiple symbols into one multi-output symbol (parity:
+    sym.Group)."""
+    return _GroupSymbol(list(symbols))
+
+
+def Variable(name, attr=None, shape=None, lr_mult=None, wd_mult=None,
+             dtype=None, init=None, stype=None, **kwargs):
+    """(parity: sym.Variable)"""
+    attrs = dict(attr or {})
+    if shape is not None:
+        attrs["__shape__"] = str(tuple(shape))
+    if dtype is not None:
+        attrs["__dtype__"] = str(dtype)
+    if lr_mult is not None:
+        attrs["__lr_mult__"] = str(lr_mult)
+    if wd_mult is not None:
+        attrs["__wd_mult__"] = str(wd_mult)
+    attrs.update({k: str(v) for k, v in kwargs.items()})
+    node = _Node(None, [], [], {}, name, attrs)
+    return Symbol(node)
+
+
+var = Variable
+
+
+def load_json(json_str):
+    """Rebuild a Symbol from symbol.json (parity: sym.load_json).
+    Reference-produced files load when their ops exist in the registry."""
+    data = json.loads(json_str)
+    nodes_meta = data["nodes"]
+    built: List[Optional[Symbol]] = [None] * len(nodes_meta)
+    node_objs: List[Optional[_Node]] = [None] * len(nodes_meta)
+    for i, meta in enumerate(nodes_meta):
+        op = meta["op"]
+        name = meta["name"]
+        attrs = dict(meta.get("attrs", meta.get("param", {})) or {})
+        inputs = [Symbol(node_objs[j], oi) for j, oi, *_ in meta["inputs"]]
+        if op == "null":
+            node = _Node(None, [], [], {}, name, attrs)
+        else:
+            layout_json = attrs.pop("__arg_layout__", None)
+            kw_inputs = json.loads(attrs.pop("__kw_inputs__", "[]"))
+            kwargs = {k: _parse_attr(v) for k, v in attrs.items()}
+            if layout_json is not None:
+                layout = json.loads(layout_json)
+            else:
+                layout = [None] * len(inputs)
+            node = _Node(op, inputs, layout, kwargs, name, {},
+                         kw_sym_names=kw_inputs)
+        node_objs[i] = node
+        built[i] = Symbol(node)
+    heads = data.get("heads", [[len(nodes_meta) - 1, 0, 0]])
+    outs = [Symbol(node_objs[h[0]], h[1] if len(h) > 1 else 0)
+            for h in heads]
+    if len(outs) == 1:
+        return outs[0]
+    return Group(outs)
+
+
+def _parse_attr(v):
+    """Parse a reference-style stringified attr back to a Python value."""
+    if not isinstance(v, str):
+        return v
+    s = v.strip()
+    try:
+        return json.loads(s)
+    except (ValueError, TypeError):
+        pass
+    if s.startswith("(") and s.endswith(")"):
+        inner = s[1:-1].strip().rstrip(",")
+        if not inner:
+            return ()
+        try:
+            return tuple(json.loads("[" + inner + "]"))
+        except ValueError:
+            return s
+    low = s.lower()
+    if low in ("true", "false"):
+        return low == "true"
+    if low == "none":
+        return None
+    return s
+
+
+def load(fname):
+    with open(fname) as f:
+        return load_json(f.read())
+
+
+def zeros(shape, dtype="float32", name=None, **kwargs):
+    return Symbol._create("zeros", [], (), {"shape": tuple(shape),
+                                            "dtype": dtype}, name)
+
+
+def ones(shape, dtype="float32", name=None, **kwargs):
+    return Symbol._create("ones", [], (), {"shape": tuple(shape),
+                                           "dtype": dtype}, name)
+
+
+def arange(start, stop=None, step=1.0, repeat=1, dtype="float32", name=None):
+    return Symbol._create("arange", [], (), {
+        "start": start, "stop": stop, "step": step, "repeat": repeat,
+        "dtype": dtype}, name)

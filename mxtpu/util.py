@@ -1,0 +1,98 @@
+"""Misc utilities (parity: python/mxnet/util.py).
+
+The reference's np_shape/np_array semantics flags control NumPy-compatible
+behavior; mxtpu is NumPy-shaped by construction (zero-size dims and scalar
+arrays are native to jax), so the flags are accepted and always-on.
+"""
+
+from __future__ import annotations
+
+import functools
+import inspect
+import os
+
+__all__ = ["makedirs", "get_gpu_count", "get_gpu_memory",
+           "is_np_shape", "is_np_array", "set_np", "reset_np", "use_np",
+           "np_shape", "np_array", "use_np_shape", "use_np_array",
+           "getenv", "setenv", "default_array"]
+
+
+def makedirs(d):
+    os.makedirs(os.path.expanduser(d), exist_ok=True)
+
+
+def get_gpu_count():
+    from .context import num_tpus
+    return num_tpus()
+
+
+def get_gpu_memory(gpu_dev_id=0):
+    import jax
+    devs = [d for d in jax.devices() if d.platform != "cpu"]
+    if gpu_dev_id >= len(devs):
+        raise ValueError("invalid device id")
+    stats = devs[gpu_dev_id].memory_stats() or {}
+    return (stats.get("bytes_in_use", 0), stats.get("bytes_limit", 0))
+
+
+# -- numpy semantics flags: always on (documented divergence: there is no
+#    legacy MXNet shape semantics to switch back to) ------------------------
+
+def is_np_shape():
+    return True
+
+
+def is_np_array():
+    return True
+
+
+def set_np(shape=True, array=True, dtype=False):
+    if not shape or not array:
+        raise ValueError(
+            "mxtpu is NumPy-semantics-native; legacy shape semantics "
+            "cannot be enabled (documented divergence)")
+
+
+def reset_np():
+    pass
+
+
+class _NoopScope:
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *a):
+        return False
+
+
+def np_shape(active=True):
+    return _NoopScope()
+
+
+def np_array(active=True):
+    return _NoopScope()
+
+
+def use_np_shape(func):
+    return func
+
+
+def use_np_array(func):
+    return func
+
+
+def use_np(func):
+    return func
+
+
+def getenv(name):
+    return os.environ.get(name)
+
+
+def setenv(name, value):
+    os.environ[name] = value
+
+
+def default_array(source_array, ctx=None, dtype=None):
+    from . import ndarray as nd
+    return nd.array(source_array, ctx=ctx, dtype=dtype)

@@ -1,0 +1,109 @@
+"""Network visualization (parity: python/mxnet/visualization.py —
+print_summary + plot_network).
+
+print_summary walks a Gluon block (or Symbol shim) and prints the layer
+table with output shapes and parameter counts; plot_network emits graphviz
+when available (optional dependency, gated)."""
+
+from __future__ import annotations
+
+__all__ = ["print_summary", "plot_network"]
+
+
+def print_summary(block_or_symbol, shape=None, line_length=120,
+                  positions=(0.44, 0.64, 0.74, 1.0)):
+    """Print a layer summary.
+
+    For a Gluon Block: pass `shape` as the input shape (incl. batch dim);
+    runs a forward with hooks to collect output shapes (the reference's
+    symbol version used static shape inference).
+    """
+    from .gluon.block import Block
+    from . import ndarray as nd
+
+    if isinstance(block_or_symbol, Block):
+        return _summary_block(block_or_symbol, shape, line_length, positions)
+    # Symbol shim: render its graph nodes
+    sym = block_or_symbol
+    rows = [(n["name"], n["op"]) for n in sym.get_internals().list_nodes()] \
+        if hasattr(sym, "get_internals") else []
+    print("%-40s %-20s" % ("Node", "Op"))
+    print("=" * 60)
+    for name, op in rows:
+        print("%-40s %-20s" % (name, op))
+
+
+def _summary_block(block, shape, line_length, positions):
+    from . import ndarray as nd
+    import numpy as onp
+
+    records = []
+    handles = []
+
+    def make_hook(name):
+        def hook(blk, inputs, output):
+            out = output[0] if isinstance(output, tuple) else output
+            n_params = sum(
+                int(onp.prod(p.shape)) for p in blk.params.values()
+                if p.shape and 0 not in p.shape)
+            records.append((name, type(blk).__name__,
+                            getattr(out, "shape", None), n_params))
+        return hook
+
+    def walk(blk, prefix=""):
+        for cname, child in blk._children.items():
+            walk(child, prefix + cname + ".")
+        if not blk._children:  # leaves only
+            handles.append(blk.register_forward_hook(
+                make_hook(prefix[:-1] or type(blk).__name__)))
+
+    walk(block)
+    try:
+        if shape is not None:
+            x = nd.zeros(shape)
+            block(x)
+    finally:
+        for h in handles:
+            h.detach()
+
+    positions = [int(line_length * p) for p in positions]
+    fields = ["Layer (type)", "Output Shape", "Param #"]
+    line = ""
+    for f, p in zip(fields, positions):
+        line = (line + f).ljust(p)
+    print("=" * line_length)
+    print(line)
+    print("=" * line_length)
+    total = 0
+    for name, typ, oshape, n in records:
+        total += n
+        line = ("%s (%s)" % (name, typ)).ljust(positions[0])
+        line += str(oshape).ljust(positions[1] - positions[0])
+        line += str(n).ljust(positions[2] - positions[1])
+        print(line)
+    print("=" * line_length)
+    print("Total params: %d" % total)
+    print("=" * line_length)
+    return total
+
+
+def plot_network(symbol, title="plot", save_format="pdf", shape=None,
+                 node_attrs=None, hide_weights=True):
+    """Graphviz rendering (parity: visualization.plot_network). Gated on
+    the optional graphviz package."""
+    try:
+        from graphviz import Digraph
+    except ImportError as e:
+        raise ImportError(
+            "plot_network requires the optional graphviz package") from e
+    dot = Digraph(name=title)
+    if hasattr(symbol, "get_internals"):
+        for node in symbol.get_internals().list_nodes():
+            name, op = node["name"], node.get("op", "null")
+            if hide_weights and op == "null" and (
+                    name.endswith(("weight", "bias", "gamma", "beta"))):
+                continue
+            dot.node(name, "%s\n%s" % (name, op))
+            for inp in node.get("inputs", []):
+                dot.edge(str(inp), name)
+    return dot

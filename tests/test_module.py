@@ -1,0 +1,158 @@
+"""Tests for the Module API (parity model: tests/python/unittest/
+test_module.py)."""
+
+import logging
+
+import numpy as np
+import pytest
+
+import mxtpu as mx
+from mxtpu import symbol as sym
+from mxtpu.io import NDArrayIter, DataBatch
+from mxtpu.module import Module, BucketingModule
+
+
+def _mlp_sym(num_hidden=3):
+    data = sym.Variable("data")
+    fc1 = sym.FullyConnected(data, sym.Variable("fc1_weight"),
+                             sym.Variable("fc1_bias"), num_hidden=16,
+                             name="fc1")
+    act = sym.Activation(fc1, act_type="relu")
+    fc2 = sym.FullyConnected(act, sym.Variable("fc2_weight"),
+                             sym.Variable("fc2_bias"),
+                             num_hidden=num_hidden, name="fc2")
+    return sym.SoftmaxOutput(fc2, sym.Variable("softmax_label"),
+                             name="softmax")
+
+
+def _toy_data(n=60, d=10, k=3, seed=0):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, d).astype("float32")
+    W = rng.randn(d, k).astype("float32")
+    y = X.dot(W).argmax(axis=1).astype("float32")
+    return X, y
+
+
+def test_module_fit_convergence():
+    X, y = _toy_data()
+    train = NDArrayIter(X, y, batch_size=10, shuffle=True)
+    val = NDArrayIter(X, y, batch_size=10)
+    mod = Module(_mlp_sym(), context=mx.cpu())
+    mod.fit(train, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.3}, num_epoch=15)
+    acc = mod.score(val, "acc")[0][1]
+    assert acc > 0.85, acc
+
+
+def test_module_checkpoint_roundtrip(tmp_path):
+    X, y = _toy_data()
+    train = NDArrayIter(X, y, batch_size=10)
+    mod = Module(_mlp_sym(), context=mx.cpu())
+    mod.fit(train, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.3}, num_epoch=6)
+    prefix = str(tmp_path / "mlp")
+    mod.save_checkpoint(prefix, 6)
+
+    mod2 = Module.load(prefix, 6)
+    mod2.bind([("data", (10, 10))], [("softmax_label", (10,))],
+              for_training=False)
+    val = NDArrayIter(X, y, batch_size=10)
+    preds = mod2.predict(val)
+    acc = (preds.asnumpy().argmax(1) == y).mean()
+    ref = mod.score(NDArrayIter(X, y, batch_size=10), "acc")[0][1]
+    assert abs(acc - ref) < 1e-6
+
+
+def test_module_forward_backward_api():
+    mod = Module(_mlp_sym(), context=mx.cpu())
+    mod.bind([("data", (4, 10))], [("softmax_label", (4,))])
+    mod.init_params()
+    mod.init_optimizer(optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.1})
+    batch = DataBatch(data=[mx.nd.random.uniform(shape=(4, 10))],
+                      label=[mx.nd.array([0, 1, 2, 0])])
+    mod.forward_backward(batch)
+    mod.update()
+    outs = mod.get_outputs()
+    assert outs[0].shape == (4, 3)
+    arg_params, aux_params = mod.get_params()
+    assert "fc1_weight" in arg_params
+
+
+def test_module_input_grads():
+    mod = Module(_mlp_sym(), context=mx.cpu())
+    mod.bind([("data", (4, 10))], [("softmax_label", (4,))],
+             inputs_need_grad=True)
+    mod.init_params()
+    batch = DataBatch(data=[mx.nd.random.uniform(shape=(4, 10))],
+                      label=[mx.nd.array([0, 1, 2, 0])])
+    mod.forward(batch, is_train=True)
+    mod.backward()
+    g = mod.get_input_grads()[0]
+    assert g.shape == (4, 10)
+    assert float(np.abs(g.asnumpy()).sum()) > 0
+
+
+def test_module_set_params():
+    mod = Module(_mlp_sym(), context=mx.cpu())
+    mod.bind([("data", (4, 10))], [("softmax_label", (4,))])
+    mod.init_params()
+    arg, aux = mod.get_params()
+    arg2 = {k: v * 0 for k, v in arg.items()}
+    mod.set_params(arg2, aux)
+    new_arg, _ = mod.get_params()
+    assert float(np.abs(new_arg["fc1_weight"].asnumpy()).sum()) == 0
+
+
+def test_bucketing_module():
+    def sym_gen(seq_len):
+        data = sym.Variable("data")
+        fc = sym.FullyConnected(data, sym.Variable("fc_weight"),
+                                sym.Variable("fc_bias"), num_hidden=3,
+                                name="fc")
+        out = sym.SoftmaxOutput(fc, sym.Variable("softmax_label"),
+                                name="softmax")
+        return out, ["data"], ["softmax_label"]
+
+    mod = BucketingModule(sym_gen, default_bucket_key=10)
+    mod.bind([("data", (4, 10))], [("softmax_label", (4,))])
+    mod.init_params()
+    mod.init_optimizer(optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.1})
+    # same feature dim, different bucket key -> new module sharing params
+    b1 = DataBatch(data=[mx.nd.random.uniform(shape=(4, 10))],
+                   label=[mx.nd.array([0, 1, 2, 0])],
+                   provide_data=[("data", (4, 10))],
+                   provide_label=[("softmax_label", (4,))])
+    b1.bucket_key = 10
+    mod.forward_backward(b1)
+    mod.update()
+    assert mod.get_outputs()[0].shape == (4, 3)
+
+
+def test_feedforward_deprecated():
+    from mxtpu.model import FeedForward
+    X, y = _toy_data()
+    with pytest.warns(DeprecationWarning):
+        ff = FeedForward(_mlp_sym(), num_epoch=3, optimizer="sgd",
+                         optimizer_params={"learning_rate": 0.3})
+    train = NDArrayIter(X, y, batch_size=10)
+    ff.fit(train)
+    preds = ff.predict(NDArrayIter(X, y, batch_size=10))
+    assert preds.shape[1] == 3
+
+
+def test_save_load_params_file(tmp_path):
+    mod = Module(_mlp_sym(), context=mx.cpu())
+    mod.bind([("data", (4, 10))], [("softmax_label", (4,))])
+    mod.init_params()
+    fname = str(tmp_path / "p.params")
+    mod.save_params(fname)
+    arg0, _ = mod.get_params()
+    mod2 = Module(_mlp_sym(), context=mx.cpu())
+    mod2.bind([("data", (4, 10))], [("softmax_label", (4,))])
+    mod2.init_params()
+    mod2.load_params(fname)
+    arg1, _ = mod2.get_params()
+    np.testing.assert_allclose(arg0["fc1_weight"].asnumpy(),
+                               arg1["fc1_weight"].asnumpy())
