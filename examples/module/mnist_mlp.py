@@ -1,0 +1,67 @@
+#!/usr/bin/env python
+"""Symbolic MLP via the legacy Module API (parity: the classic
+example/image-classification/train_mnist.py path: Symbol + Module.fit +
+Speedometer + checkpointing)."""
+
+import argparse
+import logging
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import mxtpu as mx
+from mxtpu import symbol as sym
+from mxtpu.io import NDArrayIter
+from mxtpu.module import Module
+from mxtpu.callback import Speedometer, do_checkpoint
+
+
+def mlp_symbol():
+    data = sym.Variable("data")
+    net = sym.FullyConnected(data=data, name="fc1", num_hidden=128)
+    net = sym.Activation(net, act_type="relu")
+    net = sym.FullyConnected(data=net, name="fc2", num_hidden=64)
+    net = sym.Activation(net, act_type="relu")
+    net = sym.FullyConnected(data=net, name="fc3", num_hidden=10)
+    return sym.SoftmaxOutput(net, sym.Variable("softmax_label"),
+                             name="softmax")
+
+
+def synthetic_mnist(n, seed):
+    rng = np.random.RandomState(seed)
+    y = rng.randint(0, 10, n)
+    X = rng.rand(n, 784).astype("float32") * 0.1
+    for i in range(n):
+        X[i, y[i] * 70:(y[i] + 1) * 70] += 0.8
+    return X, y.astype("float32")
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--batch-size", type=int, default=100)
+    parser.add_argument("--epochs", type=int, default=5)
+    parser.add_argument("--lr", type=float, default=0.1)
+    parser.add_argument("--prefix", default="/tmp/mnist_mlp")
+    args = parser.parse_args()
+    logging.basicConfig(level=logging.INFO)
+
+    Xtr, ytr = synthetic_mnist(6000, 0)
+    Xte, yte = synthetic_mnist(1000, 1)
+    train = NDArrayIter(Xtr, ytr, args.batch_size, shuffle=True)
+    val = NDArrayIter(Xte, yte, args.batch_size)
+
+    mod = Module(mlp_symbol(), context=mx.cpu())
+    mod.fit(train, eval_data=val,
+            optimizer="sgd",
+            optimizer_params={"learning_rate": args.lr, "momentum": 0.9},
+            num_epoch=args.epochs,
+            batch_end_callback=Speedometer(args.batch_size, 20),
+            epoch_end_callback=do_checkpoint(args.prefix))
+    print("final validation:", mod.score(val, "acc"))
+
+
+if __name__ == "__main__":
+    main()

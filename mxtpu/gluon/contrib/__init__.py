@@ -1,0 +1,5 @@
+"""Gluon contrib (parity: python/mxnet/gluon/contrib/)."""
+
+from . import nn
+from . import rnn
+from . import estimator

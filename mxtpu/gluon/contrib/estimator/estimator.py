@@ -1,0 +1,146 @@
+"""Estimator (parity: gluon/contrib/estimator/estimator.py — the 1.6+
+high-level fit API over Gluon)."""
+
+from __future__ import annotations
+
+import copy
+import logging
+import warnings
+
+from .... import autograd
+from .... import metric as metric_mod
+from ....metric import Accuracy, Loss as LossMetric
+from ... import loss as gloss
+from ...trainer import Trainer
+from .event_handler import (TrainBegin, TrainEnd, EpochBegin, EpochEnd,
+                            BatchBegin, BatchEnd, StoppingHandler,
+                            MetricHandler, ValidationHandler, LoggingHandler)
+
+__all__ = ["Estimator"]
+
+
+class Estimator:
+    """Train a Gluon net with event handlers (parity: Estimator.fit)."""
+
+    def __init__(self, net, loss, metrics=None, initializer=None,
+                 trainer=None, context=None):
+        self.net = net
+        self.logger = logging.getLogger("mxtpu.estimator")
+        if not self.logger.handlers:
+            self.logger.addHandler(logging.StreamHandler())
+            self.logger.setLevel(logging.INFO)
+        if isinstance(loss, gloss.Loss):
+            self.loss = loss
+        else:
+            raise ValueError("loss must be a gluon.loss.Loss instance")
+        if metrics is None:
+            self.train_metrics = [Accuracy()]
+        elif isinstance(metrics, (list, tuple)):
+            self.train_metrics = list(metrics)
+        else:
+            self.train_metrics = [metrics]
+        self.train_metrics.append(LossMetric(
+            name="loss"))
+        self.val_metrics = [copy.deepcopy(m) for m in self.train_metrics]
+        self.context = context
+        # initialize() on an already-initialized net warns and keeps the
+        # existing values (Parameter.initialize semantics); real
+        # initialization errors propagate
+        self.net.initialize(init=initializer)
+        self.trainer = trainer or Trainer(
+            self.net.collect_params(), "adam", {"learning_rate": 1e-3})
+
+    def evaluate(self, val_data, batch_axis=0):
+        """(parity: Estimator.evaluate)"""
+        for metric in self.val_metrics:
+            metric.reset()
+        for batch in val_data:
+            data, label = batch[0], batch[1]
+            pred = self.net(data)
+            loss = self.loss(pred, label)
+            for metric in self.val_metrics:
+                if isinstance(metric, LossMetric):
+                    metric.update(0, loss)
+                else:
+                    metric.update(label, pred)
+        return [m.get() for m in self.val_metrics]
+
+    def fit_batch(self, train_batch, batch_axis=0):
+        data, label = train_batch[0], train_batch[1]
+        with autograd.record():
+            pred = self.net(data)
+            loss = self.loss(pred, label)
+        loss.backward()
+        return data, label, pred, loss
+
+    def fit(self, train_data, val_data=None, epochs=None, event_handlers=None,
+            batches=None, batch_axis=0):
+        """(parity: Estimator.fit)"""
+        if epochs is None and batches is None:
+            raise ValueError("please specify epochs or batches")
+        event_handlers = self._prepare_default_handlers(
+            val_data, event_handlers, epochs, batches)
+        train_begin, epoch_begin, batch_begin, batch_end, epoch_end, \
+            train_end = self._categorize_handlers(event_handlers)
+        stop_handlers = [h for h in event_handlers
+                         if hasattr(h, "stop_training")]
+
+        for handler in train_begin:
+            handler.train_begin(self)
+        stop = False
+        while not stop:
+            for handler in epoch_begin:
+                handler.epoch_begin(self)
+            for batch in train_data:
+                for handler in batch_begin:
+                    handler.batch_begin(self, batch=batch)
+                data, label, pred, loss = self.fit_batch(batch, batch_axis)
+                self.trainer.step(data.shape[batch_axis])
+                for handler in batch_end:
+                    handler.batch_end(self, batch=batch, pred=pred,
+                                      label=label, loss=loss,
+                                      batch_size=data.shape[batch_axis])
+                if any(h.stop_training for h in stop_handlers):
+                    stop = True
+                    break
+            if stop:
+                break
+            for handler in epoch_end:
+                handler.epoch_end(self)
+            if any(h.stop_training for h in stop_handlers):
+                stop = True
+        for handler in train_end:
+            handler.train_end(self)
+
+    def _prepare_default_handlers(self, val_data, event_handlers, epochs,
+                                  batches):
+        event_handlers = list(event_handlers or [])
+        added = []
+        if not any(isinstance(h, StoppingHandler) for h in event_handlers):
+            event_handlers.append(StoppingHandler(epochs, batches))
+            added.append("StoppingHandler")
+        if not any(isinstance(h, MetricHandler) for h in event_handlers):
+            event_handlers.append(MetricHandler(self.train_metrics))
+            added.append("MetricHandler")
+        if val_data is not None and not any(
+                isinstance(h, ValidationHandler) for h in event_handlers):
+            event_handlers.append(ValidationHandler(
+                val_data, eval_fn=self.evaluate))
+            added.append("ValidationHandler")
+        if not any(isinstance(h, LoggingHandler) for h in event_handlers):
+            event_handlers.append(LoggingHandler(
+                metrics=self.train_metrics))
+            added.append("LoggingHandler")
+        if added:
+            self.logger.info("default handlers added: %s", ", ".join(added))
+        event_handlers.sort(key=lambda h: getattr(h, "priority", 0))
+        return event_handlers
+
+    @staticmethod
+    def _categorize_handlers(event_handlers):
+        return ([h for h in event_handlers if isinstance(h, TrainBegin)],
+                [h for h in event_handlers if isinstance(h, EpochBegin)],
+                [h for h in event_handlers if isinstance(h, BatchBegin)],
+                [h for h in event_handlers if isinstance(h, BatchEnd)],
+                [h for h in event_handlers if isinstance(h, EpochEnd)],
+                [h for h in event_handlers if isinstance(h, TrainEnd)])

@@ -1,0 +1,307 @@
+"""Estimator event handlers (parity: gluon/contrib/estimator/
+event_handler.py — the 1.6+ training-loop hook system)."""
+
+from __future__ import annotations
+
+import logging
+import os
+import time
+import warnings
+
+import numpy as onp
+
+__all__ = ["TrainBegin", "TrainEnd", "EpochBegin", "EpochEnd", "BatchBegin",
+           "BatchEnd", "StoppingHandler", "MetricHandler",
+           "ValidationHandler", "LoggingHandler", "CheckpointHandler",
+           "EarlyStoppingHandler"]
+
+
+class TrainBegin:
+    def train_begin(self, estimator, *args, **kwargs):
+        pass
+
+
+class TrainEnd:
+    def train_end(self, estimator, *args, **kwargs):
+        pass
+
+
+class EpochBegin:
+    def epoch_begin(self, estimator, *args, **kwargs):
+        pass
+
+
+class EpochEnd:
+    def epoch_end(self, estimator, *args, **kwargs):
+        pass
+
+
+class BatchBegin:
+    def batch_begin(self, estimator, *args, **kwargs):
+        pass
+
+
+class BatchEnd:
+    def batch_end(self, estimator, *args, **kwargs):
+        pass
+
+
+class StoppingHandler(TrainBegin, BatchEnd, EpochEnd):
+    """Stop after max_epoch/max_batch (parity: StoppingHandler)."""
+
+    def __init__(self, max_epoch=None, max_batch=None):
+        self.max_epoch = max_epoch
+        self.max_batch = max_batch
+        self.current_batch = 0
+        self.current_epoch = 0
+        self.stop_training = False
+
+    def train_begin(self, estimator, *args, **kwargs):
+        self.current_batch = 0
+        self.current_epoch = 0
+
+    def batch_end(self, estimator, *args, **kwargs):
+        self.current_batch += 1
+        if self.max_batch and self.current_batch == self.max_batch:
+            self.stop_training = True
+
+    def epoch_end(self, estimator, *args, **kwargs):
+        self.current_epoch += 1
+        if self.max_epoch and self.current_epoch == self.max_epoch:
+            self.stop_training = True
+
+
+class MetricHandler(EpochBegin, BatchEnd):
+    """Reset/update train metrics (parity: MetricHandler)."""
+
+    def __init__(self, metrics, priority=-1000):
+        self.metrics = metrics or []
+        self.priority = priority
+
+    def epoch_begin(self, estimator, *args, **kwargs):
+        for metric in self.metrics:
+            metric.reset()
+
+    def batch_end(self, estimator, *args, **kwargs):
+        pred = kwargs["pred"]
+        label = kwargs["label"]
+        loss = kwargs["loss"]
+        for metric in self.metrics:
+            from ....metric import Loss as LossMetric
+            if isinstance(metric, LossMetric):
+                metric.update(0, loss)
+            else:
+                metric.update(label, pred)
+
+
+class ValidationHandler(TrainBegin, BatchEnd, EpochEnd):
+    """Run validation periodically (parity: ValidationHandler)."""
+
+    def __init__(self, val_data, eval_fn, epoch_period=1, batch_period=None,
+                 priority=-1000):
+        self.val_data = val_data
+        self.eval_fn = eval_fn
+        self.epoch_period = epoch_period
+        self.batch_period = batch_period
+        self.priority = priority
+        self.current_batch = 0
+        self.current_epoch = 0
+
+    def train_begin(self, estimator, *args, **kwargs):
+        self.current_batch = 0
+        self.current_epoch = 0
+
+    def batch_end(self, estimator, *args, **kwargs):
+        self.current_batch += 1
+        if self.batch_period and self.current_batch % self.batch_period == 0:
+            self.eval_fn(val_data=self.val_data)
+
+    def epoch_end(self, estimator, *args, **kwargs):
+        self.current_epoch += 1
+        if self.epoch_period and self.current_epoch % self.epoch_period == 0:
+            self.eval_fn(val_data=self.val_data)
+
+
+class LoggingHandler(TrainBegin, TrainEnd, EpochBegin, EpochEnd, BatchBegin,
+                     BatchEnd):
+    """Log speed + metrics (parity: LoggingHandler)."""
+
+    def __init__(self, log_interval="epoch", metrics=None, priority=-1000):
+        self.log_interval = log_interval
+        self.metrics = metrics or []
+        self.priority = priority
+        self.batch_index = 0
+        self.current_epoch = 0
+        self.processed_samples = 0
+        self.log_interval_time = 0
+
+    def train_begin(self, estimator, *args, **kwargs):
+        self.train_start = time.time()
+        estimator.logger.info("Training begin")
+
+    def train_end(self, estimator, *args, **kwargs):
+        train_time = time.time() - self.train_start
+        msg = "Train finished using total %ds with %d epochs. " % (
+            train_time, self.current_epoch)
+        for metric in self.metrics:
+            name, value = metric.get()
+            msg += "%s: %.4f, " % (name, value)
+        estimator.logger.info(msg.rstrip(", "))
+
+    def batch_begin(self, estimator, *args, **kwargs):
+        if isinstance(self.log_interval, int):
+            self.batch_start = time.time()
+
+    def batch_end(self, estimator, *args, **kwargs):
+        if isinstance(self.log_interval, int):
+            batch_time = time.time() - self.batch_start
+            msg = "[Epoch %d][Batch %d]" % (self.current_epoch,
+                                            self.batch_index)
+            self.processed_samples += kwargs.get("batch_size", 0)
+            msg += "[Samples %s] " % self.processed_samples
+            self.log_interval_time += batch_time
+            if self.batch_index % self.log_interval == 0:
+                msg += "time/interval: %.3fs " % self.log_interval_time
+                self.log_interval_time = 0
+                for metric in self.metrics:
+                    name, value = metric.get()
+                    msg += "%s: %.4f, " % (name, value)
+                estimator.logger.info(msg.rstrip(", "))
+        self.batch_index += 1
+
+    def epoch_begin(self, estimator, *args, **kwargs):
+        self.epoch_start = time.time()
+
+    def epoch_end(self, estimator, *args, **kwargs):
+        epoch_time = time.time() - self.epoch_start
+        msg = "[Epoch %d] finished in %.3fs: " % (self.current_epoch,
+                                                  epoch_time)
+        for monitor in self.metrics:
+            name, value = monitor.get()
+            msg += "%s: %.4f, " % (name, value)
+        estimator.logger.info(msg.rstrip(", "))
+        self.current_epoch += 1
+        self.batch_index = 0
+
+
+class CheckpointHandler(TrainBegin, BatchEnd, EpochEnd):
+    """Save params (+trainer states) periodically, keep best (parity:
+    CheckpointHandler)."""
+
+    def __init__(self, model_dir, model_prefix="model", monitor=None,
+                 verbose=0, save_best=False, mode="auto", epoch_period=1,
+                 batch_period=None, max_checkpoints=5,
+                 resume_from_checkpoint=False):
+        self.model_dir = model_dir
+        self.model_prefix = model_prefix
+        self.monitor = monitor
+        self.verbose = verbose
+        self.save_best = save_best
+        self.epoch_period = epoch_period
+        self.batch_period = batch_period
+        self.max_checkpoints = max_checkpoints
+        self.saved_checkpoints = []
+        self.current_epoch = 0
+        self.current_batch = 0
+        if self.save_best and monitor is None:
+            raise ValueError("save_best requires a monitor metric")
+        if mode == "min":
+            self.monitor_op = onp.less
+        elif mode == "max":
+            self.monitor_op = onp.greater
+        else:
+            self.monitor_op = onp.less if monitor is not None and \
+                "loss" in (monitor.get()[0] or "") else onp.greater
+        self.best = onp.inf if self.monitor_op == onp.less else -onp.inf
+
+    def train_begin(self, estimator, *args, **kwargs):
+        os.makedirs(self.model_dir, exist_ok=True)
+        self.current_epoch = 0
+        self.current_batch = 0
+
+    def batch_end(self, estimator, *args, **kwargs):
+        self.current_batch += 1
+        if self.batch_period and self.current_batch % self.batch_period == 0:
+            self._save_checkpoint(estimator)
+
+    def epoch_end(self, estimator, *args, **kwargs):
+        self.current_epoch += 1
+        if self.epoch_period and self.current_epoch % self.epoch_period == 0:
+            self._save_checkpoint(estimator)
+
+    def _save_checkpoint(self, estimator):
+        prefix = os.path.join(self.model_dir, self.model_prefix)
+        fname = "%s-epoch%dbatch%d.params" % (prefix, self.current_epoch,
+                                              self.current_batch)
+        estimator.net.save_parameters(fname)
+        self.saved_checkpoints.append(fname)
+        while len(self.saved_checkpoints) > self.max_checkpoints:
+            old = self.saved_checkpoints.pop(0)
+            if os.path.exists(old):
+                os.remove(old)
+        if self.save_best:
+            current = self.monitor.get()[1]
+            if self.monitor_op(current, self.best):
+                self.best = current
+                estimator.net.save_parameters("%s-best.params" % prefix)
+                if self.verbose:
+                    estimator.logger.info(
+                        "new best %s: %.5f; best model saved",
+                        self.monitor.get()[0], current)
+
+
+class EarlyStoppingHandler(TrainBegin, EpochEnd, TrainEnd):
+    """Stop when a metric stops improving (parity: EarlyStoppingHandler)."""
+
+    def __init__(self, monitor, min_delta=0, patience=0, mode="auto",
+                 baseline=None):
+        self.monitor = monitor
+        self.min_delta = min_delta
+        self.patience = patience
+        self.baseline = baseline
+        self.wait = 0
+        self.stopped_epoch = 0
+        self.current_epoch = 0
+        self.stop_training = False
+        if mode == "min":
+            self.monitor_op = onp.less
+        elif mode == "max":
+            self.monitor_op = onp.greater
+        else:
+            self.monitor_op = onp.less if "loss" in (
+                monitor.get()[0] or "") else onp.greater
+        if self.monitor_op == onp.greater:
+            self.min_delta *= 1
+        else:
+            self.min_delta *= -1
+
+    def train_begin(self, estimator, *args, **kwargs):
+        self.wait = 0
+        self.stopped_epoch = 0
+        self.current_epoch = 0
+        self.stop_training = False
+        self.best = onp.inf if self.monitor_op == onp.less else -onp.inf
+        if self.baseline is not None:
+            self.best = self.baseline
+
+    def epoch_end(self, estimator, *args, **kwargs):
+        monitor_name, monitor_value = self.monitor.get()
+        if monitor_value is None or (isinstance(monitor_value, float)
+                                     and onp.isnan(monitor_value)):
+            warnings.warn("early stopping requires %s to be available" %
+                          monitor_name)
+        else:
+            if self.monitor_op(monitor_value - self.min_delta, self.best):
+                self.best = monitor_value
+                self.wait = 0
+            else:
+                self.wait += 1
+                if self.wait >= self.patience:
+                    self.stopped_epoch = self.current_epoch
+                    self.stop_training = True
+        self.current_epoch += 1
+
+    def train_end(self, estimator, *args, **kwargs):
+        if self.stopped_epoch > 0:
+            estimator.logger.info("[Epoch %d] early stopping",
+                                  self.stopped_epoch)

@@ -1,0 +1,5 @@
+"""Contrib NN blocks (parity: gluon/contrib/nn/basic_layers.py)."""
+
+from .basic_layers import (Concurrent, HybridConcurrent, Identity,
+                           SparseEmbedding, SyncBatchNorm, PixelShuffle1D,
+                           PixelShuffle2D, PixelShuffle3D)

@@ -1,0 +1,140 @@
+"""Contrib layers (parity: python/mxnet/gluon/contrib/nn/basic_layers.py).
+
+SyncBatchNorm note: the reference synchronized batch stats across GPUs with
+a dedicated kernel (src/operator/contrib/sync_batch_norm.cc). Under SPMD
+execution here, activations are GLOBAL arrays over the mesh — BatchNorm's
+batch statistics already reduce over the full global batch (XLA inserts the
+collectives) — so SyncBatchNorm IS BatchNorm; the class exists for API
+parity and documents the equivalence.
+"""
+
+from __future__ import annotations
+
+import warnings
+
+from ... import nn
+from ...block import HybridBlock
+from ....base import MXTPUError
+
+__all__ = ["Concurrent", "HybridConcurrent", "Identity", "SparseEmbedding",
+           "SyncBatchNorm", "PixelShuffle1D", "PixelShuffle2D",
+           "PixelShuffle3D"]
+
+
+class Concurrent(nn.Sequential):
+    """Parallel branches, outputs concatenated (parity: contrib.Concurrent)."""
+
+    def __init__(self, axis=-1, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self.axis = axis
+
+    def forward(self, x):
+        from .... import ndarray as nd
+        out = [block(x) for block in self._children.values()]
+        return nd.concat(*out, dim=self.axis)
+
+
+class HybridConcurrent(nn.HybridSequential):
+    """(parity: contrib.HybridConcurrent)"""
+
+    def __init__(self, axis=-1, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self.axis = axis
+
+    def hybrid_forward(self, F, x):
+        out = [block(x) for block in self._children.values()]
+        return F.concat(*out, dim=self.axis)
+
+
+class Identity(HybridBlock):
+    """(parity: contrib.Identity)"""
+
+    def hybrid_forward(self, F, x):
+        return x
+
+
+class SparseEmbedding(nn.Embedding):
+    """Sparse-gradient embedding (parity: contrib.SparseEmbedding).
+
+    Sparse storage is descoped in v1 (SURVEY §7 hard-part 6) — dense
+    gradients with a warning; XLA's scatter-add handles the update."""
+
+    def __init__(self, input_dim, output_dim, dtype="float32",
+                 weight_initializer=None, **kwargs):
+        warnings.warn("SparseEmbedding: row_sparse gradients are descoped "
+                      "in mxtpu v1; dense fallback (documented)")
+        super().__init__(input_dim, output_dim, dtype=dtype,
+                         weight_initializer=weight_initializer, **kwargs)
+
+
+class SyncBatchNorm(nn.BatchNorm):
+    """Cross-device BatchNorm (parity: contrib.SyncBatchNorm — see module
+    docstring: under SPMD the plain BatchNorm already reduces globally)."""
+
+    def __init__(self, in_channels=0, num_devices=None, momentum=0.9,
+                 epsilon=1e-5, center=True, scale=True, use_global_stats=False,
+                 beta_initializer="zeros", gamma_initializer="ones",
+                 running_mean_initializer="zeros",
+                 running_variance_initializer="ones", **kwargs):
+        super().__init__(axis=1, momentum=momentum, epsilon=epsilon,
+                         center=center, scale=scale,
+                         use_global_stats=use_global_stats,
+                         beta_initializer=beta_initializer,
+                         gamma_initializer=gamma_initializer,
+                         running_mean_initializer=running_mean_initializer,
+                         running_variance_initializer=(
+                             running_variance_initializer),
+                         in_channels=in_channels, **kwargs)
+
+
+class _PixelShuffle(HybridBlock):
+    def __init__(self, factor, ndim, **kwargs):
+        super().__init__(**kwargs)
+        if isinstance(factor, int):
+            factor = (factor,) * ndim
+        self._factor = tuple(factor)
+        self._ndim = ndim
+
+    def hybrid_forward(self, F, x):
+        f = self._factor
+        if self._ndim == 1:
+            B, C, W = x.shape
+            c = C // f[0]
+            x = F.reshape(x, shape=(B, c, f[0], W))
+            x = F.transpose(x, (0, 1, 3, 2))
+            return F.reshape(x, shape=(B, c, W * f[0]))
+        if self._ndim == 2:
+            B, C, H, W = x.shape
+            c = C // (f[0] * f[1])
+            x = F.reshape(x, shape=(B, c, f[0], f[1], H, W))
+            x = F.transpose(x, (0, 1, 4, 2, 5, 3))
+            return F.reshape(x, shape=(B, c, H * f[0], W * f[1]))
+        B, C, D, H, W = x.shape
+        c = C // (f[0] * f[1] * f[2])
+        x = F.reshape(x, shape=(B, c, f[0], f[1], f[2], D, H, W))
+        x = F.transpose(x, (0, 1, 5, 2, 6, 3, 7, 4))
+        return F.reshape(x, shape=(B, c, D * f[0], H * f[1], W * f[2]))
+
+    def __repr__(self):
+        return "{}(factor={})".format(type(self).__name__, self._factor)
+
+
+class PixelShuffle1D(_PixelShuffle):
+    """(parity: contrib.PixelShuffle1D)"""
+
+    def __init__(self, factor, **kwargs):
+        super().__init__(factor, 1, **kwargs)
+
+
+class PixelShuffle2D(_PixelShuffle):
+    """(parity: contrib.PixelShuffle2D)"""
+
+    def __init__(self, factor, **kwargs):
+        super().__init__(factor, 2, **kwargs)
+
+
+class PixelShuffle3D(_PixelShuffle):
+    """(parity: contrib.PixelShuffle3D)"""
+
+    def __init__(self, factor, **kwargs):
+        super().__init__(factor, 3, **kwargs)

@@ -1,0 +1,5 @@
+"""Contrib RNN cells (parity: gluon/contrib/rnn/)."""
+
+from .rnn_cell import VariationalDropoutCell, LSTMPCell
+from .conv_rnn_cell import Conv1DRNNCell, Conv2DRNNCell, Conv1DLSTMCell, \
+    Conv2DLSTMCell, Conv1DGRUCell, Conv2DGRUCell

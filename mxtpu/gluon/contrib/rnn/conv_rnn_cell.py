@@ -1,0 +1,193 @@
+"""Convolutional recurrent cells (parity: gluon/contrib/rnn/conv_rnn_cell.py
+— ConvRNN/ConvLSTM/ConvGRU in 1D/2D)."""
+
+from __future__ import annotations
+
+from ....base import MXTPUError
+from ...rnn.rnn_cell import HybridRecurrentCell
+
+__all__ = ["Conv1DRNNCell", "Conv2DRNNCell", "Conv1DLSTMCell",
+           "Conv2DLSTMCell", "Conv1DGRUCell", "Conv2DGRUCell"]
+
+
+def _norm_tuple(v, ndim):
+    if isinstance(v, int):
+        return (v,) * ndim
+    return tuple(v)
+
+
+class _BaseConvRNNCell(HybridRecurrentCell):
+    def __init__(self, input_shape, hidden_channels, i2h_kernel, h2h_kernel,
+                 i2h_pad, activation, num_gates, conv_ndim,
+                 i2h_weight_initializer=None, h2h_weight_initializer=None,
+                 i2h_bias_initializer="zeros", h2h_bias_initializer="zeros",
+                 prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._input_shape = tuple(input_shape)  # (C, spatial...)
+        self._hidden_channels = hidden_channels
+        self._ndim = conv_ndim
+        self._i2h_kernel = _norm_tuple(i2h_kernel, conv_ndim)
+        self._h2h_kernel = _norm_tuple(h2h_kernel, conv_ndim)
+        for k in self._h2h_kernel:
+            assert k % 2 == 1, \
+                "h2h kernel dims must be odd to preserve spatial size"
+        self._i2h_pad = _norm_tuple(i2h_pad, conv_ndim)
+        self._h2h_pad = tuple(k // 2 for k in self._h2h_kernel)
+        self._activation = activation
+        self._num_gates = num_gates
+        in_c = self._input_shape[0]
+        oc = num_gates * hidden_channels
+        self.i2h_weight = self.params.get(
+            "i2h_weight", shape=(oc, in_c) + self._i2h_kernel,
+            init=i2h_weight_initializer, allow_deferred_init=True)
+        self.h2h_weight = self.params.get(
+            "h2h_weight", shape=(oc, hidden_channels) + self._h2h_kernel,
+            init=h2h_weight_initializer, allow_deferred_init=True)
+        self.i2h_bias = self.params.get(
+            "i2h_bias", shape=(oc,), init=i2h_bias_initializer,
+            allow_deferred_init=True)
+        self.h2h_bias = self.params.get(
+            "h2h_bias", shape=(oc,), init=h2h_bias_initializer,
+            allow_deferred_init=True)
+
+    @property
+    def _state_shape(self):
+        # spatial dims preserved by same-padding h2h; i2h must preserve too
+        return (self._hidden_channels,) + self._input_shape[1:]
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (batch_size,) + self._state_shape,
+                 "__layout__": "NC" + "DHW"[-self._ndim:]}]
+
+    def infer_shape(self, inputs, states):
+        pass
+
+    def _conv_forward(self, F, inputs, states, i2h_weight, h2h_weight,
+                      i2h_bias, h2h_bias):
+        i2h = F.Convolution(inputs, i2h_weight, i2h_bias,
+                            kernel=self._i2h_kernel, pad=self._i2h_pad,
+                            num_filter=self._num_gates *
+                            self._hidden_channels)
+        h2h = F.Convolution(states[0], h2h_weight, h2h_bias,
+                            kernel=self._h2h_kernel, pad=self._h2h_pad,
+                            num_filter=self._num_gates *
+                            self._hidden_channels)
+        return i2h, h2h
+
+
+class _ConvRNNCell(_BaseConvRNNCell):
+    def __init__(self, input_shape, hidden_channels, i2h_kernel, h2h_kernel,
+                 i2h_pad, activation, conv_ndim, **kwargs):
+        super().__init__(input_shape, hidden_channels, i2h_kernel,
+                         h2h_kernel, i2h_pad, activation, 1, conv_ndim,
+                         **kwargs)
+
+    def _alias(self):
+        return "conv_rnn"
+
+    def hybrid_forward(self, F, inputs, states, i2h_weight, h2h_weight,
+                       i2h_bias, h2h_bias):
+        i2h, h2h = self._conv_forward(F, inputs, states, i2h_weight,
+                                      h2h_weight, i2h_bias, h2h_bias)
+        output = self._get_activation(F, i2h + h2h, self._activation)
+        return output, [output]
+
+
+class _ConvLSTMCell(_BaseConvRNNCell):
+    def __init__(self, input_shape, hidden_channels, i2h_kernel, h2h_kernel,
+                 i2h_pad, activation, conv_ndim, **kwargs):
+        super().__init__(input_shape, hidden_channels, i2h_kernel,
+                         h2h_kernel, i2h_pad, activation, 4, conv_ndim,
+                         **kwargs)
+
+    def _alias(self):
+        return "conv_lstm"
+
+    def state_info(self, batch_size=0):
+        shape = (batch_size,) + self._state_shape
+        layout = "NC" + "DHW"[-self._ndim:]
+        return [{"shape": shape, "__layout__": layout},
+                {"shape": shape, "__layout__": layout}]
+
+    def hybrid_forward(self, F, inputs, states, i2h_weight, h2h_weight,
+                       i2h_bias, h2h_bias):
+        i2h, h2h = self._conv_forward(F, inputs, states, i2h_weight,
+                                      h2h_weight, i2h_bias, h2h_bias)
+        gates = i2h + h2h
+        sl = F.split_v2(gates, 4, axis=1)
+        in_gate = F.Activation(sl[0], act_type="sigmoid")
+        forget_gate = F.Activation(sl[1], act_type="sigmoid")
+        in_transform = self._get_activation(F, sl[2], self._activation)
+        out_gate = F.Activation(sl[3], act_type="sigmoid")
+        next_c = forget_gate * states[1] + in_gate * in_transform
+        next_h = out_gate * self._get_activation(F, next_c, self._activation)
+        return next_h, [next_h, next_c]
+
+
+class _ConvGRUCell(_BaseConvRNNCell):
+    def __init__(self, input_shape, hidden_channels, i2h_kernel, h2h_kernel,
+                 i2h_pad, activation, conv_ndim, **kwargs):
+        super().__init__(input_shape, hidden_channels, i2h_kernel,
+                         h2h_kernel, i2h_pad, activation, 3, conv_ndim,
+                         **kwargs)
+
+    def _alias(self):
+        return "conv_gru"
+
+    def hybrid_forward(self, F, inputs, states, i2h_weight, h2h_weight,
+                       i2h_bias, h2h_bias):
+        i2h, h2h = self._conv_forward(F, inputs, states, i2h_weight,
+                                      h2h_weight, i2h_bias, h2h_bias)
+        i2h_r, i2h_z, i2h_n = F.split_v2(i2h, 3, axis=1)
+        h2h_r, h2h_z, h2h_n = F.split_v2(h2h, 3, axis=1)
+        reset_gate = F.Activation(i2h_r + h2h_r, act_type="sigmoid")
+        update_gate = F.Activation(i2h_z + h2h_z, act_type="sigmoid")
+        next_h_tmp = self._get_activation(F, i2h_n + reset_gate * h2h_n,
+                                          self._activation)
+        next_h = (1.0 - update_gate) * next_h_tmp + update_gate * states[0]
+        return next_h, [next_h]
+
+
+class Conv1DRNNCell(_ConvRNNCell):
+    def __init__(self, input_shape, hidden_channels, i2h_kernel=3,
+                 h2h_kernel=3, i2h_pad=1, activation="tanh", **kwargs):
+        super().__init__(input_shape, hidden_channels, i2h_kernel,
+                         h2h_kernel, i2h_pad, activation, 1, **kwargs)
+
+
+class Conv2DRNNCell(_ConvRNNCell):
+    def __init__(self, input_shape, hidden_channels, i2h_kernel=(3, 3),
+                 h2h_kernel=(3, 3), i2h_pad=(1, 1), activation="tanh",
+                 **kwargs):
+        super().__init__(input_shape, hidden_channels, i2h_kernel,
+                         h2h_kernel, i2h_pad, activation, 2, **kwargs)
+
+
+class Conv1DLSTMCell(_ConvLSTMCell):
+    def __init__(self, input_shape, hidden_channels, i2h_kernel=3,
+                 h2h_kernel=3, i2h_pad=1, activation="tanh", **kwargs):
+        super().__init__(input_shape, hidden_channels, i2h_kernel,
+                         h2h_kernel, i2h_pad, activation, 1, **kwargs)
+
+
+class Conv2DLSTMCell(_ConvLSTMCell):
+    def __init__(self, input_shape, hidden_channels, i2h_kernel=(3, 3),
+                 h2h_kernel=(3, 3), i2h_pad=(1, 1), activation="tanh",
+                 **kwargs):
+        super().__init__(input_shape, hidden_channels, i2h_kernel,
+                         h2h_kernel, i2h_pad, activation, 2, **kwargs)
+
+
+class Conv1DGRUCell(_ConvGRUCell):
+    def __init__(self, input_shape, hidden_channels, i2h_kernel=3,
+                 h2h_kernel=3, i2h_pad=1, activation="tanh", **kwargs):
+        super().__init__(input_shape, hidden_channels, i2h_kernel,
+                         h2h_kernel, i2h_pad, activation, 1, **kwargs)
+
+
+class Conv2DGRUCell(_ConvGRUCell):
+    def __init__(self, input_shape, hidden_channels, i2h_kernel=(3, 3),
+                 h2h_kernel=(3, 3), i2h_pad=(1, 1), activation="tanh",
+                 **kwargs):
+        super().__init__(input_shape, hidden_channels, i2h_kernel,
+                         h2h_kernel, i2h_pad, activation, 2, **kwargs)

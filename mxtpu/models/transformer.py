@@ -51,7 +51,7 @@ class MultiHeadAttention(HybridBlock):
 
     def __init__(self, units, num_heads, num_kv_heads=None, dropout=0.0,
                  use_rotary=False, causal=False, mesh=None, use_bias=True,
-                 **kwargs):
+                 use_flash=True, **kwargs):
         super().__init__(**kwargs)
         assert units % num_heads == 0
         self._units = units
@@ -63,6 +63,7 @@ class MultiHeadAttention(HybridBlock):
         self._rotary = use_rotary
         self._causal = causal
         self._mesh = mesh
+        self._use_flash = use_flash
         with self.name_scope():
             qkv_units = units + 2 * self._kv_heads * self._head_dim
             self.qkv = nn.Dense(qkv_units, use_bias=use_bias, flatten=False,
@@ -92,6 +93,8 @@ class MultiHeadAttention(HybridBlock):
             k = F.repeat(k, repeats=rep, axis=1)
             v = F.repeat(v, repeats=rep, axis=1)
 
+        from .. import autograd as _ag
+        attn_dropout = self._dropout and _ag.is_training()
         if self._ring_active():
             if mask is not None:
                 raise NotImplementedError(
@@ -100,6 +103,9 @@ class MultiHeadAttention(HybridBlock):
                     "sp=1 for masked attention")
             out = F.ring_attention(q, k, v, causal=self._causal,
                                    _mesh=self._mesh)
+        elif self._use_flash and mask is None and not attn_dropout:
+            # Pallas streaming kernel: O(T·D) HBM traffic
+            out = F.flash_attention(q, k, v, causal=self._causal)
         else:
             scores = F.batch_dot_attn(q, k) / math.sqrt(D)  # (B,H,T,T)
             if self._causal:

@@ -16,3 +16,14 @@ generates Python op stubs from the C registry at import time
 from . import tensor  # noqa: F401
 from . import nn  # noqa: F401
 from . import contrib  # noqa: F401
+
+try:  # pallas kernels (gated: interpret-mode on CPU, absent on old jax)
+    from . import pallas  # noqa: F401
+except Exception:  # pragma: no cover
+    import math as _math
+    import warnings
+    import jax as _jax
+    import jax.numpy as _jnp
+    from .base_fallbacks import register_dense_flash_attention
+    warnings.warn("pallas unavailable; flash_attention falls back to XLA")
+    register_dense_flash_attention()

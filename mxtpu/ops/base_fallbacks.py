@@ -1,0 +1,32 @@
+"""Fallback op registrations used when optional kernel backends (pallas)
+fail to import — the op names must exist either way because model code
+calls them unconditionally."""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from ..base import register_op
+
+_NEG_INF = -1e30
+
+
+def register_dense_flash_attention():
+    @register_op("flash_attention", aliases=("_contrib_flash_attention",))
+    def flash_attention_op(q, k, v, causal=False, scale=None, q_block=128,
+                           kv_block=128):
+        scale = float(scale if scale is not None
+                      else 1.0 / math.sqrt(q.shape[-1]))
+        qf = q.astype(jnp.float32)
+        s = jnp.einsum("bhqd,bhkd->bhqk", qf, k.astype(jnp.float32),
+                       preferred_element_type=jnp.float32) * scale
+        if causal:
+            Tq, Tk = s.shape[-2], s.shape[-1]
+            mask = jnp.tril(jnp.ones((Tq, Tk), jnp.bool_), Tk - Tq)
+            s = jnp.where(mask, s, _NEG_INF)
+        p = jax.nn.softmax(s, axis=-1)
+        o = jnp.einsum("bhqk,bhkd->bhqd", p, v.astype(jnp.float32))
+        return o.astype(q.dtype)

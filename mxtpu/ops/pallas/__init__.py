@@ -1,0 +1,5 @@
+"""Pallas TPU kernels (the hot-op escape hatch; parity target:
+src/operator/contrib/transformer.cc fused attention + fusion/fused_op RTC —
+where the reference hand-wrote CUDA, mxtpu hand-writes Pallas)."""
+
+from .flash_attention import flash_attention

@@ -99,6 +99,16 @@ class Symbol:
                         f"{opname}: argument {i} given positionally and by "
                         "keyword")
                 args[i] = s
+        # auto-create missing parameter Variables (parity: the reference
+        # creates `name_weight`/`name_bias`/aux vars when not supplied)
+        for pname, pos, is_aux, skip in _AUTO_VAR_INPUTS.get(spec.name, ()):
+            if skip is not None and skip(kwargs):
+                continue
+            while len(args) <= pos:
+                args.append(None)
+            if args[pos] is None:
+                attrs = {"__aux__": True} if is_aux else None
+                args[pos] = Variable("%s_%s" % (name, pname), attr=attrs)
         layout = [None if isinstance(a, Symbol) else a for a in args]
         sym_positional = [a for a in args if isinstance(a, Symbol)]
         kw_syms = [(k, v) for k, v in kwargs.items()
@@ -533,6 +543,35 @@ _PARAM_SHAPE_RULES = {
     "LayerNorm": _ln_param_shapes,
     "InstanceNorm": _ln_param_shapes,
     "Embedding": _embed_param_shapes,
+    # label-shape inference for the implicit-loss heads
+    "SoftmaxOutput": lambda in_shape, kw: [(in_shape[0],)],
+    "LinearRegressionOutput": lambda in_shape, kw: [tuple(in_shape)],
+    "MAERegressionOutput": lambda in_shape, kw: [tuple(in_shape)],
+    "LogisticRegressionOutput": lambda in_shape, kw: [tuple(in_shape)],
+}
+
+
+# op → ((param_name, positional_slot, is_aux, skip_fn), ...) for inputs the
+# reference auto-creates as Variables when omitted
+_AUTO_VAR_INPUTS = {
+    "FullyConnected": (("weight", 1, False, None),
+                       ("bias", 2, False, lambda kw: kw.get("no_bias"))),
+    "Convolution": (("weight", 1, False, None),
+                    ("bias", 2, False, lambda kw: kw.get("no_bias"))),
+    "Deconvolution": (("weight", 1, False, None),
+                      ("bias", 2, False,
+                       lambda kw: kw.get("no_bias", True))),
+    "BatchNorm": (("gamma", 1, False, None), ("beta", 2, False, None),
+                  ("moving_mean", 3, True, None),
+                  ("moving_var", 4, True, None)),
+    "LayerNorm": (("gamma", 1, False, None), ("beta", 2, False, None)),
+    "InstanceNorm": (("gamma", 1, False, None), ("beta", 2, False, None)),
+    "GroupNorm": (("gamma", 1, False, None), ("beta", 2, False, None)),
+    "Embedding": (("weight", 1, False, None),),
+    "SoftmaxOutput": (("label", 1, False, None),),
+    "LinearRegressionOutput": (("label", 1, False, None),),
+    "MAERegressionOutput": (("label", 1, False, None),),
+    "LogisticRegressionOutput": (("label", 1, False, None),),
 }
 
 

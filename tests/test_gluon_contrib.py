@@ -1,0 +1,144 @@
+"""Tests for gluon.contrib (parity model: tests/python/unittest/
+test_gluon_contrib.py + test_gluon_estimator.py)."""
+
+import numpy as np
+import pytest
+
+import mxtpu as mx
+from mxtpu import gluon
+from mxtpu.gluon import nn
+from mxtpu.gluon.contrib import nn as cnn
+from mxtpu.gluon.contrib import rnn as crnn
+from mxtpu.gluon.contrib.estimator import (Estimator, StoppingHandler,
+                                           EarlyStoppingHandler,
+                                           CheckpointHandler)
+from mxtpu.gluon.data import ArrayDataset, DataLoader
+
+
+def test_concurrent():
+    c = cnn.HybridConcurrent(axis=1)
+    c.add(nn.Dense(4, flatten=False))
+    c.add(nn.Dense(4, flatten=False))
+    c.initialize()
+    out = c(mx.nd.ones((2, 3)))
+    assert out.shape == (2, 8)
+    c2 = cnn.Concurrent(axis=-1)
+    c2.add(nn.Dense(2), nn.Dense(2))
+    c2.initialize()
+    assert c2(mx.nd.ones((2, 3))).shape == (2, 4)
+
+
+def test_identity_and_pixelshuffle():
+    x = mx.nd.random.uniform(shape=(2, 3))
+    np.testing.assert_array_equal(cnn.Identity()(x).asnumpy(), x.asnumpy())
+    assert cnn.PixelShuffle1D(2)(mx.nd.ones((1, 4, 8))).shape == (1, 2, 16)
+    assert cnn.PixelShuffle2D(2)(mx.nd.ones((1, 8, 4, 4))).shape == \
+        (1, 2, 8, 8)
+    assert cnn.PixelShuffle3D(2)(mx.nd.ones((1, 8, 2, 2, 2))).shape == \
+        (1, 1, 4, 4, 4)
+
+
+def test_sync_batchnorm():
+    sbn = cnn.SyncBatchNorm()
+    sbn.initialize()
+    out = sbn(mx.nd.random.uniform(shape=(4, 3, 2, 2)))
+    assert out.shape == (4, 3, 2, 2)
+
+
+def test_sparse_embedding_dense_fallback():
+    with pytest.warns(UserWarning):
+        emb = cnn.SparseEmbedding(10, 4)
+    emb.initialize()
+    out = emb(mx.nd.array([1, 3], dtype="int32"))
+    assert out.shape == (2, 4)
+
+
+def test_variational_dropout_cell():
+    vd = crnn.VariationalDropoutCell(gluon.rnn.GRUCell(6), drop_inputs=0.5)
+    vd.initialize()
+    out, st = vd.unroll(4, mx.nd.random.uniform(shape=(2, 4, 3)),
+                        layout="NTC", merge_outputs=True)
+    assert out.shape == (2, 4, 6)
+
+
+def test_lstmp_cell():
+    cell = crnn.LSTMPCell(8, 4)
+    cell.initialize()
+    out, states = cell(mx.nd.random.uniform(shape=(2, 3)),
+                       cell.begin_state(2))
+    assert out.shape == (2, 4)
+    assert states[0].shape == (2, 4) and states[1].shape == (2, 8)
+    # matches the fused projected LSTM geometry
+    fused = gluon.rnn.LSTM(8, projection_size=4, input_size=3)
+    fused.initialize()
+    fout = fused(mx.nd.random.uniform(shape=(5, 2, 3)))
+    assert fout.shape == (5, 2, 4)
+
+
+@pytest.mark.parametrize("cls,states", [
+    (crnn.Conv2DRNNCell, 1), (crnn.Conv2DLSTMCell, 2),
+    (crnn.Conv2DGRUCell, 1)])
+def test_conv_rnn_cells(cls, states):
+    cell = cls((3, 8, 8), 6)
+    cell.initialize()
+    out, st = cell(mx.nd.random.uniform(shape=(2, 3, 8, 8)),
+                   cell.begin_state(2))
+    assert out.shape == (2, 6, 8, 8)
+    assert len(st) == states
+    out2, _ = cell.unroll(3, mx.nd.random.uniform(shape=(2, 3, 3, 8, 8)),
+                          layout="NTC", merge_outputs=False)
+    assert len(out2) == 3
+
+
+def test_conv1d_rnn_cells():
+    cell = crnn.Conv1DLSTMCell((2, 10), 4)
+    cell.initialize()
+    out, st = cell(mx.nd.random.uniform(shape=(2, 2, 10)),
+                   cell.begin_state(2))
+    assert out.shape == (2, 4, 10)
+
+
+def _toy_loader(n=40, seed=0):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, 6).astype("float32")
+    y = (X.sum(1) > 0).astype("int32")
+    return DataLoader(ArrayDataset(X, y), batch_size=10)
+
+
+def test_estimator_fit_and_evaluate():
+    loader = _toy_loader()
+    net = nn.Sequential()
+    net.add(nn.Dense(16, activation="relu"), nn.Dense(2))
+    net.initialize()
+    est = Estimator(net, gluon.loss.SoftmaxCrossEntropyLoss(),
+                    trainer=gluon.Trainer(net.collect_params(), "adam",
+                                          {"learning_rate": 0.05}))
+    est.fit(loader, epochs=4)
+    res = dict(est.evaluate(loader))
+    assert res["accuracy"] > 0.9
+
+
+def test_estimator_early_stopping():
+    loader = _toy_loader()
+    net = nn.Sequential()
+    net.add(nn.Dense(2))
+    net.initialize()
+    est = Estimator(net, gluon.loss.SoftmaxCrossEntropyLoss(),
+                    trainer=gluon.Trainer(net.collect_params(), "sgd",
+                                          {"learning_rate": 0.0}))
+    handler = EarlyStoppingHandler(monitor=est.train_metrics[0],
+                                   patience=1, mode="max")
+    est.fit(loader, epochs=50, event_handlers=[handler])
+    assert handler.stop_training  # lr=0 -> no improvement -> stops early
+
+
+def test_estimator_checkpointing(tmp_path):
+    loader = _toy_loader()
+    net = nn.Sequential()
+    net.add(nn.Dense(2))
+    net.initialize()
+    est = Estimator(net, gluon.loss.SoftmaxCrossEntropyLoss())
+    est.fit(loader, epochs=2, event_handlers=[
+        CheckpointHandler(str(tmp_path), model_prefix="m")])
+    import os
+    assert any(f.endswith(".params") for f in os.listdir(str(tmp_path)))

@@ -128,3 +128,44 @@ def test_eval():
         x, act_type="relu")
     out = y.eval(x=mx.nd.array([-1.0, 2.0]))
     np.testing.assert_allclose(out[0].asnumpy(), [0.0, 2.0])
+
+
+def test_auto_created_param_variables():
+    """The canonical style: weights auto-created as name_weight/name_bias
+    (parity: NNVM auto var creation)."""
+    data = sym.Variable("data")
+    net = sym.FullyConnected(data=data, name="fc1", num_hidden=8)
+    net = sym.SoftmaxOutput(net, name="softmax")
+    args = net.list_arguments()
+    assert args == ["data", "fc1_weight", "fc1_bias", "softmax_label"]
+    ex = net.simple_bind(data=(4, 6))
+    assert ex.arg_dict["fc1_weight"].shape == (8, 6)
+    assert ex.forward()[0].shape == (4, 8)
+
+
+def test_auto_created_batchnorm_aux():
+    data = sym.Variable("data")
+    net = sym.Convolution(data=data, name="conv", kernel=(3, 3),
+                          num_filter=4, pad=(1, 1))
+    net = sym.BatchNorm(net, name="bn")
+    assert "bn_gamma" in net.list_arguments()
+    assert net.list_auxiliary_states() == ["bn_moving_mean", "bn_moving_var"]
+    ex = net.simple_bind(data=(2, 3, 8, 8))
+    assert ex.forward()[0].shape == (2, 4, 8, 8)
+
+
+def test_softmax_output_implicit_gradient():
+    """SoftmaxOutput backward = softmax - onehot (parity:
+    src/operator/softmax_output.cc)."""
+    data = sym.Variable("data")
+    out = sym.SoftmaxOutput(data, name="softmax")
+    ex = out.simple_bind(data=(2, 3), softmax_label=(2,))
+    ex.arg_dict["data"]._rebind(mx.nd.array([[1., 2., 3.], [1., 1., 1.]]).data)
+    ex.arg_dict["softmax_label"]._rebind(mx.nd.array([2., 0.]).data)
+    p = ex.forward(is_train=True)[0].asnumpy()
+    ex.backward()
+    expected = p.copy()
+    expected[0, 2] -= 1
+    expected[1, 0] -= 1
+    np.testing.assert_allclose(ex.grad_dict["data"].asnumpy(), expected,
+                               rtol=1e-5, atol=1e-6)

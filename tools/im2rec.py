@@ -1,0 +1,114 @@
+#!/usr/bin/env python
+"""Pack image datasets into RecordIO (parity: tools/im2rec.py; the C++
+tools/im2rec.cc is replaced by this pure-Python writer over
+mxtpu.recordio — the format is identical, so .rec files interoperate).
+
+Usage:
+    python tools/im2rec.py --list prefix image_root   # make prefix.lst
+    python tools/im2rec.py prefix image_root          # pack prefix.rec/.idx
+"""
+
+import argparse
+import os
+import random
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+_EXTS = (".jpg", ".jpeg", ".png", ".bmp")
+
+
+def list_images(root, recursive=True):
+    cat = {}
+    entries = []
+    i = 0
+    for path, dirs, files in sorted(os.walk(root, followlinks=True)):
+        dirs.sort()
+        files.sort()
+        for fname in files:
+            fpath = os.path.join(path, fname)
+            if os.path.splitext(fname)[1].lower() in _EXTS:
+                label_dir = os.path.relpath(path, root)
+                if label_dir not in cat:
+                    cat[label_dir] = len(cat)
+                entries.append((i, os.path.relpath(fpath, root),
+                                cat[label_dir]))
+                i += 1
+        if not recursive:
+            break
+    return entries, cat
+
+
+def write_list(prefix, entries, shuffle=False, train_ratio=1.0):
+    if shuffle:
+        random.shuffle(entries)
+    n_train = int(len(entries) * train_ratio)
+    chunks = {"": entries} if train_ratio >= 1.0 else {
+        "_train": entries[:n_train], "_val": entries[n_train:]}
+    for suffix, chunk in chunks.items():
+        with open(prefix + suffix + ".lst", "w") as f:
+            for i, path, label in chunk:
+                f.write("%d\t%f\t%s\n" % (i, float(label), path))
+
+
+def read_list(path):
+    with open(path) as f:
+        for line in f:
+            parts = line.strip().split("\t")
+            yield int(parts[0]), [float(x) for x in parts[1:-1]], parts[-1]
+
+
+def pack(prefix, root, quality=95, resize=0, color=1):
+    import cv2
+    from mxtpu import recordio
+
+    rec = recordio.MXIndexedRecordIO(prefix + ".idx", prefix + ".rec", "w")
+    count = 0
+    for idx, labels, rel_path in read_list(prefix + ".lst"):
+        fpath = os.path.join(root, rel_path)
+        img = cv2.imread(fpath, cv2.IMREAD_COLOR if color else
+                         cv2.IMREAD_GRAYSCALE)
+        if img is None:
+            print("imread failed:", fpath)
+            continue
+        if resize:
+            h, w = img.shape[:2]
+            if h > w:
+                img = cv2.resize(img, (resize, int(h * resize / w)))
+            else:
+                img = cv2.resize(img, (int(w * resize / h), resize))
+        label = labels[0] if len(labels) == 1 else labels
+        header = recordio.IRHeader(0, label, idx, 0)
+        packed = recordio.pack_img(header, img, quality=quality)
+        rec.write_idx(idx, packed)
+        count += 1
+        if count % 1000 == 0:
+            print("packed %d images" % count)
+    rec.close()
+    print("done: %d images -> %s.rec" % (count, prefix))
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("prefix")
+    parser.add_argument("root")
+    parser.add_argument("--list", action="store_true",
+                        help="create the .lst file instead of packing")
+    parser.add_argument("--recursive", action="store_true", default=True)
+    parser.add_argument("--shuffle", action="store_true")
+    parser.add_argument("--train-ratio", type=float, default=1.0)
+    parser.add_argument("--quality", type=int, default=95)
+    parser.add_argument("--resize", type=int, default=0)
+    parser.add_argument("--color", type=int, default=1)
+    args = parser.parse_args()
+    if args.list:
+        entries, cat = list_images(args.root, args.recursive)
+        write_list(args.prefix, entries, args.shuffle, args.train_ratio)
+        for k, v in sorted(cat.items(), key=lambda kv: kv[1]):
+            print(v, k)
+    else:
+        pack(args.prefix, args.root, args.quality, args.resize, args.color)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,77 @@
+#!/usr/bin/env python
+"""Distributed job launcher (parity: tools/launch.py → dmlc_tracker).
+
+The reference forked worker/server/scheduler processes wired by DMLC_* env
+(ssh/mpi/yarn/local trackers). The TPU-native equivalent launches one
+process per host with jax.distributed coordinates; `--launcher local`
+forks N processes on localhost with a shared coordinator — the same trick
+the reference's local tracker used, and what tests/nightly-style
+multi-process CI runs use (SURVEY §4 fixture 5).
+
+Usage:
+    python tools/launch.py -n 4 --launcher local python train.py ...
+"""
+
+import argparse
+import os
+import signal
+import subprocess
+import sys
+
+
+def main():
+    parser = argparse.ArgumentParser(description="Launch a distributed job")
+    parser.add_argument("-n", "--num-workers", type=int, required=True,
+                        help="number of worker processes")
+    parser.add_argument("-s", "--num-servers", type=int, default=0,
+                        help="accepted for parity; mxtpu has no parameter "
+                        "servers (collectives replace them)")
+    parser.add_argument("--launcher", type=str, default="local",
+                        choices=["local", "ssh", "mpi"],
+                        help="local: fork on this host; ssh/mpi: print the "
+                        "per-host command (TPU pods launch one process per "
+                        "host via their own runtime)")
+    parser.add_argument("-H", "--hostfile", type=str, default=None)
+    parser.add_argument("--port", type=int, default=9357)
+    parser.add_argument("command", nargs=argparse.REMAINDER)
+    args = parser.parse_args()
+    if args.num_servers:
+        print("note: -s/--num-servers ignored — mxtpu replaces parameter "
+              "servers with XLA collectives (dist_tpu_sync)")
+    if not args.command:
+        parser.error("no command given")
+
+    if args.launcher != "local":
+        print("Run on each host (process_id = host index):")
+        for i in range(args.num_workers):
+            print("  DMLC_PS_ROOT_URI=<host0-addr> DMLC_PS_ROOT_PORT=%d "
+                  "DMLC_NUM_WORKER=%d DMLC_WORKER_ID=%d %s" % (
+                      args.port, args.num_workers, i,
+                      " ".join(args.command)))
+        return
+
+    procs = []
+    try:
+        for i in range(args.num_workers):
+            env = dict(os.environ)
+            env.update({
+                "DMLC_PS_ROOT_URI": "127.0.0.1",
+                "DMLC_PS_ROOT_PORT": str(args.port),
+                "DMLC_NUM_WORKER": str(args.num_workers),
+                "DMLC_WORKER_ID": str(i),
+                "DMLC_ROLE": "worker",
+            })
+            procs.append(subprocess.Popen(args.command, env=env))
+        code = 0
+        for p in procs:
+            p.wait()
+            code = code or p.returncode
+        sys.exit(code)
+    except KeyboardInterrupt:
+        for p in procs:
+            p.send_signal(signal.SIGTERM)
+        sys.exit(1)
+
+
+if __name__ == "__main__":
+    main()
