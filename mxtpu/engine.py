@@ -45,18 +45,15 @@ def wait_all():
     MXNDArrayWaitAll).  PJRT executes per-device in submission order, so
     blocking on every live array is a sufficient barrier; it also surfaces
     any deferred device error here, matching the reference's semantics of
-    async exceptions raising at the wait point."""
+    async exceptions raising at the wait point.  Errors are deliberately
+    NOT swallowed — a failed effect or poisoned buffer raises here, like
+    the reference's engine rethrowing stored exceptions on WaitAll."""
     import jax
 
-    try:
-        jax.effects_barrier()
-    except Exception:
-        pass
-    for arr in jax.live_arrays():
-        try:
-            arr.block_until_ready()
-        except Exception:
-            raise
+    jax.effects_barrier()
+    # one batched wait over every live buffer (cheap flag-checks for
+    # already-ready arrays) rather than a python loop of sequential blocks
+    jax.block_until_ready(jax.live_arrays())
 
 
 @contextlib.contextmanager

@@ -10,6 +10,7 @@ overlaps input processing with TPU compute via JAX async dispatch.
 """
 
 import multiprocessing
+import os
 import queue as _queue
 import threading
 
@@ -184,11 +185,31 @@ class DataLoader:
                 from multiprocessing.pool import ThreadPool
                 self._pool = ThreadPool(self._num_workers)
             else:
-                ctx = multiprocessing.get_context("fork")
-                self._pool = ctx.Pool(
-                    self._num_workers,
-                    initializer=_worker_init,
-                    initargs=(self._dataset, self._batchify_fn))
+                # forkserver (not fork): forking a process whose JAX runtime
+                # has live threads deadlocks (JAX warns on os.fork); the
+                # forkserver parent is launched clean, so workers never
+                # inherit JAX state.  The sanitized env below makes worker
+                # interpreters skip the TPU plugin (sitecustomize register()
+                # is keyed on PALLAS_AXON_POOL_IPS) and pin any incidental
+                # jax use to host CPU — decode/augment is host work, like
+                # the reference's CPU decode threads (iter_image_recordio_2).
+                methods = multiprocessing.get_all_start_methods()
+                ctx = multiprocessing.get_context(
+                    "forkserver" if "forkserver" in methods else "spawn")
+                sanitize = {"PALLAS_AXON_POOL_IPS": "", "JAX_PLATFORMS": "cpu"}
+                saved = {k: os.environ.get(k) for k in sanitize}
+                os.environ.update(sanitize)
+                try:
+                    self._pool = ctx.Pool(
+                        self._num_workers,
+                        initializer=_worker_init,
+                        initargs=(self._dataset, self._batchify_fn))
+                finally:
+                    for k, v in saved.items():
+                        if v is None:
+                            os.environ.pop(k, None)
+                        else:
+                            os.environ[k] = v
 
     def _single_process_iter(self):
         for batch_idx in self._batch_sampler:

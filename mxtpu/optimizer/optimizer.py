@@ -102,6 +102,15 @@ class Optimizer:
         """Pure update rule over jax arrays; override in subclasses."""
         raise NotImplementedError
 
+    def _step_t(self, weight, grad, state, lr, wd, t):
+        """Pure update rule with the update count ``t`` as a traced device
+        scalar.  This is the SPMD entry point (SPMDTrainer jits it inside
+        the train step): optimizers whose rule depends on the step count
+        (Adam bias correction, LAMB) override it so the correction happens
+        on device and no host-side isinstance special-casing is needed.
+        Default delegates to ``_step`` (t-independent rules)."""
+        return self._step(weight, grad, state, lr, wd)
+
     @functools.lru_cache(maxsize=None)
     def _jit_step(self):
         # donate weight and state buffers: the old values die with the update,
@@ -335,6 +344,13 @@ class Adam(Optimizer):
         w = weight - lr * mean / (jnp.sqrt(var) + self.epsilon)
         return w, (mean, var)
 
+    def _step_t(self, weight, grad, state, lr, wd, t):
+        # bias correction folded into lr on device (same coef math as
+        # update(), but t is traced so one compiled step serves all steps)
+        t = jnp.asarray(t, jnp.float32)
+        lr = lr * jnp.sqrt(1. - self.beta2 ** t) / (1. - self.beta1 ** t)
+        return self._step(weight, grad, state, lr, wd)
+
 
 @register
 class AdamW(Adam):
@@ -489,6 +505,9 @@ class LAMB(Optimizer):
     @functools.lru_cache(maxsize=None)
     def _jit_t_step(self):
         return jax.jit(self._t_step, donate_argnums=(0, 2))
+
+    def _step_t(self, weight, grad, state, lr, wd, t):
+        return self._t_step(weight, grad, state, lr, wd, t)
 
     def _t_step(self, weight, grad, state, lr, wd, t):
         mean, var = state

@@ -66,11 +66,13 @@ class SPMDTrainer:
         self._donate = donate
         if isinstance(optimizer, str):
             optimizer = opt_mod.create(optimizer, **(optimizer_params or {}))
-        if type(optimizer)._step is opt_mod.Optimizer._step:
+        cls = type(optimizer)
+        if (cls._step is opt_mod.Optimizer._step
+                and cls._step_t is opt_mod.Optimizer._step_t):
             raise ValueError(
-                "SPMDTrainer requires an optimizer with a pure _step "
-                "(sgd/adam/adamw/...); %s updates statefully — use "
-                "gluon.Trainer for it" % type(optimizer).__name__)
+                "SPMDTrainer requires an optimizer with a pure _step/_step_t "
+                "(sgd/adam/adamw/lamb/...); %s updates statefully — use "
+                "gluon.Trainer for it" % cls.__name__)
         self._optimizer = optimizer
         self._num_update = 0
         self._params_sharded = False
@@ -137,7 +139,8 @@ class SPMDTrainer:
         if self._remat:
             forward = jax.checkpoint(forward, static_argnums=())
 
-        def step(diff_leaves, aux_leaves, opt_states, lr, batch, label, key):
+        def step(diff_leaves, aux_leaves, opt_states, lr, t, batch, label,
+                 key):
             def loss_of(dl):
                 return forward(dl, aux_leaves, key, batch, label)
 
@@ -146,7 +149,9 @@ class SPMDTrainer:
             new_leaves = []
             new_states = []
             for leaf, g, st, wd in zip(diff_leaves, grads, opt_states, wds):
-                w, s = optimizer._step(leaf, g, st, lr, wd)
+                # _step_t: step count traced on device, so t-dependent rules
+                # (Adam bias correction, LAMB) need no host special-casing
+                w, s = optimizer._step_t(leaf, g, st, lr, wd, t)
                 new_leaves.append(w.astype(leaf.dtype))
                 new_states.append(s)
             return tuple(new_leaves), new_aux, tuple(new_states), loss
@@ -162,7 +167,7 @@ class SPMDTrainer:
                 lambda a: NamedSharding(jm, self._rules.spec_for(
                     p.name, getattr(a, "ndim", 0))), st)
             for p, st in zip(diff_params, self._opt_states))
-        in_sh = (diff_sh, aux_sh, state_sh, rep,
+        in_sh = (diff_sh, aux_sh, state_sh, rep, rep,
                  NamedSharding(jm, self._batch_spec),
                  NamedSharding(jm, self._label_spec), rep)
         out_sh = (diff_sh, aux_sh, state_sh, rep)
@@ -201,12 +206,13 @@ class SPMDTrainer:
             i: self._num_update for i in range(len(self._diff_params))}
         self._optimizer.num_update = self._num_update
         lr = jnp.asarray(self._effective_lr(), jnp.float32)
+        t = jnp.asarray(self._num_update, jnp.float32)
 
         diff_leaves = tuple(p.data()._data for p in self._diff_params)
         aux_leaves = tuple(p.data()._data for p in self._aux_params)
         new_leaves, new_aux, new_states, loss = jitted(
-            diff_leaves, aux_leaves, tuple(self._opt_states), lr, batch, lab,
-            _random.next_key())
+            diff_leaves, aux_leaves, tuple(self._opt_states), lr, t, batch,
+            lab, _random.next_key())
         for p, leaf in zip(self._diff_params, new_leaves):
             p.data()._rebind(leaf)
         for p, leaf in zip(self._aux_params, new_aux):
@@ -215,15 +221,11 @@ class SPMDTrainer:
         return NDArray(loss)
 
     def _effective_lr(self):
-        """Per-step scalar lr with schedules and Adam-style bias correction
-        folded in on host (recompile-free: passed as a device scalar)."""
-        o = self._optimizer
-        lr = o._get_lr(0)
-        if isinstance(o, opt_mod.Adam):  # covers AdamW; folding matches
-            import math                  # Adam.update's own coef math
-            t = self._num_update
-            lr = lr * math.sqrt(1. - o.beta2 ** t) / (1. - o.beta1 ** t)
-        return lr
+        """Per-step scalar lr from schedules only (recompile-free: passed
+        as a device scalar).  Step-count-dependent corrections (Adam bias
+        correction, LAMB) live in the optimizer's pure _step_t, with t
+        passed as a traced device scalar."""
+        return self._optimizer._get_lr(0)
 
     @property
     def learning_rate(self):
