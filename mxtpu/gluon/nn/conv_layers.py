@@ -1,7 +1,9 @@
 """Convolution and pooling layers (parity: python/mxnet/gluon/nn/
 conv_layers.py): Conv1D/2D/3D(+Transpose), Max/Avg/GlobalMax/GlobalAvg
-pooling, ReflectionPad2D.  Layout NCHW-family at the API (XLA:TPU re-lays
-out internally, see mxtpu/ops/nn.py)."""
+pooling, ReflectionPad2D.  Layout NCHW-family at the API; 2-D convs run
+NHWC internally in the op because that is the measured-faster layout on
+TPU (see mxtpu/ops/nn.py module docstring).  Conv2D also accepts
+layout='NHWC' end-to-end, with the MXNet OHWI weight convention."""
 
 from __future__ import annotations
 
@@ -52,6 +54,10 @@ class _Conv(HybridBlock):
             if self._transposed:
                 # Deconv weight layout: (in_channels, channels//groups, *k)
                 wshape = (in_channels, channels // groups) + tuple(kernel_size)
+            elif layout == "NHWC":
+                # MXNet NHWC convention: (num_filter, *kernel, channels)
+                wshape = (channels,) + tuple(kernel_size) + (
+                    in_channels // groups if in_channels else 0,)
             else:
                 wshape = (channels, in_channels // groups if in_channels
                           else 0) + tuple(kernel_size)
@@ -70,11 +76,15 @@ class _Conv(HybridBlock):
                 self.act = None
 
     def infer_shape(self, x, *args):
-        in_channels = x.shape[1]
+        layout = self._kwargs["layout"]
+        in_channels = x.shape[-1] if layout == "NHWC" else x.shape[1]
         k = tuple(self._kwargs["kernel"])
         if self._transposed:
             self.weight.shape = (in_channels,
                                  self._channels // self._groups) + k
+        elif layout == "NHWC":
+            self.weight.shape = (self._channels,) + k + (
+                in_channels // self._groups,)
         else:
             self.weight.shape = (self._channels,
                                  in_channels // self._groups) + k

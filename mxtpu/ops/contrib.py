@@ -220,17 +220,22 @@ def masked_softmax(data, mask=None, axis=-1, temperature=1.0):
 def batch_dot_attn(q, k):
     """Attention scores q·kᵀ over (B, H, T, D) (parity: the qk half of
     _contrib_interleaved_matmul_selfatt_qk, batch-major layout). fp32
-    accumulation on the MXU via preferred_element_type."""
+    accumulation on the MXU via preferred_element_type; true-fp32 dot for
+    fp32 inputs (jax>=0.9 defaults fp32 matmuls to the bf16 MXU path)."""
+    from .tensor import matmul_precision
     return jnp.einsum("bhqd,bhkd->bhqk", q, k,
-                      preferred_element_type=jnp.float32).astype(q.dtype)
+                      preferred_element_type=jnp.float32,
+                      precision=matmul_precision(q, k)).astype(q.dtype)
 
 
 @register_op("attn_value")
 def attn_value(attn, v):
     """Attention-weighted values (parity: the valatt half of the fused
     interleaved kernels, batch-major)."""
+    from .tensor import matmul_precision
     return jnp.einsum("bhqk,bhkd->bhqd", attn, v,
-                      preferred_element_type=jnp.float32).astype(v.dtype)
+                      preferred_element_type=jnp.float32,
+                      precision=matmul_precision(attn, v)).astype(v.dtype)
 
 
 @register_op("causal_mask_fill")

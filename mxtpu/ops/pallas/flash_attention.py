@@ -29,7 +29,11 @@ _NEG_INF = -1e30
 
 
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, scale, causal, q_block,
-                kv_block, seq_len, valid_len):
+                kv_block, seq_len, valid_len, hi_prec):
+    # fp32 inputs keep true-fp32 dots; bf16 inputs use the fast MXU default
+    # (jax>=0.9 interpret mode emulates TPU bf16 default precision, so the
+    # fp32 contract must be explicit)
+    prec = jax.lax.Precision.HIGHEST if hi_prec else None
     qi = pl.program_id(1)
     q = q_ref[0].astype(jnp.float32) * scale          # (Bq, D)
     bq, d = q.shape
@@ -46,7 +50,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, scale, causal, q_block,
         m, l, acc = carry
         k = k_ref[0, pl.ds(j * kv_block, kv_block), :].astype(jnp.float32)
         v = v_ref[0, pl.ds(j * kv_block, kv_block), :].astype(jnp.float32)
-        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32)  # (Bq, Bkv)
+        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32,
+                    precision=prec)  # (Bq, Bkv)
         k_pos = j * kv_block + jax.lax.broadcasted_iota(
             jnp.int32, (bq, kv_block), 1)
         if valid_len != seq_len:  # zero-padded keys must not attend
@@ -61,7 +66,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, scale, causal, q_block,
         corr = jnp.exp(m - m_new)
         l_new = l * corr + jnp.sum(p, axis=-1, keepdims=True)
         acc_new = acc * corr + jnp.dot(p, v,
-                                       preferred_element_type=jnp.float32)
+                                       preferred_element_type=jnp.float32,
+                                       precision=prec)
         return m_new, l_new, acc_new
 
     m0 = jnp.full((bq, 1), _NEG_INF, jnp.float32)
@@ -95,7 +101,8 @@ def _flash_fwd(q, k, v, scale, causal, q_block, kv_block, interpret):
     grid = (B * H, Tq // q_block)
     kernel = functools.partial(_fwd_kernel, scale=scale, causal=causal,
                                q_block=q_block, kv_block=kv_block,
-                               seq_len=Tk, valid_len=T)
+                               seq_len=Tk, valid_len=T,
+                               hi_prec=q.dtype == jnp.float32)
     out = pl.pallas_call(
         kernel,
         out_shape=jax.ShapeDtypeStruct((B * H, Tq, D), q.dtype),
@@ -113,15 +120,17 @@ def _flash_fwd(q, k, v, scale, causal, q_block, kv_block, interpret):
 
 def _dense_attention(q, k, v, scale, causal):
     """XLA reference path (also the recompute backward's forward)."""
+    prec = jax.lax.Precision.HIGHEST if q.dtype == jnp.float32 else None
     qf = q.astype(jnp.float32)
     s = jnp.einsum("bhqd,bhkd->bhqk", qf, k.astype(jnp.float32),
-                   preferred_element_type=jnp.float32) * scale
+                   preferred_element_type=jnp.float32, precision=prec) * scale
     if causal:
         Tq, Tk = s.shape[-2], s.shape[-1]
         mask = jnp.tril(jnp.ones((Tq, Tk), jnp.bool_), Tk - Tq)
         s = jnp.where(mask, s, _NEG_INF)
     p = jax.nn.softmax(s, axis=-1)
-    o = jnp.einsum("bhqk,bhkd->bhqd", p, v.astype(jnp.float32))
+    o = jnp.einsum("bhqk,bhkd->bhqd", p, v.astype(jnp.float32),
+                   precision=prec)
     return o.astype(q.dtype)
 
 

@@ -14,7 +14,6 @@ import functools
 import jax
 import jax.numpy as jnp
 from jax import lax
-from jax.experimental.shard_map import shard_map
 from jax.sharding import PartitionSpec as P
 
 __all__ = ["all_reduce", "all_gather", "reduce_scatter", "ppermute",

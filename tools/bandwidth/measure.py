@@ -33,7 +33,10 @@ def main():
     import jax
     import jax.numpy as jnp
     import numpy as onp
-    from jax.experimental.shard_map import shard_map
+    try:  # jax >= 0.8
+        from jax import shard_map
+    except ImportError:  # pragma: no cover — older jax
+        from jax.experimental.shard_map import shard_map
     from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
     devices = jax.devices()
