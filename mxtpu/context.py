@@ -91,24 +91,31 @@ class Context:
         return _DEFAULT
 
 
+_platform_cache: dict = {}
+
+
 def _devices_for(platform: str):
-    try:
-        return jax.devices(platform)
-    except RuntimeError:
-        return []
+    """Process-LOCAL devices: in a multi-process (jax.distributed) world a
+    Context must name a device this worker can address, like the reference
+    where each worker owns its local GPUs."""
+    if platform not in _platform_cache:
+        try:
+            _platform_cache[platform] = jax.local_devices(backend=platform)
+        except RuntimeError:
+            _platform_cache[platform] = []
+    return _platform_cache[platform]
 
 
 _accel_cache = None
 
 
 def _accel_devices():
-    """All non-CPU jax devices (TPU in production; empty on CPU-only hosts)."""
+    """Local non-CPU jax devices (TPU in production; empty on CPU-only
+    hosts)."""
     global _accel_cache
     if _accel_cache is None:
-        devs = jax.devices()
-        _accel_cache = [d for d in devs if d.platform != "cpu"]
-        if not _accel_cache:
-            _accel_cache = []
+        _accel_cache = [d for d in jax.local_devices()
+                        if d.platform != "cpu"]
     return _accel_cache
 
 

@@ -87,9 +87,19 @@ class NDArray:
         try:
             # deterministic for sharded arrays: lowest device id
             dev = min(self._data.devices(), key=lambda d: d.id)
+            # Context ids are process-LOCAL (multi-process jax assigns
+            # global ids like 2048*process_index to local devices); reuse
+            # context.py's cached local lists so the two stay consistent
+            from ..context import _accel_devices, _devices_for
+            locals_ = (_devices_for("cpu") if dev.platform == "cpu"
+                       else _accel_devices())
+            try:
+                local_id = locals_.index(dev)
+            except ValueError:
+                local_id = dev.id
             if dev.platform == "cpu":
-                return Context("cpu", dev.id)
-            return Context("tpu", dev.id)
+                return Context("cpu", local_id)
+            return Context("tpu", local_id)
         except Exception:  # tracers have no device
             return current_context()
 

@@ -27,8 +27,8 @@ def get_gpu_count():
 
 
 def get_gpu_memory(gpu_dev_id=0):
-    import jax
-    devs = [d for d in jax.devices() if d.platform != "cpu"]
+    from .context import _accel_devices
+    devs = _accel_devices()  # process-local, matching Context ids
     if gpu_dev_id >= len(devs):
         raise ValueError("invalid device id")
     stats = devs[gpu_dev_id].memory_stats() or {}

@@ -1,0 +1,71 @@
+"""Multi-process worker driven by tools/launch.py --launcher local
+(parity: the worker half of tests/nightly/dist_sync_kvstore.py).
+
+Each process: jax.distributed rendezvous from the DMLC_* env via
+init_process_group, DistTPUSyncKVStore push/pull with rank-dependent
+values, then one SPMDTrainer step over the global dp mesh.  Writes a JSON
+result per rank for the parent test to assert on.
+"""
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main(out_dir):
+    import numpy as np
+    import jax
+
+    import mxtpu as mx
+    from mxtpu import gluon
+    from mxtpu.gluon import nn
+    from mxtpu.parallel import make_mesh, SPMDTrainer
+    from mxtpu.parallel.mesh import init_process_group, rank, num_workers
+
+    nproc = int(os.environ.get("DMLC_NUM_WORKER", "1"))
+    if nproc > 1:
+        init_process_group()
+    r, n = rank(), num_workers()
+    assert n == nproc, (n, nproc)
+
+    result = {"rank": r, "num_workers": n}
+
+    # --- kvstore push/pull across processes --------------------------------
+    kv = mx.kv.create("dist_tpu_sync")
+    assert kv.rank == r and kv.num_workers == n
+    base = np.arange(12, dtype="float32").reshape(3, 4)
+    kv.init("w0", mx.nd.array(np.zeros((3, 4), "float32")))
+    # rank-dependent push: pull must see the sum over ranks
+    kv.push("w0", mx.nd.array(base * (r + 1)))
+    out = mx.nd.zeros((3, 4))
+    kv.pull("w0", out=out)
+    expect = base * sum(i + 1 for i in range(n))
+    np.testing.assert_allclose(out.asnumpy(), expect, rtol=1e-6)
+    result["kv_pull_ok"] = True
+
+    # --- one SPMDTrainer step over the global dp mesh ----------------------
+    mx.random.seed(7)
+    net = nn.HybridSequential()
+    net.add(nn.Dense(16, activation="relu", in_units=6),
+            nn.Dense(3, in_units=16))
+    net.initialize()
+    rng = np.random.RandomState(11)
+    X = mx.nd.array(rng.rand(8, 6).astype("float32"))
+    y = mx.nd.array(rng.randint(0, 3, (8,)))
+    mesh = make_mesh(dp=n)
+    trainer = SPMDTrainer(net, gluon.loss.SoftmaxCrossEntropyLoss(), "sgd",
+                          mesh, optimizer_params={"learning_rate": 0.1})
+    loss = trainer.step(X, y)
+    result["loss"] = float(loss.asnumpy())
+    # second step proves params stayed consistent across the process group
+    result["loss2"] = float(trainer.step(X, y).asnumpy())
+
+    with open(os.path.join(out_dir, "rank%d.json" % r), "w") as f:
+        json.dump(result, f)
+    print("worker rank %d/%d OK loss=%.6f" % (r, n, result["loss"]))
+
+
+if __name__ == "__main__":
+    main(sys.argv[1])

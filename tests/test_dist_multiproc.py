@@ -1,0 +1,56 @@
+"""Multi-process dist_tpu_sync end-to-end on localhost (VERDICT r2 task 2;
+parity: tests/nightly/dist_sync_kvstore.py via the dmlc local tracker).
+
+Spawns real OS processes through tools/launch.py --launcher local; each
+worker does jax.distributed rendezvous (DMLC_* env -> init_process_group),
+DistTPUSyncKVStore push/pull, and an SPMDTrainer step over the global dp
+mesh.  The 2-process loss must equal the single-process loss on the same
+global batch.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+WORKER = os.path.join(REPO, "tests", "dist_worker.py")
+LAUNCH = os.path.join(REPO, "tools", "launch.py")
+
+
+def _run(nproc, out_dir, port):
+    env = dict(os.environ)
+    env["PALLAS_AXON_POOL_IPS"] = ""  # axon plugin bypass (wedge-proof)
+    env["JAX_PLATFORMS"] = "cpu"
+    # one local CPU device per process => global mesh = nproc devices
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+    env["JAX_NUM_CPU_DEVICES"] = "1"
+    os.makedirs(out_dir, exist_ok=True)
+    cmd = [sys.executable, LAUNCH, "-n", str(nproc), "--launcher", "local",
+           "--port", str(port), sys.executable, WORKER, out_dir]
+    proc = subprocess.run(cmd, cwd=REPO, env=env, timeout=420,
+                          stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+                          text=True)
+    assert proc.returncode == 0, proc.stdout[-3000:]
+    results = {}
+    for r in range(nproc):
+        with open(os.path.join(out_dir, "rank%d.json" % r)) as f:
+            results[r] = json.load(f)
+    return results
+
+
+def test_dist_sync_two_process_matches_single(tmp_path):
+    two = _run(2, str(tmp_path / "n2"), port=9411)
+    one = _run(1, str(tmp_path / "n1"), port=9412)
+
+    for r in (0, 1):
+        assert two[r]["kv_pull_ok"]
+        assert two[r]["num_workers"] == 2
+    # replicated loss identical on both ranks
+    assert two[0]["loss"] == pytest.approx(two[1]["loss"], abs=0)
+    assert two[0]["loss2"] == pytest.approx(two[1]["loss2"], abs=0)
+    # 2-process dp=2 == single-process on the same global batch
+    assert two[0]["loss"] == pytest.approx(one[0]["loss"], rel=1e-6)
+    assert two[0]["loss2"] == pytest.approx(one[0]["loss2"], rel=1e-5)
