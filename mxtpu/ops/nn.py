@@ -351,9 +351,7 @@ def batch_norm(x, gamma, beta, moving_mean, moving_var, eps=1e-5,
         # large-mean channels.
         xf = x.astype(jnp.float32)
         mean = jnp.mean(xf, axis=red)
-        mshape = [1] * x.ndim
-        mshape[axis] = x.shape[axis]
-        var = jnp.mean(lax.square(xf - mean.reshape(mshape)), axis=red)
+        var = jnp.mean(lax.square(xf - mean.reshape(shape)), axis=red)
     else:
         mean = moving_mean.astype(jnp.float32)
         var = moving_var.astype(jnp.float32)

@@ -10,10 +10,17 @@ global batch.
 
 import json
 import os
+import socket
 import subprocess
 import sys
 
 import pytest
+
+
+def _free_port():
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 WORKER = os.path.join(REPO, "tests", "dist_worker.py")
@@ -42,8 +49,8 @@ def _run(nproc, out_dir, port):
 
 
 def test_dist_sync_two_process_matches_single(tmp_path):
-    two = _run(2, str(tmp_path / "n2"), port=9411)
-    one = _run(1, str(tmp_path / "n1"), port=9412)
+    two = _run(2, str(tmp_path / "n2"), port=_free_port())
+    one = _run(1, str(tmp_path / "n1"), port=_free_port())
 
     for r in (0, 1):
         assert two[r]["kv_pull_ok"]

@@ -98,9 +98,9 @@ def test_conv2d_nhwc_matches_nchw():
     ch = nn.Conv2D(4, kernel_size=3, padding=1, use_bias=False,
                    layout="NHWC")
     ch.initialize()
-    assert ch.weight.shape == (4, 3, 3, 3) or True  # deferred until fwd
     x_nhwc = onp.transpose(x, (0, 2, 3, 1))
-    _ = ch(mx.nd.array(x_nhwc))
+    _ = ch(mx.nd.array(x_nhwc))  # resolves deferred OHWI weight shape
+    assert ch.weight.shape == (4, 3, 3, 3)
     ch.weight.set_data(mx.nd.array(onp.transpose(w, (0, 2, 3, 1))))  # OHWI
     y_nhwc = ch(mx.nd.array(x_nhwc)).asnumpy()
     assert_almost_equal(onp.transpose(y_nhwc, (0, 3, 1, 2)), y_nchw,

@@ -1,0 +1,377 @@
+"""Registry-wide operator sweep (VERDICT r2 task 3; parity:
+tests/python/unittest/test_operator.py's per-op gradient checks +
+check_consistency).
+
+Every UNIQUE op in the mxtpu registry must appear either in CASES (and get
+eager-vs-jit consistency, bf16-vs-fp32 consistency, and — when marked
+differentiable — a numeric-vs-autodiff gradient check) or in SKIP with a
+stated reason.  test_registry_fully_covered enforces completeness, so a
+newly registered op fails CI until it is covered or explicitly skipped.
+"""
+
+import functools
+
+import numpy as onp
+import pytest
+import jax
+import jax.numpy as jnp
+
+from mxtpu import base
+
+R = onp.random.RandomState(42)
+
+
+def A(*shape, lo=-2.0, hi=2.0, dtype="float32"):
+    """Dense float input away from kinks/domain edges by construction."""
+    return jnp.asarray(R.uniform(lo, hi, shape).astype(dtype))
+
+
+def POS(*shape, lo=0.5, hi=2.0):
+    return A(*shape, lo=lo, hi=hi)
+
+
+def UNIT(*shape):
+    return A(*shape, lo=-0.9, hi=0.9)
+
+
+def IDX(*shape, n=4):
+    return jnp.asarray(R.randint(0, n, shape).astype("int32"))
+
+
+class Case:
+    def __init__(self, args, kwargs=None, grad=True, grad_args=None,
+                 jit=True, bf16=True, rtol=1e-2, atol=1e-3):
+        self.args = args            # callable -> tuple of jax arrays
+        self.kwargs = kwargs or {}
+        self.grad = grad            # run numeric-vs-autodiff gradient
+        self.grad_args = grad_args  # indices of args to differentiate
+        self.jit = jit              # eager-vs-jit consistency
+        self.bf16 = bf16            # bf16-vs-fp32 consistency
+        self.rtol = rtol
+        self.atol = atol
+
+
+C = Case
+
+_UNARY_ANY = ["negative", "square", "exp", "expm1", "sin", "cos", "tanh",
+              "sinh", "cosh", "arctan", "arcsinh", "erf", "sigmoid",
+              "softsign", "gelu_tanh", "swish", "hard_sigmoid", "identity",
+              "relu"]
+_UNARY_POS = ["sqrt", "rsqrt", "log", "log10", "log2", "log1p", "cbrt",
+              "rcbrt", "reciprocal", "gammaln", "abs"]
+_UNARY_UNIT = ["arcsin", "arccos", "arctanh", "erfinv"]
+_UNARY_NONDIFF = ["rint", "round", "floor", "ceil", "trunc", "fix", "sign",
+                  "isnan", "isinf", "isfinite", "logical_not"]
+_BINARY = ["add", "subtract", "multiply", "elemwise_sub", "elemwise_mul",
+           "maximum", "minimum", "hypot", "broadcast_plus",
+           "broadcast_minus", "broadcast_sub", "broadcast_mul"]
+_BINARY_DIV = ["divide", "elemwise_div", "broadcast_div"]
+_CMP = ["equal", "not_equal", "greater", "greater_equal", "lesser",
+        "lesser_equal", "logical_and", "logical_or", "logical_xor"]
+_SCALAR_DIFF = ["_plus_scalar", "_minus_scalar", "_rminus_scalar",
+                "_mul_scalar", "_div_scalar", "_rdiv_scalar",
+                "_power_scalar", "_rpower_scalar", "_maximum_scalar"]
+_SCALAR_CMP = ["_equal_scalar", "_not_equal_scalar", "_greater_scalar",
+               "_greater_equal_scalar", "_lesser_scalar",
+               "_lesser_equal_scalar", "_mod_scalar", "_rmod_scalar"]
+_REDUCE = ["sum", "mean", "max", "min", "nansum", "cumsum"]
+
+CASES = {}
+for _n in _UNARY_ANY:
+    CASES[_n] = C(lambda: (A(3, 4),))
+for _n in _UNARY_POS:
+    CASES[_n] = C(lambda: (POS(3, 4),))
+for _n in _UNARY_UNIT:
+    CASES[_n] = C(lambda: (UNIT(3, 4),), rtol=5e-2, atol=5e-3)
+for _n in _UNARY_NONDIFF:
+    CASES[_n] = C(lambda: (A(3, 4),), grad=False)
+for _n in _BINARY:
+    CASES[_n] = C(lambda: (A(3, 4), A(3, 4)))
+for _n in _BINARY_DIV:
+    CASES[_n] = C(lambda: (A(3, 4), POS(3, 4)))
+for _n in _CMP:
+    CASES[_n] = C(lambda: (A(3, 4), A(3, 4)), grad=False)
+for _n in _SCALAR_DIFF:
+    CASES[_n] = C(lambda: (POS(3, 4),), {"scalar": 2.0})
+for _n in _SCALAR_CMP:
+    CASES[_n] = C(lambda: (POS(3, 4),), {"scalar": 0.7}, grad=False)
+for _n in _REDUCE:
+    CASES[_n] = C(lambda: (A(3, 4),))
+
+CASES.update({
+    "power": C(lambda: (POS(3, 4), A(3, 4, lo=0.5, hi=1.5))),
+    "arctan2": C(lambda: (POS(3, 4), POS(3, 4))),
+    "arccosh": C(lambda: (A(3, 4, lo=1.5, hi=3.0),)),
+    "tan": C(lambda: (A(3, 4, lo=0.1, hi=1.2),)),  # stay below the pi/2 pole
+    # scalar 2.5 keeps every input strictly on the x branch (no kink)
+    "_minimum_scalar": C(lambda: (POS(3, 4),), {"scalar": 2.5}),
+    "mod": C(lambda: (POS(3, 4, lo=2.0, hi=3.0), POS(3, 4)), grad=False),
+    "prod": C(lambda: (POS(2, 3),)),
+    "norm": C(lambda: (POS(3, 4),)),
+    "clip": C(lambda: (A(3, 4),), {"a_min": -1.0, "a_max": 1.0},
+              grad=False),
+    "smooth_l1": C(lambda: (POS(3, 4),)),
+    "where": C(lambda: (IDX(3, 4, n=2).astype(bool), A(3, 4), A(3, 4)),
+               grad_args=(1, 2)),
+    "cast": C(lambda: (A(3, 4),), {"dtype": "float32"}, grad=False),
+    "stop_gradient": C(lambda: (A(3, 4),), grad=False),
+    # -- structural ------------------------------------------------------
+    "reshape": C(lambda: (A(3, 4),), {"shape": (4, 3)}),
+    "reshape_like": C(lambda: (A(3, 4), A(2, 6)), grad_args=(0,)),
+    "transpose": C(lambda: (A(3, 4),)),
+    "swapaxes": C(lambda: (A(2, 3, 4),), {"dim1": 0, "dim2": 2}),
+    "expand_dims": C(lambda: (A(3, 4),), {"axis": 1}),
+    "squeeze": C(lambda: (A(3, 1, 4),)),
+    "flatten": C(lambda: (A(2, 3, 4),)),
+    "flip": C(lambda: (A(3, 4),), {"axis": 0}),
+    "tile": C(lambda: (A(2, 3),), {"reps": (2, 2)}),
+    "repeat": C(lambda: (A(2, 3),), {"repeats": 2, "axis": 1}),
+    "stack": C(lambda: (A(2, 3), A(2, 3)), {"axis": 1}),
+    "concat": C(lambda: (A(2, 3), A(2, 3)), {"dim": 1}),
+    "split": C(lambda: (A(4, 6),), {"num_outputs": 2, "axis": 1}),
+    "split_v2": C(lambda: (A(4, 6),), {"indices_or_sections": 2, "axis": 1}),
+    "slice": C(lambda: (A(4, 6),), {"begin": (1, 0), "end": (3, 4)}),
+    "slice_axis": C(lambda: (A(4, 6),), {"axis": 1, "begin": 1, "end": 4}),
+    "slice_like": C(lambda: (A(4, 6), A(2, 3)), grad_args=(0,)),
+    "broadcast_to": C(lambda: (A(1, 4),), {"shape": (3, 4)}),
+    "broadcast_axis": C(lambda: (A(1, 4),), {"axis": 0, "size": 3}),
+    "broadcast_like": C(lambda: (A(1, 4), A(3, 4)), grad_args=(0,)),
+    "pad": C(lambda: (A(1, 1, 3, 4),),
+             {"mode": "constant",
+              "pad_width": (0, 0, 0, 0, 1, 1, 2, 2)}),
+    "depth_to_space": C(lambda: (A(1, 4, 2, 2),), {"block_size": 2}),
+    "space_to_depth": C(lambda: (A(1, 1, 4, 4),), {"block_size": 2}),
+    "diag": C(lambda: (A(4, 4),)),
+    "pick": C(lambda: (A(3, 5), IDX(3, n=5)), grad_args=(0,)),
+    "take": C(lambda: (A(5, 3), IDX(4, n=5)), grad_args=(0,)),
+    "one_hot": C(lambda: (IDX(5, n=4),), {"depth": 4}, grad=False),
+    "gather_nd": C(lambda: (A(4, 5), IDX(2, 3, n=4)), grad_args=(0,)),
+    "scatter_nd": C(lambda: (A(3,), IDX(1, 3, n=4)),
+                    {"shape": (4,)}, grad_args=(0,)),
+    "index_copy": C(lambda: (A(5, 3), jnp.asarray([1, 3]), A(2, 3)),
+                    grad_args=(0, 2)),
+    "index_array": C(lambda: (A(3, 4),), grad=False),
+    "sequence_mask": C(
+        lambda: (A(4, 3, 2), jnp.asarray([2.0, 4.0, 1.0])),
+        {"use_sequence_length": True}, grad_args=(0,)),
+    "sequence_reverse": C(
+        lambda: (A(4, 3, 2), jnp.asarray([2.0, 4.0, 1.0])),
+        {"use_sequence_length": True}, grad_args=(0,)),
+    "sequence_last": C(
+        lambda: (A(4, 3, 2), jnp.asarray([2.0, 4.0, 1.0])),
+        {"use_sequence_length": True}, grad_args=(0,)),
+    # -- sorting / indexing (non-diff paths) -----------------------------
+    "argmax": C(lambda: (A(3, 4),), grad=False),
+    "argmin": C(lambda: (A(3, 4),), grad=False),
+    "argsort": C(lambda: (A(3, 4),), grad=False),
+    "sort": C(lambda: (A(3, 4),), grad=False),
+    "topk": C(lambda: (A(3, 5),), {"k": 2}, grad=False),
+    "shape_array": C(lambda: (A(3, 4),), grad=False),
+    "size_array": C(lambda: (A(3, 4),), grad=False),
+    # -- creation --------------------------------------------------------
+    "zeros": C(lambda: (), {"shape": (2, 3)}, grad=False, bf16=False),
+    "ones": C(lambda: (), {"shape": (2, 3)}, grad=False, bf16=False),
+    "full": C(lambda: (), {"shape": (2, 3), "val": 1.5}, grad=False,
+              bf16=False),
+    "eye": C(lambda: (), {"N": 3}, grad=False, bf16=False),
+    "arange": C(lambda: (), {"start": 0, "stop": 6}, grad=False,
+                bf16=False),
+    "linspace": C(lambda: (), {"start": 0.0, "stop": 1.0, "num": 5},
+                  grad=False, bf16=False),
+    "zeros_like": C(lambda: (A(2, 3),), grad=False),
+    "ones_like": C(lambda: (A(2, 3),), grad=False),
+    "full_like": C(lambda: (A(2, 3),), {"fill_value": 2.0}, grad=False),
+    "arange_like": C(lambda: (A(2, 3),), grad=False),
+    # -- matmul family ---------------------------------------------------
+    "dot": C(lambda: (A(3, 4), A(4, 5))),
+    "batch_dot": C(lambda: (A(2, 3, 4), A(2, 4, 5))),
+    "linalg_gemm2": C(lambda: (A(3, 4), A(4, 5))),
+    "khatri_rao": C(lambda: (A(2, 3), A(4, 3))),
+    "batch_dot_attn": C(lambda: (A(2, 2, 4, 8), A(2, 2, 4, 8))),
+    "attn_value": C(lambda: (A(2, 2, 4, 4), A(2, 2, 4, 8))),
+    "causal_mask_fill": C(lambda: (A(2, 2, 4, 4),), grad=False),
+    "masked_softmax": C(lambda: (A(2, 3, 4),)),
+    "div_sqrt_dim": C(lambda: (A(3, 4),)),
+    "interleaved_matmul_selfatt_qk": C(
+        lambda: (A(5, 2, 24),), {"heads": 2}),
+    "interleaved_matmul_selfatt_valatt": C(
+        lambda: (A(5, 2, 24), A(4, 5, 5)), {"heads": 2}),
+    "interleaved_matmul_encdec_qk": C(
+        lambda: (A(5, 2, 8), A(5, 2, 16)), {"heads": 2}),
+    "interleaved_matmul_encdec_valatt": C(
+        lambda: (A(5, 2, 16), A(4, 5, 5)), {"heads": 2}),
+    "rms_norm": C(lambda: (A(3, 8), POS(8))),
+    "rope": C(lambda: (A(2, 2, 4, 8),)),
+    "smooth_l1_dup": None,  # placeholder removed below
+    # -- nn ops ----------------------------------------------------------
+    "FullyConnected": C(lambda: (A(3, 4), A(5, 4), A(5)),
+                        {"num_hidden": 5}),
+    "Convolution": C(lambda: (A(2, 3, 8, 8), A(4, 3, 3, 3), A(4)),
+                     {"kernel": (3, 3), "num_filter": 4, "pad": (1, 1)},
+                     rtol=2e-2, atol=2e-2),
+    "Deconvolution": C(lambda: (A(2, 3, 6, 6), A(3, 4, 3, 3), A(4)),
+                       {"kernel": (3, 3), "num_filter": 4},
+                       rtol=2e-2, atol=2e-2),
+    "Pooling": C(lambda: (A(2, 2, 6, 6),),
+                 {"kernel": (2, 2), "pool_type": "avg", "stride": (2, 2)}),
+    "Activation": C(lambda: (A(3, 4),), {"act_type": "tanh"}),
+    "LeakyReLU": C(lambda: (POS(3, 4),), {"act_type": "leaky"}),
+    "softmax": C(lambda: (A(3, 4),)),
+    "log_softmax": C(lambda: (A(3, 4),)),
+    "softmin": C(lambda: (A(3, 4),)),
+    "softmax_cross_entropy": C(lambda: (A(3, 5), IDX(3, n=5)),
+                               grad_args=(0,)),
+    "LayerNorm": C(lambda: (A(3, 8), POS(8), A(8))),
+    "GroupNorm": C(lambda: (A(2, 4, 3, 3), POS(4), A(4)),
+                   {"num_groups": 2}),
+    "InstanceNorm": C(lambda: (A(2, 3, 4, 4), POS(3), A(3))),
+    "L2Normalization": C(lambda: (POS(3, 4),)),
+    "BatchNorm": C(
+        lambda: (A(4, 3, 5, 5), POS(3), A(3), A(3, lo=-0.1, hi=0.1),
+                 POS(3)),
+        {"fix_gamma": False, "_training": True}, grad_args=(0, 1, 2),
+        rtol=2e-2, atol=2e-2),
+    "Embedding": C(lambda: (IDX(6, n=5), A(5, 4)), grad_args=(1,)),
+    "boolean_mask": C(
+        lambda: (A(5, 3), jnp.asarray([1, 0, 1, 1, 0], "int32")),
+        grad=False, jit=False, bf16=False),  # data-dependent output shape
+    "BilinearSampler": C(lambda: (A(2, 3, 5, 5), UNIT(2, 2, 4, 4)),
+                         grad_args=(0,), rtol=3e-2, atol=3e-2),
+    "quantize": C(lambda: (UNIT(3, 4), jnp.asarray(-1.0),
+                           jnp.asarray(1.0)), grad=False, bf16=False),
+    "dequantize": C(
+        lambda: (jnp.asarray(R.randint(0, 255, (3, 4)).astype("uint8")),
+                 jnp.asarray(-1.0), jnp.asarray(1.0)),
+        grad=False, bf16=False),
+})
+del CASES["smooth_l1_dup"]
+
+SKIP = {
+    "Dropout": "random: needs injected RNG key (_key); covered by "
+               "tests/test_gluon.py dropout tests",
+    "RNN": "stateful packed-weight fused op; covered by "
+           "tests/test_gluon_rnn.py fused-vs-unfused parity",
+    "ctc_loss": "optax lattice op; covered by gluon CTCLoss test; numeric "
+                "grad over the lattice is O(T*V) slow",
+    "flash_attention": "covered by tests/test_flash_attention.py "
+                       "(fwd parity + gradients)",
+    "ring_attention": "needs a device mesh; covered by "
+                      "tests/test_parallel.py exact-vs-dense test",
+    "ROIAlign": "covered by detection-op usage; numeric grad unstable at "
+                "bin boundaries by construction",
+    "SoftmaxOutput": "custom_vjp carries the IMPLICIT loss gradient "
+                     "(reference semantics): autodiff deliberately "
+                     "diverges from the forward's numeric jacobian; "
+                     "semantics tested in tests/test_module.py",
+    "LinearRegressionOutput": "same implicit-loss-gradient contract",
+    "MAERegressionOutput": "same implicit-loss-gradient contract",
+    "LogisticRegressionOutput": "same implicit-loss-gradient contract",
+    "_internal_getitem": "internal indexing helper for NDArray.__getitem__;"
+                         " exercised by tests/test_ndarray.py slicing",
+    "gamma": "sampling op (mx.nd.gamma parity is random sampling, not the "
+             "Γ function); RNG-key plumbed, covered via mxtpu/random.py",
+}
+
+
+def _unique_ops():
+    seen = {}
+    for spec in base._OP_REGISTRY.values():
+        seen.setdefault(id(spec), spec.name)
+    return sorted(set(seen.values()))
+
+
+def test_registry_fully_covered():
+    missing = [n for n in _unique_ops() if n not in CASES and n not in SKIP]
+    assert not missing, f"ops with no sweep case or skip reason: {missing}"
+    stale = [n for n in list(CASES) + list(SKIP)
+             if n not in base._OP_REGISTRY]
+    assert not stale, f"sweep table names unknown ops: {stale}"
+
+
+def _call(name, args, kwargs):
+    out = base.get_op(name).fn(*args, **kwargs)
+    return out
+
+
+def _flatsum(out):
+    leaves = jax.tree_util.tree_leaves(out)
+    return sum(jnp.sum(l.astype(jnp.float32)) for l in leaves
+               if jnp.issubdtype(l.dtype, jnp.inexact))
+
+
+@pytest.mark.parametrize("name", sorted(CASES))
+def test_op_eager_vs_jit(name):
+    case = CASES[name]
+    if not case.jit:
+        pytest.skip("data-dependent output shape: eager-only op")
+    args = case.args()
+    eager = _call(name, args, case.kwargs)
+    jitted = jax.jit(functools.partial(base.get_op(name).fn, **case.kwargs))(
+        *args)
+    for e, j in zip(jax.tree_util.tree_leaves(eager),
+                    jax.tree_util.tree_leaves(jitted)):
+        onp.testing.assert_allclose(onp.asarray(e), onp.asarray(j),
+                                    rtol=1e-5, atol=1e-6)
+
+
+@pytest.mark.parametrize("name", sorted(CASES))
+def test_op_bf16_consistency(name):
+    case = CASES[name]
+    if not case.bf16:
+        pytest.skip("integer/creation op: no float input to downcast")
+    args = case.args()
+    if not any(a.dtype == jnp.float32 for a in args):
+        pytest.skip("no fp32 array input")
+    f32 = _call(name, args, case.kwargs)
+    bargs = tuple(a.astype(jnp.bfloat16) if a.dtype == jnp.float32 else a
+                  for a in args)
+    b16 = _call(name, bargs, case.kwargs)
+    for e, j in zip(jax.tree_util.tree_leaves(f32),
+                    jax.tree_util.tree_leaves(b16)):
+        if not jnp.issubdtype(e.dtype, jnp.inexact):
+            continue
+        onp.testing.assert_allclose(
+            onp.asarray(e, dtype="float32"), onp.asarray(j, "float32"),
+            rtol=0.1, atol=0.1)
+
+
+@pytest.mark.parametrize(
+    "name", sorted(n for n, c in CASES.items() if c.grad))
+def test_op_numeric_gradient(name):
+    """Central-difference jacobian-vector action vs jax.grad."""
+    case = CASES[name]
+    args = case.args()
+    widx = case.grad_args
+    if widx is None:
+        widx = tuple(i for i, a in enumerate(args)
+                     if jnp.issubdtype(a.dtype, jnp.inexact))
+    assert widx, f"{name}: grad case with no float args"
+    fn = base.get_op(name).fn
+
+    def scalar_of(*wargs):
+        full = list(args)
+        for i, w in zip(widx, wargs):
+            full[i] = w
+        return _flatsum(fn(*full, **case.kwargs))
+
+    wargs = tuple(args[i] for i in widx)
+    grads = jax.grad(scalar_of, argnums=tuple(range(len(wargs))))(*wargs)
+
+    eps = 1e-2
+    for gi, (w, g) in enumerate(zip(wargs, grads)):
+        # probe a handful of coordinates (full FD sweep is O(n) evals)
+        flat = onp.asarray(w, dtype="float64").ravel()
+        coords = R.choice(flat.size, size=min(6, flat.size), replace=False)
+        for c in coords:
+            def at(val):
+                f = flat.copy()
+                f[c] = val
+                ws = list(wargs)
+                ws[gi] = jnp.asarray(f.astype("float32")).reshape(w.shape)
+                return float(scalar_of(*ws))
+
+            fd = (at(flat[c] + eps) - at(flat[c] - eps)) / (2 * eps)
+            an = float(onp.asarray(g).ravel()[c])
+            onp.testing.assert_allclose(
+                an, fd, rtol=case.rtol, atol=case.atol,
+                err_msg=f"{name}: grad arg {gi} coord {c}")
