@@ -51,6 +51,10 @@ _LAZY = {
     "amp": ".amp",
     "test_utils": ".test_utils",
     "util": ".util",
+    "np": ".numpy",
+    "numpy": ".numpy",
+    "npx": ".numpy_extension",
+    "numpy_extension": ".numpy_extension",
 }
 
 

@@ -173,6 +173,20 @@ class NDArray:
         out = NDArray(self._data)
         return out
 
+    def as_np_ndarray(self):
+        """The mx.np flavour of this array (shares the buffer AND the
+        autograd state, so gradients flow through the conversion; parity:
+        NDArray.as_np_ndarray in the 1.6+ reference)."""
+        from ..numpy import ndarray as np_ndarray
+        return self._as_flavour(np_ndarray)
+
+    def _as_flavour(self, cls):
+        out = cls(self._data, ctx=self._ctx)
+        out._grad = self._grad
+        out._grad_req = self._grad_req
+        out._tape_node = self._tape_node
+        return out
+
     def backward(self, out_grad=None, retain_graph=False, train_mode=True):
         autograd.backward([self], [out_grad] if out_grad is not None else None,
                           retain_graph=retain_graph, train_mode=train_mode)
@@ -485,10 +499,13 @@ def _translate_index(key):
     return key
 
 
-def _wrap_result(res, ctx):
+def _wrap_result(res, ctx, cls=None):
+    """Wrap raw jax results; `cls` propagates NDArray subclasses (mx.np
+    ndarray results stay np ndarrays through every registry op)."""
+    cls = cls or NDArray
     if isinstance(res, (tuple, list)):
-        return tuple(NDArray(r, ctx=ctx) for r in res)
-    return NDArray(res, ctx=ctx)
+        return tuple(cls(r, ctx=ctx) for r in res)
+    return cls(res, ctx=ctx)
 
 
 def invoke_op(name: str, args: tuple, kwargs: dict):
@@ -516,6 +533,10 @@ def invoke_op(name: str, args: tuple, kwargs: dict):
 
     recording = (autograd.is_recording() and spec.differentiable
                  and any(autograd._on_tape(a) for a in nd_args))
+    # result class follows the inputs: mx.np ndarrays beget mx.np ndarrays;
+    # any subclass operand wins regardless of operand order
+    res_cls = next((type(a) for a in nd_args if type(a) is not NDArray),
+                   NDArray)
 
     # inject runtime-state kwargs some ops need
     fn = spec.fn
@@ -539,12 +560,12 @@ def invoke_op(name: str, args: tuple, kwargs: dict):
 
         primals = tuple(a._data for a in nd_args)
         res, vjp_fn = jax.vjp(f, *primals)
-        outs = _wrap_result(res, ctx)
+        outs = _wrap_result(res, ctx, res_cls)
         out_list = list(outs) if isinstance(outs, tuple) else [outs]
         autograd.record_node(vjp_fn, nd_args, out_list, name)
     else:
         res = fn(*raw_args, **kwargs)
-        outs = _wrap_result(res, ctx)
+        outs = _wrap_result(res, ctx, res_cls)
         out_list = list(outs) if isinstance(outs, tuple) else [outs]
 
     if engine.is_sync():

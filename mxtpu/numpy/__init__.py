@@ -1,0 +1,432 @@
+"""``mx.np``: the NumPy-compatible array namespace (parity:
+python/mxnet/numpy/ — multiarray.py ndarray + ~10k LoC of generated
+function surface in the 1.6+ reference).
+
+TPU-native design: the reference re-implemented NumPy semantics op by op
+in C++ (src/operator/numpy/**); here ``jax.numpy`` IS the NumPy-semantics
+kernel library, so ``mx.np.ndarray`` is the NDArray slot with a numpy
+face, and the function surface is a thin tape-aware dispatch onto jnp.
+Every registry op propagates the array subclass (ndarray in → ndarray
+out, see _wrap_result in ndarray.py) so autograd, hybridize and the
+Gluon stack work unchanged on np arrays.
+"""
+
+from __future__ import annotations
+
+import builtins
+
+import jax
+import jax.numpy as jnp
+import numpy as onp
+
+from .. import autograd
+from ..ndarray.ndarray import NDArray, invoke_op, _wrap_result
+
+__all__ = ["ndarray", "array", "asarray"]  # extended programmatically below
+
+pi = onp.pi
+e = onp.e
+inf = onp.inf
+nan = onp.nan
+newaxis = None
+
+float32 = onp.float32
+float64 = onp.float64
+float16 = onp.float16
+int8 = onp.int8
+int32 = onp.int32
+int64 = onp.int64
+uint8 = onp.uint8
+bool_ = onp.bool_
+
+
+class ndarray(NDArray):
+    """NumPy-flavoured NDArray (parity: mxnet.numpy.ndarray).
+
+    Differences from mx.nd.NDArray follow the reference contract: true
+    division, zero-dim arrays are first-class, boolean-mask indexing,
+    and results of any registry op on an ndarray are ndarrays.
+    """
+
+    def __repr__(self):
+        return repr(self.asnumpy()).replace("array", "ndarray", 1)
+
+    # numpy-style division: always true division
+    def __div__(self, other):
+        return self.__truediv__(other)
+
+    # numpy comparison semantics: bool results (the legacy mx.nd flavour
+    # returns 0.0/1.0 floats for reference parity)
+    def __eq__(self, other):
+        if other is None:  # numpy semantics: elementwise False
+            return _apply(lambda a: jnp.zeros(a.shape, bool), self)
+        return _apply(jnp.equal, self, _unwrap(other))
+
+    def __ne__(self, other):
+        if other is None:
+            return _apply(lambda a: jnp.ones(a.shape, bool), self)
+        return _apply(jnp.not_equal, self, _unwrap(other))
+
+    def __gt__(self, other):
+        return _apply(jnp.greater, self, _unwrap(other))
+
+    def __ge__(self, other):
+        return _apply(jnp.greater_equal, self, _unwrap(other))
+
+    def __lt__(self, other):
+        return _apply(jnp.less, self, _unwrap(other))
+
+    def __le__(self, other):
+        return _apply(jnp.less_equal, self, _unwrap(other))
+
+    __hash__ = None  # numpy parity: arrays are unhashable
+
+    def as_nd_ndarray(self):
+        """Back to the legacy mx.nd flavour (shares the buffer and the
+        autograd state)."""
+        return self._as_flavour(NDArray)
+
+    def attach_grad(self, grad_req="write", stype=None):
+        super().attach_grad(grad_req, stype)
+        self._grad = ndarray(self._grad._data)  # np-flavoured .grad
+
+    def tolist(self):
+        return self.asnumpy().tolist()
+
+    @property
+    def T(self):
+        return _apply(jnp.transpose, self)
+
+    def transpose(self, *axes):
+        axes = axes if axes else None
+        if len(axes or ()) == 1 and isinstance(axes[0], (tuple, list)):
+            axes = tuple(axes[0])
+        return _apply(jnp.transpose, self, axes=axes)
+
+    def reshape(self, *shape, **kw):
+        if len(shape) == 1 and isinstance(shape[0], (tuple, list)):
+            shape = tuple(shape[0])
+        return _apply(jnp.reshape, self, shape=shape)
+
+    def astype(self, dtype, copy=True):
+        return ndarray(self._data.astype(jnp.dtype(dtype)), ctx=self._ctx)
+
+    def item(self, *args):
+        return self.asnumpy().item(*args)
+
+    def copy(self):
+        return ndarray(self._data + 0, ctx=self._ctx)
+
+    def detach(self):
+        return ndarray(self._data, ctx=self._ctx)
+
+    def std(self, axis=None, ddof=0, keepdims=False):
+        return _apply(jnp.std, self, axis=axis, ddof=ddof,
+                      keepdims=keepdims)
+
+    def var(self, axis=None, ddof=0, keepdims=False):
+        return _apply(jnp.var, self, axis=axis, ddof=ddof,
+                      keepdims=keepdims)
+
+    def all(self, axis=None, keepdims=False):
+        return _apply(jnp.all, self, axis=axis, keepdims=keepdims)
+
+    def any(self, axis=None, keepdims=False):
+        return _apply(jnp.any, self, axis=axis, keepdims=keepdims)
+
+    def round(self, decimals=0):
+        return _apply(jnp.round, self, decimals=decimals)
+
+    def dot(self, other):
+        return _apply(jnp.dot, self, other)
+
+    def cumsum(self, axis=None):
+        return _apply(jnp.cumsum, self, axis=axis)
+
+    def clip(self, a_min=None, a_max=None):
+        return _apply(jnp.clip, self, a_min, a_max)
+
+
+def _unwrap(x):
+    return x._data if isinstance(x, NDArray) else x
+
+
+def _apply(fn, *args, **kwargs):
+    """Tape-aware dispatch of an arbitrary jnp function onto ndarrays
+    (the np-namespace analogue of invoke_op; parity:
+    Imperative::Invoke + RecordOp for the numpy op set).  Arguments may be
+    arbitrary pytrees of ndarrays (e.g. concatenate's list input)."""
+    leaves, treedef = jax.tree_util.tree_flatten(
+        (args, kwargs), is_leaf=lambda x: isinstance(x, NDArray))
+    nd_idx = [i for i, l in enumerate(leaves) if isinstance(l, NDArray)]
+    nd_args = [leaves[i] for i in nd_idx]
+    raw = [l._data if isinstance(l, NDArray) else l for l in leaves]
+    # builtins.any: the module-level `any`/`all`/... generated below shadow
+    # the builtins in this module's global namespace
+    recording = (autograd.is_recording()
+                 and builtins.any(autograd._on_tape(a) for a in nd_args))
+    if recording:
+        def f(*diff_arrays):
+            call = list(raw)
+            for i, arr in zip(nd_idx, diff_arrays):
+                call[i] = arr
+            cargs, ckwargs = jax.tree_util.tree_unflatten(treedef, call)
+            return fn(*cargs, **ckwargs)
+
+        res, vjp_fn = jax.vjp(f, *(a._data for a in nd_args))
+        outs = _wrap_result(res, None, ndarray)
+        out_list = list(outs) if isinstance(outs, tuple) else [outs]
+        autograd.record_node(vjp_fn, nd_args, out_list,
+                             getattr(fn, "__name__", "np_op"))
+        return _sync_and_monitor(outs, fn)
+    cargs, ckwargs = jax.tree_util.tree_unflatten(treedef, raw)
+    res = fn(*cargs, **ckwargs)
+    return _sync_and_monitor(_wrap_result(res, None, ndarray), fn)
+
+
+def _sync_and_monitor(outs, fn):
+    """Same engine-sync + monitor-tap contract as invoke_op, so np ops
+    behave identically under MXTPU_SYNC / mx.monitor.Monitor."""
+    from .. import engine
+    from ..ndarray.ndarray import _OUTPUT_MONITORS
+    out_list = list(outs) if isinstance(outs, tuple) else [outs]
+    if engine.is_sync():
+        for o in out_list:
+            try:
+                o._data.block_until_ready()
+            except AttributeError:
+                pass  # tracer
+    if _OUTPUT_MONITORS:
+        name = getattr(fn, "__name__", "np_op")
+        for cb in list(_OUTPUT_MONITORS):
+            for o in out_list:
+                cb(name, o)
+    return outs
+
+
+def array(object, dtype=None, ctx=None):
+    if isinstance(object, NDArray):
+        object = object._data
+    return ndarray(jnp.asarray(object, dtype=jnp.dtype(dtype) if dtype
+                               else None), ctx=ctx)
+
+
+def asarray(object, dtype=None):
+    if isinstance(object, ndarray) and dtype is None:
+        return object
+    return array(object, dtype=dtype)
+
+
+# -- creation ----------------------------------------------------------------
+
+def _creation(name):
+    jfn = getattr(jnp, name)
+
+    def fn(*args, **kwargs):
+        ctx = kwargs.pop("ctx", None)
+        out = _apply(jfn, *args, **kwargs)
+        if ctx is not None:
+            out = ndarray(out._data, ctx=ctx)
+        return out
+
+    fn.__name__ = name
+    fn.__doc__ = f"mx.np.{name} (jax.numpy semantics)"
+    return fn
+
+
+_CREATION = ["zeros", "ones", "full", "eye", "identity", "arange",
+             "linspace", "logspace", "tril", "triu", "meshgrid",
+             "zeros_like", "ones_like", "full_like", "empty_like"]
+
+# -- elementwise / math / reduction / structural: direct jnp surface ---------
+
+_JNP_FUNCS = [
+    # math
+    "absolute", "abs", "sign", "negative", "reciprocal", "square", "sqrt",
+    "cbrt", "exp", "expm1", "log", "log2", "log10", "log1p", "sin", "cos",
+    "tan", "arcsin", "arccos", "arctan", "arctan2", "sinh", "cosh", "tanh",
+    "arcsinh", "arccosh", "arctanh", "degrees", "radians", "rint",
+    "floor", "ceil", "trunc", "around", "round", "clip", "maximum",
+    "minimum", "fmax", "fmin", "hypot", "copysign", "fabs", "power",
+    "mod", "remainder", "fmod", "floor_divide", "gcd", "lcm", "exp2",
+    "trunc",
+    # binary arithmetic
+    "add", "subtract", "multiply", "divide", "true_divide",
+    # linalg-ish
+    "dot", "vdot", "inner", "outer", "matmul", "tensordot", "einsum",
+    "trace", "kron", "cross",
+    # reductions
+    "sum", "prod", "mean", "std", "var", "median", "average", "amax",
+    "amin", "max", "min", "argmax", "argmin", "cumsum", "cumprod",
+    "nansum", "nanprod", "nanmean", "nanmax", "nanmin", "ptp",
+    # comparison / logic
+    "equal", "not_equal", "greater", "greater_equal", "less", "less_equal",
+    "logical_and", "logical_or", "logical_xor", "logical_not", "isnan",
+    "isinf", "isfinite", "isposinf", "isneginf", "all", "any",
+    "allclose", "isclose", "array_equal",
+    # structural
+    "reshape", "ravel", "transpose", "swapaxes", "moveaxis", "rollaxis",
+    "expand_dims", "squeeze", "broadcast_to", "broadcast_arrays",
+    "concatenate", "stack", "vstack", "hstack", "dstack", "column_stack",
+    "split", "array_split", "hsplit", "vsplit", "dsplit", "tile", "repeat",
+    "flip", "fliplr", "flipud", "roll", "rot90", "atleast_1d",
+    "atleast_2d", "atleast_3d", "append", "insert", "delete", "pad",
+    # indexing / search / sort
+    "where", "take", "take_along_axis", "choose", "compress", "diag",
+    "diagonal", "diagflat", "searchsorted", "sort", "argsort", "unique",
+    "nonzero", "flatnonzero", "count_nonzero", "unravel_index",
+    "histogram", "bincount", "digitize", "interp",
+    # sets
+    "intersect1d", "union1d", "setdiff1d", "isin",
+    # misc
+    "result_type", "can_cast",
+    "real", "imag", "conj", "angle", "diff", "ediff1d", "gradient",
+    "convolve", "correlate", "vander", "heaviside", "nan_to_num",
+]
+
+
+def _jnp_func(name):
+    jfn = getattr(jnp, name)
+
+    def fn(*args, **kwargs):
+        return _apply(jfn, *args, **kwargs)
+
+    fn.__name__ = name
+    fn.__doc__ = (jfn.__doc__ or "").split("\n")[0] or \
+        f"mx.np.{name} (jax.numpy semantics)"
+    return fn
+
+
+_g = globals()
+for _name in _CREATION:
+    _g[_name] = _creation(_name)
+    __all__.append(_name)
+for _name in _JNP_FUNCS:
+    if _name not in _g and hasattr(jnp, _name):
+        _g[_name] = _jnp_func(_name)
+        __all__.append(_name)
+
+
+def empty(shape, dtype=None, ctx=None):
+    """Parity: np.empty (XLA has no uninitialised buffers; zeros)."""
+    out = _apply(jnp.zeros, shape, dtype=dtype or "float32")
+    if ctx is not None:
+        out = ndarray(out._data, ctx=ctx)
+    return out
+
+
+def shape(a):
+    return tuple(a.shape)
+
+
+def ndim(a):
+    return a.ndim
+
+
+def size(a, axis=None):
+    if axis is None:
+        return a.size
+    return a.shape[axis]
+
+
+def copy(a):
+    return a.copy()
+
+
+def flatnonzero_(a):  # pragma: no cover - alias guard
+    return flatnonzero(a)  # noqa: F821
+
+
+# linalg / random sub-namespaces ---------------------------------------------
+
+class _Linalg:
+    """mx.np.linalg (slice: norm/inv/det/svd/cholesky/qr/eigh/solve)."""
+
+    def __getattr__(self, name):
+        jfn = getattr(jnp.linalg, name)
+
+        def fn(*args, **kwargs):
+            return _apply(jfn, *args, **kwargs)
+
+        fn.__name__ = "linalg." + name
+        return fn
+
+
+linalg = _Linalg()
+
+
+class _Random:
+    """mx.np.random over the global mxtpu key-ring (mxtpu/random.py)."""
+
+    @staticmethod
+    def _key():
+        from .. import random as _rnd
+        return _rnd.next_key()
+
+    def uniform(self, low=0.0, high=1.0, size=None, dtype="float32",
+                ctx=None):
+        size = size if size is not None else ()
+        return ndarray(jax.random.uniform(
+            self._key(), tuple(onp.atleast_1d(size)) if size != () else (),
+            minval=low, maxval=high, dtype=jnp.dtype(dtype)))
+
+    def normal(self, loc=0.0, scale=1.0, size=None, dtype="float32",
+               ctx=None):
+        size = size if size is not None else ()
+        return ndarray(loc + scale * jax.random.normal(
+            self._key(), tuple(onp.atleast_1d(size)) if size != () else (),
+            dtype=jnp.dtype(dtype)))
+
+    def randint(self, low, high=None, size=None, dtype="int32", ctx=None):
+        if high is None:
+            low, high = 0, low
+        size = size if size is not None else ()
+        return ndarray(jax.random.randint(
+            self._key(), tuple(onp.atleast_1d(size)) if size != () else (),
+            low, high, dtype=jnp.dtype(dtype)))
+
+    def rand(self, *size):
+        return self.uniform(size=size or None)
+
+    def randn(self, *size):
+        return self.normal(size=size or None)
+
+    def choice(self, a, size=None, replace=True, p=None):
+        arr = a._data if isinstance(a, NDArray) else jnp.asarray(a)
+        size = () if size is None else tuple(onp.atleast_1d(size))
+        p_ = p._data if isinstance(p, NDArray) else p
+        return ndarray(jax.random.choice(self._key(), arr, size, replace,
+                                         p_))
+
+    def shuffle(self, a):
+        perm = jax.random.permutation(self._key(), a.shape[0])
+        a._rebind(jnp.take(a._data, perm, axis=0))
+
+    def seed(self, s):
+        from .. import random as _rnd
+        _rnd.seed(s)
+
+
+random = _Random()
+
+
+def fix(x):
+    """Round toward zero (jnp.fix is deprecated in jax 0.9: use trunc)."""
+    return _apply(jnp.trunc, x)
+
+
+def in1d(ar1, ar2, invert=False):
+    """numpy.in1d compatibility (removed from jnp: isin on raveled input)."""
+    return _apply(lambda a, b: jnp.isin(jnp.ravel(a), b, invert=invert),
+                  ar1, ar2)
+
+
+def may_share_memory(a, b, max_work=None):
+    """jax arrays are immutable; buffer aliasing is an XLA detail. Parity
+    surface only: True iff both wrap the same jax buffer object."""
+    da = a._data if isinstance(a, NDArray) else a
+    db = b._data if isinstance(b, NDArray) else b
+    return da is db
+
+
+shares_memory = may_share_memory

@@ -1,0 +1,87 @@
+"""``mx.npx``: numpy extensions (parity: python/mxnet/numpy_extension/ +
+the npx op surface — set_np/reset_np flags, nn ops usable on np arrays,
+save/load).
+
+The reference gates numpy semantics behind set_np() because its legacy
+NDArray had MXNet shape semantics (e.g. no zero-dim arrays); the mxtpu
+NDArray is jnp-backed and numpy-semantic natively, so the flags default
+True and set_np/reset_np simply track user intent (documented divergence).
+"""
+
+from __future__ import annotations
+
+from .. import util
+from ..base import get_op
+from ..ndarray.ndarray import NDArray, invoke_op
+from ..numpy import ndarray, _apply
+
+__all__ = ["set_np", "reset_np", "is_np_array", "is_np_shape",
+           "use_np_shape", "use_np_array", "save", "load"]
+
+
+# flag surface delegates to mxtpu.util (numpy semantics are native; util
+# raises on attempts to turn them OFF — documented divergence)
+set_np = util.set_np
+reset_np = util.reset_np
+is_np_array = util.is_np_array
+is_np_shape = util.is_np_shape
+use_np_shape = util.use_np_shape
+use_np_array = util.use_np_array
+use_np = util.use_np
+
+
+def save(file, arr):
+    from ..ndarray import serialization
+    if isinstance(arr, dict):
+        serialization.save(file, {k: NDArray(v._data) if isinstance(
+            v, NDArray) else NDArray(v) for k, v in arr.items()})
+    else:
+        arrs = arr if isinstance(arr, (list, tuple)) else [arr]
+        serialization.save(file, [NDArray(a._data) if isinstance(
+            a, NDArray) else NDArray(a) for a in arrs])
+
+
+def load(file):
+    from ..ndarray import serialization
+    out = serialization.load(file)
+    if isinstance(out, dict):
+        return {k: ndarray(v._data) for k, v in out.items()}
+    return [ndarray(v._data) for v in out]
+
+
+def _np_op(name):
+    """npx nn op over the mxtpu registry (tape-aware, np-array in/out)."""
+
+    def fn(*args, **kwargs):
+        return invoke_op(name, args, kwargs)
+
+    fn.__name__ = name
+    fn.__doc__ = get_op(name).fn.__doc__
+    return fn
+
+
+# npx op surface (reference exposes the full op registry under npx; the
+# common nn slice here, all dispatching through the same registry so
+# subclass propagation + autograd hold)
+relu = _np_op("relu")
+sigmoid = _np_op("sigmoid")
+softmax = _np_op("softmax")
+log_softmax = _np_op("log_softmax")
+one_hot = _np_op("one_hot")
+pick = _np_op("pick")
+topk = _np_op("topk")
+batch_dot = _np_op("batch_dot")
+fully_connected = _np_op("FullyConnected")
+convolution = _np_op("Convolution")
+pooling = _np_op("Pooling")
+batch_norm = _np_op("BatchNorm")
+layer_norm = _np_op("LayerNorm")
+embedding = _np_op("Embedding")
+dropout = _np_op("Dropout")
+gamma = _np_op("gamma")
+gammaln = _np_op("gammaln")
+sequence_mask = _np_op("sequence_mask")
+gather_nd = _np_op("gather_nd")
+scatter_nd = _np_op("scatter_nd")
+reshape_like = _np_op("reshape_like")
+arange_like = _np_op("arange_like")

@@ -58,7 +58,7 @@ _UNARY_ANY = ["negative", "square", "exp", "expm1", "sin", "cos", "tanh",
               "softsign", "gelu_tanh", "swish", "hard_sigmoid", "identity",
               "relu"]
 _UNARY_POS = ["sqrt", "rsqrt", "log", "log10", "log2", "log1p", "cbrt",
-              "rcbrt", "reciprocal", "gammaln", "abs"]
+              "rcbrt", "reciprocal", "gammaln", "gamma", "abs"]
 _UNARY_UNIT = ["arcsin", "arccos", "arctanh", "erfinv"]
 _UNARY_NONDIFF = ["rint", "round", "floor", "ceil", "trunc", "fix", "sign",
                   "isnan", "isinf", "isfinite", "logical_not"]
@@ -268,8 +268,6 @@ SKIP = {
     "LogisticRegressionOutput": "same implicit-loss-gradient contract",
     "_internal_getitem": "internal indexing helper for NDArray.__getitem__;"
                          " exercised by tests/test_ndarray.py slicing",
-    "gamma": "sampling op (mx.nd.gamma parity is random sampling, not the "
-             "Γ function); RNG-key plumbed, covered via mxtpu/random.py",
 }
 
 
