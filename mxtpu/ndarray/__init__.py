@@ -35,6 +35,9 @@ for _name in list(_OP_REGISTRY):
         setattr(_mod, _name, _make_op_fn(_name))
         __all__.append(_name)
 
+# after _make_op_fn exists (contrib reuses it for its flat op stubs)
+from . import contrib  # noqa: F401,E402
+
 
 # legacy flat random-op names (mx.nd.random_uniform etc.)
 def random_uniform(low=0.0, high=1.0, shape=None, dtype="float32", ctx=None):

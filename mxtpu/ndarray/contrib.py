@@ -1,0 +1,167 @@
+"""mx.nd.contrib: control-flow wrappers with the reference calling
+convention (parity: python/mxnet/ndarray/contrib.py foreach/while_loop/
+cond), plus flat access to every _contrib_* registry op via the parent
+namespace.
+
+The wrappers reconstruct MXNet's (outputs, states) return structure from
+the flat tuple the registry ops produce; the body's output arity is
+captured during the first (tracing) call.
+"""
+
+from __future__ import annotations
+
+from .ndarray import NDArray, invoke_op
+
+__all__ = ["foreach", "while_loop", "cond"]
+
+
+def _tolist(x):
+    if x is None:
+        return []
+    if isinstance(x, (list, tuple)):
+        return list(x)
+    return [x]
+
+
+def _check_taped_closures(opname, *fns):
+    """Gradients flow only to explicit array inputs (data/states/inputs) —
+    the scan/cond is differentiated as one op via jax.vjp, so an NDArray
+    captured by closure enters the trace as a constant.  The reference's
+    imperative control flow runs eagerly and closure gradients flow there;
+    failing loudly beats silently-zero grads."""
+    from .. import autograd
+    if not autograd.is_recording():
+        return
+    for fn in fns:
+        seen = []
+        for cell in (getattr(fn, "__closure__", None) or ()):
+            try:
+                v = cell.cell_contents
+            except ValueError:
+                continue
+            vals = v if isinstance(v, (list, tuple)) else [v]
+            for item in vals:
+                if isinstance(item, NDArray) and autograd._on_tape(item):
+                    seen.append(item)
+        if seen:
+            raise ValueError(
+                "%s: the body/branch callable captures %d NDArray(s) that "
+                "are on the autograd tape; gradients cannot flow to "
+                "closure captures (the loop is differentiated as one op). "
+                "Pass them through init_states/loop_vars/inputs instead "
+                "— loop-invariant states thread through unchanged."
+                % (opname, len(seen)))
+
+
+def foreach(body, data, init_states, name=None):
+    """Scan body over the leading axis (parity: nd.contrib.foreach).
+
+    body(data_slice, states) -> (outputs, new_states); returns
+    (outputs, final_states) with the same nesting the body used.
+    """
+    _check_taped_closures("foreach", body)
+    data_l = _tolist(data)
+    states_l = _tolist(init_states)
+    arity = {}
+
+    def body2(d, s):
+        outs, ns = body(d, s)
+        arity["out_single"] = isinstance(outs, NDArray)
+        arity["n_out"] = 1 if arity["out_single"] else len(outs)
+        return outs, ns
+
+    flat = invoke_op("foreach", tuple(data_l) + tuple(states_l),
+                     {"body": body2, "num_data": len(data_l)})
+    flat = list(flat) if isinstance(flat, tuple) else [flat]
+    n_out = arity["n_out"]
+    outs = flat[:n_out]
+    states = flat[n_out:]
+    outs = outs[0] if arity["out_single"] else outs
+    # states mirror the nesting of init_states (reference contract)
+    if not isinstance(init_states, (list, tuple)):
+        states = states[0]
+    elif isinstance(init_states, tuple):
+        states = tuple(states)
+    return outs, states
+
+
+def while_loop(cond, func, loop_vars, max_iterations=None, name=None):
+    """parity: nd.contrib.while_loop.  func(*loop_vars) ->
+    (step_outputs, new_loop_vars); returns (stacked_outputs,
+    final_loop_vars); output rows past termination are zeros (the
+    reference leaves them undefined)."""
+    if max_iterations is None:
+        raise ValueError("max_iterations is required")
+    _check_taped_closures("while_loop", cond, func)
+    vars_l = _tolist(loop_vars)
+    arity = {}
+
+    def func2(*vs):
+        outs, nvs = func(*vs)
+        arity["out_single"] = isinstance(outs, NDArray)
+        arity["n_out"] = 1 if arity["out_single"] else len(outs)
+        return outs, nvs
+
+    flat = invoke_op("while_loop", tuple(vars_l),
+                     {"cond": cond, "func": func2,
+                      "max_iterations": int(max_iterations)})
+    flat = list(flat)
+    n_out = arity["n_out"]
+    outs = flat[:n_out]
+    states = flat[n_out:-1]  # last element is the internal step count
+    outs = outs[0] if arity["out_single"] else outs
+    if isinstance(loop_vars, NDArray):
+        states = states[0]
+    elif isinstance(loop_vars, tuple):
+        states = tuple(states)
+    return outs, states
+
+
+def cond(pred, then_func, else_func, inputs=None, name=None):
+    """parity: nd.contrib.cond.  Branch callables receive *inputs (or no
+    arguments, closure-style, when inputs is None — the reference's
+    imperative convention)."""
+    _check_taped_closures("cond", then_func, else_func)
+    inputs_l = _tolist(inputs)
+    if inputs is None:
+        tf = lambda: then_func()  # noqa: E731
+        ef = lambda: else_func()  # noqa: E731
+    else:
+        tf, ef = then_func, else_func
+    arity = {}
+
+    def t2(*a):
+        out = tf(*a)
+        arity["single"] = isinstance(out, NDArray)
+        return out
+
+    def e2(*a):
+        out = ef(*a)
+        arity["single"] = isinstance(out, NDArray)
+        return out
+
+    flat = invoke_op("cond", (pred,) + tuple(inputs_l),
+                     {"then_func": t2, "else_func": e2})
+    if isinstance(flat, tuple) and arity.get("single"):
+        return flat[0]
+    if isinstance(flat, tuple):
+        return list(flat)
+    return flat
+
+
+def _flat_contrib_ops():
+    """Expose every _contrib_-prefixed registry op under nd.contrib too,
+    via the same stub factory as the flat nd namespace."""
+    from ..base import _OP_REGISTRY
+    from . import _make_op_fn
+
+    g = globals()
+    for name in list(_OP_REGISTRY):
+        if name.startswith("_contrib_"):
+            short = name[len("_contrib_"):]
+            if short not in g:
+                g[short] = _make_op_fn(name)
+                __all__.append(short)
+
+
+_flat_contrib_ops()

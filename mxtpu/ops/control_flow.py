@@ -1,0 +1,117 @@
+"""Control-flow operators (parity: src/operator/control_flow.cc —
+_foreach, _while_loop, _cond contrib ops).
+
+TPU-native design: the reference implements these as subgraph ops that
+re-enter the executor per iteration; here they lower to XLA structured
+control flow — `lax.scan` (foreach), a masked `lax.scan` (while_loop:
+scan over max_iterations with an active flag keeps the op
+REVERSE-DIFFERENTIABLE, which `lax.while_loop` is not), and `lax.cond`.
+One body contract everywhere: callables take and return NDArrays (they
+run fine under tracing — NDArray wraps tracers), so the same body works
+imperatively, under autograd, under hybridize and in Symbol graphs.
+"""
+
+from __future__ import annotations
+
+from ..base import register_op
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def _wrap(raw):
+    from ..ndarray.ndarray import NDArray
+    return NDArray(raw)
+
+
+def _unwrap_struct(out):
+    """body returns NDArray | list/tuple of NDArray → tuple of raw + arity."""
+    from ..ndarray.ndarray import NDArray
+    if isinstance(out, NDArray):
+        return (out._data,), True
+    return tuple(o._data if isinstance(o, NDArray) else jnp.asarray(o)
+                 for o in out), False
+
+
+@register_op("foreach", aliases=("_foreach", "_contrib_foreach"))
+def foreach(*arrays, body=None, num_data=1):
+    """Scan `body` over the leading axis of the data arrays.
+
+    arrays = (*data, *init_states); body(data, states) -> (outputs, states)
+    where data is an NDArray (or list when num_data > 1) and states a list.
+    Returns (*stacked_outputs, *final_states).
+    """
+    if body is None:
+        raise ValueError("foreach requires a body callable")
+    data = arrays[:num_data]
+    init_states = tuple(arrays[num_data:])
+
+    def step(states, slices):
+        d = [_wrap(s) for s in slices]
+        outs, new_states = body(d[0] if num_data == 1 else d,
+                                [_wrap(s) for s in states])
+        raw_outs, _ = _unwrap_struct(outs)
+        raw_states, _ = _unwrap_struct(new_states)
+        return raw_states, raw_outs
+
+    final_states, stacked = lax.scan(step, init_states, data)
+    return tuple(stacked) + tuple(final_states)
+
+
+@register_op("while_loop", aliases=("_while_loop", "_contrib_while_loop"))
+def while_loop(*loop_vars, cond=None, func=None, max_iterations=None):
+    """MXNet while_loop: run `func` while `cond` holds, at most
+    max_iterations times.  func(loop_vars) -> (step_outputs, new_loop_vars).
+
+    Lowered to a masked lax.scan so the whole loop has a reverse-mode
+    gradient (rows of the stacked outputs past termination are zeros —
+    the reference leaves them undefined).  Returns
+    (*stacked_outputs, *final_loop_vars, num_steps).
+    """
+    if cond is None or func is None or max_iterations is None:
+        raise ValueError("while_loop requires cond, func and "
+                         "max_iterations")
+
+    def step(carry, _):
+        vars_, active, n = carry
+        wrapped = [_wrap(v) for v in vars_]
+        pred = cond(*wrapped)
+        pred = (pred._data if hasattr(pred, "_data") else
+                jnp.asarray(pred)).reshape(()).astype(bool)
+        run = jnp.logical_and(active, pred)
+        outs, new_vars = func(*wrapped)
+        raw_outs, _ = _unwrap_struct(outs)
+        raw_vars, _ = _unwrap_struct(new_vars)
+        kept = tuple(jnp.where(run, nv, v)
+                     for nv, v in zip(raw_vars, vars_))
+        masked = tuple(jnp.where(run, o, jnp.zeros_like(o))
+                       for o in raw_outs)
+        return (kept, run, n + run.astype(jnp.int32)), masked
+
+    init = (tuple(v for v in loop_vars), jnp.asarray(True),
+            jnp.asarray(0, jnp.int32))
+    (final_vars, _, n_steps), stacked = lax.scan(
+        step, init, None, length=int(max_iterations))
+    return tuple(stacked) + tuple(final_vars) + (n_steps,)
+
+
+@register_op("cond", aliases=("_cond", "_contrib_cond"))
+def cond_op(pred, *inputs, then_func=None, else_func=None):
+    """MXNet cond: run then_func(*inputs) or else_func(*inputs) depending
+    on scalar pred.  Both branches must return the same structure.
+    Lowered to lax.cond (both branches traced/compiled once)."""
+    if then_func is None or else_func is None:
+        raise ValueError("cond requires then_func and else_func")
+    p = jnp.asarray(pred).reshape(()).astype(bool)
+
+    def mk(branch):
+        def run(raw_inputs):
+            out = branch(*[_wrap(r) for r in raw_inputs])
+            raw, single = _unwrap_struct(out)
+            # single-output branches return a bare array so the op has ONE
+            # output (a 1-tuple would make autograd expect tuple cotangents)
+            return raw[0] if single else raw
+        return run
+
+    return lax.cond(p, mk(then_func), mk(else_func), tuple(inputs))

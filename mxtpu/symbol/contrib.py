@@ -1,0 +1,76 @@
+"""mx.sym.contrib: Symbol-level control flow (parity:
+python/mxnet/symbol/contrib.py foreach/while_loop/cond).
+
+Divergence (documented): the reference's symbolic control flow takes
+subgraph-BUILDING functions over Symbols and splices nnvm subgraphs; here
+the body is the same NDArray-level callable used imperatively — it is
+traced by lax.scan/lax.cond when the graph executes (Symbol execution
+dispatches to the same registry op).  One body, four execution modes
+(imperative / autograd / hybridize / Symbol-Executor).  The Symbol
+wrappers support single-output bodies (every reference example is one);
+multi-output bodies work through the flat multi-output Symbol directly:
+mx.sym.foreach(...)[i].
+"""
+
+from __future__ import annotations
+
+from .symbol import Symbol
+
+__all__ = ["foreach", "while_loop", "cond"]
+
+
+def _tolist(x):
+    if x is None:
+        return []
+    if isinstance(x, (list, tuple)):
+        return list(x)
+    return [x]
+
+
+def foreach(body, data, init_states, name=None):
+    """body(data_slice, states) -> (output NDArray, new_states).
+    Returns (stacked_outputs Symbol, final_states Symbol(s))."""
+    data_l = _tolist(data)
+    states_l = _tolist(init_states)
+    node = Symbol._create(
+        "foreach", data_l + states_l, tuple(data_l + states_l),
+        {"body": body, "num_data": len(data_l)}, name, None)
+    # static output count for graph-build-time slicing (single-output body)
+    node._node.num_outputs = 1 + len(states_l)
+    states = [node[1 + i] for i in range(len(states_l))]
+    # states mirror the nesting of init_states (same contract as nd.contrib)
+    if not isinstance(init_states, (list, tuple)):
+        states = states[0] if states else []
+    elif isinstance(init_states, tuple):
+        states = tuple(states)
+    return node[0], states
+
+
+def while_loop(cond, func, loop_vars, max_iterations=None, name=None):
+    """func(*loop_vars) -> (step_output NDArray, new_loop_vars).
+    Returns (stacked_outputs Symbol, final_loop_vars Symbol(s))."""
+    if max_iterations is None:
+        raise ValueError("max_iterations is required")
+    vars_l = _tolist(loop_vars)
+    node = Symbol._create(
+        "while_loop", vars_l, tuple(vars_l),
+        {"cond": cond, "func": func,
+         "max_iterations": int(max_iterations)}, name, None)
+    # (*outputs, *final_vars, n_steps) with a single-output func
+    node._node.num_outputs = 1 + len(vars_l) + 1
+    states = [node[1 + i] for i in range(len(vars_l))]
+    if not isinstance(loop_vars, (list, tuple)):
+        states = states[0]
+    elif isinstance(loop_vars, tuple):
+        states = tuple(states)
+    return node[0], states
+
+
+def cond(pred, then_func, else_func, inputs=None, name=None):
+    """Branch on scalar pred; branches receive *inputs as NDArrays."""
+    inputs_l = _tolist(inputs)
+    syms = [pred] + inputs_l
+    node = Symbol._create(
+        "cond", syms, tuple(syms),
+        {"then_func": then_func, "else_func": else_func}, name, None)
+    return node[0]

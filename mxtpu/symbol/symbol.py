@@ -190,6 +190,18 @@ class Symbol:
             names = self.list_outputs()
             idx = names.index(idx)
         entries = self._output_entries()
+        if (len(entries) == 1 and entries[0][0].num_outputs > 1
+                and entries[0][1] == 0):
+            # select among THIS node's outputs (multi-output op, e.g.
+            # split / control-flow): sym[i] -> i-th output.  Only from the
+            # base (index-0) symbol — an already-selected output indexes
+            # itself like any single-output symbol.
+            node, _ = entries[0]
+            if idx < 0:
+                idx += node.num_outputs
+            if not 0 <= idx < node.num_outputs:
+                raise IndexError(idx)
+            return Symbol(node, idx)
         node, base = entries[idx]
         return Symbol(node, base)
 
@@ -410,6 +422,16 @@ class Symbol:
     # -- save/load --------------------------------------------------------
     def tojson(self):
         order = self._topo()
+        for n in order:
+            for k, v in n.kwargs.items():
+                if callable(v):
+                    raise MXTPUError(
+                        "cannot serialize symbol graph: node %r has a "
+                        "Python-callable parameter %r (control-flow body). "
+                        "Rebuild via your sym_gen function instead of "
+                        "loading from JSON (reference subgraph "
+                        "serialization has no closure analogue here)"
+                        % (n.name, k))
         index = {id(n): i for i, n in enumerate(order)}
         nodes = []
         arg_nodes = []

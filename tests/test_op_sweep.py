@@ -268,6 +268,12 @@ SKIP = {
     "LogisticRegressionOutput": "same implicit-loss-gradient contract",
     "_internal_getitem": "internal indexing helper for NDArray.__getitem__;"
                          " exercised by tests/test_ndarray.py slicing",
+    "foreach": "takes a body callable (not arrays-only); value+gradient "
+               "covered by tests/test_control_flow.py",
+    "while_loop": "takes cond/func callables; value+gradient covered by "
+                  "tests/test_control_flow.py",
+    "cond": "takes branch callables; value+gradient covered by "
+            "tests/test_control_flow.py",
 }
 
 
