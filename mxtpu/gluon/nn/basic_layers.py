@@ -168,22 +168,43 @@ class Dropout(HybridBlock):
 
 
 class Embedding(HybridBlock):
-    """Index → vector lookup (parity: nn.Embedding).  sparse_grad accepted
-    but dense (sparse descoped v1, SURVEY §7)."""
+    """Index → vector lookup (parity: nn.Embedding).
+
+    sparse_grad=True gives the weight a row_sparse gradient: accumulation
+    stays dense on device (XLA scatter-add), but the parameter records the
+    touched row ids of every RECORDED eager forward (unioned until the
+    optimizer consumes the grad), so Parameter.grad() compacts to
+    (indices, values) and SGD updates only those rows — the reference's
+    large-embedding workflow (src/operator/tensor/indexing_op.cc
+    EmbeddingOpBackward row_sparse path) with TPU-native accumulation.
+    Constraints (as in the reference): the weight must not be shared with
+    dense-grad consumers, and hybridized forwards fall back to dense grads
+    (no ids are recordable under tracing — grad() then returns the dense
+    buffer, which is always exact)."""
 
     def __init__(self, input_dim, output_dim, dtype="float32",
                  weight_initializer=None, sparse_grad=False, **kwargs):
         super().__init__(**kwargs)
         self._input_dim = input_dim
         self._output_dim = output_dim
+        self._sparse_grad = sparse_grad
         with self.name_scope():
             self.weight = self.params.get(
                 "weight", shape=(input_dim, output_dim), dtype=dtype,
-                init=weight_initializer, allow_deferred_init=True)
+                init=weight_initializer, allow_deferred_init=True,
+                grad_stype="row_sparse" if sparse_grad else "default")
 
     def hybrid_forward(self, F, x, weight):
+        if self._sparse_grad and autograd.is_recording():
+            import jax
+            import jax.numpy as jnp
+            xd = x.data if hasattr(x, "data") else x
+            if not isinstance(xd, jax.core.Tracer):  # eager only
+                self.weight._accumulate_sparse_row_ids(
+                    jnp.unique(xd.astype(jnp.int32).ravel()))
         return F.Embedding(x, weight, input_dim=self._input_dim,
-                           output_dim=self._output_dim)
+                           output_dim=self._output_dim,
+                           sparse_grad=self._sparse_grad)
 
     def __repr__(self):
         return (f"{type(self).__name__}({self._input_dim} -> "

@@ -66,10 +66,18 @@ class Parameter:
             grad_req = "null"
         self._grad_req = None
         self.grad_req = grad_req
-        if stype != "default" or grad_stype != "default":
+        # row_sparse grad: gradients ACCUMULATE densely (XLA scatter-add is
+        # the TPU fast path) but are EXPOSED sparsely — grad() compacts to
+        # the touched rows recorded by the producing layer (Embedding
+        # sparse_grad), and the SGD update applies only those rows.
+        self._grad_stype = grad_stype
+        self._sparse_row_ids = None
+        if stype != "default":
             import warnings
-            warnings.warn("sparse stype is descoped in mxtpu v1; using dense "
-                          "(SURVEY.md §7)")
+            warnings.warn("sparse parameter stype is dense-backed in mxtpu "
+                          "(row_sparse grads ARE supported; SURVEY.md §7)")
+        if grad_stype not in ("default", "row_sparse"):
+            raise ValueError(f"unsupported grad_stype {grad_stype!r}")
 
     def __repr__(self):
         return f"Parameter {self.name} (shape={self.shape}, dtype={self.dtype})"
@@ -216,13 +224,48 @@ class Parameter:
             raise MXTPUError(
                 f"Cannot get gradient array for Parameter {self.name} "
                 "because grad_req='null'")
-        return self._check_and_get(self._grad, ctx)
+        g = self._check_and_get(self._grad, ctx)
+        return self._sparsify_grad(g)
 
     def list_grad(self) -> List[NDArray]:
         if self._data is not None and self._grad is None:
             raise MXTPUError(
                 f"Cannot get gradient array for Parameter {self.name} "
                 "because grad_req='null'")
+        return [self._sparsify_grad(g)
+                for g in self._check_and_get(self._grad, list)]
+
+    def _accumulate_sparse_row_ids(self, ids):
+        """Union newly touched rows into the pending id set (called by the
+        producing layer on every recorded eager forward; consumed —
+        reset — by the optimizer step / zero_grad)."""
+        import jax.numpy as jnp
+        if self._sparse_row_ids is None:
+            self._sparse_row_ids = NDArray(jnp.asarray(ids, jnp.int32))
+        else:
+            self._sparse_row_ids = NDArray(jnp.union1d(
+                self._sparse_row_ids.data, jnp.asarray(ids, jnp.int32)))
+
+    def _consume_sparse_row_ids(self):
+        self._sparse_row_ids = None
+
+    def _sparsify_grad(self, g):
+        """row_sparse grad view: compact the dense buffer onto the union
+        of rows touched since the last consume (exact — untouched rows
+        accumulated zero).  With no recorded ids (e.g. hybridized forward:
+        tracing records none) the dense buffer is returned — always
+        exact, just not compact."""
+        if self._grad_stype != "row_sparse" or self._sparse_row_ids is None:
+            return g
+        from ..ndarray.sparse import RowSparseNDArray
+        import jax.numpy as jnp
+        ids_j = self._sparse_row_ids.data
+        vals = jnp.take(g.data, ids_j, axis=0)
+        return RowSparseNDArray(NDArray(vals), NDArray(ids_j), g.shape)
+
+    def _list_dense_grad(self):
+        """Dense grad buffers for kvstore allreduce (the reduced result is
+        written back in place; sparse views are re-derived afterwards)."""
         return self._check_and_get(self._grad, list)
 
     def list_ctx(self) -> List[Context]:
@@ -252,6 +295,7 @@ class Parameter:
     def zero_grad(self):
         if self._grad is None:
             return
+        self._consume_sparse_row_ids()
         for g in self._grad:
             g._rebind(jnp.zeros(g.shape, g.data.dtype))
 

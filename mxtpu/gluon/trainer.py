@@ -202,9 +202,11 @@ class Trainer:
         for i, param in enumerate(self._params):
             if param.grad_req != "null":
                 idx = self._param2idx[param.name]
+                # dense buffers: the reduce writes back in place; sparse
+                # views are re-derived from the reduced buffer at update
+                dense = param._list_dense_grad()
                 if not self._update_on_kvstore:
-                    self._kvstore.pushpull(idx, param.list_grad(),
-                                           out=param.list_grad(),
+                    self._kvstore.pushpull(idx, dense, out=dense,
                                            priority=-i)
                 else:
                     self._kvstore.push(idx, param.list_grad(), priority=-i)
@@ -231,10 +233,12 @@ class Trainer:
                 # weights live in the store; pull them back
                 idx = self._param2idx[param.name]
                 self._kvstore.pull(idx, out=param.list_data(), priority=-i)
+                param._consume_sparse_row_ids()
                 continue
             for upd, arr, grad in zip(self._updaters, param.list_data(),
                                       param.list_grad()):
                 upd(i, grad, arr)
+            param._consume_sparse_row_ids()  # grad consumed: new id epoch
 
     def save_states(self, fname):
         """Save optimizer/updater states (parity: save_states)."""

@@ -91,6 +91,7 @@ class KVStore:
         return arr
 
     def push(self, key, value, priority=0):
+        from .ndarray.sparse import BaseSparseNDArray
         keys, values = _pairs(key, value, allow_list_of_lists=True)
         for k, vlist in zip(keys, values):
             k = _key2str(k)
@@ -98,6 +99,20 @@ class KVStore:
                 raise MXTPUError(f"key {k} has not been initialized")
             if not isinstance(vlist, (list, tuple)):
                 vlist = [vlist]
+            if (self._updater is not None and len(vlist) == 1
+                    and isinstance(vlist[0], BaseSparseNDArray)):
+                # update_on_kvstore with a row_sparse grad: hand the sparse
+                # grad to the updater so the LAZY update semantics match
+                # the update_on_kvstore=False path (parity: server-side
+                # sparse update in kvstore_dist_server.h)
+                w = NDArray(self._store[k])
+                self._updater(_updater_key(k), vlist[0], w)
+                self._store[k] = w.data
+                continue
+            # multi-device sparse pushes densify before the reduce (store
+            # is dense; row_sparse_pull re-sparsifies on the way out)
+            vlist = [v.todense() if isinstance(v, BaseSparseNDArray) else v
+                     for v in vlist]
             reduced = self._reduce(list(vlist))
             if self._updater is not None:
                 # update_on_kvstore: stored value is the weight; run updater
@@ -136,9 +151,43 @@ class KVStore:
             self.pull(key, out=out, priority=priority)
 
     def row_sparse_pull(self, key, out=None, priority=0, row_ids=None):
-        # sparse storage descoped v1 (SURVEY §7 hard-part 6): dense fallback
-        warnings.warn("row_sparse_pull: sparse descoped; dense pull instead")
-        self.pull(key, out=out, priority=priority)
+        """Pull only the requested rows as RowSparseNDArray(s) (parity:
+        KVStore.row_sparse_pull over kvstore_local.h row_sparse path).
+        ``row_ids``: int NDArray (or list of them, one per out)."""
+        if row_ids is None:
+            raise MXTPUError("row_sparse_pull requires row_ids")
+        from .ndarray.sparse import RowSparseNDArray
+        import jax.numpy as jnp
+        keys, _ = _pairs(key, key)
+        outs = list(out) if isinstance(out, (list, tuple)) else \
+            [out] * len(keys)
+        rids = list(row_ids) if isinstance(row_ids, (list, tuple)) else \
+            [row_ids] * len(keys)
+        if len(outs) != len(keys) or len(rids) != len(keys):
+            raise MXTPUError("row_sparse_pull: keys/out/row_ids lengths "
+                             "differ (%d/%d/%d)"
+                             % (len(keys), len(outs), len(rids)))
+        results = []
+        for k, o, rid in zip(keys, outs, rids):
+            dense = self._store.get(_key2str(k))  # raw jax array
+            if dense is None:
+                raise MXTPUError(f"key {k!r} not initialized")
+            ids = (rid.data if hasattr(rid, "data")
+                   else jnp.asarray(rid)).astype(jnp.int32).ravel()
+            ids = jnp.unique(ids)
+            vals = jnp.take(dense, ids, axis=0)
+            rs = RowSparseNDArray(NDArray(vals), NDArray(ids),
+                                  tuple(dense.shape))
+            if isinstance(o, RowSparseNDArray):
+                o._values = rs._values
+                o._indices = rs._indices
+                o._shape = rs._shape
+                results.append(o)
+            else:
+                results.append(rs)
+        single = not isinstance(key, (list, tuple)) and \
+            not isinstance(out, (list, tuple))
+        return results[0] if single else results
 
     def broadcast(self, key, value, out, priority=0):
         self.init(key, value)

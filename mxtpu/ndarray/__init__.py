@@ -37,6 +37,7 @@ for _name in list(_OP_REGISTRY):
 
 # after _make_op_fn exists (contrib reuses it for its flat op stubs)
 from . import contrib  # noqa: F401,E402
+from . import sparse  # noqa: F401,E402
 
 
 # legacy flat random-op names (mx.nd.random_uniform etc.)

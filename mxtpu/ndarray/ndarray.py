@@ -212,10 +212,15 @@ class NDArray:
         return NDArray(self._data.astype(jnp.dtype(dtype)), ctx=self._ctx)
 
     def tostype(self, stype):
-        if stype != "default":
-            raise MXTPUError("sparse storage is descoped in mxtpu v1 "
-                             "(dense fallback; see SURVEY.md §7)")
-        return self
+        if stype == "default":
+            return self
+        if stype == "row_sparse":
+            from .sparse import _dense_to_row_sparse
+            return _dense_to_row_sparse(self)
+        if stype == "csr":
+            from .sparse import csr_matrix
+            return csr_matrix(self)
+        raise MXTPUError(f"unknown storage type {stype!r}")
 
     # -- mutation --------------------------------------------------------
     def _check_inplace_record(self):

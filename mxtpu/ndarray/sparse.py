@@ -1,0 +1,264 @@
+"""Sparse NDArray storage (parity: python/mxnet/ndarray/sparse.py over
+src/ndarray/ndarray.cc kRowSparseStorage/kCSRStorage).
+
+TPU-native scope: XLA kernels are dense — the reference's motivation for
+row_sparse (skip untouched embedding rows in the optimizer update and on
+the wire) is served here by keeping COMPUTE dense under jit (XLA
+scatter-add is the fast path on TPU) while representing STORAGE and
+COMMUNICATION sparsely: RowSparseNDArray carries (indices, values) for
+gradients/pulls whose touched-row set is known (Embedding sparse_grad,
+kvstore row_sparse_pull), and the SGD update applies only those rows.
+CSRNDArray is the minimal read-side format (todense + dot).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as onp
+
+from ..base import MXTPUError
+from .ndarray import NDArray
+
+__all__ = ["RowSparseNDArray", "CSRNDArray", "row_sparse_array",
+           "csr_matrix", "array", "zeros"]
+
+
+class BaseSparseNDArray:
+    stype = "undefined"
+
+    # shared face with NDArray so metric/trainer code can stay generic
+    @property
+    def dtype(self):
+        return self.data.dtype
+
+    @property
+    def context(self):
+        return self.data.context
+
+    ctx = context
+
+    @property
+    def ndim(self):
+        return len(self.shape)
+
+    @property
+    def size(self):
+        n = 1
+        for s in self.shape:
+            n *= s
+        return n
+
+    def wait_to_read(self):
+        self.data.wait_to_read()
+
+    def asnumpy(self):
+        return self.todense().asnumpy()
+
+    def __repr__(self):
+        return "<%s %s @%s>" % (type(self).__name__, self.shape, self.stype)
+
+
+class RowSparseNDArray(BaseSparseNDArray):
+    """(indices, values) rows of a dense 2-D+ array (parity:
+    RowSparseNDArray).  indices: (nnz,) int32 sorted row ids; values:
+    (nnz, *row_shape)."""
+
+    stype = "row_sparse"
+
+    def __init__(self, values, indices, shape):
+        values = values if isinstance(values, NDArray) else NDArray(values)
+        indices = indices if isinstance(indices, NDArray) else \
+            NDArray(indices, dtype="int32")
+        if indices.ndim != 1:
+            raise MXTPUError("row_sparse indices must be 1-D row ids")
+        if values.shape[0] != indices.shape[0]:
+            raise MXTPUError("values/indices leading dims differ")
+        if tuple(values.shape[1:]) != tuple(shape[1:]):
+            raise MXTPUError("values row shape %s != dense row shape %s"
+                             % (values.shape[1:], shape[1:]))
+        self._values = values
+        self._indices = indices
+        self._shape = tuple(shape)
+
+    # -- reference surface ----------------------------------------------
+    @property
+    def data(self) -> NDArray:
+        return self._values
+
+    @property
+    def indices(self) -> NDArray:
+        return self._indices
+
+    @property
+    def shape(self):
+        return self._shape
+
+    def todense(self) -> NDArray:
+        dense = jnp.zeros(self._shape, self._values.data.dtype)
+        dense = dense.at[self._indices.data].add(self._values.data)
+        return NDArray(dense)
+
+    def tostype(self, stype):
+        if stype == "default":
+            return self.todense()
+        if stype == "row_sparse":
+            return self
+        raise MXTPUError(f"cannot convert row_sparse to {stype!r}")
+
+    def retain(self, row_ids) -> "RowSparseNDArray":
+        """Keep only the requested rows (parity: sparse.retain)."""
+        row_ids = row_ids if isinstance(row_ids, NDArray) else \
+            NDArray(row_ids, dtype="int32")
+        ids = onp.asarray(row_ids.data).astype("int64")
+        have = onp.asarray(self._indices.data).astype("int64")
+        pos = {int(r): i for i, r in enumerate(have)}
+        keep = [r for r in ids if int(r) in pos]
+        sel = jnp.asarray([pos[int(r)] for r in keep], jnp.int32)
+        vals = jnp.take(self._values.data, sel, axis=0) if keep else \
+            jnp.zeros((0,) + self._shape[1:], self._values.data.dtype)
+        return RowSparseNDArray(NDArray(vals),
+                                NDArray(jnp.asarray(keep, jnp.int32)),
+                                self._shape)
+
+    def copy(self):
+        return RowSparseNDArray(self._values.copy(), self._indices.copy(),
+                                self._shape)
+
+    def copyto(self, other):
+        if isinstance(other, RowSparseNDArray):
+            other._values = self._values.copy()
+            other._indices = self._indices.copy()
+            other._shape = self._shape
+            return other
+        return self.todense().copyto(other)
+
+    def astype(self, dtype):
+        return RowSparseNDArray(self._values.astype(dtype), self._indices,
+                                self._shape)
+
+    def as_in_context(self, ctx):
+        return RowSparseNDArray(self._values.as_in_context(ctx),
+                                self._indices.as_in_context(ctx),
+                                self._shape)
+
+
+class CSRNDArray(BaseSparseNDArray):
+    """Compressed-sparse-row 2-D array (parity: CSRNDArray; read-side
+    minimal: construct, todense, dot-with-dense via densify)."""
+
+    stype = "csr"
+
+    def __init__(self, data, indices, indptr, shape):
+        self._data = data if isinstance(data, NDArray) else NDArray(data)
+        self._indices = indices if isinstance(indices, NDArray) else \
+            NDArray(indices, dtype="int32")
+        self._indptr = indptr if isinstance(indptr, NDArray) else \
+            NDArray(indptr, dtype="int32")
+        self._shape = tuple(shape)
+
+    @property
+    def data(self):
+        return self._data
+
+    @property
+    def indices(self):
+        return self._indices
+
+    @property
+    def indptr(self):
+        return self._indptr
+
+    @property
+    def shape(self):
+        return self._shape
+
+    def todense(self) -> NDArray:
+        n_rows = self._shape[0]
+        indptr = onp.asarray(self._indptr.data)
+        rows = onp.repeat(onp.arange(n_rows), onp.diff(indptr))
+        dense = jnp.zeros(self._shape, self._data.data.dtype)
+        dense = dense.at[jnp.asarray(rows),
+                         self._indices.data].add(self._data.data)
+        return NDArray(dense)
+
+    def tostype(self, stype):
+        if stype == "default":
+            return self.todense()
+        if stype == "csr":
+            return self
+        raise MXTPUError(f"cannot convert csr to {stype!r}")
+
+
+# -- constructors ------------------------------------------------------------
+
+def row_sparse_array(arg1, shape=None, ctx=None, dtype=None):
+    """(data, indices) tuple, dense array, or RowSparseNDArray →
+    RowSparseNDArray (parity: sparse.row_sparse_array)."""
+    if isinstance(arg1, RowSparseNDArray):
+        return arg1
+    if isinstance(arg1, tuple) and len(arg1) == 2:
+        data, indices = arg1
+        data = data if isinstance(data, NDArray) else NDArray(
+            data, dtype=dtype)
+        if shape is None:
+            raise MXTPUError("shape is required for (data, indices) input")
+        return RowSparseNDArray(data, indices, shape)
+    dense = arg1 if isinstance(arg1, NDArray) else NDArray(arg1, dtype=dtype)
+    return _dense_to_row_sparse(dense)
+
+
+def _dense_to_row_sparse(dense: NDArray) -> RowSparseNDArray:
+    arr = onp.asarray(dense.data)
+    nz = onp.nonzero(arr.reshape(arr.shape[0], -1).any(axis=1))[0]
+    vals = jnp.take(dense.data, jnp.asarray(nz, jnp.int32), axis=0)
+    return RowSparseNDArray(NDArray(vals),
+                            NDArray(jnp.asarray(nz, jnp.int32)),
+                            dense.shape)
+
+
+def csr_matrix(arg1, shape=None, ctx=None, dtype=None):
+    """(data, indices, indptr) tuple or dense → CSRNDArray."""
+    if isinstance(arg1, CSRNDArray):
+        return arg1
+    if isinstance(arg1, tuple) and len(arg1) == 3:
+        data, indices, indptr = arg1
+        if shape is None:
+            raise MXTPUError("shape is required for (data,indices,indptr)")
+        return CSRNDArray(data, indices, indptr, shape)
+    dense = arg1 if isinstance(arg1, NDArray) else NDArray(arg1, dtype=dtype)
+    arr = onp.asarray(dense.data)
+    if arr.ndim != 2:
+        raise MXTPUError("csr_matrix requires a 2-D input")
+    indptr = [0]
+    cols = []
+    vals = []
+    for row in arr:
+        nz = onp.nonzero(row)[0]
+        cols.extend(nz.tolist())
+        vals.extend(row[nz].tolist())
+        indptr.append(len(cols))
+    return CSRNDArray(NDArray(onp.asarray(vals, arr.dtype)),
+                      NDArray(onp.asarray(cols, "int32")),
+                      NDArray(onp.asarray(indptr, "int32")),
+                      dense.shape)
+
+
+def array(source_array, ctx=None, dtype=None):
+    """parity: mx.nd.sparse.array — passthrough constructor."""
+    if isinstance(source_array, (RowSparseNDArray, CSRNDArray)):
+        return source_array
+    raise MXTPUError("use row_sparse_array/csr_matrix for dense input "
+                     "(stype is ambiguous)")
+
+
+def zeros(stype, shape, ctx=None, dtype="float32"):
+    if stype == "row_sparse":
+        return RowSparseNDArray(
+            NDArray(jnp.zeros((0,) + tuple(shape[1:]), jnp.dtype(dtype))),
+            NDArray(jnp.zeros((0,), jnp.int32)), shape)
+    if stype == "csr":
+        return CSRNDArray(NDArray(jnp.zeros((0,), jnp.dtype(dtype))),
+                          NDArray(jnp.zeros((0,), jnp.int32)),
+                          NDArray(jnp.zeros((shape[0] + 1,), jnp.int32)),
+                          shape)
+    raise MXTPUError(f"unknown sparse stype {stype!r}")

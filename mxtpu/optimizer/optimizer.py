@@ -128,7 +128,49 @@ class Optimizer:
         weight._rebind(new_w)
         return new_state
 
+    def _update_row_sparse(self, index, weight, grad, state):
+        """Row-sparse (lazy) update: run the optimizer's OWN update() on
+        views of the touched rows only, then scatter the results back
+        (parity: sparse sgd_update / lazy adam semantics — state rows of
+        untouched ids do not advance).  Row-local rules get this fast
+        path; cross-row rules (LAMB/LARS global norms, ...) densify the
+        gradient instead (exact, documented fallback)."""
+        from ..ndarray.ndarray import NDArray
+        if not self._row_sparse_safe():
+            # cross-row rules (LAMB/LARS global norms, ...): exact dense
+            # fallback through the normal (multi-precision-aware) entry
+            return self.update_multi_precision(index, weight,
+                                               grad.todense(), state)
+        ids = grad.indices.data
+        wnd = weight.data
+        rows = NDArray(jnp.take(wnd, ids, axis=0))
+        is_rowwise = lambda s: getattr(s, "ndim", -1) == wnd.ndim and \
+            s.shape[0] == wnd.shape[0]  # noqa: E731
+        row_state = jax.tree_util.tree_map(
+            lambda s: jnp.take(s, ids, axis=0) if is_rowwise(s) else s,
+            state)
+        new_row_state = self.update(index, rows, grad.data, row_state)
+        weight._rebind(wnd.at[ids].set(rows.data.astype(wnd.dtype)))
+        return jax.tree_util.tree_map(
+            lambda s, nrs: s.at[ids].set(nrs) if is_rowwise(s) else nrs,
+            state, new_row_state)
+
+    def _row_sparse_safe(self):
+        """Whether the update rule is row-local (no cross-row coupling),
+        making the lazy row update equal to the reference's sparse path."""
+        return type(self).__name__ in ("SGD", "NAG", "Adam", "AdamW",
+                                       "AdaGrad", "RMSProp")
+
     def update_multi_precision(self, index, weight, grad, state):
+        from ..ndarray.sparse import RowSparseNDArray
+        if isinstance(grad, RowSparseNDArray):
+            if self.multi_precision and weight.data.dtype == jnp.bfloat16:
+                # multi-precision state is (w32, inner): the lazy row path
+                # would thread the tuple into the rule — densify instead
+                # (exact, just not lazy; rare combo)
+                return self.update_multi_precision(index, weight,
+                                                   grad.todense(), state)
+            return self._update_row_sparse(index, weight, grad, state)
         if self.multi_precision and weight.data.dtype == jnp.bfloat16:
             w32, inner = state
             g32 = grad.data.astype(jnp.float32)

@@ -1,0 +1,235 @@
+"""Row-sparse storage tests (VERDICT r2 task 6; parity:
+tests/python/unittest/test_sparse_ndarray.py / test_sparse_operator.py
+core behaviors: RowSparseNDArray round-trips, Embedding(sparse_grad=True)
+training matching dense numerics, kvstore row_sparse_pull)."""
+
+import numpy as onp
+import pytest
+
+import mxtpu as mx
+from mxtpu import gluon
+from mxtpu.gluon import nn
+from mxtpu.ndarray import sparse
+
+
+def test_row_sparse_construct_and_todense():
+    vals = onp.array([[1.0, 2.0], [3.0, 4.0]], "float32")
+    ids = onp.array([1, 3], "int32")
+    rs = sparse.row_sparse_array((vals, ids), shape=(5, 2))
+    assert rs.stype == "row_sparse"
+    assert rs.shape == (5, 2)
+    dense = rs.todense().asnumpy()
+    want = onp.zeros((5, 2), "float32")
+    want[[1, 3]] = vals
+    onp.testing.assert_array_equal(dense, want)
+    onp.testing.assert_array_equal(rs.asnumpy(), want)
+    onp.testing.assert_array_equal(rs.indices.asnumpy(), ids)
+    onp.testing.assert_array_equal(rs.data.asnumpy(), vals)
+
+
+def test_dense_row_sparse_round_trip():
+    d = onp.zeros((6, 3), "float32")
+    d[2] = 1.5
+    d[5] = -2.0
+    nd = mx.nd.array(d)
+    rs = nd.tostype("row_sparse")
+    assert rs.stype == "row_sparse"
+    onp.testing.assert_array_equal(rs.indices.asnumpy(), [2, 5])
+    onp.testing.assert_array_equal(rs.tostype("default").asnumpy(), d)
+
+
+def test_retain():
+    vals = onp.arange(8, dtype="float32").reshape(4, 2)
+    rs = sparse.row_sparse_array((vals, onp.array([0, 2, 4, 6], "int32")),
+                                 shape=(8, 2))
+    kept = rs.retain(mx.nd.array([2, 3, 6], dtype="int32"))
+    onp.testing.assert_array_equal(kept.indices.asnumpy(), [2, 6])
+    onp.testing.assert_array_equal(kept.data.asnumpy(), vals[[1, 3]])
+
+
+def test_csr_round_trip():
+    d = onp.array([[0, 1, 0], [2, 0, 3], [0, 0, 0]], "float32")
+    csr = sparse.csr_matrix(mx.nd.array(d))
+    assert csr.stype == "csr"
+    onp.testing.assert_array_equal(csr.todense().asnumpy(), d)
+    onp.testing.assert_array_equal(csr.indptr.asnumpy(), [0, 1, 3, 3])
+
+
+def test_sparse_zeros():
+    z = sparse.zeros("row_sparse", (4, 3))
+    assert z.indices.shape == (0,)
+    onp.testing.assert_array_equal(z.asnumpy(), onp.zeros((4, 3)))
+
+
+def _train_embedding(sparse_grad, optimizer="sgd", steps=5, **opt_kw):
+    mx.random.seed(42)
+    emb = nn.Embedding(20, 4, sparse_grad=sparse_grad)
+    emb.initialize()
+    trainer = gluon.Trainer(emb.collect_params(), optimizer,
+                            {"learning_rate": 0.5, **opt_kw})
+    rng = onp.random.RandomState(0)
+    for _ in range(steps):
+        x = mx.nd.array(rng.randint(0, 20, (8,)), dtype="int32")
+        tgt = mx.nd.array(rng.rand(8, 4).astype("float32"))
+        with mx.autograd.record():
+            out = emb(x)
+            loss = ((out - tgt) ** 2).mean()
+        loss.backward()
+        trainer.step(1)
+    return emb.weight.data().asnumpy()
+
+
+def test_sparse_grad_embedding_matches_dense_sgd():
+    w_dense = _train_embedding(False, "sgd", wd=0.0)
+    w_sparse = _train_embedding(True, "sgd", wd=0.0)
+    onp.testing.assert_allclose(w_sparse, w_dense, rtol=1e-5, atol=1e-6)
+
+
+def test_sparse_grad_embedding_matches_dense_adam_touched_rows():
+    """Adam lazy update advances only touched rows; rows touched in every
+    step match the dense run exactly when all rows are touched."""
+    mx.random.seed(1)
+
+    def run(sparse_grad):
+        emb = nn.Embedding(6, 3, sparse_grad=sparse_grad)
+        emb.initialize(mx.init.Xavier())
+        trainer = gluon.Trainer(emb.collect_params(), "adam",
+                                {"learning_rate": 0.1, "wd": 0.0})
+        for _ in range(4):
+            x = mx.nd.array(onp.arange(6), dtype="int32")  # all rows
+            with mx.autograd.record():
+                loss = (emb(x) ** 2).sum()
+            loss.backward()
+            trainer.step(1)
+        return emb.weight.data().asnumpy()
+
+    mx.random.seed(7)
+    a = run(False)
+    mx.random.seed(7)
+    b = run(True)
+    onp.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-6)
+
+
+def test_sparse_grad_view_has_touched_rows_only():
+    emb = nn.Embedding(10, 2, sparse_grad=True)
+    emb.initialize()
+    x = mx.nd.array([1, 1, 7], dtype="int32")
+    with mx.autograd.record():
+        loss = emb(x).sum()
+    loss.backward()
+    g = emb.weight.grad()
+    assert g.stype == "row_sparse"
+    onp.testing.assert_array_equal(g.indices.asnumpy(), [1, 7])
+    onp.testing.assert_allclose(g.data.asnumpy(),
+                                [[2.0, 2.0], [1.0, 1.0]])
+
+
+def test_kvstore_row_sparse_pull():
+    kv = mx.kv.create("local")
+    w = onp.random.RandomState(0).rand(8, 3).astype("float32")
+    kv.init("emb", mx.nd.array(w))
+    rs = kv.row_sparse_pull("emb", row_ids=mx.nd.array([5, 1, 5],
+                                                       dtype="int32"))
+    onp.testing.assert_array_equal(rs.indices.asnumpy(), [1, 5])
+    onp.testing.assert_allclose(rs.data.asnumpy(), w[[1, 5]], rtol=1e-6)
+    # out= RowSparseNDArray is filled in place
+    out = sparse.zeros("row_sparse", (8, 3))
+    kv.row_sparse_pull("emb", out=out, row_ids=mx.nd.array([0, 2]))
+    onp.testing.assert_allclose(out.data.asnumpy(), w[[0, 2]], rtol=1e-6)
+
+
+def test_row_sparse_pull_requires_row_ids():
+    kv = mx.kv.create("local")
+    kv.init("k", mx.nd.ones((4, 2)))
+    with pytest.raises(mx.base.MXTPUError):
+        kv.row_sparse_pull("k")
+
+
+def test_sparse_row_ids_union_across_microbatches():
+    """grad_req='add' micro-batching: ids union, none dropped (review)."""
+    emb = nn.Embedding(10, 2, sparse_grad=True)
+    emb.initialize()
+    emb.weight.grad_req = "add"
+    for batch in ([1, 2], [7]):
+        with mx.autograd.record():
+            loss = emb(mx.nd.array(batch, dtype="int32")).sum()
+        loss.backward()
+    g = emb.weight.grad()
+    onp.testing.assert_array_equal(g.indices.asnumpy(), [1, 2, 7])
+    # an eager INFERENCE forward between backward and step must not
+    # pollute the id set (ids only recorded while recording)
+    emb(mx.nd.array([9], dtype="int32"))
+    onp.testing.assert_array_equal(
+        emb.weight.grad().indices.asnumpy(), [1, 2, 7])
+    emb.weight.zero_grad()
+    assert emb.weight._sparse_row_ids is None
+
+
+def test_sparse_grad_dense_fallback_without_ids():
+    """No recorded ids (e.g. hybridized forward) -> dense grad (exact)."""
+    emb = nn.Embedding(5, 2, sparse_grad=True)
+    emb.initialize()
+    emb.weight._sparse_row_ids = None
+    x = mx.nd.array([0, 1], dtype="int32")
+    with mx.autograd.record():
+        loss = emb(x).sum()
+    loss.backward()
+    emb.weight._sparse_row_ids = None  # simulate tracer-only forward
+    g = emb.weight.grad()
+    assert not hasattr(g, "stype") or g.stype == "default"
+    assert g.shape == (5, 2)
+
+
+def test_row_sparse_pull_multi_key():
+    kv = mx.kv.create("local")
+    a = onp.random.RandomState(1).rand(4, 2).astype("float32")
+    b = onp.random.RandomState(2).rand(6, 2).astype("float32")
+    kv.init("a", mx.nd.array(a))
+    kv.init("b", mx.nd.array(b))
+    res = kv.row_sparse_pull(["a", "b"],
+                             row_ids=[mx.nd.array([0], dtype="int32"),
+                                      mx.nd.array([5], dtype="int32")])
+    assert len(res) == 2
+    onp.testing.assert_allclose(res[0].data.asnumpy(), a[[0]], rtol=1e-6)
+    onp.testing.assert_allclose(res[1].data.asnumpy(), b[[5]], rtol=1e-6)
+
+
+def test_update_on_kvstore_sparse_matches_local():
+    """The kvstore-updater path applies the same LAZY update as the
+    local path (review finding: no silent densify divergence)."""
+    def run(update_on_kvstore):
+        mx.random.seed(5)
+        emb = nn.Embedding(8, 2, sparse_grad=True)
+        emb.initialize()
+        kv = "device" if update_on_kvstore else None
+        tr = gluon.Trainer(emb.collect_params(), "adam",
+                           {"learning_rate": 0.2, "wd": 0.01},
+                           kvstore=kv, update_on_kvstore=update_on_kvstore)
+        for _ in range(3):
+            x = mx.nd.array([1, 4], dtype="int32")
+            with mx.autograd.record():
+                loss = (emb(x) ** 2).sum()
+            loss.backward()
+            tr.step(1)
+        return emb.weight.data().asnumpy()
+
+    a = run(False)
+    # single-ctx trainer never creates a kvstore; exercise the updater
+    # path directly instead
+    mx.random.seed(5)
+    emb = nn.Embedding(8, 2, sparse_grad=True)
+    emb.initialize()
+    kv = mx.kv.create("local")
+    kv.init(0, emb.weight.data())
+    opt = mx.optimizer.create("adam", learning_rate=0.2, wd=0.01)
+    kv.set_optimizer(opt)
+    for _ in range(3):
+        x = mx.nd.array([1, 4], dtype="int32")
+        with mx.autograd.record():
+            loss = (emb(x) ** 2).sum()
+        loss.backward()
+        kv.push(0, emb.weight.grad())
+        kv.pull(0, out=emb.weight.data())
+        emb.weight._consume_sparse_row_ids()
+    onp.testing.assert_allclose(emb.weight.data().asnumpy(), a,
+                                rtol=1e-5, atol=1e-6)
