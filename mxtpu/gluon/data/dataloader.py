@@ -9,6 +9,7 @@ replacing the reference's C++ PrefetcherIter double buffer
 overlaps input processing with TPU compute via JAX async dispatch.
 """
 
+import contextlib
 import multiprocessing
 import os
 import queue as _queue
@@ -51,6 +52,74 @@ def default_mp_batchify_fn(data):
 
 _worker_dataset = None
 _worker_batchify = None
+
+_pool_ctx_lock = threading.Lock()
+_pool_ctx = None
+
+_SANITIZE_ENV = {"PALLAS_AXON_POOL_IPS": "", "JAX_PLATFORMS": "cpu"}
+
+
+@contextlib.contextmanager
+def _sanitized_env():
+    """Temporarily pin the env keys that make a child interpreter skip the
+    TPU plugin (sitecustomize register() is keyed on PALLAS_AXON_POOL_IPS)
+    and use host CPU for any incidental jax work.  Callers hold
+    _pool_ctx_lock, so the mutate-restore window is serialized."""
+    saved = {k: os.environ.get(k) for k in _SANITIZE_ENV}
+    os.environ.update(_SANITIZE_ENV)
+    try:
+        yield
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+
+def _get_pool_context():
+    """multiprocessing context for worker pools, created once.
+
+    forkserver (not fork): forking a process whose JAX runtime has live
+    threads deadlocks (JAX warns on os.fork); the forkserver parent is
+    launched clean, so workers never inherit JAX state.  The forkserver is
+    started HERE, exactly once, under the sanitized env — all future
+    workers fork from it and inherit that env, so pool creation never
+    mutates the parent env again (the round-2 mutate-restore around every
+    Pool() raced concurrent jax importers).  If some other library already
+    started the forkserver with the live TPU env, starting it again can't
+    fix its env — fall back to spawn, whose children re-read the parent
+    env at spawn time (sanitized per-pool in _make_worker_pool).
+    """
+    global _pool_ctx
+    with _pool_ctx_lock:
+        if _pool_ctx is not None:
+            return _pool_ctx
+        methods = multiprocessing.get_all_start_methods()
+        if "forkserver" in methods:
+            from multiprocessing import forkserver as _fs
+            already = getattr(_fs._forkserver, "_forkserver_pid",
+                              None) is not None
+            if not already:
+                with _sanitized_env():
+                    _fs._forkserver.ensure_running()
+                _pool_ctx = ("forkserver",
+                             multiprocessing.get_context("forkserver"))
+                return _pool_ctx
+        _pool_ctx = ("spawn", multiprocessing.get_context("spawn"))
+        return _pool_ctx
+
+
+def _make_worker_pool(num_workers, initializer, initargs):
+    method, ctx = _get_pool_context()
+    if method == "forkserver":  # env pinned in the forkserver: no mutation
+        return ctx.Pool(num_workers, initializer=initializer,
+                        initargs=initargs)
+    # spawn: children re-read env at spawn time, so a sanitized window is
+    # unavoidable — serialized under the lock to keep it race-free.
+    with _pool_ctx_lock, _sanitized_env():
+        return ctx.Pool(num_workers, initializer=initializer,
+                        initargs=initargs)
 
 
 def _worker_init(dataset, batchify_fn):
@@ -243,31 +312,9 @@ class DataLoader:
                 from multiprocessing.pool import ThreadPool
                 self._pool = ThreadPool(self._num_workers)
             else:
-                # forkserver (not fork): forking a process whose JAX runtime
-                # has live threads deadlocks (JAX warns on os.fork); the
-                # forkserver parent is launched clean, so workers never
-                # inherit JAX state.  The sanitized env below makes worker
-                # interpreters skip the TPU plugin (sitecustomize register()
-                # is keyed on PALLAS_AXON_POOL_IPS) and pin any incidental
-                # jax use to host CPU — decode/augment is host work, like
-                # the reference's CPU decode threads (iter_image_recordio_2).
-                methods = multiprocessing.get_all_start_methods()
-                ctx = multiprocessing.get_context(
-                    "forkserver" if "forkserver" in methods else "spawn")
-                sanitize = {"PALLAS_AXON_POOL_IPS": "", "JAX_PLATFORMS": "cpu"}
-                saved = {k: os.environ.get(k) for k in sanitize}
-                os.environ.update(sanitize)
-                try:
-                    self._pool = ctx.Pool(
-                        self._num_workers,
-                        initializer=_worker_init,
-                        initargs=(self._dataset, self._batchify_fn))
-                finally:
-                    for k, v in saved.items():
-                        if v is None:
-                            os.environ.pop(k, None)
-                        else:
-                            os.environ[k] = v
+                self._pool = _make_worker_pool(
+                    self._num_workers, _worker_init,
+                    (self._dataset, self._batchify_fn))
 
     def _single_process_iter(self):
         for batch_idx in self._batch_sampler:
@@ -299,17 +346,23 @@ class DataLoader:
                 out = res.get()
                 yield _from_shared(out) if not self._thread_pool else out
         finally:
-            # consumer abandoned us: claim in-flight results so their
-            # shared-memory segments are unlinked, not leaked.  Short
-            # timeout + bail on first miss: the pool may already be
-            # terminated (GC finalization order is arbitrary) and a dead
-            # pool never completes its results.
+            # consumer abandoned us: claim EVERY in-flight result so its
+            # shared-memory segments are unlinked, not leaked.  A slow
+            # batch (>1s decode) must not abort the drain — later results
+            # may already be sitting complete (continue, don't break); but
+            # a terminated pool (GC finalization order is arbitrary) never
+            # completes anything, so stop once the pool is known dead.
+            pool_alive = not self._thread_pool
             for res in pending:
-                try:
-                    if not self._thread_pool:
-                        _from_shared(res.get(timeout=1))
-                except Exception:
-                    break
+                while pool_alive:
+                    try:
+                        _from_shared(res.get(timeout=5))
+                        break
+                    except multiprocessing.TimeoutError:
+                        if getattr(self._pool, "_state", "RUN") != "RUN":
+                            pool_alive = False  # dead: nothing completes
+                    except Exception:
+                        break  # worker error: no segment was shipped
 
     def __iter__(self):
         source = (self._multi_worker_iter() if self._pool is not None

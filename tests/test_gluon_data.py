@@ -160,3 +160,40 @@ def test_dataloader_shm_transport_and_abandonment():
         break
     del dl
     gc.collect()
+
+
+def test_dataloader_forkserver_regression():
+    """Round-1 regression: forking a JAX-initialized parent deadlocked the
+    worker pool.  The fix (forkserver/spawn + sanitized child env,
+    dataloader.py) must (a) not deadlock — guarded by SIGALRM here,
+    (b) leave the parent env untouched, (c) give bit-identical batches to
+    the single-process path, with the runtime demonstrably live first."""
+    import os
+    import signal
+
+    import jax
+
+    jax.numpy.ones(8).block_until_ready()  # JAX runtime live in parent
+    watched = ("JAX_PLATFORMS", "PALLAS_AXON_POOL_IPS", "XLA_FLAGS")
+    env_before = {k: os.environ.get(k) for k in watched}
+
+    ds = ArrayDataset(np.random.RandomState(0).rand(48, 6).astype("float32"),
+                      np.arange(48).astype("float32"))
+    old = signal.signal(signal.SIGALRM,
+                        lambda *a: (_ for _ in ()).throw(
+                            TimeoutError("DataLoader deadlocked")))
+    signal.alarm(180)
+    try:
+        got = [(x.asnumpy(), y.asnumpy())
+               for x, y in DataLoader(ds, 8, num_workers=2)]
+    finally:
+        signal.alarm(0)
+        signal.signal(signal.SIGALRM, old)
+
+    assert {k: os.environ.get(k) for k in watched} == env_before
+    ref = [(x.asnumpy(), y.asnumpy())
+           for x, y in DataLoader(ds, 8, num_workers=0)]
+    assert len(got) == len(ref) == 6
+    for (xa, ya), (xb, yb) in zip(got, ref):
+        np.testing.assert_array_equal(xa, xb)
+        np.testing.assert_array_equal(ya, yb)

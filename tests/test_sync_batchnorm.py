@@ -1,0 +1,86 @@
+"""SyncBatchNorm under a dp-sharded batch (VERDICT r2: prove the alias).
+
+Parity: src/operator/contrib/sync_batch_norm.cc — the reference needs an
+explicit cross-GPU reduction op for global batch statistics.  The TPU
+design claims plain BatchNorm IS SyncBatchNorm under GSPMD: jnp reductions
+over a batch-sharded array are semantically global, XLA inserts the
+all-reduce.  These tests make the shards statistically different, so a
+per-shard-stats implementation would fail the comparison hard.
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+import mxtpu as mx
+from mxtpu import autograd, nd
+from mxtpu.ndarray import NDArray
+from mxtpu.gluon.contrib.nn import SyncBatchNorm
+from mxtpu.parallel import make_mesh
+
+
+def _skewed_batch(n=16, c=4, hw=5):
+    """Each sample shifted by its index → every dp shard has a different
+    mean, so local-stats BN diverges from global-stats BN by >1."""
+    rng = np.random.RandomState(0)
+    x = rng.randn(n, c, hw, hw).astype(np.float32)
+    x += np.arange(n, dtype=np.float32).reshape(n, 1, 1, 1)
+    return x
+
+
+def _fresh(c=4):
+    net = SyncBatchNorm(in_channels=c)
+    net.initialize()
+    return net
+
+
+def test_sync_batchnorm_dp_sharded_matches_global_stats():
+    mesh = make_mesh(dp=8)
+    x = _skewed_batch()
+
+    ref_net = _fresh()
+    with autograd.train_mode():
+        ref = ref_net(nd.array(x)).asnumpy()
+    rm_ref = ref_net.running_mean.data().asnumpy().copy()
+    rv_ref = ref_net.running_var.data().asnumpy().copy()
+
+    net = _fresh()
+    xs = NDArray(jax.device_put(jnp.asarray(x),
+                                NamedSharding(mesh.jax_mesh, P("dp"))))
+    with autograd.train_mode():
+        out = net(xs)
+    np.testing.assert_allclose(out.asnumpy(), ref, rtol=1e-5, atol=1e-5)
+    # running stats also reduced over the GLOBAL batch
+    np.testing.assert_allclose(net.running_mean.data().asnumpy(), rm_ref,
+                               rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(net.running_var.data().asnumpy(), rv_ref,
+                               rtol=1e-5, atol=1e-6)
+    # sanity: a per-shard-stats result would differ wildly from ref
+    local = np.concatenate([
+        (s - s.mean(axis=(0, 2, 3), keepdims=True))
+        / np.sqrt(s.var(axis=(0, 2, 3), keepdims=True) + 1e-5)
+        for s in np.split(x, 8)])
+    assert np.abs(local - ref).max() > 0.5
+
+
+def test_sync_batchnorm_dp_sharded_gradients_match():
+    """Backward through the sharded batch matches single-device backward
+    (the reference syncs grads of the stats too)."""
+    mesh = make_mesh(dp=4)
+    x = _skewed_batch(n=8)
+
+    def run(arr):
+        net = _fresh()
+        xs = NDArray(arr)
+        xs.attach_grad()
+        with autograd.record():
+            y = net(xs)
+            loss = (y * y).sum()
+        loss.backward()
+        return xs.grad.asnumpy()
+
+    g_ref = run(jnp.asarray(x))
+    g_sh = run(jax.device_put(jnp.asarray(x),
+                              NamedSharding(mesh.jax_mesh, P("dp"))))
+    np.testing.assert_allclose(g_sh, g_ref, rtol=1e-4, atol=1e-5)
