@@ -55,6 +55,8 @@ _LAZY = {
     "numpy": ".numpy",
     "npx": ".numpy_extension",
     "numpy_extension": ".numpy_extension",
+    "contrib": ".contrib",
+    "preemption": ".preemption",
 }
 
 

@@ -1,0 +1,551 @@
+"""Image API (parity: python/mxnet/image/image.py).
+
+Host-side JPEG decode + augmentation over OpenCV (same substrate as the
+reference's src/io/image_aug_default.cc), producing HWC uint8/float arrays
+that the DataLoader prefetcher stages onto the TPU. The C++ threaded
+ImageRecordIter pipeline (src/io/iter_image_recordio_2.cc) maps to
+ImageIter + DataLoader worker processes here.
+"""
+
+import os
+import random as pyrandom
+
+import numpy as onp
+
+from . import ndarray as nd
+from .ndarray import NDArray
+
+try:
+    import cv2
+    _HAS_CV2 = True
+except ImportError:  # PIL fallback
+    cv2 = None
+    _HAS_CV2 = False
+
+__all__ = ["imdecode", "imread", "imresize", "imresize_np", "resize_short",
+           "fixed_crop", "random_crop", "center_crop", "color_normalize",
+           "random_size_crop", "scale_down", "copyMakeBorder",
+           "Augmenter", "SequentialAug", "RandomOrderAug", "ResizeAug",
+           "ForceResizeAug", "RandomCropAug", "RandomSizedCropAug",
+           "CenterCropAug", "HorizontalFlipAug", "CastAug",
+
+        "BrightnessJitterAug", "ContrastJitterAug", "SaturationJitterAug",
+           "HueJitterAug", "ColorJitterAug", "LightingAug", "ColorNormalizeAug",
+           "CreateAugmenter", "ImageIter"]
+
+_INTERP = {0: 0, 1: 1, 2: 2, 3: 3, 4: 4}  # cv2 interpolation enums match
+
+
+def _cv2_interp(interp, src_shape=None, out_size=None):
+    if interp == 9:  # auto: cubic for enlarge, area for shrink
+        if src_shape is None or out_size is None:
+            return 1
+        h, w = src_shape[:2]
+        ow, oh = out_size
+        return 2 if (ow > w or oh > h) else 3
+    if interp == 10:
+        return pyrandom.randint(0, 4)
+    return _INTERP.get(interp, 1)
+
+
+def imdecode(buf, flag=1, to_rgb=True, out=None):
+    """Decode an encoded (JPEG/PNG) byte buffer to an HWC uint8 NDArray."""
+    if isinstance(buf, NDArray):
+        buf = buf.asnumpy().tobytes()
+    data = onp.frombuffer(bytes(buf), dtype=onp.uint8)
+    if _HAS_CV2:
+        img = cv2.imdecode(data, cv2.IMREAD_COLOR if flag else
+                           cv2.IMREAD_GRAYSCALE)
+        if img is None:
+            raise ValueError("Failed to decode image buffer")
+        if flag and to_rgb:
+            img = cv2.cvtColor(img, cv2.COLOR_BGR2RGB)
+        if not flag:
+            img = img[:, :, None]
+    else:
+        import io as _io
+        from PIL import Image
+        img = onp.asarray(Image.open(_io.BytesIO(bytes(buf))).convert(
+            "RGB" if flag else "L"))
+        if not flag:
+            img = img[:, :, None]
+    return nd.array(img, dtype="uint8")
+
+
+def imread(filename, flag=1, to_rgb=True):
+    with open(filename, "rb") as f:
+        return imdecode(f.read(), flag, to_rgb)
+
+
+def imresize_np(src, w, h, interp=1):
+    """numpy HWC resize — host-side helper used by transforms."""
+    src = onp.asarray(src)
+    if _HAS_CV2:
+        out = cv2.resize(src, (w, h),
+                         interpolation=_cv2_interp(interp, src.shape, (w, h)))
+        if out.ndim == 2:
+            out = out[:, :, None]
+        return out
+    from PIL import Image
+    squeeze = src.shape[-1] == 1
+    img = Image.fromarray(src[..., 0] if squeeze else src)
+    out = onp.asarray(img.resize((w, h)))
+    return out[:, :, None] if squeeze else out
+
+
+def imresize(src, w, h, interp=1):
+    return nd.array(imresize_np(_np(src), w, h, interp))
+
+
+def _np(x):
+    return x.asnumpy() if isinstance(x, NDArray) else onp.asarray(x)
+
+
+def resize_short(src, size, interp=2):
+    a = _np(src)
+    h, w = a.shape[:2]
+    if h > w:
+        new_h, new_w = size * h // w, size
+    else:
+        new_h, new_w = size, size * w // h
+    return nd.array(imresize_np(a, new_w, new_h, interp))
+
+
+def scale_down(src_size, size):
+    w, h = size
+    sw, sh = src_size
+    if sh < h:
+        w, h = float(w * sh) / h, sh
+    if sw < w:
+        w, h = sw, float(h * sw) / w
+    return int(w), int(h)
+
+
+def fixed_crop(src, x0, y0, w, h, size=None, interp=2):
+    a = _np(src)[y0:y0 + h, x0:x0 + w]
+    if size is not None and (w, h) != size:
+        a = imresize_np(a, size[0], size[1], interp)
+    return nd.array(a)
+
+
+def random_crop(src, size, interp=2):
+    a = _np(src)
+    h, w = a.shape[:2]
+    new_w, new_h = scale_down((w, h), size)
+    x0 = pyrandom.randint(0, w - new_w)
+    y0 = pyrandom.randint(0, h - new_h)
+    out = fixed_crop(a, x0, y0, new_w, new_h, size, interp)
+    return out, (x0, y0, new_w, new_h)
+
+
+def center_crop(src, size, interp=2):
+    a = _np(src)
+    h, w = a.shape[:2]
+    new_w, new_h = scale_down((w, h), size)
+    x0 = (w - new_w) // 2
+    y0 = (h - new_h) // 2
+    out = fixed_crop(a, x0, y0, new_w, new_h, size, interp)
+    return out, (x0, y0, new_w, new_h)
+
+
+def random_size_crop(src, size, area, ratio, interp=2):
+    a = _np(src)
+    h, w = a.shape[:2]
+    src_area = h * w
+    if isinstance(area, (int, float)):
+        area = (area, 1.0)
+    for _ in range(10):
+        target_area = pyrandom.uniform(*area) * src_area
+        log_ratio = (onp.log(ratio[0]), onp.log(ratio[1]))
+        aspect = onp.exp(pyrandom.uniform(*log_ratio))
+        new_w = int(round(onp.sqrt(target_area * aspect)))
+        new_h = int(round(onp.sqrt(target_area / aspect)))
+        if new_w <= w and new_h <= h:
+            x0 = pyrandom.randint(0, w - new_w)
+            y0 = pyrandom.randint(0, h - new_h)
+            out = fixed_crop(a, x0, y0, new_w, new_h, size, interp)
+            return out, (x0, y0, new_w, new_h)
+    return center_crop(a, size, interp)
+
+
+def color_normalize(src, mean, std=None):
+    a = _np(src).astype("float32")
+    a = a - _np(mean)
+    if std is not None:
+        a = a / _np(std)
+    return nd.array(a)
+
+
+def copyMakeBorder(src, top, bot, left, right, type=0, value=0):
+    a = _np(src)
+    return nd.array(onp.pad(
+        a, ((top, bot), (left, right), (0, 0)),
+        mode="constant" if type == 0 else "edge",
+        **({"constant_values": value} if type == 0 else {})))
+
+
+# ---------------------------------------------------------------- augmenters
+
+class Augmenter:
+    """Image augmenter base (parity: image.Augmenter)."""
+
+    def __init__(self, **kwargs):
+        self._kwargs = kwargs
+        for k, v in kwargs.items():
+            if isinstance(v, NDArray):
+                kwargs[k] = v.asnumpy().tolist()
+
+    def dumps(self):
+        import json
+        return json.dumps([self.__class__.__name__.lower(), self._kwargs])
+
+    def __call__(self, src):
+        raise NotImplementedError
+
+
+class SequentialAug(Augmenter):
+    def __init__(self, ts):
+        super().__init__()
+        self.ts = ts
+
+    def __call__(self, src):
+        for aug in self.ts:
+            src = aug(src)
+        return src
+
+
+class RandomOrderAug(Augmenter):
+    def __init__(self, ts):
+        super().__init__()
+        self.ts = ts
+
+    def __call__(self, src):
+        ts = list(self.ts)
+        pyrandom.shuffle(ts)
+        for aug in ts:
+            src = aug(src)
+        return src
+
+
+class ResizeAug(Augmenter):
+    def __init__(self, size, interp=2):
+        super().__init__(size=size, interp=interp)
+        self.size = size
+        self.interp = interp
+
+    def __call__(self, src):
+        return resize_short(src, self.size, self.interp)
+
+
+class ForceResizeAug(Augmenter):
+    def __init__(self, size, interp=2):
+        super().__init__(size=size, interp=interp)
+        self.size = size
+        self.interp = interp
+
+    def __call__(self, src):
+        return imresize(src, self.size[0], self.size[1], self.interp)
+
+
+class RandomCropAug(Augmenter):
+    def __init__(self, size, interp=2):
+        super().__init__(size=size, interp=interp)
+        self.size = size
+        self.interp = interp
+
+    def __call__(self, src):
+        return random_crop(src, self.size, self.interp)[0]
+
+
+class RandomSizedCropAug(Augmenter):
+    def __init__(self, size, area, ratio, interp=2):
+        super().__init__(size=size, area=area, ratio=ratio, interp=interp)
+        self.size = size
+        self.area = area
+        self.ratio = ratio
+        self.interp = interp
+
+    def __call__(self, src):
+        return random_size_crop(src, self.size, self.area, self.ratio,
+                                self.interp)[0]
+
+
+class CenterCropAug(Augmenter):
+    def __init__(self, size, interp=2):
+        super().__init__(size=size, interp=interp)
+        self.size = size
+        self.interp = interp
+
+    def __call__(self, src):
+        return center_crop(src, self.size, self.interp)[0]
+
+
+class HorizontalFlipAug(Augmenter):
+    def __init__(self, p):
+        super().__init__(p=p)
+        self.p = p
+
+    def __call__(self, src):
+        if pyrandom.random() < self.p:
+            return nd.array(_np(src)[:, ::-1])
+        return src
+
+
+class CastAug(Augmenter):
+    def __init__(self, typ="float32"):
+        super().__init__(type=typ)
+        self.typ = typ
+
+    def __call__(self, src):
+        return nd.array(_np(src).astype(self.typ))
+
+
+class BrightnessJitterAug(Augmenter):
+    def __init__(self, brightness):
+        super().__init__(brightness=brightness)
+        self.brightness = brightness
+
+    def __call__(self, src):
+        alpha = 1.0 + pyrandom.uniform(-self.brightness, self.brightness)
+        return nd.array(_np(src).astype("float32") * alpha)
+
+
+class ContrastJitterAug(Augmenter):
+    _coef = onp.array([0.299, 0.587, 0.114], dtype="float32")
+
+    def __init__(self, contrast):
+        super().__init__(contrast=contrast)
+        self.contrast = contrast
+
+    def __call__(self, src):
+        a = _np(src).astype("float32")
+        alpha = 1.0 + pyrandom.uniform(-self.contrast, self.contrast)
+        gray = (a * self._coef).sum(axis=-1).mean()
+        return nd.array(a * alpha + gray * (1 - alpha))
+
+
+class SaturationJitterAug(Augmenter):
+    _coef = onp.array([0.299, 0.587, 0.114], dtype="float32")
+
+    def __init__(self, saturation):
+        super().__init__(saturation=saturation)
+        self.saturation = saturation
+
+    def __call__(self, src):
+        a = _np(src).astype("float32")
+        alpha = 1.0 + pyrandom.uniform(-self.saturation, self.saturation)
+        gray = (a * self._coef).sum(axis=-1, keepdims=True)
+        return nd.array(a * alpha + gray * (1 - alpha))
+
+
+class HueJitterAug(Augmenter):
+    def __init__(self, hue):
+        super().__init__(hue=hue)
+        self.hue = hue
+        self.tyiq = onp.array([[0.299, 0.587, 0.114],
+                               [0.596, -0.274, -0.321],
+                               [0.211, -0.523, 0.311]], dtype="float32")
+        self.ityiq = onp.array([[1.0, 0.956, 0.621],
+                                [1.0, -0.272, -0.647],
+                                [1.0, -1.107, 1.705]], dtype="float32")
+
+    def __call__(self, src):
+        a = _np(src).astype("float32")
+        alpha = pyrandom.uniform(-self.hue, self.hue)
+        u, w = onp.cos(alpha * onp.pi), onp.sin(alpha * onp.pi)
+        bt = onp.array([[1.0, 0.0, 0.0], [0.0, u, -w], [0.0, w, u]],
+                       dtype="float32")
+        t = self.ityiq @ bt @ self.tyiq
+        return nd.array(a @ t.T)
+
+
+class ColorJitterAug(RandomOrderAug):
+    def __init__(self, brightness, contrast, saturation):
+        ts = []
+        if brightness > 0:
+            ts.append(BrightnessJitterAug(brightness))
+        if contrast > 0:
+            ts.append(ContrastJitterAug(contrast))
+        if saturation > 0:
+            ts.append(SaturationJitterAug(saturation))
+        super().__init__(ts)
+
+
+class LightingAug(Augmenter):
+    def __init__(self, alphastd, eigval, eigvec):
+        super().__init__()
+        self.alphastd = alphastd
+        self.eigval = _np(eigval)
+        self.eigvec = _np(eigvec)
+
+    def __call__(self, src):
+        alpha = onp.random.normal(0, self.alphastd, size=(3,))
+        rgb = (self.eigvec * alpha * self.eigval).sum(axis=1)
+        return nd.array(_np(src).astype("float32") + rgb)
+
+
+class ColorNormalizeAug(Augmenter):
+    def __init__(self, mean, std):
+        super().__init__()
+        self.mean = _np(mean) if mean is not None else None
+        self.std = _np(std) if std is not None else None
+
+    def __call__(self, src):
+        return color_normalize(src, self.mean, self.std)
+
+
+def CreateAugmenter(data_shape, resize=0, rand_crop=False, rand_resize=False,
+                    rand_mirror=False, mean=None, std=None, brightness=0,
+                    contrast=0, saturation=0, hue=0, pca_noise=0,
+                    rand_gray=0, inter_method=2):
+    """Build the standard augmenter list (parity: image.CreateAugmenter)."""
+    auglist = []
+    if resize > 0:
+        auglist.append(ResizeAug(resize, inter_method))
+    crop_size = (data_shape[2], data_shape[1])
+    if rand_resize:
+        assert rand_crop
+        auglist.append(RandomSizedCropAug(crop_size, (0.08, 1.0),
+                                          (3.0 / 4.0, 4.0 / 3.0),
+                                          inter_method))
+    elif rand_crop:
+        auglist.append(RandomCropAug(crop_size, inter_method))
+    else:
+        auglist.append(CenterCropAug(crop_size, inter_method))
+    if rand_mirror:
+        auglist.append(HorizontalFlipAug(0.5))
+    auglist.append(CastAug())
+    if brightness or contrast or saturation:
+        auglist.append(ColorJitterAug(brightness, contrast, saturation))
+    if hue:
+        auglist.append(HueJitterAug(hue))
+    if pca_noise > 0:
+        eigval = onp.array([55.46, 4.794, 1.148])
+        eigvec = onp.array([[-0.5675, 0.7192, 0.4009],
+                            [-0.5808, -0.0045, -0.8140],
+                            [-0.5836, -0.6948, 0.4203]])
+        auglist.append(LightingAug(pca_noise, eigval, eigvec))
+    if mean is True:
+        mean = onp.array([123.68, 116.28, 103.53])
+    if std is True:
+        std = onp.array([58.395, 57.12, 57.375])
+    if mean is not None or std is not None:
+        auglist.append(ColorNormalizeAug(mean, std))
+    return auglist
+
+
+class ImageIter:
+    """Python image iterator over .rec or .lst inputs (parity:
+    image.ImageIter). Yields DataBatch with NCHW float data."""
+
+    def __init__(self, batch_size, data_shape, label_width=1,
+                 path_imgrec=None, path_imglist=None, path_root=".",
+                 shuffle=False, part_index=0, num_parts=1, aug_list=None,
+                 imglist=None, dtype="float32", last_batch_handle="pad",
+                 **kwargs):
+        from .io import DataBatch, DataDesc
+        assert path_imgrec or path_imglist or imglist is not None
+        self.batch_size = batch_size
+        self.data_shape = tuple(data_shape)
+        self.label_width = label_width
+        self.shuffle = shuffle
+        self.dtype = dtype
+        self._batch_cls = DataBatch
+
+        self.imgrec = None
+        self.imglist = None
+        self.seq = None
+        if path_imgrec:
+            idx_path = os.path.splitext(path_imgrec)[0] + ".idx"
+            self.imgrec = MXIndexedRecordIO_lazy(idx_path, path_imgrec)
+            self.seq = list(self.imgrec.keys)
+        else:
+            if path_imglist:
+                entries = []
+                with open(path_imglist) as fin:
+                    for line in fin:
+                        parts = line.strip().split("\t")
+                        label = onp.asarray(parts[1:-1], dtype="float32")
+                        entries.append((parts[-1], label))
+            else:
+                entries = [(item[-1], onp.asarray(item[:-1], dtype="float32"))
+                           for item in imglist]
+            self.imglist = entries
+            self.path_root = path_root
+            self.seq = list(range(len(entries)))
+        if num_parts > 1:
+            self.seq = self.seq[part_index::num_parts]
+        if aug_list is None:
+            self.auglist = CreateAugmenter(data_shape, **kwargs)
+        else:
+            self.auglist = aug_list
+        self.provide_data = [DataDesc(
+            "data", (batch_size,) + self.data_shape, dtype)]
+        self.provide_label = [DataDesc(
+            "softmax_label", (batch_size, label_width) if label_width > 1
+            else (batch_size,), "float32")]
+        self.cursor = 0
+        self.reset()
+
+    def reset(self):
+        if self.shuffle:
+            pyrandom.shuffle(self.seq)
+        self.cursor = 0
+
+    def next_sample(self):
+        if self.cursor >= len(self.seq):
+            raise StopIteration
+        idx = self.seq[self.cursor]
+        self.cursor += 1
+        if self.imgrec is not None:
+            from . import recordio
+            header, img = recordio.unpack(self.imgrec.read_idx(idx))
+            return header.label, imdecode(img)
+        path, label = self.imglist[idx]
+        return label, imread(os.path.join(self.path_root, path))
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        return self.next()
+
+    def next(self):
+        c, h, w = self.data_shape
+        batch_data = onp.zeros((self.batch_size, h, w, c), dtype="float32")
+        batch_label = onp.zeros((self.batch_size, self.label_width),
+                                dtype="float32")
+        i = 0
+        try:
+            while i < self.batch_size:
+                label, img = self.next_sample()
+                for aug in self.auglist:
+                    img = aug(img)
+                batch_data[i] = _np(img)
+                batch_label[i] = onp.asarray(label).reshape(-1)[
+                    :self.label_width]
+                i += 1
+        except StopIteration:
+            if i == 0:
+                raise
+            # pad the tail with the last sample (last_batch_handle='pad')
+            while i < self.batch_size:
+                batch_data[i] = batch_data[i - 1]
+                batch_label[i] = batch_label[i - 1]
+                i += 1
+        data = nd.array(batch_data.transpose(0, 3, 1, 2).astype(self.dtype))
+        label = nd.array(batch_label.squeeze(-1) if self.label_width == 1
+                         else batch_label)
+        return self._batch_cls(data=[data], label=[label])
+
+
+class MXIndexedRecordIO_lazy:
+    """Thin wrapper deferring the recordio import (avoids cycle)."""
+
+    def __init__(self, idx_path, uri):
+        from . import recordio
+        self._rec = recordio.MXIndexedRecordIO(idx_path, uri, "r")
+        self.keys = self._rec.keys
+
+    def read_idx(self, idx):
+        return self._rec.read_idx(idx)

@@ -1,0 +1,12 @@
+"""mx.contrib namespace (parity: python/mxnet/contrib/).
+
+Members: onnx (mx2onnx exporter + onnx2mx importer), amp (re-exported —
+the implementation lives in mxtpu.amp), quantization (INT8 PTQ), text
+(vocab/embeddings — see gluon.contrib as well).
+"""
+
+from .. import amp  # noqa: F401  (mx.contrib.amp alias)
+from . import onnx  # noqa: F401
+from . import quantization  # noqa: F401
+from . import svrg_optimization  # noqa: F401
+from . import text  # noqa: F401

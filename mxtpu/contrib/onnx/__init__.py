@@ -1,0 +1,11 @@
+"""ONNX interop (parity: python/mxnet/contrib/onnx/).
+
+`export_model` serializes a Symbol + params to a standard ONNX ModelProto
+(wire-compatible vendored schema — the `onnx` pip package is not required);
+`import_model` builds a Symbol + params back from one.
+"""
+
+from .mx2onnx import export_model
+from .onnx2mx import import_model, get_model_metadata
+
+__all__ = ["export_model", "import_model", "get_model_metadata"]

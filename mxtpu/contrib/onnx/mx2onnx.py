@@ -1,0 +1,475 @@
+"""Symbol → ONNX exporter (parity: python/mxnet/contrib/onnx/mx2onnx/
+export_model.py + _op_translations.py).
+
+The reference walks the symbol json node list and applies per-op converter
+functions registered by name; this does the same over the mxtpu Symbol DAG
+(a topo walk of `_Node`s), emitting a standard `ModelProto` through the
+vendored wire-compatible schema (onnx.proto) so the output loads in stock
+ONNX runtimes.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ...base import MXTPUError
+from ...ndarray import NDArray
+from . import onnx_pb as O
+
+OPSET = 13
+_CONVERTERS = {}
+
+
+def register(*op_names):
+    def deco(fn):
+        from ...base import get_op
+        for n in op_names:
+            try:
+                n = get_op(n).name  # canonicalize: node.op stores this
+            except Exception:
+                pass
+            _CONVERTERS[n] = fn
+        return fn
+    return deco
+
+
+class _Builder:
+    def __init__(self):
+        self.nodes = []
+        self.initializers = {}
+        self._uid = 0
+
+    def uniq(self, base):
+        self._uid += 1
+        return "%s__%d" % (base, self._uid)
+
+    def node(self, op_type, inputs, outputs, name=None, **attrs):
+        n = O.NodeProto()
+        n.op_type = op_type
+        n.input.extend(inputs)
+        n.output.extend(outputs)
+        n.name = name or self.uniq(op_type)
+        for k, v in attrs.items():
+            if v is None:
+                continue
+            n.attribute.append(_attr(k, v))
+        self.nodes.append(n)
+        return outputs[0]
+
+    def tensor(self, name, arr):
+        arr = np.ascontiguousarray(arr)
+        t = O.TensorProto()
+        t.name = name
+        t.dims.extend(arr.shape)
+        t.data_type = O.DTYPE_TO_ONNX[str(arr.dtype)]
+        t.raw_data = arr.tobytes()
+        self.initializers[name] = t
+        return name
+
+    def const(self, base, arr):
+        return self.tensor(self.uniq(base), np.asarray(arr))
+
+
+def _attr(name, v):
+    a = O.AttributeProto()
+    a.name = name
+    if isinstance(v, bool):
+        a.type, a.i = O.AttributeProto.INT, int(v)
+    elif isinstance(v, int):
+        a.type, a.i = O.AttributeProto.INT, v
+    elif isinstance(v, float):
+        a.type, a.f = O.AttributeProto.FLOAT, v
+    elif isinstance(v, str):
+        a.type, a.s = O.AttributeProto.STRING, v.encode()
+    elif isinstance(v, (list, tuple)):
+        if all(isinstance(x, (int, np.integer)) for x in v):
+            a.type = O.AttributeProto.INTS
+            a.ints.extend(int(x) for x in v)
+        else:
+            a.type = O.AttributeProto.FLOATS
+            a.floats.extend(float(x) for x in v)
+    else:
+        raise MXTPUError("unsupported ONNX attribute %r=%r" % (name, v))
+    return a
+
+
+def _in(node, i):
+    return node.inputs[i].name if i < len(node.inputs) else ""
+
+
+def _pads(pad, ndim):
+    pad = tuple(pad) if pad else (0,) * ndim
+    return list(pad) + list(pad)  # symmetric begin+end
+
+
+# ---------------------------------------------------------------- nn ops
+
+@register("FullyConnected")
+def _fc(node, b, out):
+    kw = node.kwargs
+    data = _in(node, 0)
+    if kw.get("flatten", True):
+        data = b.node("Flatten", [data], [b.uniq(node.name + "_flat")],
+                      axis=1)
+    ins = [data, _in(node, 1)]
+    if not kw.get("no_bias", False) and len(node.inputs) > 2:
+        ins.append(_in(node, 2))
+    b.node("Gemm", ins, [out], name=node.name, alpha=1.0, beta=1.0,
+           transA=0, transB=1)
+
+
+@register("Convolution")
+def _conv(node, b, out):
+    kw = node.kwargs
+    kernel = tuple(kw.get("kernel", ()))
+    ndim = len(kernel)
+    ins = [_in(node, 0), _in(node, 1)]
+    if not kw.get("no_bias", False) and len(node.inputs) > 2:
+        ins.append(_in(node, 2))
+    b.node("Conv", ins, [out], name=node.name,
+           kernel_shape=list(kernel),
+           strides=list(kw.get("stride") or (1,) * ndim),
+           dilations=list(kw.get("dilate") or (1,) * ndim),
+           pads=_pads(kw.get("pad"), ndim),
+           group=int(kw.get("num_group", 1)))
+
+
+@register("Pooling")
+def _pool(node, b, out):
+    kw = node.kwargs
+    ptype = kw.get("pool_type", "max")
+    if kw.get("global_pool", False):
+        b.node("GlobalMaxPool" if ptype == "max" else "GlobalAveragePool",
+               [_in(node, 0)], [out], name=node.name)
+        return
+    kernel = tuple(kw.get("kernel", ()))
+    ndim = len(kernel)
+    attrs = dict(kernel_shape=list(kernel),
+                 strides=list(kw.get("stride") or (1,) * ndim),
+                 pads=_pads(kw.get("pad"), ndim),
+                 ceil_mode=int(kw.get("pooling_convention", "valid")
+                               == "full"))
+    if ptype == "max":
+        b.node("MaxPool", [_in(node, 0)], [out], name=node.name, **attrs)
+    else:
+        attrs["count_include_pad"] = int(kw.get("count_include_pad", True))
+        b.node("AveragePool", [_in(node, 0)], [out], name=node.name,
+               **attrs)
+
+
+_ACT = {"relu": "Relu", "sigmoid": "Sigmoid", "tanh": "Tanh",
+        "softrelu": "Softplus", "softsign": "Softsign"}
+
+
+@register("Activation")
+def _act(node, b, out):
+    act = node.kwargs.get("act_type", "relu")
+    if act not in _ACT:
+        raise MXTPUError("ONNX export: unsupported act_type %r" % act)
+    b.node(_ACT[act], [_in(node, 0)], [out], name=node.name)
+
+
+@register("LeakyReLU")
+def _leaky(node, b, out):
+    act = node.kwargs.get("act_type", "leaky")
+    slope = float(node.kwargs.get("slope", 0.25))
+    if act == "leaky":
+        b.node("LeakyRelu", [_in(node, 0)], [out], name=node.name,
+               alpha=slope)
+    elif act == "elu":
+        b.node("Elu", [_in(node, 0)], [out], name=node.name, alpha=slope)
+    elif act == "prelu":
+        b.node("PRelu", [_in(node, 0), _in(node, 1)], [out],
+               name=node.name)
+    else:
+        raise MXTPUError("ONNX export: unsupported LeakyReLU %r" % act)
+
+
+@register("BatchNorm")
+def _bn(node, b, out):
+    kw = node.kwargs
+    ins = [_in(node, i) for i in range(5)]
+    if kw.get("fix_gamma", True):
+        # reference semantics: gamma is ignored (treated as ones) when
+        # fix_gamma — ONNX BatchNormalization always applies scale, so
+        # emit an explicit ones initializer
+        gamma_name = node.inputs[1].name
+        n_ch = b.initializers.get(gamma_name)
+        dim = int(n_ch.dims[0]) if n_ch is not None else None
+        if dim is None:
+            raise MXTPUError(
+                "ONNX export: BatchNorm %r with fix_gamma needs gamma "
+                "param to infer channels" % node.name)
+        ins[1] = b.const(node.name + "_fixed_gamma",
+                         np.ones(dim, np.float32))
+    b.node("BatchNormalization", ins, [out], name=node.name,
+           epsilon=float(kw.get("eps", 1e-5)),
+           momentum=float(kw.get("momentum", 0.9)))
+
+
+@register("Dropout")
+def _dropout(node, b, out):
+    ratio = b.const(node.name + "_ratio",
+                    np.float32(node.kwargs.get("p", 0.5)))
+    b.node("Dropout", [_in(node, 0), ratio], [out], name=node.name)
+
+
+@register("softmax", "SoftmaxActivation")
+def _softmax(node, b, out):
+    b.node("Softmax", [_in(node, 0)], [out], name=node.name,
+           axis=int(node.kwargs.get("axis", -1)))
+
+
+@register("SoftmaxOutput")
+def _softmax_out(node, b, out):
+    # inference export: the label input and loss are dropped (reference
+    # mx2onnx does the same), leaving plain softmax over the last axis
+    b.node("Softmax", [_in(node, 0)], [out], name=node.name, axis=-1)
+
+
+@register("Embedding")
+def _embedding(node, b, out):
+    idx = b.node("Cast", [_in(node, 0)], [b.uniq(node.name + "_idx")],
+                 to=O.TensorProto.INT64)
+    b.node("Gather", [_in(node, 1), idx], [out], name=node.name, axis=0)
+
+
+# ------------------------------------------------------------ tensor ops
+
+@register("Flatten")
+def _flatten(node, b, out):
+    b.node("Flatten", [_in(node, 0)], [out], name=node.name, axis=1)
+
+
+@register("reshape", "Reshape")
+def _reshape(node, b, out):
+    shape = node.kwargs.get("shape")
+    sh = b.const(node.name + "_shape", np.asarray(shape, np.int64))
+    b.node("Reshape", [_in(node, 0), sh], [out], name=node.name)
+
+
+@register("transpose")
+def _transpose(node, b, out):
+    axes = node.kwargs.get("axes")
+    b.node("Transpose", [_in(node, 0)], [out], name=node.name,
+           perm=list(axes) if axes else None)
+
+
+@register("concat", "Concat")
+def _concat(node, b, out):
+    b.node("Concat", [_in(node, i) for i in range(len(node.inputs))],
+           [out], name=node.name, axis=int(node.kwargs.get("dim", 1)))
+
+
+@register("expand_dims")
+def _expand_dims(node, b, out):
+    ax = b.const(node.name + "_axes",
+                 np.asarray([node.kwargs.get("axis", 0)], np.int64))
+    b.node("Unsqueeze", [_in(node, 0), ax], [out], name=node.name)
+
+
+@register("slice_axis")
+def _slice_axis(node, b, out):
+    kw = node.kwargs
+    end = kw.get("end")
+    end = np.iinfo(np.int64).max if end is None else end
+    b.node("Slice",
+           [_in(node, 0),
+            b.const(node.name + "_st", np.asarray([kw["begin"]], np.int64)),
+            b.const(node.name + "_en", np.asarray([end], np.int64)),
+            b.const(node.name + "_ax", np.asarray([kw["axis"]], np.int64))],
+           [out], name=node.name)
+
+
+def _binary(onnx_op):
+    def conv(node, b, out):
+        b.node(onnx_op, [_in(node, 0), _in(node, 1)], [out],
+               name=node.name)
+    return conv
+
+
+for _mx, _ox in [("elemwise_add", "Add"), ("broadcast_add", "Add"),
+                 ("elemwise_sub", "Sub"), ("broadcast_sub", "Sub"),
+                 ("elemwise_mul", "Mul"), ("broadcast_mul", "Mul"),
+                 ("elemwise_div", "Div"), ("broadcast_div", "Div"),
+                 ("dot", "MatMul"), ("broadcast_maximum", "Max"),
+                 ("broadcast_minimum", "Min"), ("broadcast_power", "Pow")]:
+    register(_mx)(_binary(_ox))
+
+
+def _scalar(onnx_op, rev=False):
+    def conv(node, b, out):
+        c = b.const(node.name + "_s",
+                    np.float32(node.kwargs.get("scalar", 0.0)))
+        ins = [c, _in(node, 0)] if rev else [_in(node, 0), c]
+        b.node(onnx_op, ins, [out], name=node.name)
+    return conv
+
+
+for _mx, _ox, _rev in [("_plus_scalar", "Add", False),
+                       ("_minus_scalar", "Sub", False),
+                       ("_rminus_scalar", "Sub", True),
+                       ("_mul_scalar", "Mul", False),
+                       ("_div_scalar", "Div", False),
+                       ("_rdiv_scalar", "Div", True),
+                       ("_power_scalar", "Pow", False)]:
+    register(_mx)(_scalar(_ox, _rev))
+
+
+def _unary(onnx_op):
+    def conv(node, b, out):
+        b.node(onnx_op, [_in(node, 0)], [out], name=node.name)
+    return conv
+
+
+for _mx, _ox in [("relu", "Relu"), ("sigmoid", "Sigmoid"), ("tanh", "Tanh"),
+                 ("exp", "Exp"), ("log", "Log"), ("sqrt", "Sqrt"),
+                 ("abs", "Abs"), ("negative", "Neg"), ("floor", "Floor"),
+                 ("ceil", "Ceil"), ("erf", "Erf"), ("identity", "Identity"),
+                 ("BlockGrad", "Identity"), ("softsign", "Softsign")]:
+    register(_mx)(_unary(_ox))
+
+
+@register("add_n", "ElementWiseSum")
+def _add_n(node, b, out):
+    b.node("Sum", [_in(node, i) for i in range(len(node.inputs))], [out],
+           name=node.name)
+
+
+@register("clip")
+def _clip(node, b, out):
+    b.node("Clip",
+           [_in(node, 0),
+            b.const(node.name + "_min",
+                    np.float32(node.kwargs.get("a_min", 0.0))),
+            b.const(node.name + "_max",
+                    np.float32(node.kwargs.get("a_max", 0.0)))],
+           [out], name=node.name)
+
+
+def _reduce(onnx_op):
+    def conv(node, b, out):
+        kw = node.kwargs
+        axis = kw.get("axis")
+        if axis is None:
+            axes = None
+        else:
+            axes = [axis] if isinstance(axis, int) else list(axis)
+        b.node(onnx_op, [_in(node, 0)], [out], name=node.name, axes=axes,
+               keepdims=int(kw.get("keepdims", False)))
+    return conv
+
+
+for _mx, _ox in [("mean", "ReduceMean"), ("max", "ReduceMax"),
+                 ("min", "ReduceMin"), ("prod", "ReduceProd")]:
+    register(_mx)(_reduce(_ox))
+
+
+@register("sum", "sum_axis")
+def _sum(node, b, out):
+    kw = node.kwargs
+    axis = kw.get("axis")
+    ins = [_in(node, 0)]
+    if axis is not None:
+        axes = [axis] if isinstance(axis, int) else list(axis)
+        ins.append(b.const(node.name + "_axes",
+                           np.asarray(axes, np.int64)))
+    b.node("ReduceSum", ins, [out], name=node.name,
+           keepdims=int(kw.get("keepdims", False)))
+
+
+# ------------------------------------------------------------- exporter
+
+def export_model(sym, params, input_shape, input_type=np.float32,
+                 onnx_file_path="model.onnx", verbose=False):
+    """Export a Symbol + params to an ONNX file (parity:
+    mx.contrib.onnx.export_model).
+
+    input_shape: list of shapes, one per data input (in list_arguments
+    order of the non-param inputs).  Returns the output path.
+    """
+    from ...symbol import Symbol, load as sym_load
+
+    if isinstance(sym, str):
+        sym = sym_load(sym)
+    if isinstance(params, str):
+        from ... import ndarray as nd
+        loaded = nd.load(params)
+        params = {k.split(":", 1)[-1]: v for k, v in loaded.items()}
+    params = {k.split(":", 1)[-1]: (v.asnumpy() if isinstance(v, NDArray)
+                                    else np.asarray(v))
+              for k, v in params.items()}
+    if not isinstance(input_shape, list):
+        input_shape = [input_shape]
+
+    b = _Builder()
+    graph = O.GraphProto()
+    graph.name = sym.name
+
+    data_names = [n for n in sym.list_arguments() if n not in params] + \
+        [n for n in sym.list_auxiliary_states() if n not in params]
+    if len(data_names) != len(input_shape):
+        raise MXTPUError(
+            "export_model: %d data inputs %s but %d input shapes" %
+            (len(data_names), data_names, len(input_shape)))
+    dtype_name = np.dtype(input_type).name
+
+    for name, shape in zip(data_names, input_shape):
+        vi = graph.input.add()
+        vi.name = name
+        vi.type.tensor_type.elem_type = O.DTYPE_TO_ONNX[dtype_name]
+        for d in shape:
+            vi.type.tensor_type.shape.dim.add().dim_value = int(d)
+
+    for name, arr in params.items():
+        b.tensor(name, arr)
+
+    converted_params = set(params)
+    for node in sym._topo():
+        if node.op is None:  # variable: already an input or initializer
+            if node.name not in converted_params and \
+                    node.name not in data_names:
+                raise MXTPUError("export_model: no value for variable %r"
+                                 % node.name)
+            continue
+        conv = _CONVERTERS.get(node.op)
+        if conv is None:
+            raise MXTPUError(
+                "ONNX export: no converter for op %r (node %r)" %
+                (node.op, node.name))
+        out_name = node.name if node.num_outputs == 1 else \
+            "%s_output0" % node.name
+        conv(node, b, out_name)
+        if verbose:
+            print("converted %s -> %s" % (node.op, out_name))
+
+    graph.node.extend(b.nodes)
+    graph.initializer.extend(b.initializers.values())
+
+    # output value info with inferred shapes
+    shape_kwargs = dict(zip(data_names, input_shape))
+    try:
+        _, out_shapes, _ = sym.infer_shape(**shape_kwargs)
+    except Exception:
+        out_shapes = [None] * len(sym._roots())
+    out_names = [n.name for n in sym._roots()]
+    for name, shape in zip(out_names, out_shapes):
+        vi = graph.output.add()
+        vi.name = name
+        vi.type.tensor_type.elem_type = O.DTYPE_TO_ONNX[dtype_name]
+        if shape:
+            for d in shape:
+                vi.type.tensor_type.shape.dim.add().dim_value = int(d)
+
+    model = O.ModelProto()
+    model.ir_version = 8
+    model.producer_name = "mxtpu"
+    model.producer_version = "3.0"
+    opset = model.opset_import.add()
+    opset.domain = ""
+    opset.version = OPSET
+    model.graph.CopyFrom(graph)
+
+    with open(onnx_file_path, "wb") as f:
+        f.write(model.SerializeToString())
+    return onnx_file_path

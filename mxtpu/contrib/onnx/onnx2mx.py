@@ -1,0 +1,415 @@
+"""ONNX → Symbol importer (parity: python/mxnet/contrib/onnx/onnx2mx/
+import_model.py + import_onnx.py per-op translations).
+
+Walks a ModelProto's graph.node list in order (ONNX graphs are already
+topologically sorted by spec), mapping each node onto mxtpu Symbol ops;
+initializers become arg/aux params as NDArrays.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ...base import MXTPUError
+from ... import ndarray as nd
+from ... import symbol as sym_api
+from . import onnx_pb as O
+
+_IMPORTERS = {}
+
+
+def register(*names):
+    def deco(fn):
+        for n in names:
+            _IMPORTERS[n] = fn
+        return fn
+    return deco
+
+
+def _attrs(node):
+    out = {}
+    for a in node.attribute:
+        T = O.AttributeProto
+        if a.type == T.INT:
+            out[a.name] = int(a.i)
+        elif a.type == T.FLOAT:
+            out[a.name] = float(a.f)
+        elif a.type == T.STRING:
+            out[a.name] = a.s.decode()
+        elif a.type == T.INTS:
+            out[a.name] = [int(x) for x in a.ints]
+        elif a.type == T.FLOATS:
+            out[a.name] = [float(x) for x in a.floats]
+        elif a.type == T.TENSOR:
+            out[a.name] = _tensor_to_np(a.t)
+    return out
+
+
+def _tensor_to_np(t):
+    dtype = np.dtype(O.ONNX_TO_DTYPE[t.data_type])
+    if t.raw_data:
+        arr = np.frombuffer(t.raw_data, dtype=dtype)
+    elif t.float_data:
+        arr = np.asarray(list(t.float_data), np.float32).astype(dtype)
+    elif t.int64_data:
+        arr = np.asarray(list(t.int64_data), np.int64).astype(dtype)
+    elif t.int32_data:
+        arr = np.asarray(list(t.int32_data), np.int32).astype(dtype)
+    else:
+        arr = np.zeros(0, dtype)
+    return arr.reshape(tuple(t.dims))
+
+
+class _Ctx:
+    """Import state: name → Symbol plus constant lookups."""
+
+    def __init__(self):
+        self.syms = {}
+        self.consts = {}   # name → np array (initializers / Constant nodes)
+        self.params = {}   # initializer name → np array (for output params)
+        self.param_used_as_input = set()
+
+    def get(self, name):
+        if name in self.syms:
+            return self.syms[name]
+        if name in self.consts:
+            self.param_used_as_input.add(name)
+            s = sym_api.Variable(name)
+            self.syms[name] = s
+            return s
+        raise MXTPUError("ONNX import: undefined input %r" % name)
+
+    def const_value(self, name):
+        if name not in self.consts:
+            raise MXTPUError(
+                "ONNX import: %r must be a constant initializer" % name)
+        return self.consts[name]
+
+
+def _halve_pads(pads):
+    if not pads:
+        return None
+    n = len(pads) // 2
+    if list(pads[:n]) != list(pads[n:]):
+        raise MXTPUError("ONNX import: asymmetric pads %r unsupported"
+                         % (pads,))
+    return tuple(pads[:n])
+
+
+@register("Conv")
+def _conv(node, ctx, at):
+    w = ctx.const_value(node.input[1])
+    kwargs = dict(kernel=tuple(at.get("kernel_shape", w.shape[2:])),
+                  num_filter=int(w.shape[0]),
+                  num_group=int(at.get("group", 1)),
+                  no_bias=len(node.input) < 3)
+    if at.get("strides"):
+        kwargs["stride"] = tuple(at["strides"])
+    if at.get("dilations"):
+        kwargs["dilate"] = tuple(at["dilations"])
+    p = _halve_pads(at.get("pads"))
+    if p:
+        kwargs["pad"] = p
+    ins = [ctx.get(n) for n in node.input]
+    return sym_api.Symbol._create("Convolution", None, ins, kwargs,
+                                  name=node.name or None)
+
+
+@register("Gemm")
+def _gemm(node, ctx, at):
+    if at.get("transA"):
+        raise MXTPUError("ONNX import: Gemm transA unsupported")
+    w = ctx.const_value(node.input[1])
+    if not at.get("transB", 0):
+        # FullyConnected wants (num_hidden, in); pre-transpose the constant
+        name = node.input[1]
+        ctx.consts[name] = np.ascontiguousarray(w.T)
+        w = ctx.consts[name]
+    kwargs = dict(num_hidden=int(w.shape[0]), flatten=False,
+                  no_bias=len(node.input) < 3)
+    ins = [ctx.get(n) for n in node.input]
+    return sym_api.Symbol._create("FullyConnected", None, ins, kwargs,
+                                  name=node.name or None)
+
+
+@register("BatchNormalization")
+def _bn(node, ctx, at):
+    ins = [ctx.get(n) for n in node.input]
+    kwargs = dict(eps=float(at.get("epsilon", 1e-5)),
+                  momentum=float(at.get("momentum", 0.9)),
+                  fix_gamma=False, use_global_stats=False)
+    for aux in node.input[3:5]:  # mean/var are aux states
+        ctx.syms[aux]._node.attrs["__aux__"] = True
+    return sym_api.Symbol._create("BatchNorm", None, ins, kwargs,
+                                  name=node.name or None)
+
+
+def _simple(mx_op, **fixed):
+    def imp(node, ctx, at):
+        ins = [ctx.get(n) for n in node.input]
+        return sym_api.Symbol._create(mx_op, None, ins, dict(fixed),
+                                      name=node.name or None)
+    return imp
+
+
+for _ox, _mx in [("Relu", "relu"), ("Sigmoid", "sigmoid"), ("Tanh", "tanh"),
+                 ("Exp", "exp"), ("Log", "log"), ("Sqrt", "sqrt"),
+                 ("Abs", "abs"), ("Neg", "negative"), ("Erf", "erf"),
+                 ("Floor", "floor"), ("Ceil", "ceil"),
+                 ("Identity", "identity"),
+                 ("Add", "broadcast_add"), ("Sub", "broadcast_sub"),
+                 ("Mul", "broadcast_mul"), ("Div", "broadcast_div"),
+                 ("Pow", "broadcast_power"), ("MatMul", "dot"),
+                 ("Max", "broadcast_maximum"), ("Min", "broadcast_minimum"),
+                 ("Sum", "add_n")]:
+    register(_ox)(_simple(_mx))
+
+register("Softplus")(_simple("Activation", act_type="softrelu"))
+register("Softsign")(_simple("Activation", act_type="softsign"))
+register("GlobalAveragePool")(_simple("Pooling", pool_type="avg",
+                                      global_pool=True))
+register("GlobalMaxPool")(_simple("Pooling", pool_type="max",
+                                  global_pool=True))
+register("PRelu")(_simple("LeakyReLU", act_type="prelu"))
+
+
+@register("LeakyRelu")
+def _leaky(node, ctx, at):
+    return sym_api.Symbol._create(
+        "LeakyReLU", None, [ctx.get(node.input[0])],
+        dict(act_type="leaky", slope=float(at.get("alpha", 0.01))),
+        name=node.name or None)
+
+
+@register("Elu")
+def _elu(node, ctx, at):
+    return sym_api.Symbol._create(
+        "LeakyReLU", None, [ctx.get(node.input[0])],
+        dict(act_type="elu", slope=float(at.get("alpha", 1.0))),
+        name=node.name or None)
+
+
+@register("MaxPool", "AveragePool")
+def _pool(node, ctx, at):
+    kwargs = dict(kernel=tuple(at["kernel_shape"]),
+                  pool_type="max" if node.op_type == "MaxPool" else "avg",
+                  pooling_convention="full" if at.get("ceil_mode") else
+                  "valid")
+    if at.get("strides"):
+        kwargs["stride"] = tuple(at["strides"])
+    p = _halve_pads(at.get("pads"))
+    if p:
+        kwargs["pad"] = p
+    if node.op_type == "AveragePool":
+        kwargs["count_include_pad"] = bool(at.get("count_include_pad", 0))
+    return sym_api.Symbol._create("Pooling", None,
+                                  [ctx.get(node.input[0])], kwargs,
+                                  name=node.name or None)
+
+
+@register("Flatten")
+def _flatten(node, ctx, at):
+    if at.get("axis", 1) != 1:
+        raise MXTPUError("ONNX import: Flatten axis != 1 unsupported")
+    return sym_api.Symbol._create("Flatten", None,
+                                  [ctx.get(node.input[0])], {},
+                                  name=node.name or None)
+
+
+@register("Reshape")
+def _reshape(node, ctx, at):
+    shape = tuple(int(x) for x in ctx.const_value(node.input[1]))
+    return sym_api.Symbol._create("reshape", None,
+                                  [ctx.get(node.input[0])],
+                                  dict(shape=shape),
+                                  name=node.name or None)
+
+
+@register("Transpose")
+def _transpose(node, ctx, at):
+    kwargs = {}
+    if at.get("perm") is not None:
+        kwargs["axes"] = tuple(at["perm"])
+    return sym_api.Symbol._create("transpose", None,
+                                  [ctx.get(node.input[0])], kwargs,
+                                  name=node.name or None)
+
+
+@register("Concat")
+def _concat(node, ctx, at):
+    ins = [ctx.get(n) for n in node.input]
+    return sym_api.Symbol._create("concat", None, ins,
+                                  dict(dim=int(at.get("axis", 1))),
+                                  name=node.name or None)
+
+
+@register("Softmax")
+def _softmax(node, ctx, at):
+    return sym_api.Symbol._create("softmax", None,
+                                  [ctx.get(node.input[0])],
+                                  dict(axis=int(at.get("axis", -1))),
+                                  name=node.name or None)
+
+
+@register("Dropout")
+def _dropout(node, ctx, at):
+    p = at.get("ratio", 0.5)
+    if len(node.input) > 1 and node.input[1]:
+        p = float(ctx.const_value(node.input[1]))
+    return sym_api.Symbol._create("Dropout", None,
+                                  [ctx.get(node.input[0])], dict(p=p),
+                                  name=node.name or None)
+
+
+@register("Cast")
+def _cast(node, ctx, at):
+    dtype = O.ONNX_TO_DTYPE[at["to"]]
+    return sym_api.Symbol._create("cast", None, [ctx.get(node.input[0])],
+                                  dict(dtype=dtype),
+                                  name=node.name or None)
+
+
+@register("Gather")
+def _gather(node, ctx, at):
+    ins = [ctx.get(node.input[0]), ctx.get(node.input[1])]
+    return sym_api.Symbol._create("take", None, ins,
+                                  dict(axis=int(at.get("axis", 0))),
+                                  name=node.name or None)
+
+
+@register("Clip")
+def _clip(node, ctx, at):
+    a_min = at.get("min", float(ctx.const_value(node.input[1]))
+                   if len(node.input) > 1 else -np.inf)
+    a_max = at.get("max", float(ctx.const_value(node.input[2]))
+                   if len(node.input) > 2 else np.inf)
+    return sym_api.Symbol._create("clip", None, [ctx.get(node.input[0])],
+                                  dict(a_min=float(a_min),
+                                       a_max=float(a_max)),
+                                  name=node.name or None)
+
+
+@register("ReduceMean", "ReduceMax", "ReduceMin", "ReduceProd")
+def _reduce(node, ctx, at):
+    mx_op = {"ReduceMean": "mean", "ReduceMax": "max", "ReduceMin": "min",
+             "ReduceProd": "prod"}[node.op_type]
+    axes = at.get("axes")
+    kwargs = dict(keepdims=bool(at.get("keepdims", 1)))
+    if axes is not None:
+        kwargs["axis"] = tuple(axes)
+    return sym_api.Symbol._create(mx_op, None, [ctx.get(node.input[0])],
+                                  kwargs, name=node.name or None)
+
+
+@register("ReduceSum")
+def _reduce_sum(node, ctx, at):
+    kwargs = dict(keepdims=bool(at.get("keepdims", 1)))
+    if len(node.input) > 1 and node.input[1]:
+        kwargs["axis"] = tuple(int(x)
+                               for x in ctx.const_value(node.input[1]))
+    elif at.get("axes") is not None:
+        kwargs["axis"] = tuple(at["axes"])
+    return sym_api.Symbol._create("sum", None, [ctx.get(node.input[0])],
+                                  kwargs, name=node.name or None)
+
+
+@register("Unsqueeze")
+def _unsqueeze(node, ctx, at):
+    if len(node.input) > 1:
+        axes = [int(x) for x in ctx.const_value(node.input[1])]
+    else:
+        axes = at["axes"]
+    s = ctx.get(node.input[0])
+    for ax in axes:
+        s = sym_api.Symbol._create("expand_dims", None, [s],
+                                   dict(axis=int(ax)))
+    return s
+
+
+@register("Slice")
+def _slice(node, ctx, at):
+    starts = [int(x) for x in ctx.const_value(node.input[1])]
+    ends = [int(x) for x in ctx.const_value(node.input[2])]
+    axes = ([int(x) for x in ctx.const_value(node.input[3])]
+            if len(node.input) > 3 else list(range(len(starts))))
+    s = ctx.get(node.input[0])
+    big = np.iinfo(np.int64).max
+    for st, en, ax in zip(starts, ends, axes):
+        s = sym_api.Symbol._create(
+            "slice_axis", None, [s],
+            dict(axis=ax, begin=st, end=None if en >= big else en))
+    return s
+
+
+@register("Constant")
+def _constant(node, ctx, at):
+    ctx.consts[node.output[0]] = at["value"]
+    return None
+
+
+def import_model(model_file):
+    """Import an ONNX file → (sym, arg_params, aux_params) (parity:
+    mx.contrib.onnx.import_model)."""
+    model = O.ModelProto()
+    with open(model_file, "rb") as f:
+        model.ParseFromString(f.read())
+    return _import_graph(model.graph)
+
+
+def get_model_metadata(model_file):
+    """Input/output names and shapes (parity: get_model_metadata)."""
+    model = O.ModelProto()
+    with open(model_file, "rb") as f:
+        model.ParseFromString(f.read())
+    g = model.graph
+    inits = {t.name for t in g.initializer}
+
+    def vi_shape(vi):
+        return (vi.name, tuple(d.dim_value
+                               for d in vi.type.tensor_type.shape.dim))
+    return {"input_tensor_data": [vi_shape(v) for v in g.input
+                                  if v.name not in inits],
+            "output_tensor_data": [vi_shape(v) for v in g.output]}
+
+
+def _import_graph(g):
+    ctx = _Ctx()
+    for t in g.initializer:
+        arr = _tensor_to_np(t)
+        ctx.consts[t.name] = arr
+        ctx.params[t.name] = arr
+    inits = set(ctx.consts)
+    for vi in g.input:
+        if vi.name not in inits:
+            ctx.syms[vi.name] = sym_api.Variable(vi.name)
+
+    for node in g.node:
+        imp = _IMPORTERS.get(node.op_type)
+        if imp is None:
+            raise MXTPUError("ONNX import: unsupported op %r (node %r)" %
+                             (node.op_type, node.name))
+        out = imp(node, ctx, _attrs(node))
+        if out is None:
+            continue
+        if len(node.output) == 1:
+            ctx.syms[node.output[0]] = out
+        else:
+            for i, oname in enumerate(node.output):
+                if oname:
+                    ctx.syms[oname] = out[i]
+
+    outs = [ctx.syms[v.name] for v in g.output]
+    sym = outs[0] if len(outs) == 1 else sym_api.Group(outs)
+
+    arg_names = set(sym.list_arguments())
+    aux_names = set(sym.list_auxiliary_states())
+    arg_params, aux_params = {}, {}
+    for name in ctx.param_used_as_input:
+        # Gemm import may have transposed the stored weight — read back
+        # the (possibly updated) constant table, not the original proto.
+        arr = nd.array(ctx.consts[name])
+        if name in aux_names:
+            aux_params[name] = arr
+        elif name in arg_names:
+            arg_params[name] = arr
+    return sym, arg_params, aux_params

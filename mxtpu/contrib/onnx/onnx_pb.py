@@ -1,0 +1,43 @@
+"""Protobuf bindings for the vendored ONNX schema subset (onnx.proto).
+
+The checked-in ``onnx_pb2.py`` is regenerated with the in-image ``protoc``
+if it is missing or was built by an incompatible protobuf generation.
+"""
+
+import os
+import subprocess
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+
+
+def _regen():
+    subprocess.run(["protoc", "--python_out=.", "onnx.proto"],
+                   cwd=_HERE, check=True)
+
+
+try:
+    from . import onnx_pb2
+except Exception:  # stale generated code vs protobuf runtime
+    _regen()
+    from . import onnx_pb2
+
+AttributeProto = onnx_pb2.AttributeProto
+GraphProto = onnx_pb2.GraphProto
+ModelProto = onnx_pb2.ModelProto
+NodeProto = onnx_pb2.NodeProto
+OperatorSetIdProto = onnx_pb2.OperatorSetIdProto
+TensorProto = onnx_pb2.TensorProto
+TensorShapeProto = onnx_pb2.TensorShapeProto
+TypeProto = onnx_pb2.TypeProto
+ValueInfoProto = onnx_pb2.ValueInfoProto
+
+# numpy dtype name <-> TensorProto.DataType
+DTYPE_TO_ONNX = {
+    "float32": TensorProto.FLOAT, "float64": TensorProto.DOUBLE,
+    "float16": TensorProto.FLOAT16, "bfloat16": TensorProto.BFLOAT16,
+    "int8": TensorProto.INT8, "uint8": TensorProto.UINT8,
+    "int16": TensorProto.INT16, "uint16": TensorProto.UINT16,
+    "int32": TensorProto.INT32, "int64": TensorProto.INT64,
+    "bool": TensorProto.BOOL,
+}
+ONNX_TO_DTYPE = {v: k for k, v in DTYPE_TO_ONNX.items()}

@@ -1,0 +1,351 @@
+"""INT8 post-training quantization (parity: python/mxnet/contrib/
+quantization.py — `quantize_model` graph rewrite + naive/entropy
+calibration over src/operator/quantization/*).
+
+TPU-native design: quantized FullyConnected/Convolution execute as real
+int8 tensor ops with int32 accumulation (`lax.dot_general` /
+`conv_general_dilated` with ``preferred_element_type=int32`` — the MXU has
+native int8 throughput), then dequantize by the combined scale.  Weights
+are stored int8 in the quantized params (the memory win is real); per-layer
+input ranges come from calibration exactly like the reference: 'naive'
+min/max over calibration batches, or 'entropy' KL-optimal thresholds
+(histogram search, quantization/calibrate.cc analogue).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..base import MXTPUError, register_op
+from .. import ndarray as nd
+from ..ndarray import NDArray
+
+__all__ = ["quantize_model", "quantize_params", "optimal_thresholds"]
+
+QUANTIZABLE = ("FullyConnected", "Convolution")
+
+
+# ------------------------------------------------------------ quant ops
+
+def _q_scale(mn, mx):
+    """Symmetric int8 scale from a (possibly asymmetric) float range."""
+    return jnp.maximum(jnp.maximum(jnp.abs(mn), jnp.abs(mx)), 1e-8) / 127.0
+
+
+@register_op("_contrib_quantize_v2", differentiable=False)
+def quantize_v2(x, min_calib_range=None, max_calib_range=None):
+    """fp32 → (int8, min, max) (parity: quantize_v2-inl.h, symmetric
+    int8 mode).  Without calib ranges, uses the tensor's own min/max."""
+    mn = jnp.min(x) if min_calib_range is None else \
+        jnp.asarray(min_calib_range, jnp.float32)
+    mx = jnp.max(x) if max_calib_range is None else \
+        jnp.asarray(max_calib_range, jnp.float32)
+    scale = _q_scale(mn, mx)
+    q = jnp.clip(jnp.round(x / scale), -127, 127).astype(jnp.int8)
+    return q, mn, mx
+
+
+@register_op("_contrib_dequantize_v2", differentiable=False)
+def dequantize_v2(q, mn, mx):
+    """int8 symmetric dequantize, the inverse of _contrib_quantize_v2
+    (the uint8 affine `dequantize` lives in ops/contrib.py)."""
+    return q.astype(jnp.float32) * _q_scale(mn, mx)
+
+
+@register_op("_contrib_quantized_fully_connected", differentiable=False)
+def quantized_fully_connected(x, weight, x_min, x_max, w_min, w_max,
+                              bias=None, num_hidden=0, no_bias=False,
+                              flatten=True):
+    """int8 GEMM with int32 accumulation; float bias is added after
+    dequantization (simpler than the reference's int32-bias requantize,
+    same numerics class)."""
+    if flatten and x.ndim > 2:
+        x = jnp.reshape(x, (x.shape[0], -1))
+    acc = lax.dot_general(x, weight,
+                          (((x.ndim - 1,), (1,)), ((), ())),
+                          preferred_element_type=jnp.int32)
+    out = acc.astype(jnp.float32) * (_q_scale(x_min, x_max) *
+                                     _q_scale(w_min, w_max))
+    if not no_bias and bias is not None:
+        out = out + bias
+    return out
+
+
+@register_op("_contrib_quantized_conv", differentiable=False)
+def quantized_conv(x, weight, x_min, x_max, w_min, w_max, bias=None,
+                   kernel=(), stride=(), dilate=(), pad=(), num_filter=0,
+                   num_group=1, no_bias=False):
+    """int8 NCHW convolution, int32 accumulation (cuDNN int8 conv
+    analogue — on TPU the MXU takes int8 natively)."""
+    ndim = len(kernel) if kernel else x.ndim - 2
+    stride = tuple(stride) if stride else (1,) * ndim
+    dilate = tuple(dilate) if dilate else (1,) * ndim
+    pad = tuple(pad) if pad else (0,) * ndim
+    spatial = "DHW"[3 - ndim:]
+    dn = lax.conv_dimension_numbers(
+        x.shape, weight.shape,
+        ("NC" + spatial, "OI" + spatial, "NC" + spatial))
+    acc = lax.conv_general_dilated(
+        x, weight, window_strides=stride,
+        padding=[(p, p) for p in pad], rhs_dilation=dilate,
+        dimension_numbers=dn, feature_group_count=num_group,
+        preferred_element_type=jnp.int32)
+    out = acc.astype(jnp.float32) * (_q_scale(x_min, x_max) *
+                                     _q_scale(w_min, w_max))
+    if not no_bias and bias is not None:
+        out = out + bias.reshape((1, -1) + (1,) * ndim)
+    return out
+
+
+# ----------------------------------------------------------- calibration
+
+def optimal_thresholds(hist, hist_edges, num_quantized_bins=255):
+    """KL-divergence-optimal |threshold| from a symmetric histogram
+    (parity: _get_optimal_threshold / the TensorRT-style KL search in
+    quantization/calibrate).  P is the windowed histogram with clipped
+    outlier mass folded into its edge bins; Q is the window re-binned to
+    num_quantized_bins WITHOUT the outlier mass — so clipping real mass
+    shows up as P-edge >> Q-edge divergence, and over-wide windows pay
+    through coarse re-binning.  Returns the |edge| minimizing KL(P||Q)."""
+    num_bins = len(hist)
+    zero = num_bins // 2
+    best_kl, best_t = np.inf, abs(hist_edges[-1])
+    for i in range(num_quantized_bins // 2, zero + 1):
+        lo, hi = zero - i, zero + i
+        sliced = hist[lo:hi].astype(np.float64)
+        p = sliced.copy()
+        p[0] += hist[:lo].sum()
+        p[-1] += hist[hi:].sum()
+        if p.sum() == 0:
+            continue
+        # re-bin the (outlier-free) window to the quantized grid, then
+        # expand back over the nonzero support of the window
+        factor = len(sliced) / num_quantized_bins
+        q = np.zeros_like(sliced)
+        for j in range(num_quantized_bins):
+            a = int(np.floor(j * factor))
+            b = min(int(np.ceil((j + 1) * factor)), len(sliced))
+            chunk = sliced[a:b]
+            cnt = (chunk > 0).sum()
+            if cnt:
+                q[a:b][chunk > 0] = chunk.sum() / cnt
+        p_n = p / p.sum()
+        if q.sum() == 0:
+            continue
+        q_n = q / q.sum()
+        support = p_n > 0
+        q_s = np.where(q_n[support] > 0, q_n[support], 1e-10)
+        kl = float(np.sum(p_n[support] * np.log(p_n[support] / q_s)))
+        if kl < best_kl:
+            best_kl = kl
+            best_t = abs(hist_edges[hi])
+    return best_t
+
+
+class _Collector:
+    """Per-layer input statistics over calibration batches."""
+
+    def __init__(self, mode, num_bins=2048):
+        self.mode = mode
+        self.num_bins = num_bins
+        self.minmax = {}
+        self.hists = {}
+
+    def update(self, name, arr):
+        arr = np.asarray(arr)
+        mn, mx = float(arr.min()), float(arr.max())
+        if name in self.minmax:
+            omn, omx = self.minmax[name]
+            self.minmax[name] = (min(mn, omn), max(mx, omx))
+        else:
+            self.minmax[name] = (mn, mx)
+        if self.mode == "entropy":
+            th = max(abs(mn), abs(mx), 1e-8)
+            hist, edges = np.histogram(arr, bins=self.num_bins,
+                                       range=(-th, th))
+            self.hists.setdefault(name, []).append((hist, edges))
+
+    def ranges(self):
+        out = {}
+        for name, (mn, mx) in self.minmax.items():
+            if self.mode == "entropy":
+                # merge per-batch histograms onto one grid spanning the
+                # global range (midpoint re-binning), then KL-search
+                th = max(abs(mn), abs(mx), 1e-8)
+                edges = np.linspace(-th, th, self.num_bins + 1)
+                grid = np.zeros(self.num_bins, np.int64)
+                for h, e in self.hists[name]:
+                    mids = (e[:-1] + e[1:]) / 2
+                    idx = np.clip(np.searchsorted(edges, mids) - 1, 0,
+                                  self.num_bins - 1)
+                    np.add.at(grid, idx, h)
+                t = optimal_thresholds(grid, edges)
+                out[name] = (-t, t)
+            else:
+                out[name] = (mn, mx)
+        return out
+
+
+# --------------------------------------------------------- graph rewrite
+
+def quantize_params(qsym, params):
+    """int8-quantize the weights referenced by a quantized symbol
+    (parity: quantize_params)."""
+    out = {}
+    for name in set(qsym.list_arguments()) | \
+            set(qsym.list_auxiliary_states()):
+        if name.endswith("_quantized"):
+            src = name[:-len("_quantized")]
+            w = params[src].asnumpy()
+            t = max(abs(w.min()), abs(w.max()), 1e-8)
+            scale = t / 127.0
+            out[name] = nd.array(
+                np.clip(np.round(w / scale), -127, 127).astype(np.int8))
+            out[src + "_qmin"] = nd.array(np.float32(-t))
+            out[src + "_qmax"] = nd.array(np.float32(t))
+        elif name in params:
+            out[name] = params[name]
+    return out
+
+
+def _rebuild_quantized(sym, ranges, excluded):
+    """Topo-rebuild the graph, swapping quantizable nodes onto the int8
+    ops with calibrated input ranges."""
+    from ..symbol import Symbol, Variable
+
+    memo = {}
+
+    def rebuild(s):
+        node = s._node
+        if id(node) in memo:
+            return memo[id(node)][s._index] if node.num_outputs > 1 \
+                else memo[id(node)]
+        if node.op is None:
+            out = s
+            memo[id(node)] = out
+            return out
+        new_inputs = [rebuild(i) for i in node.inputs]
+        if node.op in QUANTIZABLE and node.name not in excluded and \
+                node.name in ranges:
+            mn, mx = ranges[node.name]
+            data = new_inputs[0]
+            wname = node.inputs[1].name
+            w_q = Variable(wname + "_quantized")
+            w_mn = Variable(wname + "_qmin")
+            w_mx = Variable(wname + "_qmax")
+            no_bias = node.kwargs.get("no_bias", False)
+            bias = None if no_bias or len(new_inputs) < 3 else new_inputs[2]
+            calib_kw = {} if mn is None else dict(
+                min_calib_range=float(mn), max_calib_range=float(mx))
+            q_data = Symbol._create(
+                "_contrib_quantize_v2", None, [data], calib_kw,
+                name=node.name + "_quantize")
+            q_data._node.num_outputs = 3
+            qop = ("_contrib_quantized_fully_connected"
+                   if node.op == "FullyConnected"
+                   else "_contrib_quantized_conv")
+            kwargs = dict(node.kwargs)
+            for junk in ("cudnn_tune", "cudnn_off", "workspace", "layout"):
+                kwargs.pop(junk, None)
+            ins = [q_data[0], w_q, q_data[1], q_data[2], w_mn, w_mx]
+            if bias is not None:
+                ins.append(bias)  # trailing optional bias slot
+            else:
+                kwargs["no_bias"] = True
+            out = Symbol._create(qop, None, ins, kwargs,
+                                 name=node.name + "_quantized")
+        else:
+            args = []
+            it = iter(new_inputs)
+            for slot in node.arg_layout:
+                args.append(next(it) if slot is None else slot)
+            for extra in it:
+                args.append(extra)
+            out = Symbol._create(node.op, None, args, dict(node.kwargs),
+                                 name=node.name)
+            out._node.num_outputs = node.num_outputs
+            out._node.attrs.update(node.attrs)
+        memo[id(node)] = out
+        return out if node.num_outputs == 1 else out[s._index]
+
+    roots = [rebuild(Symbol(n, 0)) for n in sym._roots()]
+    if len(roots) == 1:
+        return roots[0]
+    from ..symbol import Group
+    return Group(roots)
+
+
+def quantize_model(sym, arg_params, aux_params=None, data_names=("data",),
+                   excluded_sym_names=(), calib_mode="naive",
+                   calib_data=None, num_calib_examples=None,
+                   quantized_dtype="int8", ctx=None, logger=None):
+    """Quantize a model (parity: mx.contrib.quantization.quantize_model).
+
+    Returns (qsym, qarg_params, aux_params).  calib_data: iterable of
+    batches (dict name→NDArray, or single-array batches for one data
+    input) used to calibrate input ranges of quantized layers; with
+    calib_mode='none' ranges are computed at runtime per batch.
+    """
+    if quantized_dtype != "int8":
+        raise MXTPUError("only int8 quantization is supported")
+    aux_params = aux_params or {}
+    excluded = set(excluded_sym_names)
+
+    targets = [n for n in sym._topo()
+               if n.op in QUANTIZABLE and n.name not in excluded]
+    if not targets:
+        raise MXTPUError("quantize_model: nothing to quantize")
+
+    if calib_mode in ("naive", "entropy"):
+        if calib_data is None:
+            raise MXTPUError("calib_mode=%r needs calib_data" % calib_mode)
+        ranges = _calibrate(sym, arg_params, aux_params, data_names,
+                            targets, calib_data, calib_mode,
+                            num_calib_examples)
+    elif calib_mode == "none":
+        ranges = {n.name: (None, None) for n in targets}
+    else:
+        raise MXTPUError("unknown calib_mode %r" % calib_mode)
+
+    qsym = _rebuild_quantized(sym, ranges, excluded)
+    params = dict(arg_params)
+    params.update(aux_params)
+    qarg = quantize_params(qsym, params)
+    qaux = {k: v for k, v in aux_params.items()
+            if k in set(qsym.list_auxiliary_states())}
+    return qsym, qarg, qaux
+
+
+def _calibrate(sym, arg_params, aux_params, data_names, targets,
+               calib_data, mode, num_examples):
+    """Run fp32 forwards over calib batches, collecting each quantizable
+    node's INPUT activation stats (the tensor that will be quantized)."""
+    from ..symbol import Group
+    from ..context import cpu
+
+    taps = [t.inputs[0] for t in targets]
+    tap_sym = Group(list(taps))
+    collector = _Collector(mode)
+    seen = 0
+    for batch in calib_data:
+        if not isinstance(batch, dict):
+            batch = {data_names[0]: batch}
+        args = {k: (v if isinstance(v, NDArray) else nd.array(v))
+                for k, v in batch.items()}
+        args.update(arg_params)
+        arg_names = set(tap_sym.list_arguments())
+        aux = dict(aux_params)
+        ex = tap_sym.bind(cpu(),
+                          {k: v for k, v in args.items()
+                           if k in arg_names},
+                          aux_states=aux)
+        outs = ex.forward()
+        for t, out in zip(targets, outs[:len(targets)]):
+            collector.update(t.name, out.asnumpy())
+        seen += next(iter(batch.values())).shape[0]
+        if num_examples and seen >= num_examples:
+            break
+    return collector.ranges()

@@ -1,0 +1,122 @@
+"""SVRG (stochastic variance-reduced gradient) training module (parity:
+python/mxnet/contrib/svrg_optimization/svrg_module.py + svrg_optimizer.py).
+
+SVRG keeps a snapshot of the parameters taken every `update_freq` epochs
+and the FULL-dataset gradient at that snapshot; each minibatch update uses
+    g = grad(w) - grad(w_snapshot) + full_grad(w_snapshot)
+which is an unbiased, lower-variance gradient estimate.  The reference
+implements this as a Module subclass driving two executors plus a special
+KVStore optimizer pair (_SVRGOptimizer); here the same algebra runs over
+the Module API directly — the snapshot executor is a second Module bound
+to shared data shapes.
+"""
+
+from __future__ import annotations
+
+import logging
+
+import numpy as np
+
+from ... import ndarray as nd
+from ...module.module import Module
+
+__all__ = ["SVRGModule"]
+
+
+class SVRGModule(Module):
+    def __init__(self, symbol, data_names=("data",),
+                 label_names=("softmax_label",), update_freq=2,
+                 logger=logging, **kwargs):
+        super().__init__(symbol, data_names=data_names,
+                         label_names=label_names, logger=logger, **kwargs)
+        if update_freq < 1:
+            raise ValueError("update_freq must be >= 1")
+        self.update_freq = update_freq
+        self._mod_aux = Module(symbol, data_names=data_names,
+                               label_names=label_names, logger=logger,
+                               **kwargs)
+        self._param_dict = None   # full grad at snapshot, per param
+        self._snapshot_epoch = -1
+
+    # -- plumbing shared with the aux (snapshot) module -------------------
+    def bind(self, *args, **kwargs):
+        super().bind(*args, **kwargs)
+        self._mod_aux.bind(*args, **kwargs)
+
+    def init_params(self, *args, **kwargs):
+        super().init_params(*args, **kwargs)
+        self._sync_snapshot_params()
+
+    def _sync_snapshot_params(self):
+        arg, aux = self.get_params()
+        self._mod_aux.set_params({k: v.copy() for k, v in arg.items()},
+                                 {k: v.copy() for k, v in aux.items()})
+
+    # -- SVRG specifics ----------------------------------------------------
+    @staticmethod
+    def _grad_arrays(mod):
+        gd = mod._exec.grad_dict
+        return {n: gd[n] for n in mod._param_names if gd.get(n) is not None}
+
+    def update_full_grads(self, train_data):
+        """Snapshot current params and accumulate the full-dataset
+        gradient at the snapshot (parity: SVRGModule.update_full_grads)."""
+        self._sync_snapshot_params()
+        if hasattr(train_data, "reset"):
+            train_data.reset()
+        acc = None
+        nbatch = 0
+        for batch in train_data:
+            self._mod_aux.forward_backward(batch)
+            grads = self._grad_arrays(self._mod_aux)
+            if acc is None:
+                acc = {k: g.asnumpy().copy() for k, g in grads.items()}
+            else:
+                for k, g in grads.items():
+                    acc[k] += g.asnumpy()
+            nbatch += 1
+        if not nbatch:
+            raise ValueError("update_full_grads: empty iterator")
+        self._param_dict = {k: nd.array(v / nbatch)
+                            for k, v in acc.items()}
+        if hasattr(train_data, "reset"):
+            train_data.reset()
+
+    def update_svrg_gradients(self):
+        """Rewrite this module's gradients in place:
+        g ← g - g_snapshot(batch) + full_grad_snapshot."""
+        if self._param_dict is None:
+            return
+        cur = self._grad_arrays(self)
+        snap = self._grad_arrays(self._mod_aux)
+        for name, g in cur.items():
+            adj = g.asnumpy() - snap[name].asnumpy() + \
+                self._param_dict[name].asnumpy()
+            g._rebind(nd.array(adj)._data)
+
+    def forward_backward(self, data_batch):
+        super().forward_backward(data_batch)
+        if self._param_dict is not None:
+            # same minibatch through the snapshot weights
+            self._mod_aux.forward_backward(data_batch)
+            self.update_svrg_gradients()
+
+    def fit(self, train_data, eval_data=None, eval_metric="acc",
+            num_epoch=None, **kwargs):
+        """Training loop with the SVRG schedule: refresh the snapshot +
+        full gradient every `update_freq` epochs (parity:
+        SVRGModule.fit)."""
+        if num_epoch is None:
+            raise ValueError("num_epoch required")
+        for epoch in range(num_epoch):
+            if epoch % self.update_freq == 0:
+                if not (self.binded and self.params_initialized):
+                    # zero-epoch fit: bind + init_params + init_optimizer
+                    # without running batches (range(begin, num) is empty)
+                    super().fit(train_data, eval_data, eval_metric,
+                                begin_epoch=epoch, num_epoch=epoch,
+                                **kwargs)
+                self.update_full_grads(train_data)
+            super().fit(train_data, eval_data, eval_metric,
+                        begin_epoch=epoch, num_epoch=epoch + 1, **kwargs)
+        return self

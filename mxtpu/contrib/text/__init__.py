@@ -1,0 +1,4 @@
+"""Text utilities (parity: python/mxnet/contrib/text/)."""
+
+from . import embedding, utils, vocab  # noqa: F401
+from .vocab import Vocabulary  # noqa: F401

@@ -1,0 +1,215 @@
+"""Token embeddings (parity: python/mxnet/contrib/text/embedding.py —
+`TokenEmbedding` registry + from-file loaders + CompositeEmbedding).
+
+The reference downloads pretrained GloVe/FastText tables; with zero
+network here the same classes load from local files in the identical
+text format (`token v1 v2 ... vN` per line, optional fastText header
+line), so user-supplied pretrained files work unchanged.
+"""
+
+from __future__ import annotations
+
+import io
+import os
+
+import numpy as np
+
+from ... import ndarray as nd
+from ...base import MXTPUError
+from .vocab import Vocabulary
+
+__all__ = ["register", "create", "get_pretrained_file_names",
+           "TokenEmbedding", "CustomEmbedding", "CompositeEmbedding",
+           "GloVe", "FastText"]
+
+_REGISTRY = {}
+
+
+def register(cls):
+    """Register an embedding class (parity: embedding.register)."""
+    _REGISTRY[cls.__name__.lower()] = cls
+    return cls
+
+
+def create(embedding_name, **kwargs):
+    """Create a registered embedding by name (parity: embedding.create)."""
+    cls = _REGISTRY.get(embedding_name.lower())
+    if cls is None:
+        raise MXTPUError("unknown embedding %r; registered: %s"
+                         % (embedding_name, sorted(_REGISTRY)))
+    return cls(**kwargs)
+
+
+def get_pretrained_file_names(embedding_name=None):
+    """Names of pretrained tables each class knows how to parse.  (The
+    reference returns downloadable archives; here the names document the
+    expected local-file naming.)"""
+    if embedding_name is not None:
+        cls = _REGISTRY.get(embedding_name.lower())
+        if cls is None:
+            raise MXTPUError("unknown embedding %r" % embedding_name)
+        return list(cls.pretrained_file_names)
+    return {name: list(cls.pretrained_file_names)
+            for name, cls in _REGISTRY.items()}
+
+
+class TokenEmbedding:
+    """Base: token → vector lookup table with an unknown-token vector."""
+
+    pretrained_file_names = ()
+
+    def __init__(self, unknown_token="<unk>",
+                 init_unknown_vec=None):
+        self._unknown_token = unknown_token
+        self._init_unknown_vec = init_unknown_vec or (lambda s: np.zeros(s))
+        self._idx_to_token = [unknown_token]
+        self._token_to_idx = {unknown_token: 0}
+        self._idx_to_vec = None  # NDArray (N, dim)
+
+    # -- file loading ----------------------------------------------------
+    def _load_embedding_txt(self, path, elem_delim=" ", encoding="utf8"):
+        vecs = []
+        dim = None
+        with io.open(path, "r", encoding=encoding) as f:
+            for lineno, line in enumerate(f):
+                parts = line.rstrip().split(elem_delim)
+                if lineno == 0 and len(parts) == 2:
+                    continue  # fastText header: "<count> <dim>"
+                if len(parts) < 2:
+                    continue
+                token, elems = parts[0], parts[1:]
+                if dim is None:
+                    dim = len(elems)
+                elif len(elems) != dim:
+                    raise MXTPUError(
+                        "%s:%d: inconsistent vector length %d != %d"
+                        % (path, lineno + 1, len(elems), dim))
+                if token in self._token_to_idx:
+                    continue
+                self._token_to_idx[token] = len(self._idx_to_token)
+                self._idx_to_token.append(token)
+                vecs.append(np.asarray(elems, dtype=np.float32))
+        if dim is None:
+            raise MXTPUError("no vectors found in %s" % path)
+        table = np.empty((len(self._idx_to_token), dim), np.float32)
+        table[0] = self._init_unknown_vec((dim,))
+        if vecs:
+            table[1:] = np.stack(vecs)
+        self._idx_to_vec = nd.array(table)
+
+    # -- API -------------------------------------------------------------
+    def __len__(self):
+        return len(self._idx_to_token)
+
+    @property
+    def vec_len(self):
+        return int(self._idx_to_vec.shape[1])
+
+    @property
+    def unknown_token(self):
+        return self._unknown_token
+
+    @property
+    def idx_to_vec(self):
+        return self._idx_to_vec
+
+    @property
+    def token_to_idx(self):
+        return self._token_to_idx
+
+    @property
+    def idx_to_token(self):
+        return self._idx_to_token
+
+    def get_vecs_by_tokens(self, tokens, lower_case_backup=False):
+        single = isinstance(tokens, str)
+        toks = [tokens] if single else tokens
+        idxs = []
+        for t in toks:
+            if t in self._token_to_idx:
+                idxs.append(self._token_to_idx[t])
+            elif lower_case_backup and t.lower() in self._token_to_idx:
+                idxs.append(self._token_to_idx[t.lower()])
+            else:
+                idxs.append(0)
+        out = self._idx_to_vec[np.asarray(idxs)]
+        return out[0] if single else out
+
+    def update_token_vectors(self, tokens, new_vectors):
+        toks = [tokens] if isinstance(tokens, str) else tokens
+        arr = np.array(self._idx_to_vec.asnumpy())  # writable copy
+        new = new_vectors.asnumpy() if hasattr(new_vectors, "asnumpy") \
+            else np.asarray(new_vectors, np.float32)
+        new = new.reshape(len(toks), -1)
+        for t, v in zip(toks, new):
+            if t not in self._token_to_idx:
+                raise MXTPUError("token %r not indexed" % t)
+            arr[self._token_to_idx[t]] = v
+        self._idx_to_vec = nd.array(arr)
+
+
+@register
+class GloVe(TokenEmbedding):
+    """GloVe text-format table from a local file (parity: text.embedding
+    .GloVe minus the download step)."""
+
+    pretrained_file_names = (
+        "glove.42B.300d.txt", "glove.6B.50d.txt", "glove.6B.100d.txt",
+        "glove.6B.200d.txt", "glove.6B.300d.txt", "glove.840B.300d.txt",
+        "glove.twitter.27B.25d.txt", "glove.twitter.27B.50d.txt",
+        "glove.twitter.27B.100d.txt", "glove.twitter.27B.200d.txt")
+
+    def __init__(self, pretrained_file_name="glove.6B.50d.txt",
+                 embedding_root=None, **kwargs):
+        super().__init__(**kwargs)
+        path = pretrained_file_name if os.path.isabs(pretrained_file_name) \
+            else os.path.join(embedding_root or ".", pretrained_file_name)
+        self._load_embedding_txt(path)
+
+
+@register
+class FastText(TokenEmbedding):
+    """fastText .vec table (same line format, with a count/dim header)."""
+
+    pretrained_file_names = (
+        "wiki.en.vec", "wiki.simple.vec", "wiki.zh.vec", "wiki.fr.vec")
+
+    def __init__(self, pretrained_file_name="wiki.simple.vec",
+                 embedding_root=None, **kwargs):
+        super().__init__(**kwargs)
+        path = pretrained_file_name if os.path.isabs(pretrained_file_name) \
+            else os.path.join(embedding_root or ".", pretrained_file_name)
+        self._load_embedding_txt(path)
+
+
+class CustomEmbedding(TokenEmbedding):
+    """Any local file in `token<delim>v1<delim>...` format (parity:
+    text.embedding.CustomEmbedding)."""
+
+    def __init__(self, pretrained_file_path, elem_delim=" ",
+                 encoding="utf8", **kwargs):
+        super().__init__(**kwargs)
+        self._load_embedding_txt(pretrained_file_path, elem_delim,
+                                 encoding)
+
+
+class CompositeEmbedding(TokenEmbedding):
+    """Concatenate several embeddings over one vocabulary (parity:
+    text.embedding.CompositeEmbedding)."""
+
+    def __init__(self, vocabulary, token_embeddings):
+        if not isinstance(vocabulary, Vocabulary):
+            raise MXTPUError("vocabulary must be a text.vocab.Vocabulary")
+        if not isinstance(token_embeddings, (list, tuple)):
+            token_embeddings = [token_embeddings]
+        super().__init__(unknown_token=vocabulary.unknown_token)
+        self._vocab = vocabulary
+        self._idx_to_token = list(vocabulary.idx_to_token)
+        self._token_to_idx = dict(vocabulary.token_to_idx)
+        parts = [e.get_vecs_by_tokens(self._idx_to_token).asnumpy()
+                 for e in token_embeddings]
+        self._idx_to_vec = nd.array(np.concatenate(parts, axis=1))
+
+    @property
+    def vocabulary(self):
+        return self._vocab

@@ -1,0 +1,21 @@
+"""Text helpers (parity: python/mxnet/contrib/text/utils.py)."""
+
+import collections
+import re
+
+__all__ = ["count_tokens_from_str"]
+
+
+def count_tokens_from_str(source_str, token_delim=" ", seq_delim="\n",
+                          to_lower=False, counter_to_update=None):
+    """Tokenize a string and count tokens (parity:
+    count_tokens_from_str)."""
+    source_str = re.sub("[%s%s]" % (token_delim, seq_delim), " ",
+                        source_str)
+    tokens = [t for t in source_str.split(" ") if t]
+    if to_lower:
+        tokens = [t.lower() for t in tokens]
+    counter = counter_to_update if counter_to_update is not None \
+        else collections.Counter()
+    counter.update(tokens)
+    return counter

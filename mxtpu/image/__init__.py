@@ -1,0 +1,19 @@
+"""Image pipeline (parity: python/mxnet/image/ — image.py + detection.py).
+
+The classification pipeline lives in mxtpu/_image_impl.py (kept as one
+module for its ImageIter/recordio coupling); this package re-exports it
+and adds the detection augmenters.
+"""
+
+from .._image_impl import *  # noqa: F401,F403
+from .._image_impl import (Augmenter, SequentialAug, RandomOrderAug,  # noqa: F401
+                           CreateAugmenter, ImageIter, imdecode, imread,
+                           imresize, fixed_crop, random_crop, center_crop,
+                           scale_down, resize_short, color_normalize,
+                           HorizontalFlipAug, CastAug, ResizeAug,
+                           ForceResizeAug, RandomCropAug, CenterCropAug,
+                           RandomSizedCropAug, BrightnessJitterAug,
+                           ContrastJitterAug, SaturationJitterAug,
+                           HueJitterAug, ColorJitterAug, LightingAug,
+                           ColorNormalizeAug)
+from .detection import *  # noqa: F401,F403

@@ -1,0 +1,263 @@
+"""Detection augmenters (parity: python/mxnet/image/detection.py).
+
+Labels are (N, 5+) float arrays, one row per object:
+``[class_id, xmin, ymin, xmax, ymax, ...]`` with coordinates normalized
+to [0, 1] — the reference's contract.  All geometry transforms update the
+label; objects whose remaining visible area fraction falls below
+``min_eject_coverage`` after a crop are ejected (class set by removal).
+Host-side numpy work, like the reference (augmentation never belongs on
+the TPU).
+"""
+
+from __future__ import annotations
+
+import json
+import random as pyrandom
+
+import numpy as np
+
+from .._image_impl import (Augmenter, HorizontalFlipAug, ResizeAug,
+                           ForceResizeAug, CastAug, ColorJitterAug,
+                           LightingAug, ColorNormalizeAug,
+                           BrightnessJitterAug, ContrastJitterAug,
+                           SaturationJitterAug, HueJitterAug,
+                           RandomOrderAug, fixed_crop, _np)
+
+__all__ = ["DetAugmenter", "DetBorrowAug", "DetRandomSelectAug",
+           "DetHorizontalFlipAug", "DetRandomCropAug", "DetRandomPadAug",
+           "CreateDetAugmenter"]
+
+
+class DetAugmenter:
+    """Detection augmenter base (parity: detection.DetAugmenter)."""
+
+    def __init__(self, **kwargs):
+        self._kwargs = kwargs
+
+    def dumps(self):
+        return json.dumps([self.__class__.__name__.lower(), self._kwargs])
+
+    def __call__(self, src, label):
+        raise NotImplementedError
+
+
+class DetBorrowAug(DetAugmenter):
+    """Wrap an image-only Augmenter; the label passes through (parity:
+    DetBorrowAug)."""
+
+    def __init__(self, augmenter):
+        if not isinstance(augmenter, Augmenter):
+            raise TypeError("DetBorrowAug requires an image Augmenter")
+        super().__init__(augmenter=augmenter.dumps())
+        self.augmenter = augmenter
+
+    def __call__(self, src, label):
+        return self.augmenter(src), label
+
+
+class DetRandomSelectAug(DetAugmenter):
+    """Randomly pick one of several augmenters, or none (parity:
+    DetRandomSelectAug)."""
+
+    def __init__(self, aug_list, skip_prob=0.0):
+        super().__init__(skip_prob=skip_prob)
+        self.aug_list = list(aug_list)
+        self.skip_prob = skip_prob
+
+    def __call__(self, src, label):
+        if pyrandom.random() < self.skip_prob or not self.aug_list:
+            return src, label
+        return pyrandom.choice(self.aug_list)(src, label)
+
+
+class DetHorizontalFlipAug(DetAugmenter):
+    """Mirror image and x-coordinates together (parity:
+    DetHorizontalFlipAug)."""
+
+    def __init__(self, p=0.5):
+        super().__init__(p=p)
+        self.p = p
+
+    def __call__(self, src, label):
+        if pyrandom.random() < self.p:
+            src = _np(src)[:, ::-1, :]
+            label = label.copy()
+            xmin = label[:, 1].copy()
+            label[:, 1] = 1.0 - label[:, 3]
+            label[:, 3] = 1.0 - xmin
+        return src, label
+
+
+def _overlap_frac(boxes, crop):
+    """Fraction of each box's area inside crop (both normalized xyxy)."""
+    x0 = np.maximum(boxes[:, 0], crop[0])
+    y0 = np.maximum(boxes[:, 1], crop[1])
+    x1 = np.minimum(boxes[:, 2], crop[2])
+    y1 = np.minimum(boxes[:, 3], crop[3])
+    inter = np.clip(x1 - x0, 0, None) * np.clip(y1 - y0, 0, None)
+    area = np.clip(boxes[:, 2] - boxes[:, 0], 1e-12, None) * \
+        np.clip(boxes[:, 3] - boxes[:, 1], 1e-12, None)
+    return inter / area
+
+
+def _update_labels(label, crop):
+    """Re-express labels in a crop's coordinate frame; returns the new
+    label rows (pre-filtered by caller)."""
+    cw = crop[2] - crop[0]
+    ch = crop[3] - crop[1]
+    out = label.copy()
+    out[:, 1] = np.clip((label[:, 1] - crop[0]) / cw, 0, 1)
+    out[:, 2] = np.clip((label[:, 2] - crop[1]) / ch, 0, 1)
+    out[:, 3] = np.clip((label[:, 3] - crop[0]) / cw, 0, 1)
+    out[:, 4] = np.clip((label[:, 4] - crop[1]) / ch, 0, 1)
+    return out
+
+
+class DetRandomCropAug(DetAugmenter):
+    """Random crop constrained by object coverage (parity:
+    DetRandomCropAug — the SSD-style sampler: a crop is accepted only if
+    every kept object is covered at least ``min_object_covered``; objects
+    covered less than ``min_eject_coverage`` are dropped from the
+    label)."""
+
+    def __init__(self, min_object_covered=0.1,
+                 aspect_ratio_range=(0.75, 1.33),
+                 area_range=(0.05, 1.0), min_eject_coverage=0.3,
+                 max_attempts=50):
+        super().__init__(min_object_covered=min_object_covered,
+                         aspect_ratio_range=aspect_ratio_range,
+                         area_range=area_range,
+                         min_eject_coverage=min_eject_coverage,
+                         max_attempts=max_attempts)
+        self.min_object_covered = min_object_covered
+        self.aspect_ratio_range = aspect_ratio_range
+        self.area_range = area_range
+        self.min_eject_coverage = min_eject_coverage
+        self.max_attempts = max_attempts
+
+    def _sample_crop(self, label):
+        for _ in range(self.max_attempts):
+            area = pyrandom.uniform(*self.area_range)
+            ratio = pyrandom.uniform(*self.aspect_ratio_range)
+            w = min(np.sqrt(area * ratio), 1.0)
+            h = min(area / max(w, 1e-12), 1.0)
+            x0 = pyrandom.uniform(0, 1 - w)
+            y0 = pyrandom.uniform(0, 1 - h)
+            crop = (x0, y0, x0 + w, y0 + h)
+            if label.size == 0:
+                return crop, label
+            frac = _overlap_frac(label[:, 1:5], crop)
+            keep = frac >= self.min_eject_coverage
+            if not keep.any():
+                continue
+            if (frac[keep] >= self.min_object_covered).all():
+                return crop, _update_labels(label[keep], crop)
+        return None, None
+
+    def __call__(self, src, label):
+        crop, new_label = self._sample_crop(label)
+        if crop is None:
+            return src, label
+        img = _np(src)
+        h, w = img.shape[:2]
+        x0, y0 = int(crop[0] * w), int(crop[1] * h)
+        cw = max(int((crop[2] - crop[0]) * w), 1)
+        ch = max(int((crop[3] - crop[1]) * h), 1)
+        return img[y0:y0 + ch, x0:x0 + cw], new_label
+
+
+class DetRandomPadAug(DetAugmenter):
+    """Random expansion padding; labels shrink into the new canvas
+    (parity: DetRandomPadAug)."""
+
+    def __init__(self, aspect_ratio_range=(0.75, 1.33),
+                 area_range=(1.0, 3.0), max_attempts=50,
+                 pad_val=(127, 127, 127)):
+        super().__init__(aspect_ratio_range=aspect_ratio_range,
+                         area_range=area_range, max_attempts=max_attempts,
+                         pad_val=pad_val)
+        self.aspect_ratio_range = aspect_ratio_range
+        self.area_range = area_range
+        self.max_attempts = max_attempts
+        self.pad_val = pad_val
+
+    def __call__(self, src, label):
+        img = _np(src)
+        h, w = img.shape[:2]
+        for _ in range(self.max_attempts):
+            area = pyrandom.uniform(*self.area_range)
+            ratio = pyrandom.uniform(*self.aspect_ratio_range)
+            nw = int(w * min(np.sqrt(area * ratio), 4.0))
+            nh = int(h * min(np.sqrt(area / ratio), 4.0))
+            if nw < w or nh < h:
+                continue
+            x0 = pyrandom.randint(0, nw - w)
+            y0 = pyrandom.randint(0, nh - h)
+            canvas = np.empty((nh, nw, img.shape[2]), img.dtype)
+            canvas[:] = np.asarray(self.pad_val, img.dtype)
+            canvas[y0:y0 + h, x0:x0 + w] = img
+            new_label = label.copy()
+            if label.size:
+                new_label[:, 1] = (label[:, 1] * w + x0) / nw
+                new_label[:, 3] = (label[:, 3] * w + x0) / nw
+                new_label[:, 2] = (label[:, 2] * h + y0) / nh
+                new_label[:, 4] = (label[:, 4] * h + y0) / nh
+            return canvas, new_label
+        return img, label
+
+
+def CreateDetAugmenter(data_shape, resize=0, rand_crop=0, rand_pad=0,
+                       rand_gray=0., rand_mirror=False, mean=None,
+                       std=None, brightness=0, contrast=0, saturation=0,
+                       pca_noise=0, hue=0, inter_method=2,
+                       min_object_covered=0.1,
+                       aspect_ratio_range=(0.75, 1.33),
+                       area_range=(0.05, 3.0), min_eject_coverage=0.3,
+                       max_attempts=50, pad_val=(127, 127, 127)):
+    """Standard detection augmenter pipeline (parity:
+    CreateDetAugmenter — same knobs, same ordering: resize → crop/pad →
+    mirror → force-resize to data_shape → color → normalize)."""
+    auglist = []
+    if resize > 0:
+        auglist.append(DetBorrowAug(ResizeAug(resize, inter_method)))
+    if rand_crop > 0:
+        crop = DetRandomCropAug(min_object_covered, aspect_ratio_range,
+                                (area_range[0], min(1.0, area_range[1])),
+                                min_eject_coverage, max_attempts)
+        auglist.append(DetRandomSelectAug([crop], 1 - rand_crop))
+    if rand_pad > 0:
+        pad = DetRandomPadAug(aspect_ratio_range,
+                              (1.0, max(1.0, area_range[1])),
+                              max_attempts, pad_val)
+        auglist.append(DetRandomSelectAug([pad], 1 - rand_pad))
+    if rand_mirror:
+        auglist.append(DetHorizontalFlipAug(0.5))
+    # force to the network input size
+    auglist.append(DetBorrowAug(
+        ForceResizeAug((data_shape[2], data_shape[1]), inter_method)))
+    auglist.append(DetBorrowAug(CastAug()))
+    color = []
+    if brightness:
+        color.append(BrightnessJitterAug(brightness))
+    if contrast:
+        color.append(ContrastJitterAug(contrast))
+    if saturation:
+        color.append(SaturationJitterAug(saturation))
+    if color:
+        auglist.append(DetBorrowAug(RandomOrderAug(color)))
+    if hue:
+        auglist.append(DetBorrowAug(HueJitterAug(hue)))
+    if pca_noise > 0:
+        eigval = np.array([55.46, 4.794, 1.148])
+        eigvec = np.array([[-0.5675, 0.7192, 0.4009],
+                           [-0.5808, -0.0045, -0.8140],
+                           [-0.5836, -0.6948, 0.4203]])
+        auglist.append(DetBorrowAug(LightingAug(pca_noise, eigval,
+                                                eigvec)))
+    if mean is True:
+        mean = np.array([123.68, 116.28, 103.53])
+    if std is True:
+        std = np.array([58.395, 57.12, 57.375])
+    if mean is not None and std is not None:
+        auglist.append(DetBorrowAug(ColorNormalizeAug(mean, std)))
+    return auglist

@@ -791,3 +791,14 @@ def rnn(data, parameters, state, state_cell=None, state_size=0, num_layers=1,
     if is_lstm:
         return x, jnp.stack(h_states), jnp.stack(c_states)
     return x, jnp.stack(h_states)
+
+
+@register_op("SoftmaxActivation", differentiable=True)
+def softmax_activation(x, mode="instance"):
+    """Deprecated reference op (src/operator/nn/softmax_activation.cc):
+    softmax over channels (mode='channel', axis 1) or over all non-batch
+    dims flattened (mode='instance')."""
+    if mode == "channel":
+        return jax.nn.softmax(x, axis=1)
+    flat = jnp.reshape(x, (x.shape[0], -1))
+    return jnp.reshape(jax.nn.softmax(flat, axis=-1), x.shape)

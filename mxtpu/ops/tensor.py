@@ -744,3 +744,12 @@ _SCALAR_CMP = {
 for _sname, _sfn in _SCALAR_CMP.items():
     register_op(_sname, differentiable=False)(
         lambda x, scalar=0.0, _f=_sfn: _f(x, scalar).astype(x.dtype))
+
+
+@register_op("add_n", aliases=("ElementWiseSum",), differentiable=True)
+def add_n(*xs):
+    """Sum of N arrays (parity: src/operator/tensor/elemwise_sum.cc)."""
+    out = xs[0]
+    for x in xs[1:]:
+        out = out + x
+    return out

@@ -1,0 +1,152 @@
+"""contrib.text (vocab/embedding) + contrib.svrg_optimization tests
+(parity: tests/python/unittest/test_contrib_text.py and
+test_contrib_svrg_module.py)."""
+
+import collections
+
+import numpy as np
+import pytest
+
+import mxtpu as mx
+from mxtpu import nd
+from mxtpu.contrib import text
+from mxtpu.contrib.svrg_optimization import SVRGModule
+
+
+# ------------------------------------------------------------------ text
+
+def test_vocabulary_indexing():
+    counter = collections.Counter(
+        ["b", "b", "b", "a", "a", "c", "rare"])
+    v = text.vocab.Vocabulary(counter, min_freq=2,
+                              reserved_tokens=["<pad>"])
+    # index 0 unk, then reserved, then freq desc / ties alpha
+    assert v.idx_to_token == ["<unk>", "<pad>", "b", "a"]
+    assert v.to_indices(["b", "nope", "a"]) == [2, 0, 3]
+    assert v.to_tokens([2, 3]) == ["b", "a"]
+    assert "b" in v and "nope" not in v
+    assert len(v) == 4
+    with pytest.raises(ValueError):
+        v.to_tokens(99)
+    with pytest.raises(ValueError):
+        text.vocab.Vocabulary(counter, reserved_tokens=["<unk>"])
+
+
+def test_count_tokens_from_str():
+    c = text.utils.count_tokens_from_str("Life is Life\nis good",
+                                         to_lower=True)
+    assert c == collections.Counter(
+        {"life": 2, "is": 2, "good": 1})
+
+
+def test_custom_embedding_and_composite(tmp_path):
+    p1 = tmp_path / "emb1.txt"
+    p1.write_text("hello 1.0 2.0\nworld 3.0 4.0\n")
+    p2 = tmp_path / "emb2.txt"
+    p2.write_text("2 3\nhello 0.1 0.2 0.3\nthere 0.4 0.5 0.6\n")
+
+    e1 = text.embedding.CustomEmbedding(str(p1))
+    assert e1.vec_len == 2 and len(e1) == 3  # unk + 2 tokens
+    np.testing.assert_allclose(
+        e1.get_vecs_by_tokens("world").asnumpy(), [3.0, 4.0])
+    np.testing.assert_allclose(
+        e1.get_vecs_by_tokens("missing").asnumpy(), [0.0, 0.0])
+
+    # fastText-style header line is skipped
+    e2 = text.embedding.FastText(pretrained_file_name=str(p2))
+    assert e2.vec_len == 3
+    np.testing.assert_allclose(
+        e2.get_vecs_by_tokens("there").asnumpy(), [0.4, 0.5, 0.6])
+
+    vocab = text.vocab.Vocabulary(
+        collections.Counter(["hello", "world", "there"]))
+    comp = text.embedding.CompositeEmbedding(vocab, [e1, e2])
+    assert comp.vec_len == 5
+    got = comp.get_vecs_by_tokens("hello").asnumpy()
+    np.testing.assert_allclose(got, [1.0, 2.0, 0.1, 0.2, 0.3])
+
+
+def test_embedding_registry(tmp_path):
+    p = tmp_path / "glove.test.txt"
+    p.write_text("a 1.0\nb 2.0\n")
+    e = text.embedding.create("glove", pretrained_file_name=str(p))
+    assert isinstance(e, text.embedding.GloVe)
+    assert "glove" in text.embedding.get_pretrained_file_names()
+    with pytest.raises(Exception):
+        text.embedding.create("nope")
+
+
+def test_update_token_vectors(tmp_path):
+    p = tmp_path / "e.txt"
+    p.write_text("x 1.0 1.0\ny 2.0 2.0\n")
+    e = text.embedding.CustomEmbedding(str(p))
+    e.update_token_vectors("x", nd.array(np.array([[9.0, 8.0]], "f")))
+    np.testing.assert_allclose(
+        e.get_vecs_by_tokens("x").asnumpy(), [9.0, 8.0])
+
+
+# ------------------------------------------------------------------ svrg
+
+def _lin_data(n=200, dim=5, seed=3):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, dim).astype(np.float32)
+    w = rng.randn(dim).astype(np.float32)
+    y = X @ w + 0.01 * rng.randn(n).astype(np.float32)
+    return X, y
+
+
+def test_svrg_module_trains():
+    from mxtpu import symbol as sym
+    from mxtpu.io import NDArrayIter
+
+    X, y = _lin_data()
+    data = sym.Variable("data")
+    fc = sym.FullyConnected(data, num_hidden=1, name="fc")
+    out = sym.LinearRegressionOutput(fc, sym.Variable("lin_label"),
+                                     name="lin")
+
+    mod = SVRGModule(out, data_names=("data",),
+                     label_names=("lin_label",), update_freq=2)
+    train = NDArrayIter(X, y.reshape(-1, 1), batch_size=20,
+                        shuffle=False, label_name="lin_label")
+    mod.fit(train, num_epoch=6, eval_metric="mse",
+            optimizer="sgd",
+            optimizer_params={"learning_rate": 0.05})
+
+    # converged to a small residual
+    arg, _ = mod.get_params()
+    pred = X @ arg["fc_weight"].asnumpy().T + arg["fc_bias"].asnumpy()
+    mse = float(np.mean((pred.ravel() - y) ** 2))
+    assert mse < 0.05, mse
+    # snapshot machinery was actually engaged
+    assert mod._param_dict is not None
+    assert set(mod._param_dict) == {"fc_weight", "fc_bias"}
+
+
+def test_svrg_gradient_identity_at_snapshot():
+    """At the snapshot point (w == w_snapshot), the SVRG gradient must
+    equal the full-batch gradient: g - g_snap + full = full when the
+    minibatch is the full batch."""
+    from mxtpu import symbol as sym
+    from mxtpu.io import NDArrayIter, DataBatch
+
+    X, y = _lin_data(n=40)
+    data = sym.Variable("data")
+    fc = sym.FullyConnected(data, num_hidden=1, name="fc")
+    out = sym.LinearRegressionOutput(fc, sym.Variable("lin_label"),
+                                     name="lin")
+    mod = SVRGModule(out, data_names=("data",),
+                     label_names=("lin_label",), update_freq=1)
+    it = NDArrayIter(X, y.reshape(-1, 1), batch_size=40,
+                     label_name="lin_label")
+    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    mod.init_params()
+    mod.update_full_grads(it)
+
+    batch = DataBatch(data=[nd.array(X)],
+                      label=[nd.array(y.reshape(-1, 1))])
+    mod.forward_backward(batch)
+    g_svrg = mod._grad_arrays(mod)["fc_weight"].asnumpy()
+    np.testing.assert_allclose(
+        g_svrg, mod._param_dict["fc_weight"].asnumpy(),
+        rtol=1e-4, atol=1e-5)

@@ -1,0 +1,93 @@
+"""Detection augmenter tests (parity: tests/python/unittest/test_image.py
+TestImage.test_det_augmenters — label-consistency under geometry)."""
+
+import random
+
+import numpy as np
+
+from mxtpu.image import detection as det
+
+
+def _img(h=64, w=48):
+    return np.random.RandomState(0).randint(
+        0, 255, (h, w, 3)).astype(np.uint8)
+
+
+def _label():
+    # [cls, xmin, ymin, xmax, ymax]
+    return np.array([[0, 0.1, 0.2, 0.4, 0.6],
+                     [1, 0.5, 0.5, 0.9, 0.8]], np.float32)
+
+
+def test_det_horizontal_flip_updates_boxes():
+    random.seed(0)
+    aug = det.DetHorizontalFlipAug(p=1.0)
+    img, lab = aug(_img(), _label())
+    np.testing.assert_allclose(lab[0, [1, 3]], [0.6, 0.9], atol=1e-6)
+    np.testing.assert_allclose(lab[1, [1, 3]], [0.1, 0.5], atol=1e-6)
+    # widths preserved, ymin/ymax untouched
+    ref = _label()
+    np.testing.assert_allclose(lab[:, 3] - lab[:, 1],
+                               ref[:, 3] - ref[:, 1], atol=1e-6)
+    np.testing.assert_allclose(lab[:, [2, 4]], ref[:, [2, 4]])
+    # double flip = identity
+    img2, lab2 = aug(img, lab)
+    np.testing.assert_allclose(lab2, ref, atol=1e-6)
+
+
+def test_det_random_crop_constraints():
+    random.seed(1)
+    aug = det.DetRandomCropAug(min_object_covered=0.5,
+                               area_range=(0.3, 1.0),
+                               min_eject_coverage=0.3, max_attempts=100)
+    for _ in range(10):
+        img, lab = aug(_img(), _label())
+        assert img.ndim == 3 and img.shape[0] >= 1 and img.shape[1] >= 1
+        if lab.size:
+            assert (lab[:, 1:] >= 0).all() and (lab[:, 1:] <= 1).all()
+            # boxes stay well-formed
+            assert (lab[:, 3] >= lab[:, 1]).all()
+            assert (lab[:, 4] >= lab[:, 2]).all()
+
+
+def test_det_random_pad_shrinks_boxes():
+    random.seed(2)
+    aug = det.DetRandomPadAug(area_range=(1.5, 2.5), max_attempts=100)
+    img, lab = aug(_img(), _label())
+    ref = _label()
+    assert img.shape[0] >= 64 and img.shape[1] >= 48
+    # padded canvas → normalized box area can only shrink
+    area_new = (lab[:, 3] - lab[:, 1]) * (lab[:, 4] - lab[:, 2])
+    area_old = (ref[:, 3] - ref[:, 1]) * (ref[:, 4] - ref[:, 2])
+    assert (area_new <= area_old + 1e-6).all()
+
+
+def test_det_borrow_and_select():
+    from mxtpu._image_impl import CastAug
+
+    random.seed(3)
+    borrow = det.DetBorrowAug(CastAug())
+    img, lab = borrow(_img(), _label())
+    assert img.dtype == np.float32
+    np.testing.assert_allclose(lab, _label())
+
+    sel = det.DetRandomSelectAug([borrow], skip_prob=1.0)
+    img2, _ = sel(_img(), _label())
+    assert img2.dtype == np.uint8  # skipped
+
+
+def test_create_det_augmenter_pipeline():
+    random.seed(4)
+    augs = det.CreateDetAugmenter((3, 32, 32), rand_crop=0.5, rand_pad=0.5,
+                                  rand_mirror=True, mean=True, std=True,
+                                  brightness=0.1, contrast=0.1,
+                                  saturation=0.1)
+    img, lab = _img(), _label()
+    for a in augs:
+        img, lab = a(img, lab)
+    assert img.shape == (32, 32, 3)
+    assert img.dtype == np.float32
+    if lab.size:
+        assert (lab[:, 1:5] >= 0).all() and (lab[:, 1:5] <= 1).all()
+    # every augmenter serializes
+    assert all(isinstance(a.dumps(), str) for a in augs)

@@ -17,6 +17,7 @@ import jax
 import jax.numpy as jnp
 
 from mxtpu import base
+import mxtpu.contrib.quantization  # noqa: F401 — registers the int8 ops
 
 R = onp.random.RandomState(42)
 
@@ -108,6 +109,8 @@ CASES.update({
     "mod": C(lambda: (POS(3, 4, lo=2.0, hi=3.0), POS(3, 4)), grad=False),
     "prod": C(lambda: (POS(2, 3),)),
     "norm": C(lambda: (POS(3, 4),)),
+    "add_n": C(lambda: (A(3, 4), A(3, 4), A(3, 4))),
+    "SoftmaxActivation": C(lambda: (A(3, 4),), {"mode": "channel"}),
     "clip": C(lambda: (A(3, 4),), {"a_min": -1.0, "a_max": 1.0},
               grad=False),
     "smooth_l1": C(lambda: (POS(3, 4),)),
@@ -247,6 +250,16 @@ CASES.update({
 del CASES["smooth_l1_dup"]
 
 SKIP = {
+    "_contrib_quantize_v2": "int8 quantization op (non-differentiable); "
+                            "round-trip + model accuracy covered by "
+                            "tests/test_quantization.py",
+    "_contrib_dequantize_v2": "inverse of quantize_v2; covered by "
+                              "tests/test_quantization.py",
+    "_contrib_quantized_fully_connected": "int8 GEMM; quantized-vs-fp32 "
+                                          "parity covered by "
+                                          "tests/test_quantization.py",
+    "_contrib_quantized_conv": "int8 conv; covered by "
+                               "tests/test_quantization.py",
     "Dropout": "random: needs injected RNG key (_key); covered by "
                "tests/test_gluon.py dropout tests",
     "RNN": "stateful packed-weight fused op; covered by "

@@ -1,0 +1,107 @@
+"""INT8 PTQ tests (parity: tests/python/quantization/test_quantization.py
+— quantize_model accuracy + per-op quantize/dequantize behavior)."""
+
+import numpy as np
+import pytest
+
+import mxtpu as mx
+from mxtpu import nd
+from mxtpu import symbol as sym
+from mxtpu.contrib import quantization as q
+
+
+def test_quantize_dequantize_roundtrip():
+    x = nd.array(np.linspace(-3, 5, 64, dtype=np.float32).reshape(8, 8))
+    qx, mn, mx_ = nd.invoke_op("_contrib_quantize_v2", (x,), {})
+    assert qx.dtype == np.int8
+    back = nd.invoke_op("_contrib_dequantize_v2", (qx, mn, mx_), {})
+    # max error is one quantization step
+    step = max(abs(float(mn.asnumpy())), abs(float(mx_.asnumpy()))) / 127
+    assert np.abs(back.asnumpy() - x.asnumpy()).max() <= step + 1e-6
+
+
+def test_optimal_threshold_prefers_bulk_over_outlier():
+    rng = np.random.RandomState(0)
+    data = np.concatenate([rng.randn(100000), [40.0]]).astype(np.float32)
+    hist, edges = np.histogram(data, bins=2048, range=(-40, 40))
+    t = q.optimal_thresholds(hist, edges)
+    assert t < 10.0  # KL clips the lone outlier instead of wasting range
+
+
+def _mlp_and_params(rng, in_dim=16, hidden=32, classes=10):
+    x = sym.Variable("data")
+    h = sym.FullyConnected(x, num_hidden=hidden, name="fc1")
+    h = sym.Activation(h, act_type="relu", name="relu1")
+    out = sym.FullyConnected(h, num_hidden=classes, name="fc2")
+    params = {
+        "fc1_weight": nd.array(rng.randn(hidden, in_dim).astype("f") * .3),
+        "fc1_bias": nd.array(rng.randn(hidden).astype("f") * .1),
+        "fc2_weight": nd.array(rng.randn(classes, hidden).astype("f") * .3),
+        "fc2_bias": nd.array(rng.randn(classes).astype("f") * .1),
+    }
+    return out, params
+
+
+def _run(s, params, data):
+    arg_names = set(s.list_arguments())
+    args = {k: v for k, v in params.items() if k in arg_names}
+    args["data"] = nd.array(data)
+    ex = s.bind(mx.cpu(), args,
+                aux_states={k: v for k, v in params.items()
+                            if k in set(s.list_auxiliary_states())})
+    return ex.forward()[0].asnumpy()
+
+
+@pytest.mark.parametrize("calib_mode", ["naive", "entropy"])
+def test_quantize_model_mlp_accuracy(calib_mode):
+    rng = np.random.RandomState(1)
+    s, params = _mlp_and_params(rng)
+    calib = [rng.rand(32, 16).astype(np.float32) for _ in range(4)]
+
+    qsym, qargs, qaux = q.quantize_model(
+        s, params, {}, calib_mode=calib_mode, calib_data=iter(calib))
+    ops = {n.op for n in qsym._topo()}
+    assert "_contrib_quantized_fully_connected" in ops
+    assert "FullyConnected" not in ops
+    # weights really stored int8
+    assert qargs["fc1_weight_quantized"].dtype == np.int8
+
+    test = rng.rand(16, 16).astype(np.float32)
+    ref = _run(s, params, test)
+    got = _run(qsym, {**qargs, **qaux}, test)
+    # int8 quantization error bound: top-1 agreement, small mean error,
+    # bounded worst element (entropy clips the relu tail harder — a real
+    # int8 PTQ tradeoff, not a bug)
+    assert np.argmax(got, 1).tolist() == np.argmax(ref, 1).tolist()
+    denom = np.abs(ref).max()
+    assert np.abs(got - ref).mean() / denom < 0.05
+    assert np.abs(got - ref).max() / denom < 0.2
+
+
+def test_quantize_model_conv_and_exclusion():
+    rng = np.random.RandomState(2)
+    x = sym.Variable("data")
+    h = sym.Convolution(x, kernel=(3, 3), num_filter=4, pad=(1, 1),
+                        name="conv1")
+    h = sym.Activation(h, act_type="relu", name="r1")
+    h = sym.Pooling(h, global_pool=True, pool_type="avg", name="gap")
+    h = sym.Flatten(h, name="fl")
+    out = sym.FullyConnected(h, num_hidden=3, name="fc")
+    params = {
+        "conv1_weight": nd.array(rng.randn(4, 2, 3, 3).astype("f") * .3),
+        "conv1_bias": nd.array(rng.randn(4).astype("f") * .1),
+        "fc_weight": nd.array(rng.randn(3, 4).astype("f") * .3),
+        "fc_bias": nd.array(np.zeros(3, "f")),
+    }
+    calib = [rng.rand(8, 2, 6, 6).astype(np.float32) for _ in range(2)]
+    qsym, qargs, qaux = q.quantize_model(
+        out, params, {}, calib_data=iter(calib),
+        excluded_sym_names=["fc"])
+    ops = [n.op for n in qsym._topo()]
+    assert "_contrib_quantized_conv" in ops
+    assert "FullyConnected" in ops  # excluded layer kept fp32
+
+    test = rng.rand(4, 2, 6, 6).astype(np.float32)
+    ref = _run(out, params, test)
+    got = _run(qsym, {**qargs, **qaux}, test)
+    assert np.abs(got - ref).max() / np.abs(ref).max() < 0.1
