@@ -1,0 +1,28 @@
+"""opperf harness smoke test (parity: the reference ships
+benchmark/opperf as a user-facing tool; this pins its contract)."""
+
+import sys
+import os
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+
+def test_opperf_subset():
+    from benchmark.opperf.opperf import run_op_benchmarks
+
+    res = run_op_benchmarks(["relu", "dot", "Convolution", "softmax"],
+                            runs=2, verbose=False)
+    by_op = {r["op"]: r for r in res}
+    assert set(by_op) == {"relu", "dot", "Convolution", "softmax"}
+    for r in res:
+        assert "error" not in r, r
+        assert r["eager_ms"] > 0 and r["jit_ms"] > 0
+    # differentiable ops got a fwd+bwd number
+    assert by_op["dot"].get("fwd_bwd_ms")
+
+
+def test_opperf_scale():
+    from benchmark.opperf.opperf import run_op_benchmarks
+
+    res = run_op_benchmarks(["relu"], scale=4, runs=1, verbose=False)
+    assert res[0]["shapes"][0][0] == 12  # 3 * scale

@@ -68,3 +68,44 @@ def test_im2rec_pack_and_read(tmp_path):
     ds = ImageRecordDataset(rec)
     img, label = ds[0]
     assert img.shape[2] == 3
+
+
+def test_parse_log(tmp_path):
+    """parse_log extracts epochs/metrics/speed from fit+Speedometer logs
+    (parity: tools/parse_log.py)."""
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    import parse_log
+
+    log = """\
+INFO Epoch[0] Batch [20]\tSpeed: 1000.00 samples/sec\taccuracy=0.5
+INFO Epoch[0] Batch [40]\tSpeed: 3000.00 samples/sec\taccuracy=0.6
+INFO Epoch[0] Train-accuracy=0.62
+INFO Epoch[0] Time cost=10.5
+INFO Epoch[0] Validation-accuracy=0.58
+INFO Epoch[1] Train-accuracy=0.81
+INFO Epoch[1] Validation-accuracy=0.77
+"""
+    parsed = parse_log.parse_log(log.splitlines())
+    assert sorted(parsed) == [0, 1]
+    assert parsed[0]["speed"] == [1000.0, 3000.0]
+    assert parsed[0]["train"]["accuracy"] == 0.62
+    assert parsed[0]["val"]["accuracy"] == 0.58
+    assert parsed[0]["time"] == 10.5
+    table = parse_log.format_table(parsed)
+    assert "| 0 |" in table and "0.77" in table
+    tsv = parse_log.format_table(parsed, fmt="tsv")
+    assert tsv.splitlines()[0].startswith("epoch\t")
+
+
+def test_diagnose_runs():
+    """diagnose dumps env/library/device info and exits 0 (parity:
+    tools/diagnose.py)."""
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "diagnose.py")],
+        env=_env(1), cwd=REPO, timeout=240, capture_output=True,
+        text=True)
+    assert out.returncode == 0, out.stderr[-1500:]
+    for section in ("Python Info", "Library Info", "MXTPU Info",
+                    "Device Info"):
+        assert section in out.stdout
+    assert "jax" in out.stdout

@@ -1,0 +1,98 @@
+#!/usr/bin/env python
+"""Diagnose the runtime environment (parity: tools/diagnose.py — the
+reference dumps platform/python/library/hardware info for bug reports;
+this dumps the TPU-stack equivalents: jax/backend/devices/mesh-ability,
+mxtpu feature flags, and env configuration)."""
+
+from __future__ import annotations
+
+import os
+import platform
+import sys
+import time
+
+
+def check_python():
+    print("----------Python Info----------")
+    print("Version      :", platform.python_version())
+    print("Compiler     :", platform.python_compiler())
+    print("Build        :", platform.python_build())
+
+
+def check_os():
+    print("----------System Info----------")
+    print("Platform     :", platform.platform())
+    print("system       :", platform.system())
+    print("machine      :", platform.machine())
+    print("processor    :", platform.processor() or "n/a")
+    try:
+        print("cpu count    :", os.cpu_count())
+    except Exception:
+        pass
+
+
+def check_libraries():
+    print("----------Library Info----------")
+    for lib in ("numpy", "jax", "jaxlib", "flax", "optax"):
+        try:
+            mod = __import__(lib)
+            print("%-12s : %s" % (lib, getattr(mod, "__version__", "?")))
+        except Exception as e:
+            print("%-12s : NOT AVAILABLE (%s)" % (lib, e))
+
+
+def check_mxtpu():
+    print("----------MXTPU Info----------")
+    t0 = time.time()
+    try:
+        import mxtpu
+        print("mxtpu        :", getattr(mxtpu, "__version__", "dev"))
+        print("import time  : %.2fs" % (time.time() - t0))
+        from mxtpu.runtime import Features
+        feats = Features()
+        enabled = [f for f in feats.keys() if feats.is_enabled(f)]
+        print("features     :", ", ".join(sorted(enabled)) or "none")
+    except Exception as e:
+        print("mxtpu        : IMPORT FAILED (%s: %s)"
+              % (type(e).__name__, e))
+
+
+def check_devices(timeout_s=60):
+    print("----------Device Info----------")
+    try:
+        import jax
+        t0 = time.time()
+        devs = jax.devices()
+        print("backend      :", jax.default_backend())
+        print("devices      :", devs)
+        print("device query : %.2fs" % (time.time() - t0))
+        import jax.numpy as jnp
+        import numpy as np
+        t0 = time.time()
+        x = jnp.ones((256, 256)) @ jnp.ones((256, 256))
+        np.asarray(x)  # host transfer = the reliable barrier (PERF.md)
+        print("compute      : ok (%.2fs incl. compile)"
+              % (time.time() - t0))
+    except Exception as e:
+        print("devices      : FAILED (%s: %s)" % (type(e).__name__, e))
+
+
+def check_environment():
+    print("----------Environment----------")
+    for k, v in sorted(os.environ.items()):
+        if k.startswith(("MXTPU_", "MXNET_", "JAX_", "XLA_", "TPU_",
+                         "PALLAS_", "DMLC_")):
+            print("%s=%s" % (k, v))
+
+
+def main():
+    check_python()
+    check_os()
+    check_libraries()
+    check_environment()
+    check_mxtpu()
+    check_devices()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,97 @@
+#!/usr/bin/env python
+"""Parse training logs into an epoch table (parity: tools/parse_log.py —
+extracts per-epoch train/validation metrics and throughput from the
+Speedometer/fit log format into tabular or markdown output).
+
+The accepted lines are what mxtpu's own fit loop + Speedometer emit
+(same shapes as the reference):
+  Epoch[3] Batch [40]  Speed: 1234.56 samples/sec  accuracy=0.91
+  Epoch[3] Train-accuracy=0.93
+  Epoch[3] Validation-accuracy=0.88
+  Epoch[3] Time cost=12.34
+"""
+
+from __future__ import annotations
+
+import argparse
+import re
+import sys
+
+RE_SPEED = re.compile(
+    r"Epoch\[(\d+)\].*?Speed:\s*([\d.]+)\s*samples/sec")
+RE_TRAIN = re.compile(r"Epoch\[(\d+)\]\s+Train-([\w-]+)=([\d.eE+-]+)")
+RE_VAL = re.compile(r"Epoch\[(\d+)\]\s+Validation-([\w-]+)=([\d.eE+-]+)")
+RE_TIME = re.compile(r"Epoch\[(\d+)\]\s+Time cost=([\d.]+)")
+
+
+def parse_log(lines):
+    """Returns {epoch: {"speed": [..], "train": {m: v}, "val": {m: v},
+    "time": t}}."""
+    out = {}
+
+    def rec(epoch):
+        return out.setdefault(int(epoch),
+                              {"speed": [], "train": {}, "val": {},
+                               "time": None})
+
+    for line in lines:
+        m = RE_SPEED.search(line)
+        if m:
+            rec(m.group(1))["speed"].append(float(m.group(2)))
+            continue
+        m = RE_TRAIN.search(line)
+        if m:
+            rec(m.group(1))["train"][m.group(2)] = float(m.group(3))
+            continue
+        m = RE_VAL.search(line)
+        if m:
+            rec(m.group(1))["val"][m.group(2)] = float(m.group(3))
+            continue
+        m = RE_TIME.search(line)
+        if m:
+            rec(m.group(1))["time"] = float(m.group(2))
+    return out
+
+
+def format_table(parsed, fmt="markdown"):
+    metrics = sorted({m for r in parsed.values() for m in r["train"]} |
+                     {m for r in parsed.values() for m in r["val"]})
+    header = ["epoch"] + ["train-%s" % m for m in metrics] + \
+        ["val-%s" % m for m in metrics] + ["speed", "time"]
+    rows = []
+    for epoch in sorted(parsed):
+        r = parsed[epoch]
+        speed = (sum(r["speed"]) / len(r["speed"])) if r["speed"] else None
+        row = [str(epoch)]
+        row += ["%.6g" % r["train"][m] if m in r["train"] else "-"
+                for m in metrics]
+        row += ["%.6g" % r["val"][m] if m in r["val"] else "-"
+                for m in metrics]
+        row.append("%.1f" % speed if speed is not None else "-")
+        row.append("%.1f" % r["time"] if r["time"] is not None else "-")
+        rows.append(row)
+    if fmt == "markdown":
+        lines = ["| " + " | ".join(header) + " |",
+                 "|" + "---|" * len(header)]
+        lines += ["| " + " | ".join(r) + " |" for r in rows]
+    else:
+        lines = ["\t".join(header)] + ["\t".join(r) for r in rows]
+    return "\n".join(lines)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("logfile", nargs="?", default="-")
+    ap.add_argument("--format", choices=("markdown", "tsv"),
+                    default="markdown")
+    args = ap.parse_args(argv)
+    if args.logfile == "-":
+        lines = sys.stdin.readlines()
+    else:
+        with open(args.logfile) as f:
+            lines = f.readlines()
+    print(format_table(parse_log(lines), args.format))
+
+
+if __name__ == "__main__":
+    main()
