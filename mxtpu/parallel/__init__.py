@@ -29,3 +29,5 @@ from . import collectives
 from .sharding import ShardingRules, PartitionSpec
 from .trainer import SPMDTrainer
 from . import ring_attention
+from . import pipeline as pipeline_mod
+from .pipeline import pipeline, stack_stage_params, stage_sharding
