@@ -278,3 +278,54 @@ def test_kvstore_factory_types():
     assert mx.kv.create("nccl").type == "nccl"
     with pytest.raises(Exception):
         mx.kv.create("bogus")
+
+
+def test_kvstore_gradient_compression_2bit():
+    """2-bit compression (parity: gradient_compression.cc semantics —
+    ternary quantize to {-t, 0, +t} with worker-side error-feedback
+    residual; nothing is lost, only delayed)."""
+    import numpy as np
+    from mxtpu import kvstore, nd
+
+    kv = kvstore.create("local")
+    kv.init("w", nd.array(np.zeros(4, "f")))
+    kv.set_gradient_compression({"type": "2bit", "threshold": 0.5})
+
+    g = np.array([0.3, 0.7, -0.9, 0.1], "f")
+    out = nd.array(np.zeros(4, "f"))
+    kv.push("w", [nd.array(g)])
+    kv.pull("w", out=out)
+    # first push: only |g|>=t survives, rounded to +/-t
+    np.testing.assert_allclose(out.asnumpy(), [0.0, 0.5, -0.5, 0.0])
+
+    # residual carries: repeated pushes converge to the true sum
+    total = out.asnumpy().copy()
+    for _ in range(12):
+        kv.push("w", [nd.array(g)])
+        kv.pull("w", out=out)
+        total = out.asnumpy().copy()
+    # store holds last reduced value only when no updater: accumulate
+    # manually across pushes — after 13 pushes the summed quantized
+    # stream must be within one threshold of 13*g per element
+    # (the kv store replaces, so compare per-push stream instead)
+    import jax.numpy as jnp
+    from mxtpu.kvstore import _twobit_compress
+
+    res = jnp.zeros(4)
+    sent = np.zeros(4, "f")
+    for _ in range(13):
+        q, res = _twobit_compress(jnp.asarray(g), res, jnp.float32(0.5))
+        sent += np.asarray(q)
+    # the error-feedback invariant: sent + residual == true sum, exactly
+    np.testing.assert_allclose(sent + np.asarray(res), 13 * g,
+                               rtol=1e-5, atol=1e-6)
+    # per-step send saturates at +/-threshold (reference clipping
+    # behavior for persistently-large grads; threshold is a tuning knob)
+    assert np.abs(sent).max() <= 13 * 0.5 + 1e-6
+    # sub-threshold elements still get through once the residual tops up
+    assert sent[0] > 0 and sent[3] > 0
+
+    # unsupported type rejected
+    import pytest
+    with pytest.raises(Exception):
+        kv.set_gradient_compression({"type": "1bit"})

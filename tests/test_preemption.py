@@ -1,0 +1,67 @@
+"""Preemption checkpoint hook tests (SURVEY §5: the checkpoint-restart
+recovery story gets a signal-triggered save — new TPU-side capability,
+no reference analogue)."""
+
+import os
+import signal
+
+import numpy as np
+
+import mxtpu as mx
+from mxtpu import nd, preemption
+from mxtpu.gluon import nn
+
+
+def test_install_saves_once_and_sets_flag():
+    calls = []
+    preemption.install(lambda: calls.append(1),
+                       signals=(signal.SIGUSR1,))
+    try:
+        assert not preemption.preempted()
+        os.kill(os.getpid(), signal.SIGUSR1)
+        assert preemption.preempted()
+        assert calls == [1]
+        os.kill(os.getpid(), signal.SIGUSR1)  # second signal: no double-save
+        assert calls == [1]
+    finally:
+        preemption.uninstall()
+        preemption.reset()
+    # after uninstall the signal is back to the previous disposition
+    assert signal.getsignal(signal.SIGUSR1) is not preemption._handler
+
+
+def test_save_exception_does_not_kill_process():
+    def bad():
+        raise RuntimeError("disk full")
+    preemption.install(bad, signals=(signal.SIGUSR1,))
+    try:
+        os.kill(os.getpid(), signal.SIGUSR1)  # must not propagate
+        assert preemption.preempted()
+    finally:
+        preemption.uninstall()
+        preemption.reset()
+
+
+def test_preemption_checkpoint_handler(tmp_path):
+    net = nn.Dense(3, in_units=4)
+    net.initialize()
+    net(nd.array(np.ones((2, 4), np.float32)))
+    prefix = str(tmp_path / "model")
+    h = preemption.PreemptionCheckpointHandler(
+        prefix, net, signals=(signal.SIGUSR2,))
+    try:
+        os.kill(os.getpid(), signal.SIGUSR2)
+        params_file = prefix + "-preempt.params"
+        assert os.path.exists(params_file)
+        # round-trips
+        net2 = nn.Dense(3, in_units=4)
+        net2.load_parameters(params_file)
+        np.testing.assert_allclose(net2.weight.data().asnumpy(),
+                                   net.weight.data().asnumpy())
+        # handler asks the estimator loop to stop at the batch boundary
+        assert not h.stop_training
+        h.batch_end(None)
+        assert h.stop_training
+    finally:
+        preemption.uninstall()
+        preemption.reset()
