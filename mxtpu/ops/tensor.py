@@ -17,6 +17,7 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 
 from ..base import register_op
@@ -753,3 +754,171 @@ def add_n(*xs):
     for x in xs[1:]:
         out = out + x
     return out
+
+
+# ---------------------------------------------------------------------------
+# linalg family (parity: src/operator/tensor/la_op.cc — the LAPACK/BLAS-3
+# operator set.  XLA lowers these to MXU-friendly batched kernels; autodiff
+# comes from jax's native rules rather than the reference's hand-written
+# backward kernels.)
+# ---------------------------------------------------------------------------
+
+@register_op("linalg_gemm")
+def linalg_gemm(a, b, c, transpose_a=False, transpose_b=False, alpha=1.0,
+                beta=1.0, axis=-2):
+    if axis != -2:
+        raise NotImplementedError(
+            "linalg_gemm: only axis=-2 (matrix rows on the second-to-last "
+            "axis) is supported; moveaxis the inputs instead")
+    if transpose_a:
+        a = jnp.swapaxes(a, -1, -2)
+    if transpose_b:
+        b = jnp.swapaxes(b, -1, -2)
+    return alpha * jnp.matmul(a, b, precision=matmul_precision(a, b)) + \
+        beta * c
+
+
+@register_op("linalg_potrf")
+def linalg_potrf(a):
+    """Lower Cholesky factor of a symmetric positive-definite matrix."""
+    return jnp.linalg.cholesky(a)
+
+
+@register_op("linalg_potri")
+def linalg_potri(a):
+    """Inverse of the SPD matrix whose lower Cholesky factor is `a`:
+    out = (a a^T)^{-1} (reference potri contract)."""
+    import jax.scipy.linalg as jsl
+
+    eye = jnp.broadcast_to(jnp.eye(a.shape[-1], dtype=a.dtype), a.shape)
+    inv_l = jsl.solve_triangular(a, eye, lower=True)
+    return jnp.matmul(jnp.swapaxes(inv_l, -1, -2), inv_l,
+                      precision=matmul_precision(a, a))
+
+
+@register_op("linalg_trmm")
+def linalg_trmm(a, b, transpose=False, rightside=False, lower=True,
+                alpha=1.0):
+    """Triangular matrix multiply: alpha*op(A)·B (or B·op(A))."""
+    tri = jnp.tril(a) if lower else jnp.triu(a)
+    if transpose:
+        tri = jnp.swapaxes(tri, -1, -2)
+    prod = jnp.matmul(b, tri, precision=matmul_precision(a, b)) \
+        if rightside else jnp.matmul(tri, b,
+                                     precision=matmul_precision(a, b))
+    return alpha * prod
+
+
+@register_op("linalg_trsm")
+def linalg_trsm(a, b, transpose=False, rightside=False, lower=True,
+                alpha=1.0):
+    """Solve op(A)·X = alpha·B (or X·op(A) = alpha·B) with triangular A."""
+    import jax.scipy.linalg as jsl
+
+    if rightside:
+        # X·op(A) = alpha·B  <=>  op(A)^T·X^T = alpha·B^T: same stored A,
+        # transpose flag flipped
+        xt = jsl.solve_triangular(a, jnp.swapaxes(alpha * b, -1, -2),
+                                  lower=lower,
+                                  trans=0 if transpose else 1)
+        return jnp.swapaxes(xt, -1, -2)
+    return jsl.solve_triangular(a, alpha * b, lower=lower,
+                                trans=1 if transpose else 0)
+
+
+@register_op("linalg_syrk")
+def linalg_syrk(a, transpose=False, alpha=1.0):
+    at = jnp.swapaxes(a, -1, -2)
+    out = jnp.matmul(at, a, precision=matmul_precision(a, a)) if transpose \
+        else jnp.matmul(a, at, precision=matmul_precision(a, a))
+    return alpha * out
+
+
+@register_op("linalg_sumlogdiag")
+def linalg_sumlogdiag(a):
+    return jnp.sum(jnp.log(jnp.diagonal(a, axis1=-2, axis2=-1)), axis=-1)
+
+
+@register_op("linalg_extractdiag")
+def linalg_extractdiag(a, offset=0):
+    return jnp.diagonal(a, offset=offset, axis1=-2, axis2=-1)
+
+
+@register_op("linalg_makediag")
+def linalg_makediag(a, offset=0):
+    return jnp.vectorize(lambda v: jnp.diag(v, k=offset),
+                         signature="(n)->(m,m)")(a)
+
+
+def _trian_indices(n, offset, lower):
+    """Reference contract (la_op extracttrian/maketrian): a positive
+    offset selects the UPPER triangle starting at that superdiagonal, a
+    negative offset the LOWER triangle from that subdiagonal; `lower`
+    only disambiguates offset == 0."""
+    eff_lower = lower if offset == 0 else offset < 0
+    return (jnp.tril_indices(n, k=offset) if eff_lower
+            else jnp.triu_indices(n, k=offset))
+
+
+@register_op("linalg_extracttrian")
+def linalg_extracttrian(a, offset=0, lower=True):
+    rows, cols = _trian_indices(a.shape[-1], offset, lower)
+    return a[..., rows, cols]
+
+
+def _trian_count(n, offset, lower):
+    """Number of packed entries for _trian_indices(n, offset, lower)."""
+    eff_lower = lower if offset == 0 else offset < 0
+    tri = np.tril(np.ones((n, n), bool), offset) if eff_lower else \
+        np.triu(np.ones((n, n), bool), offset)
+    return int(tri.sum())
+
+
+@register_op("linalg_maketrian")
+def linalg_maketrian(a, offset=0, lower=True):
+    # infer the square size n whose (offset, lower) triangle has exactly
+    # k entries; shapes are static under trace, so the search is
+    # host-side python
+    k = a.shape[-1]
+    n = 1
+    while _trian_count(n, offset, lower) < k:
+        n += 1
+    if _trian_count(n, offset, lower) != k:
+        raise ValueError(
+            "linalg_maketrian: packed length %d does not match any "
+            "square size for offset=%d lower=%s" % (k, offset, lower))
+    rows, cols = _trian_indices(n, offset, lower)
+    out = jnp.zeros(a.shape[:-1] + (n, n), a.dtype)
+    return out.at[..., rows, cols].set(a)
+
+
+@register_op("linalg_inverse", aliases=("inverse",))
+def linalg_inverse(a):
+    return jnp.linalg.inv(a)
+
+
+@register_op("linalg_det", aliases=("det",))
+def linalg_det(a):
+    return jnp.linalg.det(a)
+
+
+@register_op("linalg_slogdet", aliases=("slogdet",))
+def linalg_slogdet(a):
+    sign, logdet = jnp.linalg.slogdet(a)
+    return sign, logdet
+
+
+@register_op("linalg_gelqf")
+def linalg_gelqf(a):
+    """LQ factorization of a full-rank wide matrix: A = L·Q with Q's rows
+    orthonormal (reference gelqf contract), via QR of A^T."""
+    q, r = jnp.linalg.qr(jnp.swapaxes(a, -1, -2))
+    return jnp.swapaxes(r, -1, -2), jnp.swapaxes(q, -1, -2)
+
+
+@register_op("linalg_syevd")
+def linalg_syevd(a):
+    """Symmetric eigendecomposition: A = U^T·diag(w)·U with eigenvectors
+    in U's ROWS (reference syevd layout; jax.eigh returns columns)."""
+    w, v = jnp.linalg.eigh(a)
+    return jnp.swapaxes(v, -1, -2), w

@@ -1,0 +1,150 @@
+"""linalg (la_op) family tests (parity: tests/python/unittest/
+test_operator.py test_laop* — factorization round-trips and solve
+identities, here against numpy/scipy ground truth)."""
+
+import numpy as np
+import pytest
+
+import mxtpu as mx
+from mxtpu import nd
+
+
+R = np.random.RandomState(0)
+
+
+def _spd(n=4, batch=()):
+    a = R.randn(*batch, n, n).astype(np.float64).astype(np.float32)
+    return np.matmul(a, np.swapaxes(a, -1, -2)) + \
+        3 * np.eye(n, dtype=np.float32)
+
+
+def _op(name, *args, **kw):
+    return nd.invoke_op(name, tuple(nd.array(a) for a in args), kw)
+
+
+def test_gemm_and_syrk():
+    a = R.randn(3, 4).astype("f")
+    b = R.randn(4, 5).astype("f")
+    c = R.randn(3, 5).astype("f")
+    out = _op("linalg_gemm", a, b, c, alpha=2.0, beta=0.5)
+    np.testing.assert_allclose(out.asnumpy(), 2 * (a @ b) + 0.5 * c,
+                               rtol=1e-5, atol=1e-5)
+    out = _op("linalg_syrk", a, transpose=True, alpha=1.5)
+    np.testing.assert_allclose(out.asnumpy(), 1.5 * (a.T @ a),
+                               rtol=1e-5, atol=1e-5)
+
+
+@pytest.mark.parametrize("batch", [(), (2,)])
+def test_potrf_potri_roundtrip(batch):
+    a = _spd(4, batch)
+    l = _op("linalg_potrf", a).asnumpy()
+    # L is lower and L L^T == A
+    np.testing.assert_allclose(np.triu(l, 1), np.zeros_like(l), atol=1e-6)
+    np.testing.assert_allclose(np.matmul(l, np.swapaxes(l, -1, -2)), a,
+                               rtol=1e-4, atol=1e-4)
+    # potri(L) == A^{-1}
+    inv = _op("linalg_potri", l).asnumpy()
+    eye = np.broadcast_to(np.eye(4, dtype="f"), a.shape)
+    np.testing.assert_allclose(np.matmul(inv, a), eye, rtol=1e-3,
+                               atol=1e-3)
+
+
+def test_trmm_trsm_inverse_pair():
+    a = np.tril(R.randn(4, 4).astype("f")) + 4 * np.eye(4, dtype="f")
+    b = R.randn(4, 3).astype("f")
+    prod = _op("linalg_trmm", a, b, alpha=2.0).asnumpy()
+    np.testing.assert_allclose(prod, 2 * (np.tril(a) @ b), rtol=1e-5,
+                               atol=1e-5)
+    # trsm undoes trmm: solve A X = prod → X = 2B
+    back = _op("linalg_trsm", a, prod).asnumpy()
+    np.testing.assert_allclose(back, 2 * b, rtol=1e-4, atol=1e-4)
+    # rightside + transpose path
+    br = R.randn(3, 4).astype("f")
+    prod_r = _op("linalg_trmm", a, br, rightside=True).asnumpy()
+    back_r = _op("linalg_trsm", a, prod_r, rightside=True).asnumpy()
+    np.testing.assert_allclose(back_r, br, rtol=1e-4, atol=1e-4)
+
+
+def test_diag_trian_pack_unpack():
+    a = R.randn(4, 4).astype("f")
+    d = _op("linalg_extractdiag", a).asnumpy()
+    np.testing.assert_allclose(d, np.diag(a))
+    np.testing.assert_allclose(_op("linalg_makediag", d).asnumpy(),
+                               np.diag(np.diag(a)))
+    packed = _op("linalg_extracttrian", a).asnumpy()
+    assert packed.shape == (10,)
+    rebuilt = _op("linalg_maketrian", packed).asnumpy()
+    np.testing.assert_allclose(rebuilt, np.tril(a), atol=1e-6)
+
+
+def test_det_slogdet_inverse_sumlogdiag():
+    a = _spd(4)
+    np.testing.assert_allclose(_op("linalg_det", a).asnumpy(),
+                               np.linalg.det(a), rtol=1e-4)
+    sign, logdet = _op("linalg_slogdet", a)
+    s_ref, l_ref = np.linalg.slogdet(a)
+    np.testing.assert_allclose(sign.asnumpy(), s_ref)
+    np.testing.assert_allclose(logdet.asnumpy(), l_ref, rtol=1e-4)
+    inv = _op("linalg_inverse", a).asnumpy()
+    np.testing.assert_allclose(a @ inv, np.eye(4), atol=1e-3)
+    l = np.linalg.cholesky(a).astype("f")
+    np.testing.assert_allclose(_op("linalg_sumlogdiag", l).asnumpy(),
+                               np.log(np.diag(l)).sum(), rtol=1e-5)
+
+
+def test_gelqf_and_syevd():
+    a = R.randn(3, 5).astype("f")  # wide, full rank w.h.p.
+    l, q = _op("linalg_gelqf", a)
+    l, q = l.asnumpy(), q.asnumpy()
+    # A = L Q, Q rows orthonormal, L lower triangular
+    np.testing.assert_allclose(l @ q, a, rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(q @ q.T, np.eye(3), atol=1e-4)
+    np.testing.assert_allclose(np.triu(l, 1), np.zeros_like(l), atol=1e-5)
+
+    s = _spd(4)
+    u, w = _op("linalg_syevd", s)
+    u, w = u.asnumpy(), w.asnumpy()
+    # A = U^T diag(w) U (eigenvectors in rows, reference layout)
+    np.testing.assert_allclose(u.T @ np.diag(w) @ u, s, rtol=1e-3,
+                               atol=1e-3)
+
+
+def test_linalg_gradients():
+    """Autodiff flows through the factorizations (the reference hand-wrote
+    these backward kernels; jax supplies them natively)."""
+    import jax
+    import jax.numpy as jnp
+    from mxtpu.base import get_op
+
+    a = jnp.asarray(_spd(3))
+
+    def f(m):
+        return jnp.sum(get_op("linalg_sumlogdiag").fn(
+            get_op("linalg_potrf").fn(m)))
+
+    g = jax.grad(f)(a)
+    # d/dA [0.5 logdet A] = 0.5 A^{-1}; sumlogdiag(chol(A)) = 0.5 logdet A
+    np.testing.assert_allclose(
+        np.asarray(g + g.T) / 2,  # symmetrized gradient
+        np.linalg.inv(np.asarray(a)) / 2, rtol=1e-3, atol=1e-4)
+
+
+def test_maketrian_offsets():
+    """Nonzero offsets round-trip under the reference contract: a
+    positive offset selects the UPPER triangle from that superdiagonal,
+    negative the LOWER from that subdiagonal; `lower` disambiguates only
+    offset == 0."""
+    a = R.randn(4, 4).astype("f")
+    for offset, lower, ref in [
+            (-1, True, np.tril(a, -1)), (-1, False, np.tril(a, -1)),
+            (1, True, np.triu(a, 1)), (1, False, np.triu(a, 1)),
+            (0, True, np.tril(a)), (0, False, np.triu(a)),
+            (-2, True, np.tril(a, -2)), (2, False, np.triu(a, 2))]:
+        packed = _op("linalg_extracttrian", a, offset=offset,
+                     lower=lower).asnumpy()
+        rebuilt = _op("linalg_maketrian", packed, offset=offset,
+                      lower=lower).asnumpy()
+        np.testing.assert_allclose(rebuilt, ref, atol=1e-6)
+    import pytest
+    with pytest.raises(Exception):
+        _op("linalg_gemm", a, a, a, axis=0)
