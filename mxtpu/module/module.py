@@ -3,12 +3,15 @@
 The reference's Module split batches across per-GPU executors
 (DataParallelExecutorGroup). On TPU a single Executor runs the graph and
 SPMD sharding is XLA's job, so the executor-group machinery collapses to
-one executor; the ctx list is accepted for API parity.
+one executor; a ctx LIST dp-shards the batch across those devices via
+GSPMD (params replicated, grads globally reduced) — see _data_sharding.
 """
 
 from __future__ import annotations
 
 import logging
+
+import jax
 
 import numpy as onp
 
@@ -34,7 +37,14 @@ class Module(BaseModule):
         self._label_names = list(label_names or [])
         self._fixed_param_names = list(fixed_param_names or [])
         self._state_names = list(state_names or [])
-        self._context = context if context is not None else cpu()
+        # a LIST of contexts is the reference's DataParallelExecutorGroup
+        # request (module/executor_group.py: slice the batch across ctxs);
+        # here GSPMD absorbs it — see _data_sharding below
+        self._context_group = list(context) if isinstance(
+            context, (list, tuple)) else None
+        self._context = (self._context_group[0] if self._context_group
+                         else context) if context is not None else cpu()
+        self._data_mesh = None
 
         arg_names = symbol.list_arguments()
         input_names = self._data_names + self._label_names + \
@@ -154,6 +164,7 @@ class Module(BaseModule):
         if self.params_initialized and not force_init:
             return
         assert self.binded, "call bind before init_params"
+        self._params_replicated = False  # fresh host arrays: re-replicate
         if initializer is None:
             initializer = init_mod.Uniform(0.01)
         elif isinstance(initializer, str):
@@ -209,6 +220,40 @@ class Module(BaseModule):
             del self._preload_opt_states
 
     # -- execution --------------------------------------------------------
+    def _data_sharding(self):
+        """Multi-device data parallelism through the Module API (parity:
+        DataParallelExecutorGroup, module/executor_group.py — the
+        reference slices the batch across contexts and runs one executor
+        per GPU; here ONE executor runs with the batch dp-sharded across
+        the context group's devices and GSPMD/XLA inserts the collectives,
+        so params stay replicated and grads come out globally reduced).
+
+        Returns None when the host has fewer real devices than requested
+        contexts (the reference tolerated over-committed ctx lists by
+        round-robining executors; the GSPMD equivalent is to run
+        single-device)."""
+        if self._data_mesh is None:
+            from jax.sharding import Mesh
+
+            devs = [c.to_jax_device() for c in self._context_group]
+            if any(d is None for d in devs):
+                devs = jax.devices()[:len(self._context_group)]
+            unique = list(dict.fromkeys(devs))
+            if len(unique) < len(self._context_group):
+                self.logger.warning(
+                    "Module: %d contexts but only %d distinct devices — "
+                    "running single-device (over-committed ctx list)",
+                    len(self._context_group), len(unique))
+                self._data_mesh = False
+            else:
+                self._data_mesh = Mesh(onp.asarray(devs), ("dp",))
+        if self._data_mesh is False:
+            return None
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        return (NamedSharding(self._data_mesh, P("dp")),
+                NamedSharding(self._data_mesh, P()))
+
     def forward(self, data_batch, is_train=None):
         assert self.binded and self.params_initialized
         if is_train is None:
@@ -219,6 +264,30 @@ class Module(BaseModule):
         if data_batch.label:
             for name, arr in zip(self._label_names, data_batch.label):
                 feed[name] = arr
+        if self._context_group and len(self._context_group) > 1:
+            sh = self._data_sharding()
+            ndev = len(self._context_group)
+            batch_ok = sh is not None and all(
+                (a.shape[0] % ndev) == 0 for a in feed.values()
+                if getattr(a, "ndim", 0))
+            if batch_ok:
+                batch_sh, repl_sh = sh
+                for name, arr in feed.items():
+                    arr = arr if isinstance(arr, NDArray) else \
+                        nd.array(arr)
+                    feed[name] = NDArray(
+                        jax.device_put(arr.data, batch_sh))
+                if not getattr(self, "_params_replicated", False):
+                    # once per bind/param change, not per batch
+                    for d in (self._exec.arg_dict, self._exec.aux_dict):
+                        for name, val in d.items():
+                            if name not in feed:
+                                val._rebind(
+                                    jax.device_put(val.data, repl_sh))
+                    self._params_replicated = True
+            # else: uneven tail batch (or over-committed ctx list) runs
+            # unsharded — the reference's executor group sliced/padded
+            # such batches; single-device is the GSPMD analogue
         self._exec.forward(is_train=is_train, **feed)
 
     def backward(self, out_grads=None):
