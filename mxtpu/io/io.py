@@ -454,6 +454,10 @@ def ImageRecordIter(path_imgrec=None, data_shape=(3, 224, 224),
     if any(v is not None for v in std_rgb):
         aug_kwargs["std"] = onp.array([v or 1.0 for v in std_rgb])
     shuffle = kwargs.pop("shuffle", False)
+    # bilinear, like the C++ iterator's own default (image_aug_default.cc
+    # inter_method=1) — ImageIter/CreateAugmenter's python default is
+    # cubic; bilinear also enables the native whole-batch decode path
+    aug_kwargs.setdefault("inter_method", 1)
     return ImageIter(batch_size=batch_size, data_shape=data_shape,
                      label_width=label_width, path_imgrec=path_imgrec,
                      shuffle=shuffle, **aug_kwargs)
