@@ -3,3 +3,4 @@
 from . import nn
 from . import rnn
 from . import estimator
+from . import data

@@ -1,0 +1,3 @@
+"""Contrib datasets (parity: python/mxnet/gluon/contrib/data/)."""
+
+from . import text  # noqa: F401

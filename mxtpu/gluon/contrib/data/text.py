@@ -1,0 +1,90 @@
+"""Text datasets for language modelling (parity: python/mxnet/gluon/
+contrib/data/text.py — WikiText-style corpus datasets.  The reference
+downloads the archives; with zero egress these load the same file
+formats from a local root, so real WikiText checkouts work unchanged).
+"""
+
+from __future__ import annotations
+
+import io
+import os
+
+import numpy as np
+
+from ...data import dataset as _dataset
+from ....contrib.text.vocab import Vocabulary
+from .... import ndarray as nd
+
+__all__ = ["CorpusDataset", "WikiText2", "WikiText103"]
+
+
+class CorpusDataset(_dataset.Dataset):
+    """A flat token-id stream over a whitespace-tokenized text file,
+    sliced into fixed-length sequences (parity: _LanguageModelDataset /
+    CorpusDataset semantics: bos/eos insertion, vocabulary indexing,
+    seq_len slicing with the ragged tail dropped)."""
+
+    def __init__(self, filename, seq_len=35, vocab=None, bos=None,
+                 eos="<eos>", encoding="utf8"):
+        self._seq_len = int(seq_len)
+        tokens = []
+        with io.open(filename, "r", encoding=encoding) as f:
+            for line in f:
+                parts = line.split()
+                if not parts:
+                    continue
+                if bos is not None:
+                    tokens.append(bos)
+                tokens.extend(parts)
+                if eos is not None:
+                    tokens.append(eos)
+        if vocab is None:
+            import collections
+            counter = collections.Counter(tokens)
+            extra = [t for t in (bos, eos) if t is not None]
+            vocab = Vocabulary(counter, reserved_tokens=extra or None)
+        self._vocab = vocab
+        ids = np.asarray(vocab.to_indices(tokens), np.int32)
+        n = (len(ids) - 1) // self._seq_len  # -1: target is shifted by 1
+        self._data = ids[:n * self._seq_len].reshape(n, self._seq_len)
+        self._target = ids[1:n * self._seq_len + 1].reshape(
+            n, self._seq_len)
+
+    @property
+    def vocabulary(self):
+        return self._vocab
+
+    def __len__(self):
+        return len(self._data)
+
+    def __getitem__(self, idx):
+        return (nd.array(self._data[idx], dtype="int32"),
+                nd.array(self._target[idx], dtype="int32"))
+
+
+class _WikiText(CorpusDataset):
+    _namespace = None
+    _segments = {"train": "wiki.%s.tokens", "val": "wiki.%s.tokens",
+                 "test": "wiki.%s.tokens"}
+
+    def __init__(self, root, segment="train", seq_len=35, vocab=None):
+        seg_file = "wiki.%s.tokens" % ("valid" if segment == "val"
+                                       else segment)
+        path = os.path.join(root, seg_file)
+        if not os.path.exists(path):
+            raise FileNotFoundError(
+                "%s not found under %s — place a %s checkout there "
+                "(no network access: the reference's auto-download is "
+                "a documented divergence)" %
+                (seg_file, root, type(self).__name__))
+        super().__init__(path, seq_len=seq_len, vocab=vocab)
+
+
+class WikiText2(_WikiText):
+    """WikiText-2 from a local checkout (parity: contrib.data.text
+    .WikiText2)."""
+
+
+class WikiText103(_WikiText):
+    """WikiText-103 from a local checkout (parity: contrib.data.text
+    .WikiText103)."""

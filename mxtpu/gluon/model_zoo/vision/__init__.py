@@ -19,11 +19,14 @@ from .mobilenet import get_mobilenet, get_mobilenet_v2
 
 def get_model(name, **kwargs):
     """Look up a model by zoo name (parity: vision.get_model)."""
-    from . import resnet, vgg, alexnet, densenet, inception, mobilenet, \
-        squeezenet
+    import importlib
+
     models = {}
-    for mod in (resnet, vgg, alexnet, densenet, inception, mobilenet,
-                squeezenet):
+    # importlib, not `from . import X`: star-exports above shadow some
+    # submodule names with factory functions (e.g. a `resnet` builder)
+    for mod in (importlib.import_module("." + m, __package__)
+                for m in ("resnet", "vgg", "alexnet", "densenet",
+                          "inception", "mobilenet", "squeezenet")):
         for fname in mod.__all__:
             if fname.startswith(("get_", "Basic", "Bottleneck", "ResNet",
                                  "VGG", "AlexNet", "DenseNet", "Inception",

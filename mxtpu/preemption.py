@@ -25,10 +25,14 @@ _state = {"flag": False, "save_fn": None, "prev": {}, "signals": ()}
 
 
 def _handler(signum, frame):
-    with _lock:
-        already = _state["flag"]
-        _state["flag"] = True
-        save_fn = _state["save_fn"]
+    # NO lock here: signal handlers run in the main thread between
+    # bytecodes, and the main thread may already hold _lock inside
+    # install()/uninstall() — acquiring it would self-deadlock exactly
+    # when the grace window matters.  Plain dict reads/writes are atomic
+    # under the GIL, which is all the consistency this needs.
+    already = _state["flag"]
+    _state["flag"] = True
+    save_fn = _state["save_fn"]
     if already:
         return
     logging.warning("preemption signal %s received — checkpointing",
