@@ -26,9 +26,13 @@ from mxtpu.gluon.model_zoo.vision import get_model
 
 def load_data(root, n_train=2048, n_val=512, size=32):
     try:
-        from mxtpu.gluon.data.vision import CIFAR10
-        return CIFAR10(root=root, train=True), CIFAR10(root=root,
-                                                       train=False)
+        from mxtpu.gluon.data.vision import CIFAR10, transforms
+        tf = transforms.Compose([
+            transforms.ToTensor(),  # HWC uint8 -> CHW float in [0,1]
+            transforms.Normalize((0.4914, 0.4822, 0.4465),
+                                 (0.2470, 0.2435, 0.2616))])
+        return (CIFAR10(root=root, train=True).transform_first(tf),
+                CIFAR10(root=root, train=False).transform_first(tf))
     except Exception:
         rng = np.random.RandomState(0)
         centers = rng.rand(10, 3, 1, 1).astype("f")

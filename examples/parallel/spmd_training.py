@@ -36,8 +36,10 @@ def main():
     ap.add_argument("--steps", type=int, default=50)
     args = ap.parse_args()
 
-    mesh = make_mesh(dp=args.dp or None, tp=args.tp, sp=args.sp) \
-        if args.dp else make_mesh(tp=args.tp, sp=args.sp)
+    import jax
+
+    dp = args.dp or max(1, len(jax.devices()) // (args.tp * args.sp))
+    mesh = make_mesh(dp=dp, tp=args.tp, sp=args.sp)
     print("mesh:", mesh)
 
     net = nn.HybridSequential()

@@ -63,13 +63,15 @@ class CorpusDataset(_dataset.Dataset):
 
 
 class _WikiText(CorpusDataset):
-    _namespace = None
-    _segments = {"train": "wiki.%s.tokens", "val": "wiki.%s.tokens",
-                 "test": "wiki.%s.tokens"}
+    # segment name → file name (WikiText checkouts call it "valid")
+    _segments = {"train": "wiki.train.tokens", "val": "wiki.valid.tokens",
+                 "test": "wiki.test.tokens"}
 
     def __init__(self, root, segment="train", seq_len=35, vocab=None):
-        seg_file = "wiki.%s.tokens" % ("valid" if segment == "val"
-                                       else segment)
+        if segment not in self._segments:
+            raise ValueError("segment must be one of %s"
+                             % sorted(self._segments))
+        seg_file = self._segments[segment]
         path = os.path.join(root, seg_file)
         if not os.path.exists(path):
             raise FileNotFoundError(
