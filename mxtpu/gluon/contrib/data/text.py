@@ -65,7 +65,7 @@ class CorpusDataset(_dataset.Dataset):
 class _WikiText(CorpusDataset):
     # segment name → file name (WikiText checkouts call it "valid")
     _segments = {"train": "wiki.train.tokens", "val": "wiki.valid.tokens",
-                 "test": "wiki.test.tokens"}
+                 "valid": "wiki.valid.tokens", "test": "wiki.test.tokens"}
 
     def __init__(self, root, segment="train", seq_len=35, vocab=None):
         if segment not in self._segments:

@@ -76,6 +76,7 @@ class SPMDTrainer:
         self._optimizer = optimizer
         self._num_update = 0
         self._params_sharded = False
+        self._input_shardings = None  # cached in step()
         self._diff_params: List = []
         self._aux_params: List = []
         self._opt_states: List = []
@@ -190,7 +191,7 @@ class SPMDTrainer:
         label = label if isinstance(label, NDArray) else nd.array(label)
         # cached input shardings: building NamedSharding objects per step
         # showed up in the round-2 blocked-latency gap (VERDICT weak #2)
-        in_sh = getattr(self, "_input_shardings", None)
+        in_sh = self._input_shardings
         if in_sh is None:
             jm = self._mesh.jax_mesh
             in_sh = (NamedSharding(jm, self._batch_spec),

@@ -20,6 +20,7 @@ from .ndarray import NDArray
 __all__ = ["default_context", "set_default_context", "assert_almost_equal",
            "almost_equal", "same", "rand_ndarray", "rand_shape_nd",
            "random_seed", "check_numeric_gradient", "check_consistency",
+           "check_symbolic_forward", "check_symbolic_backward",
            "simple_forward", "list_gpus"]
 
 _default_ctx = None
@@ -174,3 +175,59 @@ def check_consistency(fn, inputs, ctx_list=None, rtol=None, atol=None):
 
 def list_gpus():
     return list(range(ctx_mod.num_tpus()))
+
+
+def check_symbolic_forward(sym, location, expected, rtol=1e-4, atol=1e-5,
+                           aux_states=None, ctx=None):
+    """Bind a symbol, run forward, compare each output against expected
+    (parity: test_utils.check_symbolic_forward).  location: dict
+    name→array or list in list_arguments() order."""
+    ctx = ctx or default_context()
+    args = _location_dict(sym.list_arguments(), location)
+    auxs = _location_dict(sym.list_auxiliary_states(), aux_states or {})
+    ex = sym.bind(ctx, args, aux_states=auxs)
+    outs = ex.forward()
+    if not isinstance(expected, (list, tuple)):
+        expected = [expected]
+    assert len(outs) == len(expected), \
+        "output arity %d != expected %d" % (len(outs), len(expected))
+    for i, (o, e) in enumerate(zip(outs, expected)):
+        assert_almost_equal(_as_np(o), _as_np(e), rtol, atol,
+                            names=("output[%d]" % i, "expected[%d]" % i))
+    return outs
+
+
+def check_symbolic_backward(sym, location, out_grads, expected_grads,
+                            rtol=1e-4, atol=1e-5, aux_states=None,
+                            grad_req="write", ctx=None):
+    """Bind with gradient buffers, run forward+backward, compare each
+    argument gradient against expected (parity:
+    test_utils.check_symbolic_backward).  expected_grads: dict
+    name→array (only named args are checked)."""
+    ctx = ctx or default_context()
+    args = _location_dict(sym.list_arguments(), location)
+    auxs = _location_dict(sym.list_auxiliary_states(), aux_states or {})
+    grads = {k: nd.zeros_like(v) for k, v in args.items()}
+    ex = sym.bind(ctx, args, args_grad=grads, grad_req=grad_req,
+                  aux_states=auxs)
+    ex.forward(is_train=True)
+    if out_grads is not None and not isinstance(out_grads, (list, tuple)):
+        out_grads = [out_grads]
+    if out_grads is not None:
+        out_grads = [g if isinstance(g, nd.NDArray) else nd.array(g)
+                     for g in out_grads]
+    ex.backward(out_grads)
+    for name, exp in expected_grads.items():
+        got = ex.grad_dict.get(name)
+        assert got is not None, "no gradient recorded for %r" % name
+        assert_almost_equal(_as_np(got), _as_np(exp), rtol, atol,
+                            names=("grad[%s]" % name, "expected"))
+    return ex.grad_dict
+
+
+def _location_dict(names, location):
+    if isinstance(location, dict):
+        return {k: (v if isinstance(v, nd.NDArray) else nd.array(v))
+                for k, v in location.items() if k in set(names)}
+    return {n: (v if isinstance(v, nd.NDArray) else nd.array(v))
+            for n, v in zip(names, location)}

@@ -61,3 +61,28 @@ def test_check_consistency_catches_divergence():
     with pytest.raises(AssertionError):
         test_utils.check_consistency(flaky, [np.ones(3, "f")],
                                      ctx_list=[mx.cpu(0), mx.cpu(1)])
+
+
+def test_check_symbolic_forward_backward():
+    """check_symbolic_forward/backward against hand-computed values
+    (parity: the reference test helpers used throughout
+    test_operator.py)."""
+    from mxtpu import symbol as sym
+
+    a = sym.Variable("a")
+    b = sym.Variable("b")
+    out = sym.broadcast_mul(a, b)
+    av = np.array([[1., 2.], [3., 4.]], "f")
+    bv = np.array([[5., 6.], [7., 8.]], "f")
+    test_utils.check_symbolic_forward(out, {"a": av, "b": bv}, [av * bv])
+    og = np.ones_like(av)
+    test_utils.check_symbolic_backward(out, {"a": av, "b": bv}, [og],
+                                       {"a": bv, "b": av})
+    # positional location + wrong-expectation detection
+    test_utils.check_symbolic_forward(out, [av, bv], [av * bv])
+    with pytest.raises(AssertionError):
+        test_utils.check_symbolic_forward(out, {"a": av, "b": bv},
+                                          [av + bv])
+    with pytest.raises(AssertionError):
+        test_utils.check_symbolic_backward(out, {"a": av, "b": bv}, [og],
+                                           {"a": av})
