@@ -54,17 +54,16 @@ class Identity(HybridBlock):
 
 
 class SparseEmbedding(nn.Embedding):
-    """Sparse-gradient embedding (parity: contrib.SparseEmbedding).
-
-    Sparse storage is descoped in v1 (SURVEY §7 hard-part 6) — dense
-    gradients with a warning; XLA's scatter-add handles the update."""
+    """Sparse-gradient embedding (parity: contrib.SparseEmbedding —
+    simply Embedding with sparse_grad=True since the row-sparse path
+    landed: backward produces a RowSparseNDArray gradient and optimizers
+    apply lazy row-wise updates)."""
 
     def __init__(self, input_dim, output_dim, dtype="float32",
                  weight_initializer=None, **kwargs):
-        warnings.warn("SparseEmbedding: row_sparse gradients are descoped "
-                      "in mxtpu v1; dense fallback (documented)")
         super().__init__(input_dim, output_dim, dtype=dtype,
-                         weight_initializer=weight_initializer, **kwargs)
+                         weight_initializer=weight_initializer,
+                         sparse_grad=True, **kwargs)
 
 
 class SyncBatchNorm(nn.BatchNorm):

@@ -1,0 +1,116 @@
+"""AMP tests (parity: tests/python/unittest/test_amp.py — op lists,
+convert_model casting policy, dynamic loss scaling, end-to-end training
+in the low-precision dtype)."""
+
+import numpy as np
+import pytest
+
+import mxtpu as mx
+from mxtpu import amp, autograd, nd
+from mxtpu.gluon import Trainer, nn
+from mxtpu.gluon.loss import L2Loss
+
+
+@pytest.fixture(autouse=True)
+def _reset_amp_state():
+    yield
+    amp._amp_state.update({"initialized": False, "target_dtype": None,
+                           "loss_scaler": None})
+
+
+def test_op_lists_disjoint_and_nonempty():
+    lp16 = set(amp.list_lp16_ops())
+    fp32 = set(amp.list_fp32_ops())
+    assert lp16 and fp32
+    assert not (lp16 & fp32)
+    # the matmul-class ops ride the MXU in low precision; softmax/norms
+    # stay fp32 (reference list policy)
+    assert "FullyConnected" in lp16 and "Convolution" in lp16
+    assert any("softmax" in o.lower() for o in fp32)
+
+
+def test_convert_model_casts_but_keeps_norm_stats_fp32():
+    net = nn.HybridSequential()
+    net.add(nn.Dense(8, in_units=4), nn.BatchNorm(in_channels=8))
+    net.initialize()
+    amp.init()  # bfloat16 on TPU
+    amp.convert_model(net)
+    assert net[0].weight.data().dtype == np.dtype("bfloat16")
+    # norm statistics stay fp32 (BatchNorm.cast policy)
+    assert net[1].gamma.data().dtype == np.dtype("float32")
+    out = net(nd.array(np.random.rand(2, 4), dtype="bfloat16"))
+    assert out.dtype == np.dtype("bfloat16")
+
+
+def test_loss_scaler_dynamics():
+    s = amp.LossScaler(init_scale=64.0, scale_factor=2.0, scale_window=3)
+    s.update_scale(overflow=True)
+    assert s.loss_scale == 32.0
+    for _ in range(3):
+        s.update_scale(overflow=False)
+    assert s.loss_scale == 64.0
+    # overflow detection over grads
+    good = nd.array(np.ones(3, "f"))
+    bad = nd.array(np.array([1.0, np.inf, 3.0], "f"))
+    assert not s.has_overflow([good])
+    assert s.has_overflow([good, bad])
+
+
+def test_fp16_scale_loss_and_unscale_roundtrip():
+    amp.init(target_dtype="float16")
+    net = nn.Dense(1, in_units=3)
+    net.initialize()
+    trainer = Trainer(net.collect_params(), "sgd",
+                      {"learning_rate": 0.0})
+    amp.init_trainer(trainer)
+    scaler = trainer._amp_loss_scaler
+    assert scaler is not None
+
+    X = nd.array(np.random.RandomState(0).rand(8, 3).astype("f"))
+    y = nd.array(np.zeros((8, 1), "f"))
+    loss_fn = L2Loss()
+    with autograd.record():
+        raw = loss_fn(net(X), y)
+        with amp.scale_loss(raw, trainer) as scaled:
+            pass
+    # scaled loss is raw * loss_scale
+    np.testing.assert_allclose(scaled.asnumpy(),
+                               raw.asnumpy() * scaler.loss_scale,
+                               rtol=1e-3)
+    scaled.sum().backward()
+    g_scaled = net.weight.grad().asnumpy().copy()
+    amp.unscale(trainer)
+    np.testing.assert_allclose(net.weight.grad().asnumpy(),
+                               g_scaled / scaler.loss_scale, rtol=1e-3,
+                               atol=1e-6)
+
+
+def test_bf16_training_end_to_end():
+    """The TPU-native AMP mode: cast to bf16, no loss scaling needed,
+    training still converges."""
+    amp.init()  # bfloat16
+    mx.random.seed(3)
+    net = nn.Dense(1, in_units=4)
+    net.initialize()
+    amp.convert_model(net)
+    trainer = Trainer(net.collect_params(), "sgd",
+                      {"learning_rate": 0.1})
+    amp.init_trainer(trainer)  # no-op scaler in bf16
+
+    rng = np.random.RandomState(1)
+    X = rng.rand(64, 4).astype("f")
+    w = rng.rand(4, 1).astype("f")
+    y = X @ w
+    loss_fn = L2Loss()
+    first = last = None
+    for _ in range(60):
+        with autograd.record():
+            raw = loss_fn(net(nd.array(X)), nd.array(y))
+            with amp.scale_loss(raw, trainer) as scaled:
+                pass
+        scaled.backward()
+        trainer.step(X.shape[0])
+        lv = float(raw.asnumpy().mean())
+        first = lv if first is None else first
+        last = lv
+    assert last < first * 0.2, (first, last)

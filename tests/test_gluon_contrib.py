@@ -45,9 +45,8 @@ def test_sync_batchnorm():
     assert out.shape == (4, 3, 2, 2)
 
 
-def test_sparse_embedding_dense_fallback():
-    with pytest.warns(UserWarning):
-        emb = cnn.SparseEmbedding(10, 4)
+def test_sparse_embedding_forward():
+    emb = cnn.SparseEmbedding(10, 4)  # no warning: real sparse path now
     emb.initialize()
     out = emb(mx.nd.array([1, 3], dtype="int32"))
     assert out.shape == (2, 4)
@@ -177,3 +176,28 @@ def test_contrib_data_corpus_dataset(tmp_path):
     from mxtpu.gluon.contrib.data.text import WikiText2
     with pytest.raises(FileNotFoundError):
         WikiText2(str(tmp_path), segment="train")
+
+
+def test_sparse_embedding_row_sparse_grads():
+    """contrib.SparseEmbedding now rides the real row-sparse gradient
+    path (round-3 sparse storage) instead of the old warn-and-densify
+    stub."""
+    import numpy as np
+    import warnings as _w
+    import mxtpu as mx
+    from mxtpu import autograd, nd
+    from mxtpu.gluon.contrib.nn import SparseEmbedding
+    from mxtpu.ndarray.sparse import RowSparseNDArray
+
+    with _w.catch_warnings():
+        _w.simplefilter("error")  # the old stub warned here
+        emb = SparseEmbedding(50, 8)
+    emb.initialize()
+    idx = nd.array(np.array([1, 3, 3, 7], "f"))
+    with autograd.record():
+        out = emb(idx)
+        loss = (out * out).sum()
+    loss.backward()
+    g = emb.weight.grad()
+    assert isinstance(g, RowSparseNDArray)
+    assert set(np.asarray(g.indices.asnumpy()).tolist()) == {1, 3, 7}
