@@ -36,6 +36,7 @@ class Monitor:
         self.exes = []
         self.re_prog = re.compile(pattern)
         self.sort = sort
+        self._in_tap = False
 
     def install(self, exe=None):
         """Register the tap (parity: Monitor.install(exe); exe optional —
@@ -57,7 +58,15 @@ class Monitor:
         self.step += 1
 
     def _tap(self, op_name, out):
-        self._stat_helper(op_name, out)
+        # reentrancy guard: stat_func itself dispatches ops (the default
+        # uses nd.norm), which would re-enter this tap and recurse
+        if self._in_tap:
+            return
+        self._in_tap = True
+        try:
+            self._stat_helper(op_name, out)
+        finally:
+            self._in_tap = False
 
     def toc(self):
         """Stop collecting, return list of (step, opname, stat)."""
