@@ -25,7 +25,7 @@ from .._image_impl import (Augmenter, HorizontalFlipAug, ResizeAug,
 
 __all__ = ["DetAugmenter", "DetBorrowAug", "DetRandomSelectAug",
            "DetHorizontalFlipAug", "DetRandomCropAug", "DetRandomPadAug",
-           "CreateDetAugmenter"]
+           "CreateDetAugmenter", "ImageDetIter"]
 
 
 class DetAugmenter:
@@ -261,3 +261,115 @@ def CreateDetAugmenter(data_shape, resize=0, rand_crop=0, rand_pad=0,
     if mean is not None and std is not None:
         auglist.append(DetBorrowAug(ColorNormalizeAug(mean, std)))
     return auglist
+
+
+class ImageDetIter:
+    """Detection data iterator (parity: image.ImageDetIter).
+
+    Wraps ImageIter's record/list reading; labels are object lists
+    ``[cls, xmin, ymin, xmax, ymax]`` per image, padded to a fixed
+    object count and emitted in the reference's packed layout
+    ``[header_width, object_width, pad..., objects...]`` per row, with
+    detection augmenters applied jointly to image + label.
+    """
+
+    def __init__(self, batch_size, data_shape, path_imgrec=None,
+                 path_imglist=None, path_root=".", path_imgidx=None,
+                 shuffle=False, aug_list=None, imglist=None,
+                 dtype="float32", max_objects=16, **kwargs):
+        from .._image_impl import ImageIter
+        from ..io import DataBatch, DataDesc
+
+        if aug_list is None:
+            aug_list = CreateDetAugmenter(data_shape, **kwargs)
+        self._aug_list = aug_list
+        self._max_objects = int(max_objects)
+        self._batch_cls = DataBatch
+        self._dtype = dtype
+        # reuse ImageIter's reading machinery with NO image augs (the det
+        # augmenters need image+label together)
+        self._base = ImageIter(batch_size=batch_size,
+                               data_shape=data_shape,
+                               path_imgrec=path_imgrec,
+                               path_imglist=path_imglist,
+                               path_root=path_root,
+                               path_imgidx=path_imgidx,
+                               imglist=imglist,
+                               shuffle=shuffle, aug_list=[],
+                               label_width=1 + 5 * self._max_objects,
+                               dtype=dtype)
+        self._base._native_mode = None  # per-image python path
+        self.batch_size = batch_size
+        self.data_shape = tuple(data_shape)
+        obj_w = 5
+        self.provide_data = [DataDesc(
+            "data", (batch_size,) + self.data_shape, dtype)]
+        self.provide_label = [DataDesc(
+            "label", (batch_size, self._max_objects, obj_w), "float32")]
+
+    def reset(self):
+        self._base.reset()
+
+    def __iter__(self):
+        return self
+
+    def _parse_label(self, raw):
+        """Flat record label → (N, 5) object array (parity:
+        ImageDetIter._parse_label: [header_width, object_width, ...])."""
+        arr = np.asarray(raw, np.float32).ravel()
+        if arr.size < 2:
+            return np.zeros((0, 5), np.float32)
+        header_width = int(arr[0])
+        object_width = int(arr[1])
+        # the reference rejects malformed layouts rather than guessing
+        # (ImageDetIter._parse_label raises on invalid label shape)
+        if (header_width < 2 or object_width < 5
+                or arr[0] != header_width or arr[1] != object_width
+                or (arr.size - header_width) % object_width != 0):
+            raise ValueError(
+                "invalid detection label: expected "
+                "[header_width>=2, object_width>=5, objects...], got "
+                "length-%d label with header %r" % (arr.size,
+                                                    arr[:2].tolist()))
+        body = arr[header_width:]
+        objs = body.reshape(-1, object_width)[:, :5]
+        # drop padding rows (class id < 0)
+        return objs[objs[:, 0] >= 0].astype(np.float32)
+
+    def next(self):
+        from .. import ndarray as nd
+
+        c, h, w = self.data_shape
+        data = np.zeros((self.batch_size, h, w, c), np.float32)
+        labels = np.full((self.batch_size, self._max_objects, 5), -1.0,
+                         np.float32)
+        i = 0
+        try:
+            while i < self.batch_size:
+                raw_label, img = self._base.next_sample()
+                label = self._parse_label(raw_label)
+                arr = img.asnumpy() if hasattr(img, "asnumpy") else \
+                    np.asarray(img)
+                for aug in self._aug_list:
+                    arr, label = aug(arr, label)
+                arr = arr.asnumpy() if hasattr(arr, "asnumpy") else \
+                    np.asarray(arr)
+                data[i] = arr.astype(np.float32)
+                n = min(len(label), self._max_objects)
+                if n:
+                    labels[i, :n] = label[:n, :5]
+                i += 1
+        except StopIteration:
+            if i == 0:
+                raise
+            while i < self.batch_size:
+                data[i] = data[i - 1]
+                labels[i] = labels[i - 1]
+                i += 1
+        return self._batch_cls(
+            data=[nd.array(data.transpose(0, 3, 1, 2).astype(
+                self._dtype))],
+            label=[nd.array(labels)])
+
+    def __next__(self):
+        return self.next()
