@@ -23,7 +23,8 @@ from ..base import MXTPUError, register_op
 from .. import ndarray as nd
 from ..ndarray import NDArray
 
-__all__ = ["quantize_model", "quantize_params", "optimal_thresholds"]
+__all__ = ["quantize_model", "quantize_net", "quantize_params",
+           "optimal_thresholds"]
 
 QUANTIZABLE = ("FullyConnected", "Convolution")
 
@@ -349,3 +350,41 @@ def _calibrate(sym, arg_params, aux_params, data_names, targets,
         if num_examples and seen >= num_examples:
             break
     return collector.ranges()
+
+
+def quantize_net(network, quantized_dtype="int8", exclude_layers=(),
+                 calib_data=None, calib_mode="naive",
+                 num_calib_examples=None, data_names=("data",),
+                 ctx=None, logger=None):
+    """Quantize a Gluon HybridBlock into an int8 SymbolBlock (parity:
+    mx.contrib.quantization.quantize_net — trace the block to a symbol,
+    run quantize_model, wrap the result for imperative use)."""
+    from ..gluon.block import SymbolBlock
+    from ..symbol import trace_block, var
+
+    sym = trace_block(network, input_names=data_names)
+    all_params = {}
+    for name, p in network.collect_params().items():
+        if p._data is None:
+            raise MXTPUError(
+                "quantize_net: parameter %r is uninitialized — run a "
+                "forward pass first" % name)
+        all_params[name] = p.data()
+    # classify by the TRACED GRAPH's own view, not grad_req: traced
+    # Parameter.var()s are plain Variables (no __aux__), so BatchNorm
+    # running stats land in list_arguments() and must be bound as args
+    # during calibration
+    arg_names = set(sym.list_arguments())
+    aux_names = set(sym.list_auxiliary_states())
+    arg_params = {k: v for k, v in all_params.items() if k in arg_names}
+    aux_params = {k: v for k, v in all_params.items() if k in aux_names}
+
+    qsym, qargs, qaux = quantize_model(
+        sym, arg_params, aux_params, data_names=data_names,
+        excluded_sym_names=exclude_layers, calib_mode=calib_mode,
+        calib_data=calib_data, num_calib_examples=num_calib_examples,
+        quantized_dtype=quantized_dtype)
+
+    params = {k: v for k, v in qargs.items()}
+    params.update(qaux)
+    return SymbolBlock(qsym, [var(n) for n in data_names], params=params)

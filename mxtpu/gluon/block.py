@@ -542,7 +542,11 @@ class SymbolBlock(HybridBlock):
             for name, arr in params.items():
                 clean = name.replace("arg:", "").replace("aux:", "")
                 if clean in self._params:
-                    self._params[clean]._load_init(arr, None)
+                    p = self._params[clean]
+                    # adopt the stored dtype — int8 quantized weights
+                    # must NOT be silently upcast to the fp32 default
+                    p.dtype = arr.dtype
+                    p._load_init(arr, None)
 
     @staticmethod
     def imports(symbol_file, input_names, param_file=None, ctx=None):
@@ -568,7 +572,10 @@ class SymbolBlock(HybridBlock):
         for name, p in self._params.items():
             if p._data is not None:
                 args_map[name] = p.data(x.context)
-        return self._sym_outputs.eval(**args_map)
+        outs = self._sym_outputs.eval(**args_map)
+        if isinstance(outs, (list, tuple)) and len(outs) == 1:
+            return outs[0]  # single-output symbols yield one NDArray
+        return outs
 
     def hybrid_forward(self, F, x, *args, **kwargs):
         raise NotImplementedError  # forward is overridden

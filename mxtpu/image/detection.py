@@ -266,11 +266,12 @@ def CreateDetAugmenter(data_shape, resize=0, rand_crop=0, rand_pad=0,
 class ImageDetIter:
     """Detection data iterator (parity: image.ImageDetIter).
 
-    Wraps ImageIter's record/list reading; labels are object lists
-    ``[cls, xmin, ymin, xmax, ymax]`` per image, padded to a fixed
-    object count and emitted in the reference's packed layout
-    ``[header_width, object_width, pad..., objects...]`` per row, with
-    detection augmenters applied jointly to image + label.
+    Wraps ImageIter's record/list reading.  INPUT record labels use the
+    reference's packed layout ``[header_width, object_width,
+    objects...]``; emitted batches carry headerless object tensors of
+    shape ``(batch, max_objects, 5)`` — rows ``[cls, xmin, ymin, xmax,
+    ymax]``, padded with -1 — with detection augmenters applied jointly
+    to image + label.
     """
 
     def __init__(self, batch_size, data_shape, path_imgrec=None,
@@ -286,8 +287,8 @@ class ImageDetIter:
         self._max_objects = int(max_objects)
         self._batch_cls = DataBatch
         self._dtype = dtype
-        # reuse ImageIter's reading machinery with NO image augs (the det
-        # augmenters need image+label together)
+        # reuse ImageIter's reading machinery (next_sample only) with NO
+        # image augs — the det augmenters need image+label together
         self._base = ImageIter(batch_size=batch_size,
                                data_shape=data_shape,
                                path_imgrec=path_imgrec,
@@ -296,9 +297,7 @@ class ImageDetIter:
                                path_imgidx=path_imgidx,
                                imglist=imglist,
                                shuffle=shuffle, aug_list=[],
-                               label_width=1 + 5 * self._max_objects,
                                dtype=dtype)
-        self._base._native_mode = None  # per-image python path
         self.batch_size = batch_size
         self.data_shape = tuple(data_shape)
         obj_w = 5

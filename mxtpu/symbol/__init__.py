@@ -30,3 +30,18 @@ _mod = _sys.modules[__name__]
 for _name, _spec in list(_REG.items()):
     if not hasattr(_mod, _name):
         setattr(_mod, _name, _make_sym_op(_name))
+
+
+def trace_block(block, input_names=("data",)):
+    """Trace a HybridBlock into a Symbol graph (parity: the hybridize
+    _build_cache trace, gluon/block.py — hybrid_forward is called with
+    Symbol variables for the data inputs and each Parameter's var()).
+    Used by HybridBlock.export / SymbolBlock round trips and
+    contrib.quantization.quantize_net."""
+    if isinstance(input_names, str):
+        input_names = (input_names,)
+    inputs = [var(n) for n in input_names]
+    out = block(*inputs)
+    if isinstance(out, (list, tuple)):
+        out = Group(list(out))
+    return out
