@@ -451,8 +451,10 @@ def export_model(sym, params, input_shape, input_type=np.float32,
     try:
         _, out_shapes, _ = sym.infer_shape(**shape_kwargs)
     except Exception:
-        out_shapes = [None] * len(sym._roots())
+        out_shapes = None
     out_names = [n.name for n in sym._roots()]
+    if out_shapes is None:  # infer_shape may also RETURN (None,)*3
+        out_shapes = [None] * len(out_names)
     for name, shape in zip(out_names, out_shapes):
         vi = graph.output.add()
         vi.name = name

@@ -164,3 +164,29 @@ def test_import_gather_and_reduce(tmp_path):
     idx = np.array([0, 2, 4], np.float32)
     got = _bind_run(s, args, idx, data_name="idx")
     np.testing.assert_allclose(got, table[[0, 2, 4]].mean(axis=1))
+
+
+def test_resnet18_full_model_roundtrip(tmp_path):
+    """Whole model-zoo ResNet-18 through export_model → import_model with
+    bit-exact predictions — the real interop workload (trace_block +
+    every converter the architecture touches)."""
+    from mxtpu.gluon.model_zoo.vision import resnet18_v1
+    from mxtpu.symbol import trace_block
+
+    net = resnet18_v1(classes=10)
+    net.initialize()
+    x = nd.array(np.random.RandomState(0).rand(1, 3, 32, 32).astype("f"))
+    ref = net(x).asnumpy()
+    s = trace_block(net)
+    params = {n: p.data() for n, p in net.collect_params().items()}
+    path = onnx_mxtpu.export_model(s, params, [(1, 3, 32, 32)],
+                                   np.float32,
+                                   str(tmp_path / "resnet18.onnx"))
+    s2, a2, x2 = onnx_mxtpu.import_model(path)
+    feed = {**a2, **x2, "data": x}
+    ex = s2.bind(mx.cpu(), {k: v for k, v in feed.items()
+                            if k in s2.list_arguments()},
+                 aux_states={k: v for k, v in feed.items()
+                             if k in set(s2.list_auxiliary_states())})
+    got = ex.forward()[0].asnumpy()
+    np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-4)
