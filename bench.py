@@ -8,12 +8,26 @@ Line 2: BERT-base training samples/sec (config 3: MHA + LayerNorm path).
 Each metric prints ONE JSON line:
   {"metric", "value", "unit", "vs_baseline", ...extras}
 
-Robustness contract (round-1 postmortem): the TPU tunnel (axon plugin) can
+Robustness contract (round-1 postmortem, tightened round 4 after the
+round-3 artifact was rc:124/empty): the TPU tunnel (axon plugin) can
 wedge, which HANGS or fails backend init.  This parent process therefore
 never imports jax itself; it runs the real benchmark in a child subprocess
-under a bounded timeout, retries with backoff, and on final failure emits a
-structured JSON diagnostic line instead of a traceback, so the driver
-always records a parseable result.
+under a bounded timeout and on failure emits a structured JSON diagnostic
+line instead of a traceback, so the driver always records a parseable
+result.  Round-4 rules that make the contract actually hold:
+
+  1. TOTAL wall-clock deadline (TOTAL_DEADLINE_S, default 19 min — the
+     driver budget was observed to be ~<=20 min in round 3): every child
+     timeout is derated so the CPU fallback + diagnostic line always
+     print before the deadline.  A wedged tunnel can no longer burn
+     3 x 25 min before the first fallback byte.
+  2. A cheap health probe (~2 min cap: jax.devices() + a tiny jit) runs
+     FIRST; if it hangs or fails, we skip the long TPU attempts entirely
+     and spend the whole remaining budget on the clearly-labeled CPU
+     fallback.
+  3. Children print each metric line as it completes (flush=True) and
+     the parent parses partial stdout on timeout, so a half-finished run
+     still records its completed metrics.
 
 vs_baseline for ResNet-50 divides by 375 img/s — the commonly cited
 upstream MXNet 1.x fp32 ResNet-50 per-V100 figure (BASELINE.md: the
@@ -32,12 +46,20 @@ import subprocess
 import sys
 import time
 
+import os
+
 RESNET_BASELINE_IPS = 375.0
 V5E_PEAK_BF16 = 197e12
 RESNET_FLOPS_PER_IMG = 3 * 4.09e9
-CHILD_TIMEOUT_S = 1500
-ATTEMPTS = 3
-BACKOFFS_S = (15, 45)
+TOTAL_DEADLINE_S = float(os.environ.get("MXTPU_BENCH_DEADLINE_S", 1140))
+PROBE_TIMEOUT_S = 120
+MAX_CHILD_TIMEOUT_S = 780     # one healthy-chip attempt incl. compiles
+CPU_FALLBACK_RESERVE_S = 340  # kept back so the fallback always runs
+_T0 = time.monotonic()
+
+
+def _remaining():
+    return TOTAL_DEADLINE_S - (time.monotonic() - _T0)
 
 
 # --------------------------------------------------------------- child side
@@ -203,13 +225,46 @@ def _child_main():
     _bench_bert()
 
 
+def _probe_main():
+    """Cheap TPU-health check: backend init + one tiny compile.  A wedged
+    tunnel hangs in make_c_api_client, so merely finishing is the signal."""
+    import jax
+    import jax.numpy as jnp
+    platform = jax.devices()[0].platform
+    jax.jit(lambda x: x * 2 + 1)(jnp.ones(128)).block_until_ready()
+    import mxtpu as mx  # catch framework-level import errors here too,
+    mx.nd.array([1.0, 2.0]).asnumpy()  # not 2 x 12 min into the attempts
+    print(json.dumps({"probe": "ok", "platform": platform}), flush=True)
+
+
 # -------------------------------------------------------------- parent side
+
+def _run_probe():
+    """Returns (platform, probe_timeout); platform None if init hung."""
+    timeout_s = max(10, min(PROBE_TIMEOUT_S,
+                            _remaining() - CPU_FALLBACK_RESERVE_S))
+    try:
+        proc = subprocess.run([sys.executable, __file__, "--probe"],
+                              timeout=timeout_s, stdout=subprocess.PIPE,
+                              stderr=subprocess.PIPE, text=True)
+    except subprocess.TimeoutExpired:
+        return None, timeout_s
+    if proc.returncode != 0:
+        return None, timeout_s
+    for ln in proc.stdout.splitlines():
+        ln = ln.strip()
+        if ln.startswith("{") and '"probe"' in ln:
+            try:
+                return json.loads(ln).get("platform"), timeout_s
+            except ValueError:
+                pass
+    return None, timeout_s
+
 
 def _run_child(timeout_s, cpu_fallback=False):
     cmd = [sys.executable, __file__, "--child"]
     env = None
     if cpu_fallback:
-        import os
         env = dict(os.environ)
         # bypass the axon plugin entirely (sitecustomize register() is
         # keyed on PALLAS_AXON_POOL_IPS) — a wedged tunnel hangs backend
@@ -220,19 +275,21 @@ def _run_child(timeout_s, cpu_fallback=False):
         # their constant — keep the three sites in sync.
         env["PALLAS_AXON_POOL_IPS"] = ""
         env["JAX_PLATFORMS"] = "cpu"
+    # Popen + kill + communicate, NOT subprocess.run(timeout=...):
+    # TimeoutExpired.output is None on POSIX, which would throw away any
+    # metric lines the child already printed before blowing its budget.
+    proc = subprocess.Popen(cmd, env=env, stdout=subprocess.PIPE,
+                            stderr=subprocess.PIPE, text=True)
     try:
-        proc = subprocess.run(cmd, timeout=timeout_s, env=env,
-                              stdout=subprocess.PIPE,
-                              stderr=subprocess.PIPE, text=True)
-        return proc.returncode, proc.stdout, proc.stderr
-    except subprocess.TimeoutExpired as e:
-        out = e.output or ""
-        err = e.stderr or ""
-        if isinstance(out, bytes):
-            out = out.decode("utf-8", "replace")
-        if isinstance(err, bytes):
-            err = err.decode("utf-8", "replace")
-        return -9, out, "TIMEOUT after %ds\n%s" % (timeout_s, err)
+        out, err = proc.communicate(timeout=timeout_s)
+        return proc.returncode, out, err
+    except subprocess.TimeoutExpired:
+        proc.kill()
+        try:
+            out, err = proc.communicate(timeout=10)
+        except subprocess.TimeoutExpired:
+            out, err = "", ""
+        return -9, out or "", "TIMEOUT after %ds\n%s" % (timeout_s, err or "")
 
 
 def _json_lines(text):
@@ -251,25 +308,48 @@ def _json_lines(text):
 
 def main():
     last_err = ""
-    for attempt in range(ATTEMPTS):
-        rc, out, err = _run_child(CHILD_TIMEOUT_S)
-        lines = _json_lines(out)
-        if lines:
-            for ln in lines:
-                print(ln)
-            if rc != 0:
-                sys.stderr.write(
-                    "bench child rc=%d after emitting %d metric(s)\n"
-                    % (rc, len(lines)))
-            return 0
-        last_err = (err or out)[-1200:]
-        if attempt < ATTEMPTS - 1:
-            time.sleep(BACKOFFS_S[min(attempt, len(BACKOFFS_S) - 1)])
-    # TPU attempts exhausted (wedged tunnel?): one CPU smoke run with the
-    # plugin bypassed — an honest, clearly-labeled number beats a zero.
-    # Bounded tighter than the TPU attempts so the parent always reaches
-    # the structured-diagnostic line within its budget.
-    rc, out, err = _run_child(CHILD_TIMEOUT_S // 2, cpu_fallback=True)
+    platform, probe_t = _run_probe()
+    if platform is None:
+        last_err = ("health probe hung or failed within %ds — tunnel "
+                    "presumed wedged, skipping TPU attempts" % probe_t)
+        sys.stderr.write("bench: %s\n" % last_err)
+    elif platform not in ("tpu", "axon"):
+        # jax silently fell back to CPU (dead pool that fails fast instead
+        # of wedging): an unlabeled CPU number with a TPU vs_baseline would
+        # be misleading — route to the clearly-labeled CPU fallback.
+        last_err = ("health probe reports platform %r (no TPU backend); "
+                    "skipping TPU attempts" % platform)
+        sys.stderr.write("bench: %s\n" % last_err)
+    else:
+        # Probe passed: commit to full attempts (capped — a fast-failing
+        # child must not be relaunched back-to-back for the whole budget)
+        # while always reserving enough for the CPU fallback + diagnostic.
+        for attempt in range(2):
+            budget = _remaining() - CPU_FALLBACK_RESERVE_S
+            if budget < 240:
+                break
+            rc, out, err = _run_child(min(MAX_CHILD_TIMEOUT_S, budget))
+            lines = _json_lines(out)
+            if lines:
+                for ln in lines:
+                    print(ln)
+                if rc != 0:
+                    sys.stderr.write(
+                        "bench child rc=%d after emitting %d metric(s)\n"
+                        % (rc, len(lines)))
+                return 0
+            last_err = (err or out)[-1200:]
+            if attempt == 0:
+                time.sleep(10)
+    # No full-attempt result (wedged tunnel or budget gone): one CPU smoke
+    # run with the plugin bypassed — an honest, clearly-labeled number
+    # beats a zero.  Bounded by the remaining budget so the parent always
+    # reaches the structured-diagnostic line within the total deadline.
+    fb_timeout = _remaining() - 40
+    if fb_timeout < 20:  # no budget left: go straight to the diagnostic
+        rc, out, err = 1, "", ""
+    else:
+        rc, out, err = _run_child(fb_timeout, cpu_fallback=True)
     lines = _json_lines(out)
     if lines:
         for ln in lines:
@@ -287,8 +367,8 @@ def main():
         "value": 0.0,
         "unit": "images/sec",
         "vs_baseline": 0.0,
-        "error": "bench child failed after %d attempts; last stderr tail: %s"
-                 % (ATTEMPTS, last_err),
+        "error": "bench failed within %.0fs deadline; last stderr tail: %s"
+                 % (TOTAL_DEADLINE_S, last_err),
     }))
     return 1
 
@@ -296,5 +376,7 @@ def main():
 if __name__ == "__main__":
     if "--child" in sys.argv:
         _child_main()
+    elif "--probe" in sys.argv:
+        _probe_main()
     else:
         sys.exit(main())

@@ -31,6 +31,7 @@ __all__ = ["imdecode", "imread", "imresize", "imresize_np", "resize_short",
 
         "BrightnessJitterAug", "ContrastJitterAug", "SaturationJitterAug",
            "HueJitterAug", "ColorJitterAug", "LightingAug", "ColorNormalizeAug",
+           "RandomGrayAug",
            "CreateAugmenter", "ImageIter"]
 
 _INTERP = {0: 0, 1: 1, 2: 2, 3: 3, 4: 4}  # cv2 interpolation enums match
@@ -183,7 +184,8 @@ def random_size_crop(src, size, area, ratio, interp=2):
 
 def color_normalize(src, mean, std=None):
     a = _np(src).astype("float32")
-    a = a - _np(mean)
+    if mean is not None:
+        a = a - _np(mean)
     if std is not None:
         a = a / _np(std)
     return nd.array(a)
@@ -384,6 +386,24 @@ class ColorJitterAug(RandomOrderAug):
         super().__init__(ts)
 
 
+class RandomGrayAug(Augmenter):
+    """With probability p, replace the image by its 3-channel luminance
+    (parity: image.RandomGrayAug — which uses the 0.21/0.72/0.07
+    luminance matrix, not the BT.601 coefficients)."""
+    _coef = onp.array([0.21, 0.72, 0.07], dtype="float32")
+
+    def __init__(self, p):
+        super().__init__(p=p)
+        self.p = p
+
+    def __call__(self, src):
+        if pyrandom.random() >= self.p:
+            return src
+        a = _np(src).astype("float32")
+        gray = (a * self._coef).sum(axis=-1, keepdims=True)
+        return nd.array(onp.broadcast_to(gray, a.shape).copy())
+
+
 class LightingAug(Augmenter):
     def __init__(self, alphastd, eigval, eigvec):
         super().__init__()
@@ -438,6 +458,8 @@ def CreateAugmenter(data_shape, resize=0, rand_crop=False, rand_resize=False,
                             [-0.5808, -0.0045, -0.8140],
                             [-0.5836, -0.6948, 0.4203]])
         auglist.append(LightingAug(pca_noise, eigval, eigvec))
+    if rand_gray > 0:
+        auglist.append(RandomGrayAug(rand_gray))
     if mean is True:
         mean = onp.array([123.68, 116.28, 103.53])
     if std is True:

@@ -38,6 +38,11 @@ class _Builder:
         self.nodes = []
         self.initializers = {}
         self._uid = 0
+        self.shapes = {}  # (id(node), out_idx) -> shape, from infer_shape
+
+    def shape_of(self, sym):
+        """Inferred shape of an input Symbol, or None if unknown."""
+        return self.shapes.get((id(sym._node), sym._index))
 
     def uniq(self, base):
         self._uid += 1
@@ -292,9 +297,64 @@ for _mx, _ox in [("elemwise_add", "Add"), ("broadcast_add", "Add"),
                  ("elemwise_sub", "Sub"), ("broadcast_sub", "Sub"),
                  ("elemwise_mul", "Mul"), ("broadcast_mul", "Mul"),
                  ("elemwise_div", "Div"), ("broadcast_div", "Div"),
-                 ("dot", "MatMul"), ("broadcast_maximum", "Max"),
+                 ("broadcast_maximum", "Max"),
                  ("broadcast_minimum", "Min"), ("broadcast_power", "Pow")]:
     register(_mx)(_binary(_ox))
+
+
+@register("dot")
+def _dot(node, b, out):
+    # MXNet dot contracts the LAST axis of lhs with the FIRST axis of rhs;
+    # ONNX MatMul matches that only for <=2-D operands (for higher ranks
+    # MatMul batches over the leading dims instead).  Refuse rather than
+    # export a silently wrong graph.
+    for i in (0, 1):
+        shp = b.shape_of(node.inputs[i])
+        if shp is None:
+            raise MXTPUError(
+                "ONNX export: cannot verify operand rank of dot node %r "
+                "(shape inference did not reach it); dot is only "
+                "exportable for 2-D operands" % node.name)
+        if len(shp) > 2:
+            raise MXTPUError(
+                "ONNX export: dot with %d-D input %r has last-axis x "
+                "first-axis contraction semantics that MatMul does not "
+                "match; reshape to 2-D before dot" %
+                (len(shp), node.inputs[i].name))
+    a_name, b_name = _in(node, 0), _in(node, 1)
+    kw = node.kwargs
+    if kw.get("transpose_a"):
+        a_name = b.node("Transpose", [a_name], [b.uniq(node.name + "_tA")],
+                        perm=[1, 0])
+    if kw.get("transpose_b"):
+        b_name = b.node("Transpose", [b_name], [b.uniq(node.name + "_tB")],
+                        perm=[1, 0])
+    b.node("MatMul", [a_name, b_name], [out], name=node.name)
+
+
+@register("batch_dot")
+def _batch_dot(node, b, out):
+    """batch_dot == jnp.matmul == ONNX MatMul for every rank; transposes
+    swap the last two axes, which needs the operand rank for the perm."""
+    a_name, b_name = _in(node, 0), _in(node, 1)
+    kw = node.kwargs
+
+    def swap_last2(name, i, tag):
+        shp = b.shape_of(node.inputs[i])
+        if shp is None or len(shp) < 2:
+            raise MXTPUError(
+                "ONNX export: batch_dot transpose needs a known >=2-D "
+                "operand rank for node %r" % node.name)
+        perm = list(range(len(shp)))
+        perm[-1], perm[-2] = perm[-2], perm[-1]
+        return b.node("Transpose", [name], [b.uniq(node.name + tag)],
+                      perm=perm)
+
+    if kw.get("transpose_a"):
+        a_name = swap_last2(a_name, 0, "_tA")
+    if kw.get("transpose_b"):
+        b_name = swap_last2(b_name, 1, "_tB")
+    b.node("MatMul", [a_name, b_name], [out], name=node.name)
 
 
 def _scalar(onnx_op, rev=False):
@@ -424,6 +484,30 @@ def export_model(sym, params, input_shape, input_type=np.float32,
     for name, arr in params.items():
         b.tensor(name, arr)
 
+    # Per-node output shapes for converters that need rank information
+    # (e.g. dot).  Partial inference: nodes whose shapes cannot be derived
+    # simply stay absent from the map — converters that REQUIRE rank info
+    # (dot) raise loudly on absence rather than exporting a wrong graph.
+    # Skipped entirely when no rank-dependent op is in the graph: the
+    # common CNN export shouldn't pay a second abstract-eval graph walk.
+    _RANK_DEPENDENT = {"dot", "batch_dot"}
+    if any(n.op in _RANK_DEPENDENT for n in sym._topo()):
+        try:
+            internals = sym.get_internals()
+            known = dict(zip(data_names, (tuple(s) for s in input_shape)))
+            known.update({k: tuple(v.shape) for k, v in params.items()})
+            _, int_shapes, _ = internals._infer_shape_impl(
+                partial=True, known_shapes=known)
+            if int_shapes:
+                for (n, idx), shp in zip(internals._output_entries(),
+                                         int_shapes):
+                    if shp is not None:
+                        b.shapes[(id(n), idx)] = tuple(shp)
+        except Exception as e:  # rank-needing converters fail closed
+            import warnings
+            warnings.warn("ONNX export: shape inference failed (%s); "
+                          "rank-dependent converters will reject" % (e,))
+
     converted_params = set(params)
     for node in sym._topo():
         if node.op is None:  # variable: already an input or initializer
@@ -446,13 +530,18 @@ def export_model(sym, params, input_shape, input_type=np.float32,
     graph.node.extend(b.nodes)
     graph.initializer.extend(b.initializers.values())
 
-    # output value info with inferred shapes
-    shape_kwargs = dict(zip(data_names, input_shape))
-    try:
-        _, out_shapes, _ = sym.infer_shape(**shape_kwargs)
-    except Exception:
-        out_shapes = None
+    # output value info with inferred shapes; reuse the internals pass
+    # when it already ran rather than paying a second abstract-eval walk
     out_names = [n.name for n in sym._roots()]
+    if b.shapes:
+        out_shapes = [b.shapes.get((id(n), i))
+                      for n, i in sym._output_entries()]
+    else:
+        shape_kwargs = dict(zip(data_names, input_shape))
+        try:
+            _, out_shapes, _ = sym.infer_shape(**shape_kwargs)
+        except Exception:
+            out_shapes = None
     if out_shapes is None:  # infer_shape may also RETURN (None,)*3
         out_shapes = [None] * len(out_names)
     for name, shape in zip(out_names, out_shapes):

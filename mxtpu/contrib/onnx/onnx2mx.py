@@ -115,19 +115,56 @@ def _conv(node, ctx, at):
                                   name=node.name or None)
 
 
+def _derived_const(ctx, base, arr):
+    """Register a derived constant under a name that is guaranteed not to
+    collide with a DIFFERENT tensor (a model may legitimately contain an
+    initializer that happens to share our suffix convention); identical
+    values are deduplicated."""
+    name = base
+    i = 0
+    while name in ctx.consts:
+        existing = ctx.consts[name]
+        if (existing.shape == arr.shape and existing.dtype == arr.dtype
+                and np.array_equal(existing, arr)):
+            return name
+        i += 1
+        name = "%s_%d" % (base, i)
+    ctx.consts[name] = arr
+    return name
+
+
 @register("Gemm")
 def _gemm(node, ctx, at):
     if at.get("transA"):
         raise MXTPUError("ONNX import: Gemm transA unsupported")
-    w = ctx.const_value(node.input[1])
+    w_name = node.input[1]
+    w = ctx.const_value(w_name)
+    alpha = float(at.get("alpha", 1.0))
+    beta = float(at.get("beta", 1.0))
+    if alpha != 1.0:
+        # fold alpha into the (constant) weight under a derived name
+        w = w * np.asarray(alpha, w.dtype)
+        w_name = _derived_const(ctx, w_name + "__mxtpu_a", w)
+    rest = list(node.input[2:])
+    if beta != 1.0 and rest:
+        c_name = rest[0]
+        if c_name not in ctx.consts:
+            raise MXTPUError(
+                "ONNX import: Gemm beta=%g with non-constant C input %r "
+                "unsupported" % (beta, c_name))
+        scaled = ctx.consts[c_name] * np.asarray(beta,
+                                                 ctx.consts[c_name].dtype)
+        rest[0] = _derived_const(ctx, c_name + "__mxtpu_b", scaled)
     if not at.get("transB", 0):
-        # FullyConnected wants (num_hidden, in); pre-transpose the constant
-        name = node.input[1]
-        ctx.consts[name] = np.ascontiguousarray(w.T)
-        w = ctx.consts[name]
+        # FullyConnected wants (num_hidden, in); register the transposed
+        # weight under a fresh name instead of mutating the stored constant
+        # — the same initializer may feed other consumers (shared weights),
+        # which must keep seeing the original orientation.
+        w = np.ascontiguousarray(w.T)
+        w_name = _derived_const(ctx, w_name + "__mxtpu_T", w)
     kwargs = dict(num_hidden=int(w.shape[0]), flatten=False,
-                  no_bias=len(node.input) < 3)
-    ins = [ctx.get(n) for n in node.input]
+                  no_bias=not rest)
+    ins = [ctx.get(n) for n in [node.input[0], w_name] + rest]
     return sym_api.Symbol._create("FullyConnected", None, ins, kwargs,
                                   name=node.name or None)
 
@@ -159,7 +196,11 @@ for _ox, _mx in [("Relu", "relu"), ("Sigmoid", "sigmoid"), ("Tanh", "tanh"),
                  ("Identity", "identity"),
                  ("Add", "broadcast_add"), ("Sub", "broadcast_sub"),
                  ("Mul", "broadcast_mul"), ("Div", "broadcast_div"),
-                 ("Pow", "broadcast_power"), ("MatMul", "dot"),
+                 # MatMul → batch_dot (= jnp.matmul): ONNX MatMul batches
+                 # over leading dims for rank>2, which MXNet dot does NOT
+                 # (dot contracts last axis x first axis); batch_dot matches
+                 # MatMul for every rank.
+                 ("Pow", "broadcast_power"), ("MatMul", "batch_dot"),
                  ("Max", "broadcast_maximum"), ("Min", "broadcast_minimum"),
                  ("Sum", "add_n")]:
     register(_ox)(_simple(_mx))
@@ -279,10 +320,18 @@ def _gather(node, ctx, at):
 
 @register("Clip")
 def _clip(node, ctx, at):
-    a_min = at.get("min", float(ctx.const_value(node.input[1]))
-                   if len(node.input) > 1 else -np.inf)
-    a_max = at.get("max", float(ctx.const_value(node.input[2]))
-                   if len(node.input) > 2 else np.inf)
+    # opset-6 style puts min/max in attributes; opset-11+ passes them as
+    # optional inputs whose name is "" when omitted.  Branch explicitly —
+    # never evaluate const_value("") (dict.get defaults are eager).
+    def bound(attr, idx, default):
+        if attr in at:
+            return float(at[attr])
+        if len(node.input) > idx and node.input[idx]:
+            return float(ctx.const_value(node.input[idx]))
+        return default
+
+    a_min = bound("min", 1, -np.inf)
+    a_max = bound("max", 2, np.inf)
     return sym_api.Symbol._create("clip", None, [ctx.get(node.input[0])],
                                   dict(a_min=float(a_min),
                                        a_max=float(a_max)),
@@ -405,8 +454,9 @@ def _import_graph(g):
     aux_names = set(sym.list_auxiliary_states())
     arg_params, aux_params = {}, {}
     for name in ctx.param_used_as_input:
-        # Gemm import may have transposed the stored weight — read back
-        # the (possibly updated) constant table, not the original proto.
+        # Gemm import may have registered derived constants (transposed
+        # weights under fresh names) — read the constant table, not the
+        # original proto.
         arr = nd.array(ctx.consts[name])
         if name in aux_names:
             aux_params[name] = arr

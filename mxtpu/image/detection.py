@@ -21,7 +21,7 @@ from .._image_impl import (Augmenter, HorizontalFlipAug, ResizeAug,
                            LightingAug, ColorNormalizeAug,
                            BrightnessJitterAug, ContrastJitterAug,
                            SaturationJitterAug, HueJitterAug,
-                           RandomOrderAug, fixed_crop, _np)
+                           RandomGrayAug, RandomOrderAug, fixed_crop, _np)
 
 __all__ = ["DetAugmenter", "DetBorrowAug", "DetRandomSelectAug",
            "DetHorizontalFlipAug", "DetRandomCropAug", "DetRandomPadAug",
@@ -247,6 +247,8 @@ def CreateDetAugmenter(data_shape, resize=0, rand_crop=0, rand_pad=0,
         auglist.append(DetBorrowAug(RandomOrderAug(color)))
     if hue:
         auglist.append(DetBorrowAug(HueJitterAug(hue)))
+    if rand_gray > 0:
+        auglist.append(DetBorrowAug(RandomGrayAug(rand_gray)))
     if pca_noise > 0:
         eigval = np.array([55.46, 4.794, 1.148])
         eigvec = np.array([[-0.5675, 0.7192, 0.4009],
@@ -258,7 +260,7 @@ def CreateDetAugmenter(data_shape, resize=0, rand_crop=0, rand_pad=0,
         mean = np.array([123.68, 116.28, 103.53])
     if std is True:
         std = np.array([58.395, 57.12, 57.375])
-    if mean is not None and std is not None:
+    if mean is not None or std is not None:
         auglist.append(DetBorrowAug(ColorNormalizeAug(mean, std)))
     return auglist
 

@@ -323,17 +323,22 @@ class Symbol:
     def infer_shape_partial(self, **kwargs):
         return self._infer_shape_impl(partial=True, **kwargs)
 
-    def _infer_shape_impl(self, partial=False, **kwargs):
+    def _infer_shape_impl(self, partial=False, known_shapes=None, **kwargs):
         """Forward shape propagation: topo walk, per-node jax.eval_shape,
         with parameter-shape rules for weight-carrying ops (the eval_shape
-        equivalent of the reference's FInferShape protocol)."""
+        equivalent of the reference's FInferShape protocol).
+
+        known_shapes: optional dict of name → shape for internal callers —
+        unlike **kwargs it cannot collide with a variable literally named
+        "partial" / "known_shapes"."""
         import jax
         import jax.numpy as jnp
         from .. import ndarray as ndpkg
 
         arg_names = self.list_arguments()
         aux_names = self.list_auxiliary_states()
-        known = {k: tuple(v) for k, v in kwargs.items() if v is not None}
+        known = {k: tuple(v) for k, v in (known_shapes or kwargs).items()
+                 if v is not None}
         # variables may declare __shape__ attrs
         for node in self._topo():
             if node.op is None and node.name not in known:

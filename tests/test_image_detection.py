@@ -164,3 +164,39 @@ def test_image_det_iter_contracts(tmp_path):
                        path_root=str(tmp_path))
     with pytest.raises(ValueError, match="invalid detection label"):
         next(iter(bad))
+
+
+def test_rand_gray_aug_applied():
+    """Regression (round-3 advisor): rand_gray was silently ignored."""
+    from mxtpu import nd
+    from mxtpu._image_impl import CreateAugmenter, RandomGrayAug
+
+    img = nd.array(np.random.RandomState(0).rand(8, 8, 3) * 255)
+    out = RandomGrayAug(1.0)(img).asnumpy()
+    np.testing.assert_allclose(out[..., 0], out[..., 1], rtol=1e-6)
+    np.testing.assert_allclose(out[..., 1], out[..., 2], rtol=1e-6)
+
+    augs = CreateAugmenter((3, 8, 8), rand_gray=0.5)
+    assert any(isinstance(a, RandomGrayAug) for a in augs)
+    det_augs = det.CreateDetAugmenter((3, 8, 8), rand_gray=0.5)
+    assert any(isinstance(getattr(a, "augmenter", None), RandomGrayAug)
+               for a in det_augs)
+
+
+def test_det_augmenter_mean_only_normalizes():
+    """Regression (round-3 advisor): mean-only (or std-only) must still
+    append ColorNormalizeAug, matching CreateAugmenter."""
+    from mxtpu._image_impl import ColorNormalizeAug
+
+    from mxtpu import nd
+
+    for kw in ({"mean": True}, {"std": True}):
+        augs = det.CreateDetAugmenter((3, 8, 8), **kw)
+        assert any(isinstance(getattr(a, "augmenter", None),
+                              ColorNormalizeAug) for a in augs), kw
+        # and the pipeline must actually run (std-only used to crash in
+        # color_normalize, which subtracted a None mean)
+        img = nd.array(np.random.RandomState(1).rand(8, 8, 3) * 255)
+        label = np.array([[0, 0.1, 0.1, 0.6, 0.6]], np.float32)
+        for a in augs:
+            img, label = a(img, label)

@@ -190,3 +190,188 @@ def test_resnet18_full_model_roundtrip(tmp_path):
                              if k in set(s2.list_auxiliary_states())})
     got = ex.forward()[0].asnumpy()
     np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-4)
+
+
+def _add_init(g, name, arr):
+    t = g.initializer.add()
+    t.name = name
+    t.dims.extend(arr.shape)
+    t.data_type = O.DTYPE_TO_ONNX[str(arr.dtype)]
+    t.raw_data = arr.tobytes()
+
+
+def test_gemm_shared_initializer_import(tmp_path):
+    """Regression (round-3 advisor): one initializer feeding two
+    transB=0 Gemm nodes must not be double-transposed in place."""
+    rng = np.random.RandomState(3)
+    W = rng.randn(8, 8).astype(np.float32)  # (in, out) — transB=0 layout
+    m = O.ModelProto()
+    m.ir_version = 8
+    m.opset_import.add().version = 13
+    g = m.graph
+    g.name = "shared_gemm"
+    vi = g.input.add()
+    vi.name = "x"
+    vi.type.tensor_type.elem_type = O.TensorProto.FLOAT
+    for d in (2, 8):
+        vi.type.tensor_type.shape.dim.add().dim_value = d
+    _add_init(g, "W", W)
+    n1 = g.node.add()
+    n1.op_type = "Gemm"
+    n1.input.extend(["x", "W"])
+    n1.output.append("h")
+    n2 = g.node.add()
+    n2.op_type = "Gemm"
+    n2.input.extend(["h", "W"])
+    n2.output.append("out")
+    g.output.add().name = "out"
+
+    path = str(tmp_path / "g.onnx")
+    with open(path, "wb") as f:
+        f.write(m.SerializeToString())
+    s, args, aux = onnx_mxtpu.import_model(path)
+    x = rng.rand(2, 8).astype(np.float32)
+    got = _bind_run(s, {**args, **aux}, x, data_name="x")
+    np.testing.assert_allclose(got, (x @ W) @ W, rtol=1e-5, atol=1e-5)
+
+
+def test_clip_opset11_optional_min_import(tmp_path):
+    """Regression (round-3 advisor): opset-11 Clip with only max given
+    (inputs ['x', '', 'max']) must default min to -inf, not raise."""
+    m = O.ModelProto()
+    m.ir_version = 8
+    m.opset_import.add().version = 13
+    g = m.graph
+    g.name = "clip_max_only"
+    vi = g.input.add()
+    vi.name = "x"
+    vi.type.tensor_type.elem_type = O.TensorProto.FLOAT
+    vi.type.tensor_type.shape.dim.add().dim_value = 5
+    _add_init(g, "mx_", np.asarray(1.0, np.float32))
+    n = g.node.add()
+    n.op_type = "Clip"
+    n.input.extend(["x", "", "mx_"])
+    n.output.append("out")
+    g.output.add().name = "out"
+
+    path = str(tmp_path / "c.onnx")
+    with open(path, "wb") as f:
+        f.write(m.SerializeToString())
+    s, args, aux = onnx_mxtpu.import_model(path)
+    x = np.array([-3.0, -1.0, 0.0, 0.5, 2.0], np.float32)
+    got = _bind_run(s, {**args, **aux}, x, data_name="x")
+    np.testing.assert_allclose(got, np.minimum(x, 1.0))
+
+
+def test_dot_3d_export_raises(tmp_path):
+    """Regression (round-3 advisor): MXNet dot on >2-D operands is not
+    MatMul — exporting it must fail loudly, not emit a wrong graph."""
+    a = sym.Variable("a")
+    w = sym.Variable("w")
+    out = sym.dot(a, w, name="d")  # (2,3,4) . (4,5): valid, but 3-D lhs
+    W = nd.array(np.zeros((4, 5), np.float32))
+    with pytest.raises(Exception, match="dot.*2-D|2-D.*dot"):
+        onnx_mxtpu.export_model(out, {"w": W}, [(2, 3, 4)], np.float32,
+                                str(tmp_path / "d.onnx"))
+
+
+def test_dot_transpose_export_roundtrip(tmp_path):
+    rng = np.random.RandomState(4)
+    a = sym.Variable("data")
+    w = sym.Variable("w")
+    out = sym.dot(a, w, transpose_b=True, name="dt")
+    W = rng.randn(5, 4).astype(np.float32)
+    data = rng.rand(3, 4).astype(np.float32)
+    path = str(tmp_path / "dt.onnx")
+    onnx_mxtpu.export_model(out, {"w": nd.array(W)}, [data.shape],
+                            np.float32, path)
+    s2, a2, x2 = onnx_mxtpu.import_model(path)
+    got = _bind_run(s2, {**a2, **x2}, data)
+    np.testing.assert_allclose(got, data @ W.T, rtol=1e-5, atol=1e-5)
+
+
+def test_gemm_alpha_beta_import(tmp_path):
+    """Gemm alpha/beta must be folded into the constants, not ignored."""
+    rng = np.random.RandomState(5)
+    W = rng.randn(4, 6).astype(np.float32)   # transB=1 layout (out, in)
+    C = rng.randn(4).astype(np.float32)
+    m = O.ModelProto()
+    m.ir_version = 8
+    m.opset_import.add().version = 13
+    g = m.graph
+    g.name = "gemm_ab"
+    vi = g.input.add()
+    vi.name = "x"
+    vi.type.tensor_type.elem_type = O.TensorProto.FLOAT
+    for d in (2, 6):
+        vi.type.tensor_type.shape.dim.add().dim_value = d
+    _add_init(g, "W", W)
+    _add_init(g, "C", C)
+    n = g.node.add()
+    n.op_type = "Gemm"
+    n.input.extend(["x", "W", "C"])
+    n.output.append("out")
+    for nm, v in (("alpha", 0.5), ("beta", 2.0), ("transB", 1)):
+        a = n.attribute.add()
+        a.name = nm
+        if nm == "transB":
+            a.type, a.i = O.AttributeProto.INT, int(v)
+        else:
+            a.type, a.f = O.AttributeProto.FLOAT, v
+    g.output.add().name = "out"
+
+    path = str(tmp_path / "ab.onnx")
+    with open(path, "wb") as f:
+        f.write(m.SerializeToString())
+    s, args, aux = onnx_mxtpu.import_model(path)
+    x = rng.rand(2, 6).astype(np.float32)
+    got = _bind_run(s, {**args, **aux}, x, data_name="x")
+    np.testing.assert_allclose(got, 0.5 * (x @ W.T) + 2.0 * C,
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_matmul_3d_import_batched_semantics(tmp_path):
+    """ONNX MatMul on rank-3 operands must import with batched (matmul)
+    semantics — NOT MXNet dot's last-axis x first-axis contraction."""
+    rng = np.random.RandomState(6)
+    A = rng.rand(2, 3, 4).astype(np.float32)
+    B = rng.rand(2, 4, 5).astype(np.float32)
+    m = O.ModelProto()
+    m.ir_version = 8
+    m.opset_import.add().version = 13
+    g = m.graph
+    g.name = "bmm"
+    vi = g.input.add()
+    vi.name = "x"
+    vi.type.tensor_type.elem_type = O.TensorProto.FLOAT
+    for d in A.shape:
+        vi.type.tensor_type.shape.dim.add().dim_value = d
+    _add_init(g, "B", B)
+    n = g.node.add()
+    n.op_type = "MatMul"
+    n.input.extend(["x", "B"])
+    n.output.append("out")
+    g.output.add().name = "out"
+
+    path = str(tmp_path / "bmm.onnx")
+    with open(path, "wb") as f:
+        f.write(m.SerializeToString())
+    s, args, aux = onnx_mxtpu.import_model(path)
+    got = _bind_run(s, {**args, **aux}, A, data_name="x")
+    np.testing.assert_allclose(got, A @ B, rtol=1e-5, atol=1e-5)
+
+
+def test_batch_dot_export_roundtrip(tmp_path):
+    rng = np.random.RandomState(7)
+    a = sym.Variable("data")
+    w = sym.Variable("w")
+    out = sym.batch_dot(a, w, transpose_b=True, name="bd")
+    W = rng.rand(2, 5, 4).astype(np.float32)
+    data = rng.rand(2, 3, 4).astype(np.float32)
+    path = str(tmp_path / "bd.onnx")
+    onnx_mxtpu.export_model(out, {"w": nd.array(W)}, [data.shape],
+                            np.float32, path)
+    s2, a2, x2 = onnx_mxtpu.import_model(path)
+    got = _bind_run(s2, {**a2, **x2}, data)
+    np.testing.assert_allclose(got, data @ W.transpose(0, 2, 1),
+                               rtol=1e-5, atol=1e-5)
