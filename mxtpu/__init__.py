@@ -57,6 +57,8 @@ _LAZY = {
     "numpy_extension": ".numpy_extension",
     "contrib": ".contrib",
     "preemption": ".preemption",
+    "operator": ".operator",
+    "horovod": ".horovod",
 }
 
 

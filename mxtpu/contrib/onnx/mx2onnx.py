@@ -323,10 +323,12 @@ def _dot(node, b, out):
                 (len(shp), node.inputs[i].name))
     a_name, b_name = _in(node, 0), _in(node, 1)
     kw = node.kwargs
-    if kw.get("transpose_a"):
+    # transpose on a 1-D operand is a no-op in MXNet dot (ops/tensor.py);
+    # emitting perm=[1,0] on a rank-1 tensor would be an invalid graph
+    if kw.get("transpose_a") and len(b.shape_of(node.inputs[0])) >= 2:
         a_name = b.node("Transpose", [a_name], [b.uniq(node.name + "_tA")],
                         perm=[1, 0])
-    if kw.get("transpose_b"):
+    if kw.get("transpose_b") and len(b.shape_of(node.inputs[1])) >= 2:
         b_name = b.node("Transpose", [b_name], [b.uniq(node.name + "_tB")],
                         perm=[1, 0])
     b.node("MatMul", [a_name, b_name], [out], name=node.name)

@@ -1,0 +1,119 @@
+"""Horovod-compatible facade (parity: the horovod.mxnet integration the
+reference documents — DistributedTrainer, init/rank/size/allreduce/
+broadcast_parameters; SURVEY §2.3 row 53 plans this as an alias onto the
+native distributed path).
+
+Horovod's value in the reference stack is an MPI/NCCL allreduce engine
+bolted beside kvstore; on TPU that engine IS the platform (XLA
+collectives over ICI/DCN through jax.distributed), so this module is a
+thin vocabulary adapter: Horovod names, native semantics.  Use
+``import mxtpu.horovod as hvd`` where reference code had
+``import horovod.mxnet as hvd``.
+"""
+
+from __future__ import annotations
+
+from . import parallel as _parallel
+from .gluon.trainer import Trainer as _Trainer
+
+__all__ = ["init", "shutdown", "rank", "local_rank", "size", "local_size",
+           "allreduce", "broadcast_parameters", "DistributedTrainer"]
+
+_initialized = False
+
+
+def init(*_args, **kwargs):
+    """hvd.init() → jax.distributed rendezvous (no-op single-process)."""
+    global _initialized
+    import jax
+
+    if not _initialized and jax.process_count() == 1:
+        # single process: nothing to rendezvous (matches hvd.init() with
+        # one worker).  Multi-process launches are expected to have called
+        # parallel.init_process_group via tools/launch.py already; calling
+        # it here too is harmless when coordinator env vars are present.
+        pass
+    _initialized = True
+
+
+def shutdown():
+    global _initialized
+    _initialized = False
+
+
+def rank():
+    return _parallel.rank()
+
+
+def local_rank():
+    # the native launch model (tools/launch.py / jax.distributed) runs ONE
+    # process per host, so the rank within a host is always 0 — matching
+    # Horovod's "if local_rank() == 0: per-host setup" idiom on every host
+    return 0
+
+
+def size():
+    return _parallel.num_workers()
+
+
+def local_size():
+    import jax
+
+    return jax.local_device_count()
+
+
+def allreduce(tensor, average=True, name=None):
+    """Cross-worker allreduce of one tensor (psum over processes)."""
+    from .ndarray import NDArray
+    from .parallel import collectives
+
+    is_nd = isinstance(tensor, NDArray)
+    out = collectives.all_reduce_across_processes(
+        tensor.data if is_nd else tensor)
+    if average:
+        out = out / size()
+    return NDArray(out) if is_nd else out
+
+
+def _broadcast_value(data, root_rank):
+    """root's value to every process: psum of the root-masked buffer.
+    Non-root contributions are fresh zeros, NOT data*0 — the whole point
+    is to discard possibly-garbage (NaN/Inf) non-root values, and
+    nan * 0 == nan would poison the sum."""
+    import jax.numpy as jnp
+
+    from .parallel import collectives
+
+    contribution = data if rank() == root_rank else jnp.zeros_like(data)
+    return collectives.all_reduce_across_processes(contribution)
+
+
+def broadcast_parameters(params, root_rank=0):
+    """Broadcast parameters from root_rank (parity:
+    hvd.broadcast_parameters)."""
+    if size() == 1:
+        return
+    items = params.items() if hasattr(params, "items") else enumerate(params)
+    for _, p in items:
+        if hasattr(p, "data"):
+            p.set_data(_broadcast_value(p.data().data, root_rank))
+        else:
+            p[:] = _broadcast_value(p.data, root_rank)
+
+
+class DistributedTrainer(_Trainer):
+    """hvd.DistributedTrainer → gluon.Trainer over the synchronous
+    cross-process kvstore.  Gradient averaging across workers happens in
+    the push/pull (psum / num_workers), matching Horovod's allreduce-mean
+    convention."""
+
+    def __init__(self, params, optimizer, optimizer_params=None,
+                 compression_params=None, **kwargs):
+        opt_params = dict(optimizer_params or {})
+        # Horovod convention: the LR is per-worker; the reference
+        # integration scales gradients by 1/size via allreduce-average,
+        # which dist_tpu_sync's psum-mean push already does.
+        kvstore = "dist_tpu_sync" if size() > 1 else "device"
+        super().__init__(params, optimizer, opt_params,
+                         kvstore=kvstore,
+                         compression_params=compression_params, **kwargs)

@@ -85,3 +85,27 @@ gather_nd = _np_op("gather_nd")
 scatter_nd = _np_op("scatter_nd")
 reshape_like = _np_op("reshape_like")
 arange_like = _np_op("arange_like")
+activation = _np_op("Activation")
+leaky_relu = _np_op("LeakyReLU")
+deconvolution = _np_op("Deconvolution")
+rnn = _np_op("RNN")
+instance_norm = _np_op("InstanceNorm")
+group_norm = _np_op("GroupNorm")
+smooth_l1 = _np_op("smooth_l1")
+slice_like = _np_op("slice_like")
+broadcast_like = _np_op("broadcast_like")
+sequence_last = _np_op("sequence_last")
+sequence_reverse = _np_op("sequence_reverse")
+cast = _np_op("Cast")
+erf = _np_op("erf")
+erfinv = _np_op("erfinv")
+stop_gradient = _np_op("stop_gradient")
+hard_sigmoid = _np_op("hard_sigmoid")
+softsign = _np_op("softsign")
+rms_norm = _np_op("rms_norm")
+rope = _np_op("rope")
+masked_softmax = _np_op("masked_softmax")
+roi_align = _np_op("ROIAlign")
+box_iou = _np_op("box_iou")
+box_nms = _np_op("box_nms")
+custom = _np_op("Custom")

@@ -1,0 +1,212 @@
+"""User-defined operators (parity: python/mxnet/operator.py — CustomOp,
+CustomOpProp, operator.register; C side src/operator/custom/custom.cc).
+
+TPU-native design.  The reference routes a Python CustomOp through the
+dependency engine as an FComputeEx that re-enters the interpreter; here
+the op body runs as a host callback (``jax.pure_callback``) wrapped in
+``jax.custom_vjp``, so one definition works identically
+
+  * imperatively (``mx.nd.Custom(x, op_type="sigmoid")``),
+  * under autograd (the tape differentiates through the custom_vjp),
+  * inside jit-compiled graphs: hybridized blocks and bound Symbols
+    (``mx.sym.Custom``) — XLA embeds the callback at trace time and
+    calls back into the host interpreter at run time.
+
+The jit story, explicitly: under ``jit``/``hybridize`` the forward and
+backward run on the HOST python interpreter via the XLA host-callback
+mechanism — the device pipeline stalls for their duration, exactly like
+the reference's GIL-bound CustomOp stalls its execution streams.  Use
+custom ops for glue, research ops, and debugging; move hot-path compute
+into registered jax ops or Pallas kernels.
+
+Limitations vs the reference: auxiliary states are not supported (raise
+at dispatch), and the op body must be pure (XLA may elide or replay
+callbacks whose outputs are unused/recomputed).
+"""
+
+from __future__ import annotations
+
+import numpy as onp
+
+from .base import MXTPUError, register_op
+
+__all__ = ["CustomOp", "CustomOpProp", "register", "get_prop_cls"]
+
+
+class CustomOp:
+    """Base class for custom operator implementations (parity:
+    mx.operator.CustomOp).  Subclass and implement ``forward`` /
+    ``backward``; write results with ``self.assign``."""
+
+    def forward(self, is_train, req, in_data, out_data, aux):
+        raise NotImplementedError
+
+    def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+        raise NotImplementedError
+
+    def assign(self, dst, req, src):
+        """Assign ``src`` into ``dst`` honouring the write request."""
+        if req == "null":
+            return
+        if req == "add":
+            dst += src
+        else:  # "write" / "inplace"
+            dst[:] = src
+
+
+class CustomOpProp:
+    """Operator properties: shapes, dtypes, names, operator factory
+    (parity: mx.operator.CustomOpProp)."""
+
+    def __init__(self, need_top_grad=True):
+        self.need_top_grad_ = need_top_grad
+
+    def list_arguments(self):
+        return ["data"]
+
+    def list_outputs(self):
+        return ["output"]
+
+    def list_auxiliary_states(self):
+        return []
+
+    def infer_shape(self, in_shape):
+        return in_shape, [in_shape[0]], []
+
+    def infer_type(self, in_type):
+        return in_type, [in_type[0]] * len(self.list_outputs()), []
+
+    def declare_backward_dependency(self, out_grad, in_data, out_data):
+        deps = []
+        if self.need_top_grad_:
+            deps.extend(out_grad)
+        deps.extend(in_data)
+        deps.extend(out_data)
+        return deps
+
+    def create_operator(self, ctx, in_shapes, in_dtypes):
+        return CustomOp()
+
+
+_CUSTOM_PROPS = {}
+
+
+def register(reg_name):
+    """Class decorator registering a CustomOpProp under ``op_type``
+    (parity: mx.operator.register)."""
+
+    def wrap(prop_cls):
+        if not issubclass(prop_cls, CustomOpProp):
+            raise MXTPUError(
+                "operator.register expects a CustomOpProp subclass, got %r"
+                % (prop_cls,))
+        _CUSTOM_PROPS[reg_name] = prop_cls
+        return prop_cls
+
+    return wrap
+
+
+def get_prop_cls(op_type):
+    try:
+        return _CUSTOM_PROPS[op_type]
+    except KeyError:
+        raise MXTPUError(
+            "custom op %r is not registered (use @mx.operator.register)"
+            % op_type) from None
+
+
+# ------------------------------------------------------------ dispatch
+
+def _dispatch_custom(arrays, op_type, params):
+    """Build and invoke the custom_vjp-wrapped host callback for one
+    Custom node.  ``arrays`` are jax arrays or tracers."""
+    import jax
+
+    from . import autograd
+    from . import ndarray as ndpkg
+
+    prop_cls = get_prop_cls(op_type)
+    # parity: the reference passes every kwarg to the Prop as a string
+    prop = prop_cls(**{k: str(v) for k, v in params.items()})
+    if prop.list_auxiliary_states():
+        raise MXTPUError(
+            "custom op %r: auxiliary states are not supported" % op_type)
+
+    n_args = len(prop.list_arguments())
+    if len(arrays) != n_args:
+        raise MXTPUError(
+            "custom op %r expects %d inputs (%s), got %d"
+            % (op_type, n_args, prop.list_arguments(), len(arrays)))
+
+    in_shapes = [list(a.shape) for a in arrays]
+    in_types = [onp.dtype(a.dtype) for a in arrays]
+    _, out_shapes, _ = prop.infer_shape([list(s) for s in in_shapes])
+    type_res = prop.infer_type(list(in_types))
+    out_types = [onp.dtype(t) for t in type_res[1]]
+    out_structs = tuple(
+        jax.ShapeDtypeStruct(tuple(s), t)
+        for s, t in zip(out_shapes, out_types))
+    in_structs = tuple(
+        jax.ShapeDtypeStruct(tuple(s), t)
+        for s, t in zip(in_shapes, in_types))
+    # static at trace time: hybridize/CachedOp re-trace per train mode,
+    # so capturing the flag here is correct under jit as well
+    is_train = bool(autograd.is_training() or autograd.is_recording())
+
+    def _make(xs):
+        op = prop.create_operator(None, in_shapes, in_types)
+        in_data = [ndpkg.array(onp.asarray(x)) for x in xs]
+        return op, in_data
+
+    def _fwd_host(*xs):
+        # the callback body executes while the caller's autograd tape may
+        # still be recording — the op body's NDArray math must not land on
+        # that tape (parity: the reference's CustomOp runs outside the
+        # recording scope too)
+        with autograd.pause():
+            op, in_data = _make(xs)
+            out_data = [ndpkg.NDArray(onp.zeros(st.shape, st.dtype))
+                        for st in out_structs]
+            op.forward(is_train, ["write"] * len(out_data), in_data,
+                       out_data, [])
+            return tuple(
+                onp.asarray(o.asnumpy(), st.dtype).reshape(st.shape)
+                for o, st in zip(out_data, out_structs))
+
+    def _bwd_host(xs, outs, cots):
+        with autograd.pause():
+            op, in_data = _make(xs)
+            out_data = [ndpkg.array(onp.asarray(o)) for o in outs]
+            out_grad = [ndpkg.array(onp.asarray(c)) for c in cots]
+            in_grad = [ndpkg.NDArray(onp.zeros(st.shape, st.dtype))
+                       for st in in_structs]
+            op.backward(["write"] * len(in_grad), out_grad, in_data,
+                        out_data, in_grad, [])
+            return tuple(
+                onp.asarray(g.asnumpy(), st.dtype).reshape(st.shape)
+                for g, st in zip(in_grad, in_structs))
+
+    n_in, n_out = len(in_structs), len(out_structs)
+
+    def _bwd_flat(*flat):
+        return _bwd_host(flat[:n_in], flat[n_in:n_in + n_out],
+                         flat[n_in + n_out:])
+
+    @jax.custom_vjp
+    def f(*xs):
+        return jax.pure_callback(_fwd_host, out_structs, *xs)
+
+    def f_fwd(*xs):
+        outs = jax.pure_callback(_fwd_host, out_structs, *xs)
+        return outs, (xs, outs)
+
+    def f_bwd(res, cots):
+        xs, outs = res
+        if not isinstance(cots, tuple):
+            cots = (cots,)
+        return tuple(jax.pure_callback(_bwd_flat, in_structs,
+                                       *xs, *outs, *cots))
+
+    f.defvjp(f_fwd, f_bwd)
+    outs = f(*arrays)
+    return outs[0] if len(outs) == 1 else outs

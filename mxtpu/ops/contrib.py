@@ -258,3 +258,109 @@ def ring_attention_op(q, k, v, causal=False, scale=None, _mesh=None,
         raise ValueError("ring_attention requires _mesh=DeviceMesh")
     return ring_self_attention(q, k, v, _mesh, causal=causal, scale=scale,
                                batch_axis=batch_axis, seq_axis=seq_axis)
+
+
+# ------------------------------------------------------------ bounding boxes
+# (parity: src/operator/contrib/bounding_box.cc — _contrib_box_iou /
+# _contrib_box_nms.  The reference implements greedy NMS as a CUDA kernel
+# over sorted candidates; here the candidate order and O(N^2) IoU matrix
+# are static-shaped so XLA can compile them, and the sequential greedy
+# suppression is a lax.fori_loop over the sorted list.)
+
+def _boxes_to_corner(b, fmt):
+    if fmt == "corner":
+        return b
+    if fmt == "center":  # (x, y, w, h) -> (xmin, ymin, xmax, ymax)
+        x, y, w, h = b[..., 0], b[..., 1], b[..., 2], b[..., 3]
+        return jnp.stack([x - w / 2, y - h / 2, x + w / 2, y + h / 2],
+                         axis=-1)
+    raise ValueError("box format must be 'corner' or 'center', got %r"
+                     % (fmt,))
+
+
+def _boxes_from_corner(b, fmt):
+    if fmt == "corner":
+        return b
+    x0, y0, x1, y1 = b[..., 0], b[..., 1], b[..., 2], b[..., 3]
+    return jnp.stack([(x0 + x1) / 2, (y0 + y1) / 2, x1 - x0, y1 - y0],
+                     axis=-1)
+
+
+def _pairwise_iou(a, b):
+    """a (N, 4), b (M, 4) corner boxes -> (N, M) IoU."""
+    tl = jnp.maximum(a[:, None, :2], b[None, :, :2])
+    br = jnp.minimum(a[:, None, 2:], b[None, :, 2:])
+    wh = jnp.maximum(br - tl, 0.0)
+    inter = wh[..., 0] * wh[..., 1]
+    area_a = jnp.maximum((a[:, 2] - a[:, 0]) * (a[:, 3] - a[:, 1]), 0.0)
+    area_b = jnp.maximum((b[:, 2] - b[:, 0]) * (b[:, 3] - b[:, 1]), 0.0)
+    union = area_a[:, None] + area_b[None, :] - inter
+    return jnp.where(union > 0, inter / union, 0.0)
+
+
+@register_op("box_iou", aliases=("_contrib_box_iou",),
+             differentiable=False)
+def box_iou(lhs, rhs, format="corner"):
+    """IoU between every box in lhs (..., 4) and every box in rhs
+    (..., 4); output shape lhs.shape[:-1] + rhs.shape[:-1] (parity:
+    _contrib_box_iou, bounding_box.cc)."""
+    l = _boxes_to_corner(lhs, format).reshape(-1, 4)
+    r = _boxes_to_corner(rhs, format).reshape(-1, 4)
+    out = _pairwise_iou(l, r)
+    return out.reshape(tuple(lhs.shape[:-1]) + tuple(rhs.shape[:-1]))
+
+
+@register_op("box_nms", aliases=("_contrib_box_nms",),
+             differentiable=False)
+def box_nms(data, overlap_thresh=0.5, valid_thresh=0.0, topk=-1,
+            coord_start=2, score_index=1, id_index=-1, background_id=-1,
+            force_suppress=False, in_format="corner",
+            out_format="corner"):
+    """Greedy non-maximum suppression (parity: _contrib_box_nms).
+
+    data: (..., N, K) rows [.., id?, score, x1, y1, x2, y2, ..]; output
+    has the same shape with rows sorted by score and suppressed/invalid
+    rows overwritten with -1.
+    """
+    shape = data.shape
+    N, K = shape[-2], shape[-1]
+    flat = data.reshape((-1, N, K))
+
+    def one(batch):
+        scores = batch[:, score_index]
+        boxes = _boxes_to_corner(
+            batch[:, coord_start:coord_start + 4], in_format)
+        valid = scores > valid_thresh
+        if id_index >= 0:
+            ids = batch[:, id_index]
+            if background_id >= 0:
+                valid = valid & (ids != background_id)
+        # sort by score desc, invalid entries last
+        order = jnp.argsort(jnp.where(valid, -scores, jnp.inf))
+        sbatch = batch[order]
+        svalid = valid[order]
+        if topk > 0:
+            svalid = svalid & (jnp.arange(N) < topk)
+        sboxes = boxes[order]
+        iou = _pairwise_iou(sboxes, sboxes)
+        sup = (iou > overlap_thresh) & jnp.triu(
+            jnp.ones((N, N), jnp.bool_), k=1)
+        if id_index >= 0 and not force_suppress:
+            sids = sbatch[:, id_index]
+            sup = sup & (sids[:, None] == sids[None, :])
+
+        def body(i, keep):
+            # row i suppresses lower-scored overlaps only if itself kept
+            return keep & ~(sup[i] & keep[i])
+
+        keep = jax.lax.fori_loop(0, N, body, svalid)
+        out = sbatch
+        if out_format != in_format:
+            coords = _boxes_from_corner(sboxes, out_format)
+            out = jnp.concatenate(
+                [out[:, :coord_start], coords,
+                 out[:, coord_start + 4:]], axis=1)
+        return jnp.where(keep[:, None], out,
+                         jnp.full_like(out, -1.0))
+
+    return jax.vmap(one)(flat).reshape(shape)

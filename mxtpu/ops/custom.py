@@ -1,0 +1,17 @@
+"""The ``Custom`` operator node (parity: src/operator/custom/custom.cc
+NNVM registration).  The user-facing CustomOp/CustomOpProp/register API
+lives in mxtpu/operator.py; this registry entry is what surfaces it as
+``mx.nd.Custom`` / ``mx.sym.Custom`` through the generated namespaces.
+"""
+
+from ..base import MXTPUError, register_op
+
+
+@register_op("Custom")
+def Custom(*arrays, op_type=None, **params):
+    """Invoke a user-registered custom operator (parity: nd.Custom)."""
+    if op_type is None:
+        raise MXTPUError("Custom requires op_type=")
+    from .. import operator as _op_mod
+
+    return _op_mod._dispatch_custom(arrays, op_type, params)

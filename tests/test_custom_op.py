@@ -1,0 +1,157 @@
+"""CustomOp user-extension API (parity: the reference's
+tests/python/unittest/test_operator.py test_custom_op — operator.py
+CustomOp/CustomOpProp/register over src/operator/custom/custom.cc).
+"""
+
+import numpy as np
+import pytest
+
+import mxtpu as mx
+from mxtpu import nd, autograd, gluon
+
+
+@mx.operator.register("test_sigmoid")
+class SigmoidProp(mx.operator.CustomOpProp):
+    def __init__(self):
+        super().__init__(need_top_grad=True)
+
+    def list_arguments(self):
+        return ["data"]
+
+    def list_outputs(self):
+        return ["output"]
+
+    def infer_shape(self, in_shape):
+        return in_shape, [in_shape[0]], []
+
+    def create_operator(self, ctx, shapes, dtypes):
+        return Sigmoid()
+
+
+class Sigmoid(mx.operator.CustomOp):
+    def forward(self, is_train, req, in_data, out_data, aux):
+        x = in_data[0].asnumpy()
+        y = 1.0 / (1.0 + np.exp(-x))
+        self.assign(out_data[0], req[0], mx.nd.array(y))
+
+    def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+        y = out_data[0].asnumpy()
+        dy = out_grad[0].asnumpy()
+        self.assign(in_grad[0], req[0], mx.nd.array(dy * y * (1.0 - y)))
+
+
+@mx.operator.register("test_scaled_add")
+class ScaledAddProp(mx.operator.CustomOpProp):
+    """Two inputs + a string-typed scalar kwarg (the reference passes all
+    custom-op kwargs as strings)."""
+
+    def __init__(self, scale="1.0"):
+        super().__init__(need_top_grad=True)
+        self.scale = float(scale)
+
+    def list_arguments(self):
+        return ["a", "b"]
+
+    def infer_shape(self, in_shape):
+        return in_shape, [in_shape[0]], []
+
+    def create_operator(self, ctx, shapes, dtypes):
+        return ScaledAdd(self.scale)
+
+
+class ScaledAdd(mx.operator.CustomOp):
+    def __init__(self, scale):
+        self.scale = scale
+
+    def forward(self, is_train, req, in_data, out_data, aux):
+        a, b = in_data[0].asnumpy(), in_data[1].asnumpy()
+        self.assign(out_data[0], req[0], mx.nd.array(a + self.scale * b))
+
+    def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+        dy = out_grad[0].asnumpy()
+        self.assign(in_grad[0], req[0], mx.nd.array(dy))
+        self.assign(in_grad[1], req[1], mx.nd.array(self.scale * dy))
+
+
+def test_custom_imperative_forward():
+    x = nd.array(np.array([[-1.0, 0.0, 2.0]], np.float32))
+    y = nd.Custom(x, op_type="test_sigmoid")
+    expect = 1.0 / (1.0 + np.exp(-x.asnumpy()))
+    np.testing.assert_allclose(y.asnumpy(), expect, rtol=1e-6)
+
+
+def test_custom_autograd_backward():
+    xn = np.array([[-1.5, 0.3, 0.9], [2.0, -0.2, 0.0]], np.float32)
+    x = nd.array(xn)
+    x.attach_grad()
+    with autograd.record():
+        y = nd.Custom(x, op_type="test_sigmoid")
+        loss = y.sum()
+    loss.backward()
+    s = 1.0 / (1.0 + np.exp(-xn))
+    np.testing.assert_allclose(x.grad.asnumpy(), s * (1 - s), rtol=1e-5,
+                               atol=1e-6)
+
+
+def test_custom_multi_input_kwargs_grad():
+    an = np.random.RandomState(0).rand(3, 4).astype(np.float32)
+    bn = np.random.RandomState(1).rand(3, 4).astype(np.float32)
+    a, b = nd.array(an), nd.array(bn)
+    a.attach_grad()
+    b.attach_grad()
+    with autograd.record():
+        y = nd.Custom(a, b, op_type="test_scaled_add", scale=2.5)
+        loss = (y * y).sum()
+    loss.backward()
+    np.testing.assert_allclose(y.asnumpy(), an + 2.5 * bn, rtol=1e-6)
+    dy = 2 * (an + 2.5 * bn)
+    np.testing.assert_allclose(a.grad.asnumpy(), dy, rtol=1e-5)
+    np.testing.assert_allclose(b.grad.asnumpy(), 2.5 * dy, rtol=1e-5)
+
+
+class _CustomBlock(gluon.HybridBlock):
+    def __init__(self, **kw):
+        super().__init__(**kw)
+        with self.name_scope():
+            self.dense = gluon.nn.Dense(8)
+
+    def hybrid_forward(self, F, x):
+        h = self.dense(x)
+        return F.Custom(h, op_type="test_sigmoid")
+
+
+def test_custom_inside_hybridize():
+    xn = np.random.RandomState(2).rand(4, 5).astype(np.float32)
+    net = _CustomBlock()
+    net.initialize()
+    ref = net(nd.array(xn)).asnumpy()
+    net.hybridize()
+    got = net(nd.array(xn)).asnumpy()  # traced: runs via host callback
+    np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-6)
+    got2 = net(nd.array(xn)).asnumpy()  # cached executable path
+    np.testing.assert_allclose(got2, ref, rtol=1e-5, atol=1e-6)
+
+    # gradients through the jitted graph
+    x = nd.array(xn)
+    x.attach_grad()
+    with autograd.record():
+        y = net(x)
+        y.sum().backward()
+    assert np.isfinite(x.grad.asnumpy()).all()
+    assert np.abs(x.grad.asnumpy()).sum() > 0
+
+
+def test_custom_symbol_bind():
+    import mxtpu.symbol as sym
+
+    x = sym.Variable("data")
+    out = sym.Custom(x, op_type="test_sigmoid", name="csig")
+    xn = np.array([[0.5, -0.5]], np.float32)
+    ex = out.bind(mx.cpu(), {"data": nd.array(xn)})
+    got = ex.forward()[0].asnumpy()
+    np.testing.assert_allclose(got, 1.0 / (1.0 + np.exp(-xn)), rtol=1e-6)
+
+
+def test_custom_unregistered_raises():
+    with pytest.raises(Exception, match="not registered"):
+        nd.Custom(nd.array([1.0]), op_type="no_such_op")

@@ -1,0 +1,45 @@
+"""Horovod-compatible facade (SURVEY §2.3 row 53: alias onto the native
+distributed path; parity target: horovod.mxnet's API surface)."""
+
+import numpy as np
+
+import mxtpu as mx
+import mxtpu.horovod as hvd
+from mxtpu import nd, autograd, gluon
+
+
+def test_hvd_single_process_topology():
+    hvd.init()
+    assert hvd.rank() == 0
+    assert hvd.size() == 1
+    assert hvd.local_rank() == 0
+    assert hvd.local_size() >= 1
+
+
+def test_hvd_allreduce_identity_single_process():
+    x = nd.array(np.arange(6, dtype="f").reshape(2, 3))
+    out = hvd.allreduce(x, average=True)
+    np.testing.assert_allclose(out.asnumpy(), x.asnumpy(), rtol=1e-6)
+    out2 = hvd.allreduce(x, average=False)
+    np.testing.assert_allclose(out2.asnumpy(), x.asnumpy(), rtol=1e-6)
+
+
+def test_hvd_distributed_trainer_trains():
+    hvd.init()
+    rng = np.random.RandomState(0)
+    X = nd.array(rng.rand(32, 4).astype("f"))
+    y = nd.array((rng.rand(32, 1) > 0.5).astype("f"))
+    net = gluon.nn.Dense(1)
+    net.initialize()
+    hvd.broadcast_parameters(net.collect_params())
+    trainer = hvd.DistributedTrainer(net.collect_params(), "sgd",
+                                     {"learning_rate": 0.5})
+    l2 = gluon.loss.L2Loss()
+    losses = []
+    for _ in range(25):
+        with autograd.record():
+            L = l2(net(X), y)
+        L.backward()
+        trainer.step(X.shape[0])
+        losses.append(float(L.mean().asnumpy()))
+    assert losses[-1] < losses[0]

@@ -51,6 +51,21 @@ def LTRI(n=3):
     return jnp.asarray(a + 3 * onp.eye(n, dtype="float32"))
 
 
+def _BOXES(n):
+    """Valid corner boxes (x1<x2, y1<y2) on a bf16-exact 1/32 grid."""
+    xy = R.randint(0, 8, (n, 2)).astype("float32") / 32.0
+    wh = R.randint(4, 12, (n, 2)).astype("float32") / 32.0
+    return jnp.asarray(onp.concatenate([xy, xy + wh], axis=1))
+
+
+def _NMS_DATA(n=6):
+    ids = R.randint(0, 2, (n, 1)).astype("float32")
+    scores = (R.permutation(n).reshape(n, 1).astype("float32") + 1) / n
+    return jnp.asarray(
+        onp.concatenate([ids, scores, onp.asarray(_BOXES(n))], axis=1)
+    )[None]  # (1, n, 6)
+
+
 class Case:
     def __init__(self, args, kwargs=None, grad=True, grad_args=None,
                  jit=True, bf16=True, rtol=1e-2, atol=1e-3):
@@ -226,6 +241,13 @@ CASES.update({
         {"k": 2}, grad=False),
     "shape_array": C(lambda: (A(3, 4),), grad=False),
     "size_array": C(lambda: (A(3, 4),), grad=False),
+    # -- bounding boxes --------------------------------------------------
+    "box_iou": C(lambda: (_BOXES(3), _BOXES(2)), grad=False),
+    # nms decisions are discontinuous in the overlap threshold: bf16
+    # rounding can legitimately flip a borderline suppression
+    "box_nms": C(lambda: (_NMS_DATA(),),
+                 {"overlap_thresh": 0.5, "id_index": 0, "score_index": 1,
+                  "coord_start": 2}, grad=False, bf16=False),
     # -- creation --------------------------------------------------------
     "zeros": C(lambda: (), {"shape": (2, 3)}, grad=False, bf16=False),
     "ones": C(lambda: (), {"shape": (2, 3)}, grad=False, bf16=False),
@@ -342,6 +364,8 @@ SKIP = {
                   "tests/test_control_flow.py",
     "cond": "takes branch callables; value+gradient covered by "
             "tests/test_control_flow.py",
+    "Custom": "user-extension dispatch op (callable registry, host "
+              "callback); covered by tests/test_custom_op.py",
 }
 
 
