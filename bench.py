@@ -220,9 +220,66 @@ def _bench_bert():
     print(json.dumps(rec), flush=True)
 
 
+def _bench_attention():
+    """Long-sequence attention fwd+bwd (round-3 verdict item 5: measure
+    the flash-attention backward instead of assuming it).  seq 512 and
+    2048, bf16, causal — the LM training configuration."""
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+    from mxtpu.ops.pallas.flash_attention import flash_attention
+
+    platform = jax.devices()[0].platform
+    if platform == "cpu":
+        sys.stderr.write("attention bench skipped on CPU (interpret-mode "
+                         "Pallas is a correctness tool, not a benchmark)\n")
+        return
+
+    B, H, D = 8, 16, 64
+    rng = np.random.RandomState(0)
+    results = {}
+    for T in (512, 2048):
+        q, k, v = (jnp.asarray(rng.randn(B, H, T, D), jnp.bfloat16)
+                   for _ in range(3))
+
+        def loss(q, k, v):
+            return flash_attention(q, k, v, causal=True).astype(
+                jnp.float32).sum()
+
+        step = jax.jit(jax.grad(loss, argnums=(0, 1, 2)))
+        jax.block_until_ready(step(q, k, v))  # compile
+        iters = 20
+        t0 = time.perf_counter()
+        out = None
+        for _ in range(iters):
+            out = step(q, k, v)
+        jax.block_until_ready(out)
+        dt = (time.perf_counter() - t0) / iters
+        # causal fwd+bwd matmul flops: (4 + 8) * B*H*T^2*D / 2
+        flops = 12 * B * H * T * T * D / 2
+        results[T] = {"step_ms": round(dt * 1e3, 3),
+                      "tflops": round(flops / dt / 1e12, 2)}
+    rec = {
+        "metric": "flash_attention_fwd_bwd_tflops_seq2048",
+        "value": results[2048]["tflops"],
+        "unit": "TFLOP/s",
+        "vs_baseline": None,
+        "platform": platform,
+        "config": {"batch": B, "heads": H, "head_dim": D,
+                   "dtype": "bfloat16", "causal": True,
+                   "backward": "pallas dq/dkv kernels"},
+        "seq_512": results[512],
+        "seq_2048": results[2048],
+        "baseline_note": "no upstream analogue (reference has no "
+                         "flash-attention); absolute TFLOP/s vs 197 peak",
+    }
+    print(json.dumps(rec), flush=True)
+
+
 def _child_main():
     _bench_resnet()
     _bench_bert()
+    _bench_attention()
 
 
 def _probe_main():

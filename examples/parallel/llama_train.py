@@ -1,0 +1,116 @@
+#!/usr/bin/env python
+"""End-to-end Llama training + generation across dp x tp x sp (stretch
+config 5; parity target: the reference's example/distributed_training
+recipes, redesigned as one compiled SPMD step over a named mesh).
+
+The model is the llama_3_8b ARCHITECTURE (GQA, rotary, SwiGLU, RMSNorm,
+head_dim 128) at a reduced width/depth so it runs anywhere; crank
+--width-factor/--depth-factor toward 1.0 on real pods.  Try it without
+hardware on a virtual mesh:
+
+  JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \\
+      python examples/parallel/llama_train.py --dp 2 --tp 2 --sp 2
+"""
+
+import argparse
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import mxtpu as mx
+from mxtpu import gluon, nd
+from mxtpu.models import transformer
+from mxtpu.parallel import make_mesh, PartitionSpec as P, SPMDTrainer
+
+VOCAB = 512  # synthetic-corpus vocab; real runs pass their tokenizer's
+
+
+class NextTokenLoss(gluon.loss.Loss):
+    """Shifted cross-entropy: predict token t+1 from prefix <= t."""
+
+    def __init__(self):
+        super().__init__(1.0, 0)
+        self._ce = gluon.loss.SoftmaxCrossEntropyLoss()
+
+    def hybrid_forward(self, F, logits, labels):
+        return self._ce(logits[:, :-1].reshape((-1, logits.shape[-1])),
+                        labels[:, 1:].reshape((-1,)))
+
+
+def synthetic_batches(batch, seq, steps, seed=0):
+    """A learnable synthetic language: arithmetic token sequences with
+    additive noise — losses drop fast if and only if the model trains."""
+    rng = np.random.RandomState(seed)
+    for _ in range(steps):
+        start = rng.randint(0, VOCAB, (batch, 1))
+        stride = rng.randint(1, 5, (batch, 1))
+        base = (start + stride * np.arange(seq)) % VOCAB
+        noise = (rng.rand(batch, seq) < 0.02) * rng.randint(0, VOCAB,
+                                                            (batch, seq))
+        yield nd.array((base + noise) % VOCAB, dtype="int32")
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--dp", type=int, default=2)
+    ap.add_argument("--tp", type=int, default=2)
+    ap.add_argument("--sp", type=int, default=2)
+    ap.add_argument("--batch-size", type=int, default=8)
+    ap.add_argument("--seq-len", type=int, default=32)
+    ap.add_argument("--steps", type=int, default=50)
+    ap.add_argument("--width-factor", type=float, default=0.125)
+    ap.add_argument("--depth-factor", type=float, default=0.0625)
+    ap.add_argument("--lr", type=float, default=3e-3)
+    ap.add_argument("--generate", type=int, default=8,
+                    help="tokens to decode after training (0 = skip)")
+    args = ap.parse_args(argv)
+
+    mesh = make_mesh(dp=args.dp, tp=args.tp, sp=args.sp)
+    print("mesh:", mesh)
+
+    lm = transformer.llama_3_8b(vocab_size=VOCAB, mesh=mesh,
+                                width_factor=args.width_factor,
+                                depth_factor=args.depth_factor)
+    lm.initialize()
+    rules = transformer.transformer_lm_sharding_rules()
+    trainer = SPMDTrainer(lm, NextTokenLoss(), "adam", mesh, rules,
+                          {"learning_rate": args.lr},
+                          batch_spec=P("dp", "sp"),
+                          label_spec=P("dp", "sp"))
+
+    losses = []
+    t0 = time.perf_counter()
+    for i, X in enumerate(synthetic_batches(args.batch_size, args.seq_len,
+                                            args.steps)):
+        loss = trainer.step(X, X)
+        losses.append(float(loss.asnumpy()))
+        if i == 0:
+            print("compiled + step 0 in %.1fs  loss=%.4f"
+                  % (time.perf_counter() - t0, losses[0]))
+        elif (i + 1) % 10 == 0:
+            print("step %3d  loss=%.4f" % (i + 1, losses[-1]))
+    print("loss %.4f -> %.4f over %d steps"
+          % (losses[0], losses[-1], len(losses)))
+
+    if args.generate:
+        # decode with the trained weights (KV-cache incremental path).
+        # Generation is latency-bound, not flop-bound: gather the sharded
+        # training weights into replicated host copies first (the standard
+        # sharded-train -> consolidated-inference handoff; eager decode
+        # over tp-sharded params would launch a collective per step).
+        for p in lm.collect_params().values():
+            p.set_data(nd.array(p.data().asnumpy()))
+        prompt = next(synthetic_batches(2, 8, 1, seed=7))
+        out = lm.generate(prompt, max_new_tokens=args.generate)
+        print("prompt :", prompt.asnumpy().tolist())
+        print("decoded:", out.asnumpy()[:, prompt.shape[1]:].tolist())
+
+    return losses
+
+
+if __name__ == "__main__":
+    main()

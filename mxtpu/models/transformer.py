@@ -117,6 +117,54 @@ class MultiHeadAttention(HybridBlock):
         out = out.transpose((0, 2, 1, 3)).reshape(B, T, H * D)
         return self.out_proj(out)
 
+    # -- KV-cache incremental decode -----------------------------------
+    def init_cache(self, batch_size, max_length, dtype="float32"):
+        """Static-size KV cache: (B, KV_heads, T_max, D) per tensor.  The
+        fixed shape is deliberate — every decode step reuses one compiled
+        program instead of recompiling per sequence length."""
+        KV, D = self._kv_heads, self._head_dim
+        shape = (batch_size, KV, max_length, D)
+        return (nd.zeros(shape, dtype=dtype), nd.zeros(shape, dtype=dtype))
+
+    def step(self, x, cache_k, cache_v, pos):
+        """One-token decode: x (B, 1, C) → (out (B, 1, C), new_k, new_v).
+
+        Attends the single query against the full static cache with a
+        position-validity mask, so kernels see fixed shapes at every step.
+        """
+        B = x.shape[0]
+        H, KV, D = self._heads, self._kv_heads, self._head_dim
+        Tmax = cache_k.shape[2]
+        qkv = self.qkv(x)  # (B, 1, (H+2KV)*D)
+        q = qkv[:, :, :H * D].reshape(B, 1, H, D).transpose((0, 2, 1, 3))
+        k = qkv[:, :, H * D:(H + KV) * D].reshape(
+            B, 1, KV, D).transpose((0, 2, 1, 3))
+        v = qkv[:, :, (H + KV) * D:].reshape(
+            B, 1, KV, D).transpose((0, 2, 1, 3))
+        if self._rotary:
+            q = nd.rope(q, offset=pos)
+            k = nd.rope(k, offset=pos)
+        cache_k[:, :, pos:pos + 1, :] = k  # slot-rebinding scatter
+        cache_v[:, :, pos:pos + 1, :] = v
+        # GQA without materializing repeated caches: fold the rep axis
+        # into the query rows and contract against the UNrepeated cache
+        # (decode is bandwidth-bound; nd.repeat would copy the whole
+        # cache 4x per token for the 32/8-head geometry).  q head
+        # h = kv*rep + r matches hybrid_forward's nd.repeat(axis=1)
+        # interleaving.
+        rep = H // KV
+        q_r = q.reshape(B * KV, rep, D)            # (B*KV, rep, D)
+        keys = cache_k.reshape(B * KV, Tmax, D)
+        values = cache_v.reshape(B * KV, Tmax, D)
+        scores = nd.batch_dot(q_r, keys,
+                              transpose_b=True) / math.sqrt(D)
+        valid = nd.arange(0, Tmax) <= pos  # causal+occupancy in one mask
+        attn = nd.masked_softmax(
+            scores, mask=valid.reshape((1, 1, Tmax)).astype("bool"))
+        out = nd.batch_dot(attn, values)           # (B*KV, rep, D)
+        out = out.reshape(B, 1, H * D)
+        return self.out_proj(out), cache_k, cache_v
+
 
 class TransformerEncoderLayer(HybridBlock):
     """Pre-LN encoder block (BERT uses post-LN originally; pre-LN is the
@@ -250,6 +298,16 @@ class LlamaDecoderLayer(HybridBlock):
         h = self.down_proj(F.swish(self.gate_proj(h)) * self.up_proj(h))
         return x + h
 
+    def step(self, x, cache_k, cache_v, pos):
+        """One-token decode through this layer (same math as
+        hybrid_forward with T=1 + cached attention)."""
+        h, cache_k, cache_v = self.attn.step(self.attn_norm(x),
+                                             cache_k, cache_v, pos)
+        x = x + h
+        h = self.ffn_norm(x)
+        h = self.down_proj(nd.swish(self.gate_proj(h)) * self.up_proj(h))
+        return x + h, cache_k, cache_v
+
 
 class TransformerLM(HybridBlock):
     """Causal decoder LM (Llama architecture; stretch config 5).
@@ -286,6 +344,76 @@ class TransformerLM(HybridBlock):
             return F.dot(x, w, transpose_b=True)
         return self.lm_head(x)
 
+    # -- incremental decode --------------------------------------------
+    def init_cache(self, batch_size, max_length, dtype="float32"):
+        """Per-layer (k, v) static-size caches."""
+        return [layer.attn.init_cache(batch_size, max_length, dtype)
+                for layer in self.layers]
+
+    def _logits(self, x):
+        x = self.norm(x)
+        if self._tie:
+            w = self.embed.weight.data(x.context)
+            return nd.dot(x, w, transpose_b=True)
+        return self.lm_head(x)
+
+    def step(self, token_ids, caches, pos):
+        """Decode ONE token per sequence: token_ids (B, 1) → logits
+        (B, 1, V); caches updated in place (slot rebinding)."""
+        x = self.embed(token_ids)
+        new_caches = []
+        for layer, (ck, cv) in zip(self.layers, caches):
+            x, ck, cv = layer.step(x, ck, cv, pos)
+            new_caches.append((ck, cv))
+        return self._logits(x), new_caches
+
+    def generate(self, prompt_ids, max_new_tokens, max_length=None,
+                 temperature=0.0, seed=None):
+        """Greedy (temperature=0) or sampled autoregressive decode with a
+        KV cache (parity target: gluonnlp SequenceSampler / the
+        reference's example inference loops — new capability here).
+
+        prompt_ids: (B, T_prompt) int NDArray.  Returns (B, T_prompt +
+        max_new_tokens) ids.  Every step runs fixed-shape kernels: the
+        prompt prefills the cache one position at a time with the same
+        compiled step the decode loop uses.
+
+        Decode expects REPLICATED parameters.  After sharded training,
+        gather first (``p.set_data(nd.array(p.data().asnumpy()))`` per
+        param — see examples/parallel/llama_train.py); eager decode over
+        mesh-sharded weights would launch a collective per token.
+        """
+        if seed is not None and temperature and temperature > 0.0:
+            # reproducible sampling; note this seeds the GLOBAL mxtpu
+            # key stream (mx.random.seed semantics)
+            from .. import random as _rnd
+            _rnd.seed(seed)
+
+        B, Tp = prompt_ids.shape
+        total = Tp + max_new_tokens
+        max_length = max_length or total
+        if max_length < total:
+            raise ValueError("max_length %d < prompt+new %d"
+                             % (max_length, total))
+        caches = self.init_cache(B, max_length)
+        tokens = [prompt_ids[:, i:i + 1] for i in range(Tp)]
+        logits = None
+        for pos in range(Tp):  # prefill (same compiled step as decode)
+            logits, caches = self.step(tokens[pos], caches, pos)
+        for pos in range(Tp, total):
+            if temperature and temperature > 0.0:
+                scaled = logits[:, -1] / temperature
+                nxt = nd.random.multinomial(
+                    nd.softmax(scaled, axis=-1)).reshape((B, 1))
+            else:
+                nxt = logits[:, -1].argmax(axis=-1).reshape(
+                    (B, 1))
+            nxt = nxt.astype(prompt_ids.dtype)
+            tokens.append(nxt)
+            if pos < total - 1:
+                logits, caches = self.step(nxt, caches, pos)
+        return nd.concat(*tokens, dim=1)
+
 
 def llama_tiny(vocab_size=256, mesh=None, **kwargs):
     """Tiny decoder for tests/dryruns."""
@@ -294,10 +422,22 @@ def llama_tiny(vocab_size=256, mesh=None, **kwargs):
                          mesh=mesh, **kwargs)
 
 
-def llama_3_8b(vocab_size=128256, mesh=None, **kwargs):
-    """Llama-3-8B geometry (stretch config 5)."""
-    return TransformerLM(vocab_size, units=4096, hidden_size=14336,
-                         num_layers=32, num_heads=32, num_kv_heads=8,
+def llama_3_8b(vocab_size=128256, mesh=None, width_factor=1.0,
+               depth_factor=1.0, **kwargs):
+    """Llama-3-8B geometry (stretch config 5).
+
+    width_factor/depth_factor scale the architecture down while keeping
+    its shape invariants (4:1 GQA ratio, SwiGLU hidden ratio, rotary,
+    head_dim 128) — the reduced-width configs train the REAL architecture
+    end-to-end on small meshes (examples/parallel/llama_train.py).
+    """
+    heads = max(4, int(32 * width_factor) // 4 * 4)
+    units = 128 * heads          # keep head_dim 128 — the MXU-native tile
+    hidden = int(14336 * width_factor) // 128 * 128 or 128
+    layers = max(1, int(32 * depth_factor))
+    return TransformerLM(vocab_size, units=units, hidden_size=hidden,
+                         num_layers=layers, num_heads=heads,
+                         num_kv_heads=max(1, heads // 4),
                          mesh=mesh, **kwargs)
 
 

@@ -47,6 +47,19 @@ def fully_connected(x, weight, bias=None, num_hidden=0, no_bias=False,
     return y
 
 
+def _pallas_conv_bwd_active(ndim, kernel, stride, dilate, pad, num_group,
+                            x, weight):
+    """Flag-gated fused Pallas conv backward (see pallas/conv_bwd.py);
+    OFF by default pending on-chip measurement."""
+    try:
+        from .pallas import conv_bwd
+    except Exception:  # pallas unavailable on this jax
+        return False
+    return conv_bwd.enabled() and conv_bwd.eligible(
+        ndim, kernel, stride, dilate, pad, num_group,
+        in_shape=tuple(x.shape), num_filter=int(weight.shape[0]))
+
+
 def _conv_dn(ndim, layout):
     if ndim == 1:
         return ("NCW", "OIW", "NCW")
@@ -74,16 +87,22 @@ def convolution(x, weight, bias=None, kernel=(), stride=(), dilate=(),
     from .tensor import matmul_precision
 
     if ndim == 2 and layout == "NCHW":
-        y = lax.conv_general_dilated(
-            jnp.transpose(x, (0, 2, 3, 1)),
-            jnp.transpose(weight, (2, 3, 1, 0)),  # OIHW -> HWIO
-            window_strides=stride,
-            padding=[(p, p) for p in pad],
-            rhs_dilation=dilate,
-            dimension_numbers=("NHWC", "HWIO", "NHWC"),
-            feature_group_count=num_group,
-            precision=matmul_precision(x, weight),
-        )
+        x_nhwc = jnp.transpose(x, (0, 2, 3, 1))
+        w_hwio = jnp.transpose(weight, (2, 3, 1, 0))  # OIHW -> HWIO
+        if _pallas_conv_bwd_active(ndim, kernel, stride, dilate, pad,
+                                   num_group, x, weight):
+            from .pallas import conv_bwd
+            y = conv_bwd.conv3x3_s1(x_nhwc, w_hwio)
+        else:
+            y = lax.conv_general_dilated(
+                x_nhwc, w_hwio,
+                window_strides=stride,
+                padding=[(p, p) for p in pad],
+                rhs_dilation=dilate,
+                dimension_numbers=("NHWC", "HWIO", "NHWC"),
+                feature_group_count=num_group,
+                precision=matmul_precision(x, weight),
+            )
         if bias is not None and not no_bias:
             y = y + bias
         return jnp.transpose(y, (0, 3, 1, 2))

@@ -1,0 +1,158 @@
+"""Fused Pallas backward for 3x3 stride-1 SAME convolutions (round-4;
+PERF.md round-3 analysis: XLA's conv weight-grad lowering is 43% of the
+ResNet-50 step and moves ~4x the minimal bytes on the small-channel
+stages where C < the 128-lane tile).
+
+One kernel computes BOTH gradients per image with each operand read from
+HBM exactly once:
+
+    dW[kh,kw]  = sum_n  Xp[n]_shift(kh,kw)^T @ dY[n]     (9 matmuls)
+    dXp[n]     = sum_kh,kw  dY[n] @ W[kh,kw]^T  scattered at (kh,kw)
+
+where Xp is the 1-padded input.  The grid walks images sequentially; dW
+accumulates in place across grid steps (constant output block index —
+the standard TPU sequential-reduction pattern), dX streams out per
+image.  Traffic is read(X) + read(dY) + write(dX) + write(dW) — the
+minimum for the fused pair — vs XLA's separate wgrad conv (re-reading X
+per filter tap) + igrad conv (re-reading dY).
+
+Gated OFF by default (MXTPU_PALLAS_CONV_BWD=1 to enable) until the
+on-chip measurement lands; eligibility: 2-D, kernel 3x3, stride 1,
+dilation 1, pad 1, groups 1.  Everything else falls back to XLA
+autodiff.  CPU runs use interpret mode (tests).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+
+from ...base import env_bool
+
+__all__ = ["conv3x3_s1", "eligible", "enabled"]
+
+
+def enabled():
+    return env_bool("MXTPU_PALLAS_CONV_BWD", False)
+
+
+_VMEM_BUDGET = 12 * (1 << 20)  # leave headroom under the ~16 MiB VMEM
+
+
+def fits(H, W, Ci, Co):
+    """Per-grid-step VMEM footprint bound: the kernel holds the padded
+    image + the dxp accumulator (both fp32), dy (fp32), the 9 weight
+    taps, and dW — all at once.  Convs larger than this (e.g. a
+    224x224x64 stage) stay on the XLA path."""
+    f32 = 4
+    xp = (H + 2) * (W + 2) * Ci * f32
+    dxp = xp
+    dy = H * W * Co * f32
+    dw = 2 * 9 * Ci * Co * f32  # local + accumulator blocks
+    return xp + dxp + dy + dw <= _VMEM_BUDGET
+
+
+def eligible(ndim, kernel, stride, dilate, pad, num_group,
+             in_shape=None, num_filter=None):
+    """in_shape: optional NCHW input shape for the VMEM footprint check
+    (callers without shape info get the geometry gate only)."""
+    ok = (ndim == 2 and tuple(kernel) == (3, 3)
+          and tuple(stride) == (1, 1) and tuple(dilate) == (1, 1)
+          and tuple(pad) == (1, 1) and num_group == 1)
+    if ok and in_shape is not None:
+        N, Ci, H, W = in_shape
+        ok = fits(H, W, Ci, num_filter or Ci)
+    return ok
+
+
+def _bwd_kernel(xp_ref, dy_ref, w_ref, dw_ref, dxp_ref, *, H, W, hi_prec):
+    prec = jax.lax.Precision.HIGHEST if hi_prec else None
+    n = pl.program_id(0)
+    xp = xp_ref[0].astype(jnp.float32)            # (H+2, W+2, Ci)
+    dy = dy_ref[0].astype(jnp.float32)            # (H, W, Co)
+    w = w_ref[...].astype(jnp.float32)            # (3, 3, Ci, Co)
+    Ci = xp.shape[-1]
+    Co = dy.shape[-1]
+    dy_flat = dy.reshape(H * W, Co)
+
+    @pl.when(n == 0)
+    def _init():
+        dw_ref[...] = jnp.zeros(dw_ref.shape, dw_ref.dtype)
+
+    dxp = jnp.zeros((H + 2, W + 2, Ci), jnp.float32)
+    dw_local = []
+    for kh in range(3):
+        row = []
+        for kw in range(3):
+            x_sub = xp[kh:kh + H, kw:kw + W, :].reshape(H * W, Ci)
+            row.append(jnp.dot(x_sub.T, dy_flat,
+                               preferred_element_type=jnp.float32,
+                               precision=prec))          # (Ci, Co)
+            term = jnp.dot(dy_flat, w[kh, kw].T,
+                           preferred_element_type=jnp.float32,
+                           precision=prec).reshape(H, W, Ci)
+            dxp = dxp.at[kh:kh + H, kw:kw + W, :].add(term)
+        dw_local.append(jnp.stack(row))
+    dw_ref[...] += jnp.stack(dw_local).astype(dw_ref.dtype)  # (3,3,Ci,Co)
+    dxp_ref[0] = dxp.astype(dxp_ref.dtype)
+
+
+def _pallas_bwd(x, w, dy, interpret):
+    """x (N,H,W,Ci), w (3,3,Ci,Co) HWIO, dy (N,H,W,Co) -> (dx, dw)."""
+    N, H, W, Ci = x.shape
+    Co = w.shape[-1]
+    xp = jnp.pad(x, ((0, 0), (1, 1), (1, 1), (0, 0)))
+    kern = functools.partial(_bwd_kernel, H=H, W=W,
+                             hi_prec=x.dtype == jnp.float32)
+    dw, dxp = pl.pallas_call(
+        kern,
+        out_shape=[jax.ShapeDtypeStruct((3, 3, Ci, Co), jnp.float32),
+                   jax.ShapeDtypeStruct((N, H + 2, W + 2, Ci), x.dtype)],
+        grid=(N,),
+        in_specs=[
+            pl.BlockSpec((1, H + 2, W + 2, Ci), lambda n: (n, 0, 0, 0)),
+            pl.BlockSpec((1, H, W, Co), lambda n: (n, 0, 0, 0)),
+            pl.BlockSpec((3, 3, Ci, Co), lambda n: (0, 0, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((3, 3, Ci, Co), lambda n: (0, 0, 0, 0)),
+            pl.BlockSpec((1, H + 2, W + 2, Ci), lambda n: (n, 0, 0, 0)),
+        ],
+        interpret=interpret,
+    )(xp, dy, w)
+    return dxp[:, 1:H + 1, 1:W + 1, :], dw.astype(w.dtype)
+
+
+@functools.lru_cache(maxsize=8)
+def _make_conv3x3(interpret, hi_prec):
+    prec = jax.lax.Precision.HIGHEST if hi_prec else None
+
+    def fwd_conv(x, w):
+        return lax.conv_general_dilated(
+            x, w, window_strides=(1, 1), padding=[(1, 1), (1, 1)],
+            dimension_numbers=("NHWC", "HWIO", "NHWC"), precision=prec)
+
+    @jax.custom_vjp
+    def conv(x, w):
+        return fwd_conv(x, w)
+
+    def conv_fwd(x, w):
+        return fwd_conv(x, w), (x, w)
+
+    def conv_bwd(res, dy):
+        x, w = res
+        return _pallas_bwd(x, w, dy, interpret)
+
+    conv.defvjp(conv_fwd, conv_bwd)
+    return conv
+
+
+def conv3x3_s1(x, w):
+    """NHWC 3x3 stride-1 SAME conv whose backward is the fused Pallas
+    dW+dX kernel.  x (N,H,W,Ci), w (3,3,Ci,Co)."""
+    interpret = jax.default_backend() == "cpu"
+    return _make_conv3x3(interpret, x.dtype == jnp.float32)(x, w)

@@ -243,3 +243,45 @@ class SPMDTrainer:
 
     def set_learning_rate(self, lr):
         self._optimizer.lr = lr
+
+    # -- checkpoint/resume (parity: gluon.Trainer.save_states /
+    # load_states; required by the preemption-restart story, SURVEY §5) --
+    def save_states(self, fname):
+        """Serialize optimizer state + step count to fname.  State leaves
+        are gathered to host numpy — the file is mesh-layout independent,
+        so a restart may use a different device topology."""
+        import pickle
+
+        import numpy as onp
+
+        states = jax.tree_util.tree_map(lambda a: onp.asarray(a),
+                                        tuple(self._opt_states))
+        with open(fname, "wb") as f:
+            pickle.dump({"num_update": self._num_update,
+                         "opt_states": states}, f)
+
+    def load_states(self, fname):
+        """Restore optimizer state saved by save_states.  Must be called
+        after the first step (or after parameters are staged) so the
+        sharding layout to re-place the state onto is known."""
+        import pickle
+
+        with open(fname, "rb") as f:
+            blob = pickle.load(f)
+        if not self._params_sharded:
+            raise ValueError(
+                "load_states: run one step first (or stage parameters) so "
+                "optimizer state shardings exist to place the load onto")
+        if len(blob["opt_states"]) != len(self._opt_states):
+            raise ValueError(
+                "load_states: checkpoint has %d optimizer-state entries "
+                "but this trainer has %d parameters — architecture "
+                "mismatch or truncated file"
+                % (len(blob["opt_states"]), len(self._opt_states)))
+        self._num_update = int(blob["num_update"])
+        restored = []
+        for cur, saved in zip(self._opt_states, blob["opt_states"]):
+            restored.append(jax.tree_util.tree_map(
+                lambda c, s: jax.device_put(jnp.asarray(s), c.sharding),
+                cur, saved))
+        self._opt_states = restored

@@ -30,6 +30,9 @@ def main(out_dir):
     r, n = rank(), num_workers()
     assert n == nproc, (n, nproc)
 
+    if os.environ.get("MXTPU_DW_MODE") == "preempt":
+        return preempt_main(out_dir, r, n)
+
     result = {"rank": r, "num_workers": n}
 
     # --- kvstore push/pull across processes --------------------------------
@@ -62,9 +65,132 @@ def main(out_dir):
     # second step proves params stayed consistent across the process group
     result["loss2"] = float(trainer.step(X, y).asnumpy())
 
+    # --- tensor parallelism ACROSS the process boundary --------------------
+    # (round-3 verdict item 3: every process holds 1 local device, so a
+    # tp=2 axis necessarily spans two processes; XLA moves the activations
+    # over the cross-process transport)
+    if n == 1 or n % 2 == 0:
+        from mxtpu.parallel import ShardingRules, PartitionSpec as P
+
+        mx.random.seed(13)
+        net_tp = nn.HybridSequential()
+        net_tp.add(nn.Dense(32, activation="relu", in_units=6),
+                   nn.Dense(3, in_units=32))
+        net_tp.initialize()
+        rules = ShardingRules([
+            (r"dense0_weight$", P("tp", None)),
+            (r"dense0_bias$", P("tp")),
+            (r"dense1_weight$", P(None, "tp")),
+        ])
+        # n=1 runs the same model on the degenerate mesh => the reference
+        # loss the multi-process tp runs must reproduce
+        mesh_tp = make_mesh(dp=max(1, n // 2), tp=2 if n > 1 else 1)
+        tr_tp = SPMDTrainer(net_tp, gluon.loss.SoftmaxCrossEntropyLoss(),
+                            "sgd", mesh_tp, rules,
+                            optimizer_params={"learning_rate": 0.1})
+        result["tp_loss"] = float(tr_tp.step(X, y).asnumpy())
+        result["tp_loss2"] = float(tr_tp.step(X, y).asnumpy())
+
     with open(os.path.join(out_dir, "rank%d.json" % r), "w") as f:
         json.dump(result, f)
     print("worker rank %d/%d OK loss=%.6f" % (r, n, result["loss"]))
+
+
+def _preempt_net_and_data(mx, nn, np):
+    mx.random.seed(23)
+    net = nn.HybridSequential()
+    net.add(nn.Dense(16, activation="relu", in_units=6),
+            nn.Dense(3, in_units=16))
+    net.initialize()
+
+    def batch(step):
+        rng = np.random.RandomState(1000 + step)  # step-indexed: resumable
+        return (mx.nd.array(rng.rand(8, 6).astype("float32")),
+                mx.nd.array(rng.randint(0, 3, (8,))))
+
+    return net, batch
+
+
+def preempt_main(out_dir, r, n):
+    """Preemption-restart protocol (round-3 verdict item 3):
+
+    fresh run: train TOTAL_STEPS, but rank 1 receives SIGTERM mid-run;
+    its handler drops a cluster-visible flag file; EVERY rank checks the
+    flag at the step boundary (synchronous training: the barrier is the
+    step), checkpoints, and exits cleanly.  resume run: restore net +
+    trainer state and finish the remaining steps.  The parent test
+    asserts loss parity with an uninterrupted run.
+    """
+    import numpy as np
+    import jax.numpy as jnp
+
+    import mxtpu as mx
+    from mxtpu import gluon, preemption
+    from mxtpu.gluon import nn
+    from mxtpu.parallel import make_mesh, SPMDTrainer
+    from mxtpu.parallel import collectives
+
+    total_steps = int(os.environ["MXTPU_DW_TOTAL_STEPS"])
+    resume = bool(os.environ.get("MXTPU_DW_RESUME"))
+    ready = os.path.join(out_dir, "rank%d.ready" % r)
+
+    net, batch = _preempt_net_and_data(mx, nn, np)
+    trainer = SPMDTrainer(net, gluon.loss.SoftmaxCrossEntropyLoss(), "sgd",
+                          make_mesh(dp=n),
+                          optimizer_params={"learning_rate": 0.1,
+                                            "momentum": 0.9})
+
+    preemption.reset()
+    preemption.install(lambda: None)  # flag only; save happens at barrier
+
+    start = 0
+    if resume:
+        X0, y0 = batch(0)
+        trainer.step(X0, y0)  # build layouts (values replaced below)
+        net.load_parameters(os.path.join(out_dir, "ckpt.params"))
+        trainer._stage_params()  # re-place loaded params on the mesh
+        trainer.load_states(os.path.join(out_dir, "ckpt.states"))
+        start = int(open(os.path.join(out_dir, "ckpt.step")).read())
+
+    import time
+
+    # pacing for the interrupted run: gives the parent's SIGTERM a step
+    # boundary to land on (0 for reference/resume runs)
+    step_sleep = float(os.environ.get("MXTPU_DW_STEP_SLEEP", "0"))
+
+    losses = {}
+    stopped_at = None
+    for step in range(start, total_steps):
+        X, y = batch(step)
+        loss = trainer.step(X, y)
+        losses[step] = float(loss.asnumpy())
+        if step == start:
+            open(ready, "w").write(str(os.getpid()))  # parent may SIGTERM
+        if step_sleep:
+            time.sleep(step_sleep)
+        # cluster-consistent stop decision: the SIGTERM lands on ONE rank;
+        # a per-step flag allreduce makes every rank agree on the same
+        # stopping step (the barrier is the step in synchronous training)
+        local = 1.0 if preemption.preempted() else 0.0
+        stop = float(jnp.asarray(collectives.all_reduce_across_processes(
+            jnp.asarray([local])))[0]) > 0
+        if stop and step + 1 < total_steps:
+            if r == 0:
+                net.save_parameters(os.path.join(out_dir, "ckpt.params"))
+                trainer.save_states(os.path.join(out_dir, "ckpt.states"))
+                with open(os.path.join(out_dir, "ckpt.step"), "w") as f:
+                    f.write(str(step + 1))
+            stopped_at = step + 1
+            break
+
+    out = {"rank": r, "start": start, "stopped_at": stopped_at,
+           "losses": losses, "preempted": preemption.preempted()}
+    suffix = "resume" if resume else "fresh"
+    with open(os.path.join(out_dir, "rank%d.%s.json" % (r, suffix)),
+              "w") as f:
+        json.dump(out, f)
+    print("preempt worker rank %d/%d %s: start=%d stopped_at=%s"
+          % (r, n, suffix, start, stopped_at))
 
 
 if __name__ == "__main__":

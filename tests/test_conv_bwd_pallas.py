@@ -1,0 +1,104 @@
+"""Fused Pallas conv backward (3x3 s1 SAME): dW+dX vs XLA autodiff
+(round-3 verdict item 2; interpret mode on CPU)."""
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+from mxtpu.ops.pallas import conv_bwd
+
+
+def _xla_conv(x, w):
+    return jax.lax.conv_general_dilated(
+        x, w, (1, 1), [(1, 1), (1, 1)],
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        precision=jax.lax.Precision.HIGHEST)
+
+
+@pytest.mark.parametrize("shape", [
+    (2, 8, 8, 16, 32),    # small
+    (1, 14, 14, 32, 32),  # resnet-ish stage, square channels
+    (2, 7, 9, 8, 24),     # non-square spatial, Ci != Co
+])
+def test_fused_bwd_matches_xla_fp32(shape):
+    N, H, W, Ci, Co = shape
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.randn(N, H, W, Ci).astype("f"))
+    w = jnp.asarray(rng.randn(3, 3, Ci, Co).astype("f") * 0.1)
+    ct = jnp.asarray(rng.randn(N, H, W, Co).astype("f"))
+
+    out_p = conv_bwd.conv3x3_s1(x, w)
+    out_x = _xla_conv(x, w)
+    np.testing.assert_allclose(np.asarray(out_p), np.asarray(out_x),
+                               rtol=1e-5, atol=1e-5)
+
+    gp = jax.grad(lambda a, b: (conv_bwd.conv3x3_s1(a, b) * ct).sum(),
+                  argnums=(0, 1))(x, w)
+    gx = jax.grad(lambda a, b: (_xla_conv(a, b) * ct).sum(),
+                  argnums=(0, 1))(x, w)
+    np.testing.assert_allclose(np.asarray(gp[0]), np.asarray(gx[0]),
+                               rtol=1e-4, atol=1e-4, err_msg="dx")
+    np.testing.assert_allclose(np.asarray(gp[1]), np.asarray(gx[1]),
+                               rtol=1e-4, atol=1e-4, err_msg="dw")
+
+
+def test_fused_bwd_bf16():
+    rng = np.random.RandomState(1)
+    x32 = rng.randn(2, 8, 8, 16).astype("f")
+    w32 = (rng.randn(3, 3, 16, 16) * 0.1).astype("f")
+    x = jnp.asarray(x32, jnp.bfloat16)
+    w = jnp.asarray(w32, jnp.bfloat16)
+
+    gp = jax.grad(lambda a, b: conv_bwd.conv3x3_s1(a, b).astype(
+        jnp.float32).sum(), argnums=(0, 1))(x, w)
+    gx = jax.grad(lambda a, b: _xla_conv(a, b).sum(),
+                  argnums=(0, 1))(jnp.asarray(x32), jnp.asarray(w32))
+    for p, r, name in zip(gp, gx, ("dx", "dw")):
+        np.testing.assert_allclose(np.asarray(p, dtype="float32"),
+                                   np.asarray(r), rtol=1e-1, atol=0.5,
+                                   err_msg=name)
+
+
+def test_eligibility_gate():
+    assert conv_bwd.eligible(2, (3, 3), (1, 1), (1, 1), (1, 1), 1)
+    assert not conv_bwd.eligible(2, (3, 3), (2, 2), (1, 1), (1, 1), 1)
+    assert not conv_bwd.eligible(2, (7, 7), (1, 1), (1, 1), (3, 3), 1)
+    assert not conv_bwd.eligible(2, (3, 3), (1, 1), (1, 1), (1, 1), 2)
+    assert not conv_bwd.eligible(1, (3,), (1,), (1,), (1,), 1)
+    # VMEM footprint bound: a 224x224 stage exceeds the budget and must
+    # stay on the XLA path; the ResNet 56x56x64 stage fits
+    good = (2, (3, 3), (1, 1), (1, 1), (1, 1), 1)
+    assert conv_bwd.eligible(*good, in_shape=(8, 64, 56, 56),
+                             num_filter=64)
+    assert not conv_bwd.eligible(*good, in_shape=(8, 64, 224, 224),
+                                 num_filter=64)
+
+
+def test_convolution_op_flag_gated(monkeypatch):
+    """MXTPU_PALLAS_CONV_BWD=1 routes the NCHW Convolution op through the
+    fused backward; values and gradients must match the default path."""
+    import mxtpu as mx
+    from mxtpu import nd, autograd
+
+    rng = np.random.RandomState(2)
+    xn = rng.randn(2, 8, 6, 6).astype("f")
+    wn = (rng.randn(12, 8, 3, 3) * 0.1).astype("f")
+
+    def run():
+        x = nd.array(xn)
+        w = nd.array(wn)
+        x.attach_grad()
+        w.attach_grad()
+        with autograd.record():
+            y = nd.Convolution(x, w, kernel=(3, 3), num_filter=12,
+                               pad=(1, 1), no_bias=True)
+            y.sum().backward()
+        return y.asnumpy(), x.grad.asnumpy(), w.grad.asnumpy()
+
+    y0, dx0, dw0 = run()
+    monkeypatch.setenv("MXTPU_PALLAS_CONV_BWD", "1")
+    y1, dx1, dw1 = run()
+    np.testing.assert_allclose(y1, y0, rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(dx1, dx0, rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(dw1, dw0, rtol=1e-4, atol=1e-4)

@@ -61,3 +61,106 @@ def test_dist_sync_two_process_matches_single(tmp_path):
     # 2-process dp=2 == single-process on the same global batch
     assert two[0]["loss"] == pytest.approx(one[0]["loss"], rel=1e-6)
     assert two[0]["loss2"] == pytest.approx(one[0]["loss2"], rel=1e-5)
+    # tp=2 spanned the 2-process boundary (1 local device per process)
+    assert "tp_loss" in two[0]
+    assert two[0]["tp_loss"] == pytest.approx(two[1]["tp_loss"], abs=0)
+
+
+def test_dist_sync_four_process_tp_across_boundary(tmp_path):
+    """n=4, mesh dp=2 x tp=2, one device per process: the tp axis spans a
+    process boundary and kvstore/dp semantics hold at n=4 (round-3
+    verdict item 3)."""
+    four = _run(4, str(tmp_path / "n4"), port=_free_port())
+    one = _run(1, str(tmp_path / "n1"), port=_free_port())
+
+    for r in range(4):
+        assert four[r]["kv_pull_ok"]
+        assert four[r]["num_workers"] == 4
+        assert four[r]["loss"] == pytest.approx(four[0]["loss"], abs=0)
+        assert four[r]["tp_loss"] == pytest.approx(four[0]["tp_loss"],
+                                                   abs=0)
+    # dp=4 over the same global batch == single-process result
+    assert four[0]["loss"] == pytest.approx(one[0]["loss"], rel=1e-6)
+    # the tp-sharded model, dp=2 x tp=2 across processes, matches the
+    # same model computed single-process (dp=1 x tp=1 degenerate mesh)
+    assert four[0]["tp_loss"] == pytest.approx(one[0]["tp_loss"],
+                                               rel=1e-6)
+    assert four[0]["tp_loss2"] == pytest.approx(one[0]["tp_loss2"],
+                                                rel=1e-5)
+
+
+def _run_preempt(nproc, out_dir, port, total_steps, resume=False,
+                 sigterm_rank=None):
+    import signal
+    import time
+
+    env = dict(os.environ)
+    env["PALLAS_AXON_POOL_IPS"] = ""
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+    env["JAX_NUM_CPU_DEVICES"] = "1"
+    env["MXTPU_DW_MODE"] = "preempt"
+    env["MXTPU_DW_TOTAL_STEPS"] = str(total_steps)
+    if sigterm_rank is not None:
+        # pace steps so the SIGTERM lands mid-schedule, not after the end
+        env["MXTPU_DW_STEP_SLEEP"] = "0.5"
+    if resume:
+        env["MXTPU_DW_RESUME"] = "1"
+    os.makedirs(out_dir, exist_ok=True)
+    cmd = [sys.executable, LAUNCH, "-n", str(nproc), "--launcher", "local",
+           "--port", str(port), sys.executable, WORKER, out_dir]
+    proc = subprocess.Popen(cmd, cwd=REPO, env=env,
+                            stdout=subprocess.PIPE,
+                            stderr=subprocess.STDOUT, text=True)
+    try:
+        if sigterm_rank is not None:
+            ready = os.path.join(out_dir, "rank%d.ready" % sigterm_rank)
+            deadline = time.time() + 300
+            while not os.path.exists(ready):
+                assert time.time() < deadline, "workers never became ready"
+                assert proc.poll() is None, proc.communicate()[0][-3000:]
+                time.sleep(0.2)
+            os.kill(int(open(ready).read()), signal.SIGTERM)
+        out, _ = proc.communicate(timeout=420)
+    except Exception:
+        proc.kill()
+        raise
+    assert proc.returncode == 0, out[-3000:]
+    suffix = "resume" if resume else "fresh"
+    results = {}
+    for r in range(nproc):
+        with open(os.path.join(out_dir,
+                               "rank%d.%s.json" % (r, suffix))) as f:
+            results[r] = json.load(f)
+    return results
+
+
+def test_preempt_sigterm_checkpoint_resume_loss_parity(tmp_path):
+    """SIGTERM one worker mid-run; all ranks checkpoint at the step
+    barrier and exit; a resumed launch finishes the schedule; the stitched
+    loss history equals an uninterrupted run's (round-3 verdict item 3)."""
+    steps = 8
+    # uninterrupted reference
+    ref_dir = str(tmp_path / "ref")
+    ref = _run_preempt(2, ref_dir, _free_port(), steps)
+    assert ref[0]["stopped_at"] is None
+    assert sorted(map(int, ref[0]["losses"])) == list(range(steps))
+
+    # interrupted: SIGTERM rank 1 once it reports ready
+    run_dir = str(tmp_path / "preempted")
+    fresh = _run_preempt(2, run_dir, _free_port(), steps, sigterm_rank=1)
+    k = fresh[0]["stopped_at"]
+    assert k is not None and 0 < k < steps, fresh[0]
+    assert fresh[1]["stopped_at"] == k  # same barrier on every rank
+    assert fresh[1]["preempted"] and not fresh[0]["preempted"]
+
+    # resume from the checkpoint; finish the schedule
+    resumed = _run_preempt(2, run_dir, _free_port(), steps, resume=True)
+    assert resumed[0]["start"] == k
+    assert resumed[0]["stopped_at"] is None
+
+    stitched = {**fresh[0]["losses"], **resumed[0]["losses"]}
+    assert sorted(map(int, stitched)) == list(range(steps))
+    for s in range(steps):
+        assert stitched[str(s)] == pytest.approx(
+            ref[0]["losses"][str(s)], rel=1e-5), ("step %d" % s)

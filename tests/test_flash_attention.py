@@ -67,3 +67,75 @@ def test_mha_uses_flash_matches_dense():
     mha._use_flash = False
     out_dense = mha(x).asnumpy()
     np.testing.assert_allclose(out_flash, out_dense, rtol=1e-4, atol=1e-5)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_pallas_backward_all_grads_match_dense(qkv, causal):
+    """Full dq/dk/dv from the Pallas backward kernels vs dense autodiff
+    (round-3 verdict item 5; a non-trivial cotangent exercises delta)."""
+    q, k, v = qkv
+    rng = np.random.RandomState(7)
+    ct = jnp.asarray(rng.randn(*q.shape).astype("float32"))
+
+    def loss(fn):
+        def f(q_, k_, v_):
+            return (fn(q_, k_, v_) * ct).sum()
+        return f
+
+    flash = loss(lambda a, b, c: flash_attention(
+        a, b, c, causal=causal, q_block=64, kv_block=64))
+    dense = loss(lambda a, b, c: _dense_attention(
+        a, b, c, 1.0 / np.sqrt(q.shape[-1]), causal))
+    g_flash = jax.grad(flash, argnums=(0, 1, 2))(q, k, v)
+    g_dense = jax.grad(dense, argnums=(0, 1, 2))(q, k, v)
+    for gf, gd, name in zip(g_flash, g_dense, "qkv"):
+        np.testing.assert_allclose(np.asarray(gf), np.asarray(gd),
+                                   rtol=1e-4, atol=1e-5, err_msg=name)
+
+
+def test_pallas_backward_unpadded_length(qkv):
+    """T not a multiple of the block size: padded rows/keys must
+    contribute zero gradient."""
+    q, k, v = (a[:, :, :100] for a in qkv)
+    g_flash = jax.grad(lambda a, b, c: flash_attention(
+        a, b, c, causal=True, q_block=64, kv_block=64).sum(),
+        argnums=(0, 1, 2))(q, k, v)
+    g_dense = jax.grad(lambda a, b, c: _dense_attention(
+        a, b, c, 1.0 / np.sqrt(16), True).sum(), argnums=(0, 1, 2))(q, k, v)
+    for gf, gd, name in zip(g_flash, g_dense, "qkv"):
+        np.testing.assert_allclose(np.asarray(gf), np.asarray(gd),
+                                   rtol=1e-4, atol=1e-5, err_msg=name)
+
+
+def test_pallas_backward_bf16(qkv):
+    """bf16 numerics within 1e-2 of the fp32 dense reference."""
+    q, k, v = (a.astype(jnp.bfloat16) for a in qkv)
+    qf, kf, vf = qkv
+    g_flash = jax.grad(lambda a, b, c: flash_attention(
+        a, b, c, causal=True, q_block=64, kv_block=64).astype(
+            jnp.float32).sum(), argnums=(0, 1, 2))(q, k, v)
+    g_dense = jax.grad(lambda a, b, c: _dense_attention(
+        a, b, c, 1.0 / np.sqrt(16), True).sum(),
+        argnums=(0, 1, 2))(qf, kf, vf)
+    for gf, gd, name in zip(g_flash, g_dense, "qkv"):
+        np.testing.assert_allclose(np.asarray(gf, dtype="float32"),
+                                   np.asarray(gd), rtol=1e-1, atol=1e-2,
+                                   err_msg=name)
+
+
+def test_bwd_fallback_flag_matches_pallas(qkv, monkeypatch):
+    """MXTPU_FLASH_BWD=0 routes to the recompute backward; both paths
+    must agree (guards the gate itself)."""
+    from mxtpu.ops.pallas.flash_attention import _make_flash
+
+    q, k, v = qkv
+    g_pallas = jax.grad(lambda a: flash_attention(
+        a, k, v, causal=True, q_block=64, kv_block=64).sum())(q)
+    monkeypatch.setenv("MXTPU_FLASH_BWD", "0")
+    _make_flash.cache_clear()
+    g_fb = jax.grad(lambda a: flash_attention(
+        a, k, v, causal=True, q_block=64, kv_block=64).sum())(q)
+    monkeypatch.delenv("MXTPU_FLASH_BWD")
+    _make_flash.cache_clear()
+    np.testing.assert_allclose(np.asarray(g_pallas), np.asarray(g_fb),
+                               rtol=1e-4, atol=1e-5)

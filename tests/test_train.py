@@ -85,3 +85,22 @@ def test_spmd_trainer_trains_to_threshold():
     metric.update([nd.array(yv)], [net(nd.array(Xv))])
     _, acc = metric.get()
     assert acc >= 0.95, f"validation accuracy {acc:.3f} < 0.95"
+
+
+def test_llama_train_example_loss_decreases():
+    """Drive examples/parallel/llama_train.py end-to-end on the virtual
+    mesh: reduced-width llama-3 architecture, dp x tp x sp composed in
+    one compiled step, loss must drop (round-3 verdict item 4)."""
+    import importlib.util as ilu
+    import os
+
+    path = os.path.join(os.path.dirname(__file__), "..", "examples",
+                        "parallel", "llama_train.py")
+    spec = ilu.spec_from_file_location("llama_train_example", path)
+    mod = ilu.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+
+    losses = mod.main(["--steps", "16", "--generate", "4",
+                       "--batch-size", "8", "--seq-len", "32"])
+    assert len(losses) == 16
+    assert losses[-1] < losses[0] * 0.8, (losses[0], losses[-1])

@@ -1,0 +1,80 @@
+"""KV-cache incremental decode vs full-context forward (round-3 verdict
+item 4: TransformerLM.generate correctness; parity target: gluonnlp
+sequence sampling over the reference's transformer ops)."""
+
+import numpy as np
+import pytest
+
+import mxtpu as mx
+from mxtpu import nd
+from mxtpu.models.transformer import llama_tiny, TransformerLM
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    net = llama_tiny(vocab_size=50)
+    net.initialize()
+    return net
+
+
+def test_step_matches_full_context(tiny):
+    """Feeding tokens one at a time through the KV cache must reproduce
+    the full-context causal forward logits at every position."""
+    rng = np.random.RandomState(0)
+    B, T = 2, 6
+    ids = nd.array(rng.randint(0, 50, (B, T)), dtype="int32")
+    full = tiny(ids).asnumpy()  # (B, T, V)
+
+    caches = tiny.init_cache(B, T)
+    for pos in range(T):
+        logits, caches = tiny.step(ids[:, pos:pos + 1], caches, pos)
+        np.testing.assert_allclose(
+            logits.asnumpy()[:, 0], full[:, pos], rtol=2e-4, atol=2e-5)
+
+
+def test_generate_greedy_matches_no_cache_loop(tiny):
+    """generate() with temperature=0 must equal the naive no-cache greedy
+    loop (full forward each step, argmax of the last position)."""
+    rng = np.random.RandomState(1)
+    B, Tp, new = 2, 4, 5
+    prompt = nd.array(rng.randint(0, 50, (B, Tp)), dtype="int32")
+
+    out = tiny.generate(prompt, max_new_tokens=new).asnumpy()
+    assert out.shape == (B, Tp + new)
+    np.testing.assert_array_equal(out[:, :Tp], prompt.asnumpy())
+
+    seq = prompt.asnumpy()
+    for _ in range(new):
+        logits = tiny(nd.array(seq, dtype="int32")).asnumpy()
+        nxt = logits[:, -1].argmax(axis=-1).astype(seq.dtype)
+        seq = np.concatenate([seq, nxt[:, None]], axis=1)
+    np.testing.assert_array_equal(out, seq)
+
+
+def test_generate_respects_max_length(tiny):
+    prompt = nd.array(np.zeros((1, 3)), dtype="int32")
+    with pytest.raises(ValueError, match="max_length"):
+        tiny.generate(prompt, max_new_tokens=5, max_length=4)
+
+
+def test_gqa_cache_shapes(tiny):
+    """llama_tiny uses GQA (4 heads, 2 kv): cache stores KV heads only."""
+    caches = tiny.init_cache(batch_size=3, max_length=7)
+    assert len(caches) == 2  # layers
+    k, v = caches[0]
+    assert k.shape == (3, 2, 7, 16)  # (B, kv_heads, T_max, head_dim)
+    assert v.shape == (3, 2, 7, 16)
+
+
+def test_tied_weights_decode():
+    net = TransformerLM(vocab_size=40, units=32, hidden_size=64,
+                        num_layers=1, num_heads=4, tie_weights=True)
+    net.initialize()
+    ids = nd.array(np.random.RandomState(2).randint(0, 40, (1, 5)),
+                   dtype="int32")
+    full = net(ids).asnumpy()
+    caches = net.init_cache(1, 5)
+    for pos in range(5):
+        logits, caches = net.step(ids[:, pos:pos + 1], caches, pos)
+    np.testing.assert_allclose(logits.asnumpy()[:, 0], full[:, -1],
+                               rtol=2e-4, atol=2e-5)

@@ -25,8 +25,21 @@ WIDTHS = [64, 128, 256, 512]
 MM1X1 = False  # 1x1-as-matmul measured slower (49.2 vs 46.8 ms): XLA's
 # conv path already handles 1x1; the reshape adds copies. Kept for record.
 
+# MXTPU_PALLAS_CONV_BWD=1: route 3x3/s1 convs through the fused Pallas
+# dW+dX backward (mxtpu/ops/pallas/conv_bwd.py) — the round-4 candidate
+# for the conv-weight-grad bandwidth problem this tool diagnosed.
+import os as _os
+_PALLAS_BWD = _os.environ.get("MXTPU_PALLAS_CONV_BWD", "") not in ("", "0")
+if _PALLAS_BWD:
+    _os.sys.path.insert(0, _os.path.join(_os.path.dirname(
+        _os.path.abspath(__file__)), ".."))
+
 
 def conv(x, w, stride, layout):
+    if (_PALLAS_BWD and layout == "NHWC" and stride == 1
+            and w.shape[0] == 3 and w.shape[1] == 3):
+        from mxtpu.ops.pallas import conv_bwd
+        return conv_bwd.conv3x3_s1(x, w)
     if layout == "NCHW_i":  # NCHW API, NHWC internal: XLA cancels the
         # transpose pairs between consecutive convs (hypothesis under test)
         y = conv(jnp.transpose(x, (0, 2, 3, 1)),
