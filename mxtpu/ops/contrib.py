@@ -12,6 +12,8 @@ hot path additionally has a Pallas flash-attention kernel
 
 from __future__ import annotations
 
+import math
+
 import jax
 import jax.numpy as jnp
 
@@ -364,3 +366,188 @@ def box_nms(data, overlap_thresh=0.5, valid_thresh=0.0, topk=-1,
                          jnp.full_like(out, -1.0))
 
     return jax.vmap(one)(flat).reshape(shape)
+
+
+# ------------------------------------------------------------ SSD multibox
+# (parity: src/operator/contrib/multibox_prior.cc / multibox_target.cc /
+# multibox_detection.cc — the reference's SSD training + inference ops)
+
+@register_op("multibox_prior", aliases=("_contrib_MultiBoxPrior",),
+             differentiable=False)
+def multibox_prior(data, sizes=(1.0,), ratios=(1.0,), clip=False,
+                   steps=(-1.0, -1.0), offsets=(0.5, 0.5)):
+    """Anchor generation from a (B, C, H, W) feature map: per cell,
+    len(sizes) + len(ratios) - 1 normalized corner boxes
+    ((size_i, ratio_0) for all i, then (size_0, ratio_j) for j>0)."""
+    H, W = data.shape[2], data.shape[3]
+    sizes = [float(s) for s in (sizes if hasattr(sizes, "__len__")
+                                else [sizes])]
+    ratios = [float(r) for r in (ratios if hasattr(ratios, "__len__")
+                                 else [ratios])]
+    step_y = float(steps[0]) if steps[0] > 0 else 1.0 / H
+    step_x = float(steps[1]) if steps[1] > 0 else 1.0 / W
+    cy = (jnp.arange(H, dtype=jnp.float32) + float(offsets[0])) * step_y
+    cx = (jnp.arange(W, dtype=jnp.float32) + float(offsets[1])) * step_x
+    gy, gx = jnp.meshgrid(cy, cx, indexing="ij")  # (H, W)
+
+    halves = []  # (half_w, half_h) per anchor kind
+    for s in sizes:
+        r = ratios[0]
+        halves.append((s * math.sqrt(r) / 2.0, s / math.sqrt(r) / 2.0))
+    for r in ratios[1:]:
+        s = sizes[0]
+        halves.append((s * math.sqrt(r) / 2.0, s / math.sqrt(r) / 2.0))
+
+    boxes = []
+    for hw, hh in halves:
+        boxes.append(jnp.stack([gx - hw, gy - hh, gx + hw, gy + hh],
+                               axis=-1))  # (H, W, 4)
+    out = jnp.stack(boxes, axis=2).reshape(1, H * W * len(halves), 4)
+    if clip:
+        out = jnp.clip(out, 0.0, 1.0)
+    return out.astype(jnp.float32)
+
+
+def _mb_center(b):
+    """corner (x1,y1,x2,y2) -> (cx, cy, w, h)"""
+    return ((b[..., 0] + b[..., 2]) / 2, (b[..., 1] + b[..., 3]) / 2,
+            b[..., 2] - b[..., 0], b[..., 3] - b[..., 1])
+
+
+@register_op("multibox_target", aliases=("_contrib_MultiBoxTarget",),
+             differentiable=False)
+def multibox_target(anchor, label, cls_pred, overlap_threshold=0.5,
+                    ignore_label=-1.0, negative_mining_ratio=-1.0,
+                    negative_mining_thresh=0.5, minimum_negative_samples=0,
+                    variances=(0.1, 0.1, 0.2, 0.2)):
+    """SSD target assignment.  anchor (1, N, 4) corners; label
+    (B, M, 5) rows [cls, x1, y1, x2, y2] padded with -1; cls_pred
+    (B, num_cls+1, N) (used for online hard negative mining).
+
+    Returns (box_target (B, N*4), box_mask (B, N*4), cls_target (B, N))
+    — cls_target is shifted by +1 (0 = background), matching the
+    reference."""
+    A = anchor.reshape(-1, 4)
+    N = A.shape[0]
+    v = jnp.asarray(variances, jnp.float32)
+
+    def one(lab, cp):
+        gt_valid = lab[:, 0] >= 0  # (M,)
+        gt = lab[:, 1:5]
+        iou = _pairwise_iou(A, gt)  # (N, M)
+        iou = jnp.where(gt_valid[None, :], iou, -1.0)
+        # (a) each valid GT claims its best anchor (bipartite pass)
+        best_anchor = jnp.argmax(iou, axis=0)  # (M,)
+        forced = jnp.zeros((N,), jnp.int32) - 1
+        # later GTs overwrite earlier on conflict, like the sequential ref
+        for m in range(gt.shape[0]):
+            forced = jnp.where(
+                (jnp.arange(N) == best_anchor[m]) & gt_valid[m],
+                m, forced)
+        # (b) threshold pass on the rest
+        best_gt = jnp.argmax(iou, axis=1)           # (N,)
+        best_iou = jnp.max(iou, axis=1)
+        match = jnp.where(forced >= 0, forced,
+                          jnp.where(best_iou >= overlap_threshold,
+                                    best_gt, -1))
+        matched = match >= 0
+        mg = jnp.clip(match, 0, gt.shape[0] - 1)
+        g = gt[mg]                                   # (N, 4)
+        acx, acy, aw, ah = _mb_center(A)
+        gcx, gcy, gw, gh = _mb_center(g)
+        eps = 1e-8
+        tx = (gcx - acx) / jnp.maximum(aw, eps) / v[0]
+        ty = (gcy - acy) / jnp.maximum(ah, eps) / v[1]
+        tw = jnp.log(jnp.maximum(gw, eps) / jnp.maximum(aw, eps)) / v[2]
+        th = jnp.log(jnp.maximum(gh, eps) / jnp.maximum(ah, eps)) / v[3]
+        bt = jnp.stack([tx, ty, tw, th], axis=-1)    # (N, 4)
+        bt = jnp.where(matched[:, None], bt, 0.0)
+        bm = jnp.where(matched[:, None],
+                       jnp.ones((N, 4), jnp.float32), 0.0)
+        ct = jnp.where(matched, lab[mg, 0] + 1.0, 0.0)
+        if negative_mining_ratio > 0:
+            # hard negatives: unmatched anchors ranked by max non-bg conf
+            max_conf = jnp.max(cp[1:, :], axis=0)    # (N,)
+            neg_order = jnp.argsort(
+                jnp.where(matched, -jnp.inf, max_conf))[::-1]
+            n_pos = jnp.sum(matched)
+            quota = jnp.maximum(
+                (negative_mining_ratio * n_pos).astype(jnp.int32),
+                minimum_negative_samples)
+            rank = jnp.zeros((N,), jnp.int32).at[neg_order].set(
+                jnp.arange(N, dtype=jnp.int32))
+            keep_neg = (~matched) & (rank < quota)
+            ct = jnp.where(matched, ct,
+                           jnp.where(keep_neg, 0.0, float(ignore_label)))
+        return bt.reshape(-1), bm.reshape(-1), ct
+
+    bt, bm, ct = jax.vmap(one)(label.astype(jnp.float32),
+                               cls_pred.astype(jnp.float32))
+    return bt, bm, ct
+
+
+@register_op("multibox_detection", aliases=("_contrib_MultiBoxDetection",),
+             differentiable=False)
+def multibox_detection(cls_prob, loc_pred, anchor, clip=True,
+                       threshold=0.01, background_id=0,
+                       nms_threshold=0.5, force_suppress=False,
+                       variances=(0.1, 0.1, 0.2, 0.2), nms_topk=-1):
+    """SSD inference: decode loc_pred against anchors, pick each
+    anchor's best non-background class, then box_nms.  cls_prob
+    (B, num_cls+1, N), loc_pred (B, N*4), anchor (1, N, 4).
+    Output (B, N, 6) rows [cls_id, score, x1, y1, x2, y2], -1-filled."""
+    A = anchor.reshape(-1, 4)
+    N = A.shape[0]
+    v = jnp.asarray(variances, jnp.float32)
+    acx, acy, aw, ah = _mb_center(A)
+
+    def one(cp, lp):
+        p = lp.reshape(N, 4)
+        cx = p[:, 0] * v[0] * aw + acx
+        cy = p[:, 1] * v[1] * ah + acy
+        w_ = jnp.exp(p[:, 2] * v[2]) * aw
+        h_ = jnp.exp(p[:, 3] * v[3]) * ah
+        boxes = jnp.stack([cx - w_ / 2, cy - h_ / 2,
+                           cx + w_ / 2, cy + h_ / 2], axis=-1)
+        if clip:
+            boxes = jnp.clip(boxes, 0.0, 1.0)
+        if background_id != 0:
+            raise ValueError("multibox_detection: background_id must "
+                             "be 0 (reference default)")
+        fg = cp[1:]                                  # (num_cls, N)
+        cls_id = jnp.argmax(fg, axis=0).astype(jnp.float32)
+        score = jnp.max(fg, axis=0)
+        keep = score > threshold
+        rows = jnp.concatenate(
+            [jnp.where(keep, cls_id, -1.0)[:, None],
+             jnp.where(keep, score, -1.0)[:, None], boxes], axis=-1)
+        return rows
+
+    det = jax.vmap(one)(cls_prob.astype(jnp.float32),
+                        loc_pred.astype(jnp.float32))  # (B, N, 6)
+    return box_nms(det, overlap_thresh=nms_threshold, valid_thresh=0.0,
+                   topk=nms_topk, coord_start=2, score_index=1,
+                   id_index=0, force_suppress=force_suppress)
+
+
+# ------------------------------------------------------------ fft / ifft
+
+@register_op("fft", aliases=("_contrib_fft",), differentiable=False)
+def fft_op(data, compute_size=128):
+    """(parity: src/operator/contrib/fft.cc): real input (..., d) ->
+    interleaved re/im (..., 2d)."""
+    f = jnp.fft.fft(data.astype(jnp.float32), axis=-1)
+    out = jnp.stack([f.real, f.imag], axis=-1)
+    return out.reshape(*data.shape[:-1], 2 * data.shape[-1]).astype(
+        jnp.float32)
+
+
+@register_op("ifft", aliases=("_contrib_ifft",), differentiable=False)
+def ifft_op(data, compute_size=128):
+    """Inverse of fft's interleaved layout: (..., 2d) -> real (..., d).
+    NOTE (reference parity): upstream ifft does NOT normalize by d — it
+    returns d * ifft(x); we match numpy semantics * d for parity."""
+    d = data.shape[-1] // 2
+    c = data.reshape(*data.shape[:-1], d, 2)
+    z = c[..., 0] + 1j * c[..., 1]
+    return (jnp.fft.ifft(z, axis=-1).real * d).astype(jnp.float32)

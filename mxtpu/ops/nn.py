@@ -821,3 +821,328 @@ def softmax_activation(x, mode="instance"):
         return jax.nn.softmax(x, axis=1)
     flat = jnp.reshape(x, (x.shape[0], -1))
     return jnp.reshape(jax.nn.softmax(flat, axis=-1), x.shape)
+
+
+# ---------------------------------------------------------------------------
+# spatial-transform / legacy vision ops (round 4: op-surface widening)
+# ---------------------------------------------------------------------------
+
+@register_op("GridGenerator")
+def grid_generator(data, transform_type="affine", target_shape=(0, 0)):
+    """Sampling-grid generation (parity: src/operator/grid_generator.cc).
+    affine: data (B, 6) -> grid (B, 2, H, W) in [-1, 1].
+    warp: data (B, 2, H, W) pixel flow added to the identity grid."""
+    if transform_type == "affine":
+        H, W = int(target_shape[0]), int(target_shape[1])
+        theta = data.reshape(-1, 2, 3)
+        xs = jnp.linspace(-1.0, 1.0, W)
+        ys = jnp.linspace(-1.0, 1.0, H)
+        gy, gx = jnp.meshgrid(ys, xs, indexing="ij")
+        base = jnp.stack([gx.ravel(), gy.ravel(),
+                          jnp.ones(H * W, data.dtype)])  # (3, H*W)
+        out = jnp.einsum("bij,jk->bik", theta.astype(jnp.float32),
+                         base.astype(jnp.float32))       # (B, 2, H*W)
+        return out.reshape(-1, 2, H, W).astype(data.dtype)
+    if transform_type == "warp":
+        B, _, H, W = data.shape
+        xs = jnp.arange(W, dtype=jnp.float32)
+        ys = jnp.arange(H, dtype=jnp.float32)
+        gy, gx = jnp.meshgrid(ys, xs, indexing="ij")
+        fx = data[:, 0].astype(jnp.float32) + gx
+        fy = data[:, 1].astype(jnp.float32) + gy
+        # normalize to [-1, 1]
+        nx = 2.0 * fx / jnp.maximum(W - 1, 1) - 1.0
+        ny = 2.0 * fy / jnp.maximum(H - 1, 1) - 1.0
+        return jnp.stack([nx, ny], axis=1).astype(data.dtype)
+    raise ValueError("GridGenerator: unknown transform_type %r"
+                     % (transform_type,))
+
+
+@register_op("SpatialTransformer")
+def spatial_transformer(data, loc, target_shape=(0, 0),
+                        transform_type="affine",
+                        sampler_type="bilinear", cudnn_off=False):
+    """STN (parity: src/operator/spatial_transformer.cc): affine grid
+    from loc + bilinear sampling."""
+    if sampler_type != "bilinear":
+        raise ValueError("SpatialTransformer: only bilinear sampling")
+    grid = grid_generator(loc, transform_type, target_shape)
+    return bilinear_sampler(data, grid)
+
+
+@register_op("LRN", aliases=("lrn",))
+def lrn(data, alpha=1e-4, beta=0.75, knorm=2.0, nsize=5):
+    """Across-channel local response normalization (parity:
+    src/operator/nn/lrn.cc — the AlexNet-era op)."""
+    sq = jnp.square(data.astype(jnp.float32))
+    half = nsize // 2
+    ssum = lax.reduce_window(sq, 0.0, lax.add, (1, nsize, 1, 1),
+                             (1, 1, 1, 1),
+                             [(0, 0), (half, half), (0, 0), (0, 0)])
+    denom = jnp.power(knorm + (alpha / nsize) * ssum, beta)
+    return (data.astype(jnp.float32) / denom).astype(data.dtype)
+
+
+def _resize_bilinear_ac(data, oh, ow):
+    """align_corners bilinear resize on NCHW (the reference's
+    BilinearResize2D convention: scale = (in-1)/(out-1))."""
+    B, C, H, W = data.shape
+    x = data.astype(jnp.float32)
+
+    def along(arr, axis, out_size, in_size):
+        if in_size == 1 or out_size == 1:
+            pos = jnp.zeros((out_size,), jnp.float32)
+        else:
+            pos = jnp.linspace(0.0, in_size - 1.0, out_size)
+        i0 = jnp.floor(pos).astype(jnp.int32)
+        i1 = jnp.minimum(i0 + 1, in_size - 1)
+        w1 = pos - i0
+        a0 = jnp.take(arr, i0, axis=axis)
+        a1 = jnp.take(arr, i1, axis=axis)
+        shape = [1] * arr.ndim
+        shape[axis] = out_size
+        w1 = w1.reshape(shape)
+        return a0 * (1 - w1) + a1 * w1
+
+    x = along(x, 2, oh, H)
+    x = along(x, 3, ow, W)
+    return x.astype(data.dtype)
+
+
+@register_op("BilinearResize2D", aliases=("_contrib_BilinearResize2D",))
+def bilinear_resize_2d(data, height=0, width=0, scale_height=None,
+                       scale_width=None, mode="size"):
+    """(parity: src/operator/contrib/bilinear_resize.cc)"""
+    if mode != "size":
+        raise ValueError(
+            "BilinearResize2D: mode=%r unsupported (only 'size'; the "
+            "'like'/odd_scale variants need a second input)" % (mode,))
+    B, C, H, W = data.shape
+    oh = int(round(H * scale_height)) if scale_height else int(height)
+    ow = int(round(W * scale_width)) if scale_width else int(width)
+    return _resize_bilinear_ac(data, oh, ow)
+
+
+@register_op("UpSampling")
+def upsampling(*data, scale=1, sample_type="nearest", num_args=1,
+               workspace=512, num_filter=0, multi_input_mode="concat"):
+    """(parity: src/operator/nn/upsampling.cc).  nearest repeats pixels;
+    bilinear resizes (the reference's bilinear variant is a fixed-kernel
+    deconvolution — same result for align_corners geometry).  Multiple
+    inputs are upsampled to the first input's scaled size and
+    concatenated on channels."""
+    scale = int(scale)
+    B, C, H, W = data[0].shape
+    oh, ow = H * scale, W * scale
+    outs = []
+    for d in data:
+        if sample_type == "nearest":
+            r = oh // d.shape[2]
+            u = jnp.repeat(jnp.repeat(d, r, axis=2), ow // d.shape[3],
+                           axis=3)
+        else:
+            u = _resize_bilinear_ac(d, oh, ow)
+        outs.append(u)
+    if len(outs) == 1:
+        return outs[0]
+    if multi_input_mode == "sum":
+        return sum(outs[1:], outs[0])
+    return jnp.concatenate(outs, axis=1)
+
+
+@register_op("Crop", aliases=("crop",))
+def crop_op(*data, offset=(0, 0), h_w=(0, 0), center_crop=False,
+            num_args=1):
+    """Legacy Crop (parity: src/operator/crop.cc): crop data[0] to
+    data[1]'s spatial size (or h_w) at offset / centered."""
+    x = data[0]
+    H, W = x.shape[2], x.shape[3]
+    if len(data) > 1:
+        th, tw = data[1].shape[2], data[1].shape[3]
+    else:
+        th, tw = int(h_w[0]), int(h_w[1])
+    if center_crop:
+        oy, ox = (H - th) // 2, (W - tw) // 2
+    else:
+        oy, ox = int(offset[0]), int(offset[1])
+    return x[:, :, oy:oy + th, ox:ox + tw]
+
+
+@register_op("MakeLoss", aliases=("make_loss",))
+def make_loss(data, grad_scale=1.0, valid_thresh=0.0,
+              normalization="null"):
+    """(parity: src/operator/make_loss.cc): forward is identity; the
+    BACKWARD ignores the incoming gradient and emits grad_scale — the
+    symbolic 'this output IS the loss' marker."""
+    if normalization == "batch":
+        denom = data.shape[0]
+    elif normalization == "valid":
+        denom = None  # computed from data at runtime
+    else:
+        denom = 1.0
+
+    @jax.custom_vjp
+    def f(x):
+        return x
+
+    def f_fwd(x):
+        return x, x
+
+    def f_bwd(x, g):
+        if denom is None:
+            n = jnp.maximum(jnp.sum(
+                (x > valid_thresh).astype(jnp.float32)), 1.0)
+        else:
+            n = denom
+        return (jnp.full_like(x, grad_scale) / n,)
+
+    f.defvjp(f_fwd, f_bwd)
+    return f(data)
+
+
+@register_op("im2col")
+def im2col(data, kernel=(), stride=(), dilate=(), pad=()):
+    """(parity: src/operator/nn/im2col.h exposed as the im2col op):
+    (B, C, H, W) -> (B, C*kh*kw, Ho*Wo)."""
+    kh, kw = kernel
+    ndim = 2
+    stride = tuple(stride) if stride else (1,) * ndim
+    dilate = tuple(dilate) if dilate else (1,) * ndim
+    pad = tuple(pad) if pad else (0,) * ndim
+    patches = lax.conv_general_dilated_patches(
+        data, (kh, kw), stride, [(pad[0], pad[0]), (pad[1], pad[1])],
+        rhs_dilation=dilate)  # (B, C*kh*kw, Ho, Wo)
+    B, CKK = patches.shape[:2]
+    return patches.reshape(B, CKK, -1)
+
+
+@register_op("col2im")
+def col2im(data, output_size=(), kernel=(), stride=(), dilate=(),
+           pad=()):
+    """Adjoint of im2col (parity: col2im — overlapping patches sum)."""
+    kh, kw = kernel
+    C = data.shape[1] // (kh * kw)
+    B = data.shape[0]
+    shape = (B, C, int(output_size[0]), int(output_size[1]))
+    _, vjp = jax.vjp(
+        lambda a: im2col(a, kernel=kernel, stride=stride, dilate=dilate,
+                         pad=pad), jnp.zeros(shape, data.dtype))
+    return vjp(data)[0]
+
+
+def _abs_bilinear_gather(data, ys, xs):
+    """Bilinear sample NCHW data at absolute coords ys/xs (B, Ho, Wo);
+    out-of-bounds contributes zero (matches BilinearSampler)."""
+    B, C, H, W = data.shape
+    y0 = jnp.floor(ys).astype(jnp.int32)
+    x0 = jnp.floor(xs).astype(jnp.int32)
+    y1, x1 = y0 + 1, x0 + 1
+    wy = ys - y0
+    wx = xs - x0
+
+    flat = data.reshape(B, C, H * W)
+
+    def gather(y, x):
+        yc = jnp.clip(y, 0, H - 1)
+        xc = jnp.clip(x, 0, W - 1)
+        idx = (yc * W + xc).reshape(B, 1, -1)
+        g = jnp.take_along_axis(flat, jnp.broadcast_to(
+            idx, (B, C, idx.shape[-1])), axis=2)
+        valid = ((y >= 0) & (y <= H - 1) & (x >= 0) & (x <= W - 1))
+        return (g.reshape(B, C, *y.shape[1:])
+                * valid[:, None].astype(data.dtype))
+
+    return (gather(y0, x0) * ((1 - wx) * (1 - wy))[:, None]
+            + gather(y0, x1) * (wx * (1 - wy))[:, None]
+            + gather(y1, x0) * ((1 - wx) * wy)[:, None]
+            + gather(y1, x1) * (wx * wy)[:, None])
+
+
+@register_op("deformable_convolution",
+             aliases=("_contrib_DeformableConvolution",))
+def deformable_convolution(data, offset, weight, bias=None, kernel=(),
+                           stride=(), dilate=(), pad=(), num_filter=0,
+                           num_group=1, num_deformable_group=1,
+                           no_bias=False, workspace=1024, layout=None):
+    """Deformable conv v1 (parity: src/operator/contrib/
+    deformable_convolution.cc).  Each kernel tap samples the input at its
+    regular position plus a learned per-position (y, x) offset, via
+    bilinear interpolation; the deformed im2col columns then contract
+    with the weights on the MXU."""
+    if num_group != 1:
+        raise ValueError("deformable_convolution: num_group>1 TBD")
+    kh, kw = kernel
+    ndim = 2
+    stride = tuple(stride) if stride else (1,) * ndim
+    dilate = tuple(dilate) if dilate else (1,) * ndim
+    pad = tuple(pad) if pad else (0,) * ndim
+    B, C, H, W = data.shape
+    Ho = (H + 2 * pad[0] - dilate[0] * (kh - 1) - 1) // stride[0] + 1
+    Wo = (W + 2 * pad[1] - dilate[1] * (kw - 1) - 1) // stride[1] + 1
+    DG = num_deformable_group
+    off = offset.reshape(B, DG, kh, kw, 2, Ho, Wo).astype(jnp.float32)
+    cg = C // DG
+
+    base_y = (jnp.arange(Ho) * stride[0] - pad[0]).astype(jnp.float32)
+    base_x = (jnp.arange(Wo) * stride[1] - pad[1]).astype(jnp.float32)
+    gy, gx = jnp.meshgrid(base_y, base_x, indexing="ij")  # (Ho, Wo)
+
+    cols = []
+    for g in range(DG):
+        dslice = data[:, g * cg:(g + 1) * cg]
+        for i in range(kh):
+            for j in range(kw):
+                ys = gy[None] + i * dilate[0] + off[:, g, i, j, 0]
+                xs = gx[None] + j * dilate[1] + off[:, g, i, j, 1]
+                cols.append(_abs_bilinear_gather(dslice, ys, xs))
+    # (B, DG*kh*kw*cg, Ho, Wo) ordered [dg][i][j][c] -> regroup to
+    # [dg][c][i][j] = weight's (O, C, kh, kw) contraction order
+    col = jnp.stack(cols, axis=1).reshape(B, DG, kh * kw, cg, Ho, Wo)
+    col = col.transpose(0, 1, 3, 2, 4, 5).reshape(B, C * kh * kw, Ho, Wo)
+    from .tensor import matmul_precision
+    w2 = weight.reshape(num_filter, -1)  # (O, C*kh*kw)
+    y = jnp.einsum("ok,bkhw->bohw", w2, col,
+                   precision=matmul_precision(data, weight))
+    if bias is not None and not no_bias:
+        y = y + bias.reshape(1, -1, 1, 1)
+    return y.astype(data.dtype)
+
+
+@register_op("Correlation")
+def correlation(data1, data2, kernel_size=1, max_displacement=1,
+                stride1=1, stride2=1, pad_size=0, is_multiply=True):
+    """FlowNet correlation (parity: src/operator/correlation.cc),
+    kernel_size=1 form: one output channel per displacement, each the
+    channel-mean of data1 * shifted(data2)."""
+    if kernel_size != 1:
+        raise ValueError("Correlation: kernel_size>1 TBD")
+    B, C, H, W = data1.shape
+    p = pad_size
+    d1 = jnp.pad(data1, ((0, 0), (0, 0), (p, p), (p, p)))
+    d2 = jnp.pad(data2, ((0, 0), (0, 0), (p, p), (p, p)))
+    Hp, Wp = H + 2 * p, W + 2 * p
+    drange = range(-max_displacement, max_displacement + 1, stride2)
+    outs = []
+    for dy in drange:
+        for dx in drange:
+            shifted = jnp.roll(d2, (-dy, -dx), axis=(2, 3))
+            if is_multiply:
+                prod = d1 * shifted
+            else:
+                prod = jnp.abs(d1 - shifted)
+            # zero out wrapped-around borders
+            ys = jnp.arange(Hp)[None, None, :, None] + dy
+            xs = jnp.arange(Wp)[None, None, None, :] + dx
+            valid = ((ys >= 0) & (ys < Hp) & (xs >= 0)
+                     & (xs < Wp)).astype(prod.dtype)
+            corr = jnp.mean(prod * valid, axis=1)  # (B, Hp, Wp)
+            outs.append(corr)
+    out = jnp.stack(outs, axis=1)  # (B, D*D, Hp, Wp)
+    # reference shape contract (correlation.cc): trim the displacement
+    # border, then stride — top = (H + 2*pad - 2*border) / stride1 with
+    # border = max_displacement + kernel_radius (radius 0 at ks=1)
+    border = max_displacement
+    out = out[:, :, border:Hp - border, border:Wp - border]
+    if stride1 > 1:
+        out = out[:, :, ::stride1, ::stride1]
+    return out

@@ -129,8 +129,8 @@ def _flash_fwd(q, k, v, scale, causal, q_block, kv_block, interpret):
 
 
 def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
-               scale, causal, q_block, kv_block, seq_len, valid_len,
-               hi_prec):
+               scale, causal, q_block, kv_block, seq_len, q_seq_len,
+               valid_len, hi_prec):
     """dq for one Q block: stream K/V blocks, p = exp(s - lse),
     ds = p * (dp - delta), dq += scale * ds @ K."""
     prec = jax.lax.Precision.HIGHEST if hi_prec else None
@@ -174,7 +174,7 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
 
 def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref,
                 dv_ref, *, scale, causal, q_block, kv_block, seq_len,
-                valid_len, hi_prec):
+                q_seq_len, valid_len, hi_prec):
     """dk/dv for one K/V block: stream Q/dO blocks (from the diagonal on
     for causal), dv += p^T @ dO, dk += scale * ds^T @ Q."""
     prec = jax.lax.Precision.HIGHEST if hi_prec else None
@@ -182,7 +182,10 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref,
     k = k_ref[0].astype(jnp.float32)              # (Bkv, D)
     v = v_ref[0].astype(jnp.float32)
     bkv, d = k.shape
-    nq_total = seq_len // q_block
+    # Q-side padded length, NOT the K-side seq_len: with q_block !=
+    # kv_block the two paddings differ and Tk//q_block would read past
+    # the end of the q/do/lse blocks
+    nq_total = q_seq_len // q_block
     i0 = (kj * kv_block) // q_block if causal else 0
 
     k_pos_col = kj * kv_block + jax.lax.broadcasted_iota(
@@ -238,8 +241,8 @@ def _flash_bwd(q, k, v, o, lse, g, scale, causal, q_block, kv_block,
                     axis=-1)                # (BH, Tq)
 
     common = dict(scale=scale, causal=causal, q_block=q_block,
-                  kv_block=kv_block, seq_len=Tk, valid_len=T,
-                  hi_prec=q.dtype == jnp.float32)
+                  kv_block=kv_block, seq_len=Tk, q_seq_len=Tq,
+                  valid_len=T, hi_prec=q.dtype == jnp.float32)
     dq = pl.pallas_call(
         functools.partial(_dq_kernel, **common),
         out_shape=jax.ShapeDtypeStruct((BH, Tq, D), q.dtype),

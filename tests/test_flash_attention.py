@@ -139,3 +139,18 @@ def test_bwd_fallback_flag_matches_pallas(qkv, monkeypatch):
     _make_flash.cache_clear()
     np.testing.assert_allclose(np.asarray(g_pallas), np.asarray(g_fb),
                                rtol=1e-4, atol=1e-5)
+
+
+def test_pallas_backward_mixed_block_sizes(qkv):
+    """q_block != kv_block pads Tq and Tk differently; the dkv kernel
+    must iterate the Q-side padded length, not the K-side."""
+    q, k, v = (a[:, :, :150] for a in qkv)  # pads to Tq=192 vs Tk=256... 
+    g_flash = jax.grad(lambda a, b, c: flash_attention(
+        a, b, c, causal=True, q_block=64, kv_block=128).sum(),
+        argnums=(0, 1, 2))(q, k, v)
+    g_dense = jax.grad(lambda a, b, c: _dense_attention(
+        a, b, c, 1.0 / np.sqrt(16), True).sum(),
+        argnums=(0, 1, 2))(q, k, v)
+    for gf, gd, name in zip(g_flash, g_dense, "qkv"):
+        np.testing.assert_allclose(np.asarray(gf), np.asarray(gd),
+                                   rtol=1e-4, atol=1e-5, err_msg=name)

@@ -1,0 +1,199 @@
+"""Semantic tests for the round-4 op widening: spatial transformer,
+LRN, resize/upsample, im2col/col2im, deformable conv, correlation,
+MakeLoss, the SSD multibox family, fft (parity models: the reference's
+test_operator.py / test_contrib_operator.py cases for each)."""
+
+import numpy as np
+import pytest
+
+import mxtpu as mx
+from mxtpu import nd, autograd
+
+
+def test_deformable_conv_zero_offset_equals_conv():
+    rng = np.random.RandomState(0)
+    x = nd.array(rng.randn(2, 4, 8, 8).astype("f"))
+    w = nd.array((rng.randn(6, 4, 3, 3) * 0.2).astype("f"))
+    off = nd.array(np.zeros((2, 18, 8, 8), "f"))
+    ref = nd.Convolution(x, w, kernel=(3, 3), num_filter=6, pad=(1, 1),
+                         no_bias=True).asnumpy()
+    got = nd.deformable_convolution(x, off, w, kernel=(3, 3),
+                                    num_filter=6, pad=(1, 1),
+                                    no_bias=True).asnumpy()
+    np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-4)
+
+
+def test_deformable_conv_integer_offset_shifts():
+    """A constant integer offset of (0, 1) equals convolving the
+    x-shifted input (interior columns)."""
+    rng = np.random.RandomState(1)
+    xn = rng.randn(1, 2, 6, 6).astype("f")
+    w = nd.array((rng.randn(3, 2, 3, 3) * 0.3).astype("f"))
+    off = np.zeros((1, 18, 6, 6), "f")
+    off[:, 1::2] = 1.0  # x-offsets = +1
+    got = nd.deformable_convolution(nd.array(xn), nd.array(off), w,
+                                    kernel=(3, 3), num_filter=3,
+                                    pad=(1, 1), no_bias=True).asnumpy()
+    shifted = np.zeros_like(xn)
+    shifted[:, :, :, :-1] = xn[:, :, :, 1:]
+    ref = nd.Convolution(nd.array(shifted), w, kernel=(3, 3),
+                         num_filter=3, pad=(1, 1),
+                         no_bias=True).asnumpy()
+    np.testing.assert_allclose(got[:, :, 1:-1, 1:-1],
+                               ref[:, :, 1:-1, 1:-1], rtol=1e-4,
+                               atol=1e-4)
+
+
+def test_spatial_transformer_identity():
+    rng = np.random.RandomState(2)
+    x = nd.array(rng.rand(2, 3, 5, 5).astype("f"))
+    theta = nd.array(np.tile([1, 0, 0, 0, 1, 0], (2, 1)).astype("f"))
+    out = nd.SpatialTransformer(x, theta, target_shape=(5, 5)).asnumpy()
+    np.testing.assert_allclose(out, x.asnumpy(), rtol=1e-5, atol=1e-5)
+
+
+def test_lrn_matches_numpy():
+    rng = np.random.RandomState(3)
+    xn = rng.rand(2, 7, 4, 4).astype("f")
+    alpha, beta, knorm, nsize = 1e-3, 0.75, 2.0, 3
+    out = nd.LRN(nd.array(xn), alpha=alpha, beta=beta, knorm=knorm,
+                 nsize=nsize).asnumpy()
+    ref = np.empty_like(xn)
+    for c in range(7):
+        lo, hi = max(0, c - 1), min(7, c + 2)
+        ssum = (xn[:, lo:hi] ** 2).sum(axis=1)
+        ref[:, c] = xn[:, c] / (knorm + alpha / nsize * ssum) ** beta
+    np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-6)
+
+
+def test_bilinear_resize_align_corners():
+    x = nd.array(np.arange(16, dtype="f").reshape(1, 1, 4, 4))
+    out = nd.BilinearResize2D(x, height=7, width=7).asnumpy()[0, 0]
+    src = x.asnumpy()[0, 0]
+    # corners preserved exactly (align_corners geometry)
+    for (i, j), (si, sj) in [((0, 0), (0, 0)), ((0, 6), (0, 3)),
+                             ((6, 0), (3, 0)), ((6, 6), (3, 3))]:
+        np.testing.assert_allclose(out[i, j], src[si, sj], rtol=1e-6)
+    # midpoints are true averages
+    np.testing.assert_allclose(out[0, 3], (src[0, 1] + src[0, 2]) / 2,
+                               rtol=1e-6)
+    # same-size resize is identity
+    same = nd.BilinearResize2D(x, height=4, width=4).asnumpy()[0, 0]
+    np.testing.assert_allclose(same, src, rtol=1e-6)
+
+
+def test_upsampling_nearest():
+    x = nd.array(np.arange(4, dtype="f").reshape(1, 1, 2, 2))
+    out = nd.UpSampling(x, scale=2, sample_type="nearest").asnumpy()
+    ref = np.repeat(np.repeat(x.asnumpy(), 2, 2), 2, 3)
+    np.testing.assert_array_equal(out, ref)
+
+
+def test_crop_offset_and_center():
+    x = nd.array(np.arange(36, dtype="f").reshape(1, 1, 6, 6))
+    out = nd.Crop(x, h_w=(2, 2), offset=(1, 3)).asnumpy()
+    np.testing.assert_array_equal(out[0, 0],
+                                  x.asnumpy()[0, 0, 1:3, 3:5])
+    cc = nd.Crop(x, h_w=(4, 4), center_crop=True).asnumpy()
+    np.testing.assert_array_equal(cc[0, 0], x.asnumpy()[0, 0, 1:5, 1:5])
+
+
+def test_im2col_col2im_adjoint():
+    rng = np.random.RandomState(4)
+    x = nd.array(rng.rand(2, 3, 5, 5).astype("f"))
+    cols = nd.im2col(x, kernel=(3, 3), pad=(1, 1))
+    assert cols.shape == (2, 27, 25)
+    # col2im(im2col(ones)) counts each pixel's window multiplicity
+    ones = nd.array(np.ones((1, 1, 4, 4), "f"))
+    c = nd.im2col(ones, kernel=(3, 3), pad=(1, 1))
+    back = nd.col2im(c, output_size=(4, 4), kernel=(3, 3),
+                     pad=(1, 1)).asnumpy()[0, 0]
+    assert back[1, 1] == 9.0   # interior pixel seen by all 9 taps
+    assert back[0, 0] == 4.0   # corner pixel seen by 4
+
+
+def test_correlation_zero_displacement():
+    rng = np.random.RandomState(5)
+    a = rng.rand(2, 4, 5, 5).astype("f")
+    b = rng.rand(2, 4, 5, 5).astype("f")
+    # reference shape contract: out = (H + 2*pad - 2*max_disp) / stride1
+    out = nd.Correlation(nd.array(a), nd.array(b), max_displacement=1,
+                         pad_size=1).asnumpy()
+    assert out.shape == (2, 9, 5, 5)
+    np.testing.assert_allclose(out[:, 4], (a * b).mean(axis=1),
+                               rtol=1e-5)  # center channel = (0,0) disp
+    trimmed = nd.Correlation(nd.array(a), nd.array(b),
+                             max_displacement=1).asnumpy()
+    assert trimmed.shape == (2, 9, 3, 3)
+
+
+def test_make_loss_gradient_contract():
+    x = nd.array(np.array([1.0, -2.0, 3.0], "f"))
+    x.attach_grad()
+    with autograd.record():
+        y = nd.MakeLoss(x, grad_scale=0.5)
+        # multiply by 7: MakeLoss must IGNORE the incoming cotangent
+        (y * 7.0).sum().backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), [0.5, 0.5, 0.5],
+                               rtol=1e-6)
+
+
+def test_multibox_prior_geometry():
+    feat = nd.array(np.zeros((1, 8, 2, 2), "f"))
+    anchors = nd.contrib.MultiBoxPrior(
+        feat, sizes=(0.5,), ratios=(1.0,)).asnumpy()
+    assert anchors.shape == (1, 4, 4)
+    # first cell center (0.25, 0.25), half-size 0.25
+    np.testing.assert_allclose(anchors[0, 0], [0.0, 0.0, 0.5, 0.5],
+                               atol=1e-6)
+    a2 = nd.contrib.MultiBoxPrior(feat, sizes=(0.5, 0.3),
+                                   ratios=(1.0, 2.0)).asnumpy()
+    assert a2.shape == (1, 2 * 2 * 3, 4)
+
+
+def test_multibox_target_and_detection_roundtrip():
+    """Encode a GT box via multibox_target, hand the encoded offsets to
+    multibox_detection as 'perfect' loc predictions: the decoded output
+    must recover the GT box."""
+    anchors = np.array([[0.1, 0.1, 0.4, 0.4],
+                        [0.5, 0.5, 0.9, 0.9],
+                        [0.0, 0.6, 0.3, 1.0]], "f")[None]
+    gt = np.array([[[1, 0.12, 0.1, 0.42, 0.38]]], "f")  # near anchor 0
+    cls_pred = np.zeros((1, 3, 3), "f")
+    bt, bm, ct = nd.contrib.MultiBoxTarget(
+        nd.array(anchors), nd.array(gt), nd.array(cls_pred))
+    ct = ct.asnumpy()
+    assert ct.shape == (1, 3)
+    assert ct[0, 0] == 2.0  # class 1 + background shift
+    assert ct[0, 1] == 0.0 and ct[0, 2] == 0.0
+    mask = bm.asnumpy().reshape(1, 3, 4)
+    assert mask[0, 0].all() and not mask[0, 1].any()
+
+    # perfect predictions: cls_prob peaks at class 1 on anchor 0
+    cls_prob = np.zeros((1, 3, 3), "f")
+    cls_prob[0, 0] = [0.05, 0.9, 0.9]   # background elsewhere
+    cls_prob[0, 2] = [0.9, 0.05, 0.05]  # class 1 on anchor 0
+    det = nd.contrib.MultiBoxDetection(
+        nd.array(cls_prob), nd.array(bt.asnumpy().reshape(1, -1)),
+        nd.array(anchors), threshold=0.5,
+        nms_threshold=0.9).asnumpy()[0]
+    kept = det[det[:, 1] > 0]
+    assert len(kept) == 1
+    assert kept[0, 0] == 1.0  # foreground class id
+    np.testing.assert_allclose(kept[0, 2:], gt[0, 0, 1:], atol=1e-5)
+
+
+def test_fft_ifft_roundtrip():
+    rng = np.random.RandomState(6)
+    x = rng.rand(3, 8).astype("f")
+    f = nd.contrib.fft(nd.array(x))
+    assert f.shape == (3, 16)
+    # interleaved layout: de-interleave == numpy fft
+    z = f.asnumpy().reshape(3, 8, 2)
+    ref = np.fft.fft(x, axis=-1)
+    np.testing.assert_allclose(z[..., 0], ref.real, rtol=1e-4,
+                               atol=1e-4)
+    np.testing.assert_allclose(z[..., 1], ref.imag, rtol=1e-4,
+                               atol=1e-4)
+    back = nd.contrib.ifft(f).asnumpy()
+    np.testing.assert_allclose(back, x * 8, rtol=1e-4, atol=1e-4)

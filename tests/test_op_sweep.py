@@ -58,6 +58,18 @@ def _BOXES(n):
     return jnp.asarray(onp.concatenate([xy, xy + wh], axis=1))
 
 
+def _MB_LABEL(B=2, M=3):
+    """Padded (B, M, 5) detection labels [cls, x1, y1, x2, y2]."""
+    lab = onp.full((B, M, 5), -1.0, "float32")
+    for b in range(B):
+        for m in range(M - 1):  # leave one padding row
+            xy = R.rand(2) * 0.4
+            wh = R.rand(2) * 0.4 + 0.15
+            lab[b, m] = [R.randint(0, 3), xy[0], xy[1],
+                         xy[0] + wh[0], xy[1] + wh[1]]
+    return jnp.asarray(lab)
+
+
 def _NMS_DATA(n=6):
     ids = R.randint(0, 2, (n, 1)).astype("float32")
     scores = (R.permutation(n).reshape(n, 1).astype("float32") + 1) / n
@@ -241,6 +253,49 @@ CASES.update({
         {"k": 2}, grad=False),
     "shape_array": C(lambda: (A(3, 4),), grad=False),
     "size_array": C(lambda: (A(3, 4),), grad=False),
+    # -- spatial transform / legacy vision (round 4) ---------------------
+    "LRN": C(lambda: (POS(2, 8, 6, 6),)),
+    "GridGenerator": C(lambda: (A(2, 6, lo=-0.5, hi=0.5),),
+                       {"transform_type": "affine",
+                        "target_shape": (4, 5)}),
+    # |theta| bounded so every sample point stays interior: the border's
+    # zero-padding is a genuine derivative cliff (numeric != autodiff at
+    # the boundary by construction)
+    "SpatialTransformer": C(lambda: (A(2, 3, 6, 6),
+                                     A(2, 6, lo=-0.25, hi=0.25)),
+                            {"target_shape": (4, 4)}, bf16=False),
+    "BilinearResize2D": C(lambda: (A(2, 3, 4, 4),),
+                          {"height": 7, "width": 5}),
+    "UpSampling": C(lambda: (A(2, 3, 4, 4),),
+                    {"scale": 2, "sample_type": "nearest"}),
+    "Crop": C(lambda: (A(2, 3, 6, 6),),
+              {"h_w": (4, 4), "offset": (1, 1)}),
+    "im2col": C(lambda: (A(2, 3, 5, 5),),
+                {"kernel": (3, 3), "pad": (1, 1)}),
+    "col2im": C(lambda: (A(2, 27, 25),),
+                {"output_size": (5, 5), "kernel": (3, 3),
+                 "pad": (1, 1)}),
+    "deformable_convolution": C(
+        lambda: (A(2, 4, 6, 6), A(2, 18, 6, 6, lo=-0.4, hi=0.4),
+                 A(8, 4, 3, 3, lo=-0.5, hi=0.5)),
+        {"kernel": (3, 3), "pad": (1, 1), "num_filter": 8,
+         "no_bias": True}, bf16=False),
+    "Correlation": C(lambda: (A(2, 4, 5, 5), A(2, 4, 5, 5)),
+                     {"max_displacement": 1, "pad_size": 1}),
+    "multibox_prior": C(lambda: (A(1, 3, 4, 4),),
+                        {"sizes": (0.5, 0.25), "ratios": (1.0, 2.0)},
+                        grad=False),
+    "multibox_target": C(
+        lambda: (_BOXES(8)[None], _MB_LABEL(), A(2, 4, 8, lo=0.0,
+                                                 hi=1.0)),
+        {"overlap_threshold": 0.3}, grad=False, bf16=False),
+    "multibox_detection": C(
+        lambda: (POS(2, 4, 8, lo=0.01, hi=1.0), A(2, 32, lo=-0.3,
+                                                  hi=0.3),
+                 _BOXES(8)[None]),
+        {"nms_threshold": 0.5}, grad=False, bf16=False),
+    "fft": C(lambda: (A(2, 8),), grad=False),
+    "ifft": C(lambda: (A(2, 16),), grad=False),
     # -- bounding boxes --------------------------------------------------
     "box_iou": C(lambda: (_BOXES(3), _BOXES(2)), grad=False),
     # nms decisions are discontinuous in the overlap threshold: bf16
@@ -366,6 +421,10 @@ SKIP = {
             "tests/test_control_flow.py",
     "Custom": "user-extension dispatch op (callable registry, host "
               "callback); covered by tests/test_custom_op.py",
+    "MakeLoss": "custom_vjp carries the 'output IS the loss' gradient "
+                "contract (grad_scale, incoming cotangent ignored): "
+                "autodiff deliberately diverges from the numeric "
+                "jacobian; semantics in tests/test_legacy_vision_ops.py",
 }
 
 
