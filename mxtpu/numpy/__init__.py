@@ -282,7 +282,24 @@ _JNP_FUNCS = [
     "result_type", "can_cast",
     "real", "imag", "conj", "angle", "diff", "ediff1d", "gradient",
     "convolve", "correlate", "vander", "heaviside", "nan_to_num",
+    # round-4 tail: statistics / float-representation / misc
+    "percentile", "quantile", "nanpercentile", "nanquantile", "cov",
+    "corrcoef", "logaddexp", "logaddexp2", "signbit", "float_power",
+    "divmod", "modf", "frexp", "ldexp", "nextafter", "polyval",
+    "ravel_multi_index",
 ]
+
+
+def apply_along_axis(func1d, axis, arr, *args, **kwargs):
+    """mx.np.apply_along_axis: func1d receives mx.np ndarray slices and
+    may return ndarrays or raw arrays (jnp vmap-traces it, so the
+    wrapper unwraps on both sides)."""
+
+    def f(a):
+        out = func1d(ndarray(a), *args, **kwargs)
+        return out._data if isinstance(out, NDArray) else out
+
+    return _apply(lambda x: jnp.apply_along_axis(f, axis, x), arr)
 
 
 def _jnp_func(name):

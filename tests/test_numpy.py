@@ -206,3 +206,24 @@ def test_npx_gamma_is_gamma_function():
     onp.testing.assert_allclose(g.asnumpy(), [2.0, 6.0], rtol=1e-5)
     gl = npx.gammaln(np.array([3.0]))
     onp.testing.assert_allclose(gl.asnumpy(), [onp.log(2.0)], rtol=1e-5)
+
+
+def test_np_round4_tail_surface():
+    """Statistics / float-representation names added in round 4."""
+    a = np.array([[1.0, 2, 3], [4, 5, 6]])
+    assert abs(float(np.percentile(a, 50)) - 3.5) < 1e-5
+    assert abs(float(np.quantile(a, 0.5)) - 3.5) < 1e-5
+    assert np.cov(a).shape == (2, 2)
+    cc = np.corrcoef(a)
+    assert abs(float(cc[0, 1]) - 1.0) < 1e-5  # rows perfectly correlated
+    q, r = np.divmod(np.array([7.0, 9.0]), 2.0)
+    assert (q.asnumpy() == [3, 4]).all() and (r.asnumpy() == [1, 1]).all()
+    m, e = np.frexp(np.array([8.0]))
+    assert float(m[0]) == 0.5 and int(e[0]) == 4
+    assert bool(np.signbit(np.array([-1.0]))[0])
+    assert float(np.float_power(np.array([2.0]), 10)[0]) == 1024.0
+    # results stay mx.np ndarrays (subclass propagation)
+    assert type(np.logaddexp(a, a)) is type(a)
+    # apply_along_axis traces func1d written in mx.np ops
+    s = np.apply_along_axis(lambda r: np.sum(r) * 2, 1, a)
+    assert (s.asnumpy() == [12.0, 30.0]).all()

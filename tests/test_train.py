@@ -104,3 +104,47 @@ def test_llama_train_example_loss_decreases():
                        "--batch-size", "8", "--seq-len", "32"])
     assert len(losses) == 16
     assert losses[-1] < losses[0] * 0.8, (losses[0], losses[-1])
+
+
+def test_ssd_example_trains_and_localizes():
+    """Drive examples/gluon/ssd.py: multibox train loop + NMS decode.
+    The IoU assertion guards head/anchor ORDER alignment — a scrambled
+    flatten still halves the background-dominated loss, but cannot
+    localize."""
+    import importlib.util as ilu
+    import os
+
+    path = os.path.join(os.path.dirname(__file__), "..", "examples",
+                        "gluon", "ssd.py")
+    spec = ilu.spec_from_file_location("ssd_example", path)
+    mod = ilu.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+
+    losses, net = mod.main(["--steps", "60", "--batch-size", "16"],
+                           return_net=True)
+    assert losses[-1] < losses[0] * 0.25, (losses[0], losses[-1])
+
+    rng = np.random.RandomState(99)
+    X, labels = mod.synthetic_batch(rng, 8)
+    cls_pred, loc_pred, anchors = net(X)
+    det = mx.nd.contrib.MultiBoxDetection(
+        mx.nd.softmax(cls_pred, axis=1), loc_pred, anchors,
+        threshold=0.1, nms_threshold=0.45).asnumpy()
+
+    def iou(a, b):
+        tl = np.maximum(a[:2], b[:2])
+        br = np.minimum(a[2:], b[2:])
+        wh = np.maximum(br - tl, 0)
+        inter = wh[0] * wh[1]
+        ua = ((a[2] - a[0]) * (a[3] - a[1])
+              + (b[2] - b[0]) * (b[3] - b[1]) - inter)
+        return inter / ua if ua > 0 else 0.0
+
+    hits = 0
+    for b in range(8):
+        gts = labels.asnumpy()[b]
+        gts = gts[gts[:, 0] >= 0]
+        kept = det[b][det[b, :, 1] > 0]
+        if any(iou(k[2:], g[1:]) > 0.25 for k in kept[:5] for g in gts):
+            hits += 1
+    assert hits >= 4, "only %d/8 images localized a GT box" % hits
