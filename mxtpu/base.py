@@ -52,6 +52,7 @@ class OpSpec(NamedTuple):
     fn: Callable[..., Any]
     differentiable: bool = True
     aliases: Sequence[str] = ()
+    num_outputs: Optional[int] = None
 
 
 _OP_REGISTRY: Dict[str, OpSpec] = {}
@@ -61,6 +62,7 @@ def register_op(
     name: Optional[str] = None,
     differentiable: bool = True,
     aliases: Sequence[str] = (),
+    num_outputs: Optional[int] = None,
 ):
     """Decorator registering a jax-level function as an mxtpu operator.
 
@@ -72,7 +74,8 @@ def register_op(
 
     def wrap(fn: Callable[..., Any]) -> Callable[..., Any]:
         opname = name or fn.__name__
-        spec = OpSpec(opname, fn, differentiable, tuple(aliases))
+        spec = OpSpec(opname, fn, differentiable, tuple(aliases),
+                      num_outputs)
         if opname in _OP_REGISTRY:
             raise ValueError(f"operator {opname!r} registered twice")
         _OP_REGISTRY[opname] = spec

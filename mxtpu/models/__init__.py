@@ -15,3 +15,5 @@ from .transformer import (MultiHeadAttention, TransformerEncoderLayer,
                           LlamaDecoderLayer, TransformerLM, llama_tiny,
                           llama_3_8b, transformer_lm_sharding_rules,
                           bert_sharding_rules)
+from . import moe
+from .moe import SwitchMoE, MoEDecoderLayer, moe_sharding_rules

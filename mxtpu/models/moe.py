@@ -1,0 +1,113 @@
+"""Expert-parallel Mixture-of-Experts blocks (SURVEY §2.3 row 59; no
+reference analogue — the reference's distributed story stops at ps-lite
+data parallelism.  TPU-first design: static-capacity Switch routing in
+ops/moe.py, expert weights sharded over the mesh "ep" axis so GSPMD
+lowers dispatch/combine einsums into expert all-to-alls over ICI).
+"""
+
+from __future__ import annotations
+
+
+from ..gluon.block import HybridBlock
+from ..parallel.sharding import ShardingRules, PartitionSpec as P
+
+__all__ = ["SwitchMoE", "MoEDecoderLayer", "moe_sharding_rules"]
+
+
+def _is_tracer(x):
+    """True for jit tracers AND Symbols — anything that must not be
+    stored on the block as eager state."""
+    import jax
+
+    from ..symbol.symbol import Symbol
+
+    if isinstance(x, Symbol):
+        return True
+    data = getattr(x, "_data", x)
+    return isinstance(data, jax.core.Tracer)
+
+
+class SwitchMoE(HybridBlock):
+    """Switch-Transformer FFN: top-1 routed experts, static capacity.
+
+    Dropped tokens (over capacity) contribute zero — use inside a
+    residual block.
+
+    Load-balancing aux loss: with ``return_aux=True`` the forward
+    returns ``(y, aux)`` so the caller threads aux into the training
+    loss — the ONLY mechanism that works under hybridize/SPMDTrainer
+    jit, where a Python side effect would leak a tracer.  In eager mode
+    ``self.aux_loss`` is additionally updated after each forward as a
+    convenience (it is NOT updated inside compiled graphs).
+    """
+
+    def __init__(self, units, hidden_size, num_experts,
+                 capacity_factor=1.25, activation="swish",
+                 return_aux=False, **kwargs):
+        super().__init__(**kwargs)
+        self._E = num_experts
+        self._cf = capacity_factor
+        self._act = activation
+        self._return_aux = return_aux
+        with self.name_scope():
+            self.router_weight = self.params.get(
+                "router_weight", shape=(num_experts, units),
+                init="xavier")
+            self.experts_w1 = self.params.get(
+                "experts_w1", shape=(num_experts, units, hidden_size),
+                init="xavier")
+            self.experts_w2 = self.params.get(
+                "experts_w2", shape=(num_experts, hidden_size, units),
+                init="xavier")
+        self.aux_loss = None
+
+    def hybrid_forward(self, F, x, router_weight, experts_w1,
+                       experts_w2):
+        y, aux = F.switch_moe(x, router_weight, experts_w1, experts_w2,
+                              capacity_factor=self._cf,
+                              activation=self._act)
+        if not _is_tracer(aux):  # eager convenience only — never store
+            self.aux_loss = aux  # a tracer on the block (jit leak)
+        if self._return_aux:
+            return y, aux
+        return y
+
+
+class MoEDecoderLayer(HybridBlock):
+    """LlamaDecoderLayer with the SwiGLU FFN swapped for SwitchMoE
+    (pre-RMSNorm residual structure preserved)."""
+
+    def __init__(self, units, hidden_size, num_heads, num_kv_heads,
+                 num_experts, capacity_factor=1.25, mesh=None,
+                 return_aux=False, **kwargs):
+        super().__init__(**kwargs)
+        from .transformer import MultiHeadAttention, RMSNorm
+        self._return_aux = return_aux
+        with self.name_scope():
+            self.attn_norm = RMSNorm(units, prefix="attn_norm_")
+            self.attn = MultiHeadAttention(
+                units, num_heads, num_kv_heads, use_rotary=True,
+                causal=True, mesh=mesh, use_bias=False, prefix="attn_")
+            self.ffn_norm = RMSNorm(units, prefix="ffn_norm_")
+            self.moe = SwitchMoE(units, hidden_size, num_experts,
+                                 capacity_factor, return_aux=return_aux,
+                                 prefix="moe_")
+
+    def hybrid_forward(self, F, x):
+        x = x + self.attn(self.attn_norm(x))
+        if self._return_aux:
+            y, aux = self.moe(self.ffn_norm(x))
+            return x + y, aux
+        return x + self.moe(self.ffn_norm(x))
+
+
+def moe_sharding_rules(base=None):
+    """Expert weights over "ep"; router replicated.  Compose with the
+    transformer rules for tp x ep meshes."""
+    out = ShardingRules([
+        (r"experts_w1$", P("ep", None, None)),
+        (r"experts_w2$", P("ep", None, None)),
+    ])
+    if base is not None:
+        out.extend(base)
+    return out

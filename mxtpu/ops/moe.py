@@ -1,0 +1,68 @@
+"""Mixture-of-Experts ops (SURVEY §2.3 row 59 — EP/MoE, absent in the
+reference; built TPU-first: static-capacity Switch routing with one-hot
+dispatch/combine einsums, the GShard/Switch-Transformer formulation that
+GSPMD turns into expert all-to-alls when the expert dimension is sharded
+over the mesh "ep" axis).
+
+The routing decision (top-1 argmax) is discrete; gradients flow through
+the selected gate probability (standard Switch straight-through) and the
+load-balancing auxiliary loss keeps the router trainable.
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from ..base import register_op
+
+
+@register_op("switch_moe", num_outputs=2)
+def switch_moe(x, router_w, w1, w2, capacity_factor=1.25,
+               activation="swish"):
+    """Switch-Transformer FFN.
+
+    x (B, T, d) or (S, d); router_w (E, d) — Dense (out, in) layout;
+    w1 (E, d, h); w2 (E, h, d).  Returns (y, aux_loss): y matches x's
+    shape with dropped-token rows zeroed (callers add the residual), aux
+    is the E * sum(f_e * p_e) load-balancing scalar.
+    """
+    orig_shape = x.shape
+    d = orig_shape[-1]
+    xf = x.reshape(-1, d)
+    S = xf.shape[0]
+    E = router_w.shape[0]
+    cdt = jnp.float32
+
+    logits = jnp.dot(xf.astype(cdt), router_w.astype(cdt).T)  # (S, E)
+    gates = jax.nn.softmax(logits, axis=-1)
+    idx = jnp.argmax(gates, axis=-1)                          # (S,)
+    gate = jnp.max(gates, axis=-1)                            # (S,)
+    onehot = jax.nn.one_hot(idx, E, dtype=cdt)                # (S, E)
+
+    capacity = max(1, int(math.ceil(S / E * capacity_factor)))
+    pos = jnp.cumsum(onehot, axis=0) * onehot                 # 1-based
+    my_pos = jnp.sum(pos, axis=-1)                            # (S,)
+    within = (my_pos >= 1) & (my_pos <= capacity)
+    slot = jax.nn.one_hot((my_pos - 1).astype(jnp.int32), capacity,
+                          dtype=cdt) * within[:, None].astype(cdt)
+    disp = onehot[:, :, None] * slot[:, None, :]              # (S, E, C)
+
+    xe = jnp.einsum("sec,sd->ecd", disp, xf.astype(cdt))
+    h = jnp.einsum("ecd,edh->ech", xe, w1.astype(cdt))
+    if activation == "swish":
+        h = h * jax.nn.sigmoid(h)
+    elif activation == "gelu":
+        h = jax.nn.gelu(h)
+    else:
+        h = jax.nn.relu(h)
+    ye = jnp.einsum("ech,ehd->ecd", h, w2.astype(cdt))
+    y = jnp.einsum("sec,ecd->sd", disp * gate[:, None, None], ye)
+
+    # Switch load-balancing loss: E * sum_e fraction_e * router_prob_e
+    frac = jnp.mean(onehot, axis=0)
+    prob = jnp.mean(gates, axis=0)
+    aux = E * jnp.sum(jax.lax.stop_gradient(frac) * prob)
+    return y.reshape(orig_shape).astype(x.dtype), aux.astype(jnp.float32)

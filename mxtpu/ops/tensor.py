@@ -922,3 +922,13 @@ def linalg_syevd(a):
     in U's ROWS (reference syevd layout; jax.eigh returns columns)."""
     w, v = jnp.linalg.eigh(a)
     return jnp.swapaxes(v, -1, -2), w
+
+
+@register_op("einsum")
+def einsum_op(*operands, equation=""):
+    """General einsum (parity: mx.np.einsum surfaced as a registry op so
+    Symbol/hybridize graphs can use it; equation is a static string)."""
+    if not equation:
+        raise ValueError("einsum requires equation=")
+    return jnp.einsum(equation, *operands,
+                      precision=matmul_precision(*operands))

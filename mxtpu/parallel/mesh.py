@@ -18,7 +18,9 @@ from jax.sharding import Mesh
 __all__ = ["DeviceMesh", "make_mesh", "init_process_group", "rank",
            "num_workers"]
 
-_AXIS_ORDER = ("dp", "pp", "sp", "tp")  # tp innermost: highest-bandwidth ICI
+_AXIS_ORDER = ("dp", "pp", "ep", "sp", "tp")  # tp innermost: highest-
+# bandwidth ICI; ep (expert parallel) between pp and sp — expert
+# all-to-alls are chunkier than sp ring hops but rarer than tp collectives
 
 
 def init_process_group(coordinator_address: Optional[str] = None,
@@ -56,7 +58,7 @@ def num_workers() -> int:
 
 
 class DeviceMesh:
-    """A named device mesh with dp/pp/sp/tp axes.
+    """A named device mesh with dp/pp/ep/sp/tp axes.
 
     Thin, picklable-spec wrapper over jax.sharding.Mesh; `mesh.jax_mesh` is
     the object pjit consumes. Axis sizes of 1 are kept (harmless for
@@ -64,15 +66,16 @@ class DeviceMesh:
     """
 
     def __init__(self, dp: int = 1, tp: int = 1, sp: int = 1, pp: int = 1,
-                 devices=None):
+                 ep: int = 1, devices=None):
         if devices is None:
             devices = jax.devices()
-        need = dp * tp * sp * pp
+        need = dp * tp * sp * pp * ep
         if need > len(devices):
             raise ValueError(
-                f"mesh dp*tp*sp*pp={need} exceeds {len(devices)} devices")
+                f"mesh dp*tp*sp*pp*ep={need} exceeds {len(devices)} "
+                "devices")
         devices = devices[:need]
-        sizes = {"dp": dp, "pp": pp, "sp": sp, "tp": tp}
+        sizes = {"dp": dp, "pp": pp, "ep": ep, "sp": sp, "tp": tp}
         shape = tuple(sizes[a] for a in _AXIS_ORDER)
         arr = onp.asarray(devices).reshape(shape)
         self.axis_sizes = sizes
@@ -105,8 +108,9 @@ class DeviceMesh:
 
 
 def make_mesh(dp: int = 1, tp: int = 1, sp: int = 1, pp: int = 1,
-              devices=None) -> DeviceMesh:
+              ep: int = 1, devices=None) -> DeviceMesh:
     """Build a DeviceMesh; with no arguments, all local devices go to dp."""
-    if dp == 1 and tp == 1 and sp == 1 and pp == 1 and devices is None:
+    if dp == 1 and tp == 1 and sp == 1 and pp == 1 and ep == 1 \
+            and devices is None:
         dp = len(jax.devices())
-    return DeviceMesh(dp=dp, tp=tp, sp=sp, pp=pp, devices=devices)
+    return DeviceMesh(dp=dp, tp=tp, sp=sp, pp=pp, ep=ep, devices=devices)

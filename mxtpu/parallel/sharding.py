@@ -31,6 +31,12 @@ class ShardingRules:
         self._rules.append((re.compile(pattern), spec))
         return self
 
+    def extend(self, other: "ShardingRules"):
+        """Append another ruleset's rules (lower precedence — earlier
+        rules win in spec_for's first-match scan)."""
+        self._rules.extend(other._rules)
+        return self
+
     def spec_for(self, name: str, ndim: int) -> PartitionSpec:
         for pat, spec in self._rules:
             if pat.search(name):

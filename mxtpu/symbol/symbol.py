@@ -118,6 +118,10 @@ class Symbol:
         inputs = sym_positional + [v for _, v in kw_syms]
         node = _Node(spec.name, inputs, layout, static_kwargs, name,
                      attr, kw_sym_names=[k for k, _ in kw_syms])
+        if spec.num_outputs is not None:
+            # declared static output count: tuple unpacking of a freshly
+            # built multi-output node works before any evaluation
+            node.num_outputs = spec.num_outputs
         return Symbol(node)
 
     @property
@@ -183,7 +187,14 @@ class Symbol:
 
     @property
     def num_outputs(self):
-        return len(self._output_entries())
+        entries = self._output_entries()
+        if (len(entries) == 1 and entries[0][1] == 0
+                and entries[0][0].num_outputs > 1):
+            # base symbol of a multi-output node: iterate ITS outputs
+            # (mirrors __getitem__'s selection semantics, so tuple
+            # unpacking of a freshly built multi-output op works)
+            return entries[0][0].num_outputs
+        return len(entries)
 
     def __getitem__(self, idx):
         if isinstance(idx, str):

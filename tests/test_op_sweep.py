@@ -253,6 +253,8 @@ CASES.update({
         {"k": 2}, grad=False),
     "shape_array": C(lambda: (A(3, 4),), grad=False),
     "size_array": C(lambda: (A(3, 4),), grad=False),
+    "einsum": C(lambda: (A(3, 4), A(4, 5)),
+                {"equation": "ij,jk->ik"}),
     # -- spatial transform / legacy vision (round 4) ---------------------
     "LRN": C(lambda: (POS(2, 8, 6, 6),)),
     "GridGenerator": C(lambda: (A(2, 6, lo=-0.5, hi=0.5),),
@@ -421,6 +423,9 @@ SKIP = {
             "tests/test_control_flow.py",
     "Custom": "user-extension dispatch op (callable registry, host "
               "callback); covered by tests/test_custom_op.py",
+    "switch_moe": "discrete top-1 routing: numeric gradients cross "
+                  "routing decision boundaries by construction; value + "
+                  "gradient + ep-sharding covered by tests/test_moe.py",
     "MakeLoss": "custom_vjp carries the 'output IS the loss' gradient "
                 "contract (grad_scale, incoming cotangent ignored): "
                 "autodiff deliberately diverges from the numeric "
