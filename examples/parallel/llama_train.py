@@ -29,16 +29,29 @@ from mxtpu.parallel import make_mesh, PartitionSpec as P, SPMDTrainer
 VOCAB = 512  # synthetic-corpus vocab; real runs pass their tokenizer's
 
 
-class NextTokenLoss(gluon.loss.Loss):
-    """Shifted cross-entropy: predict token t+1 from prefix <= t."""
+class NextTokenLoss:
+    """Shifted cross-entropy: predict token t+1 from prefix <= t.
+    A plain callable (not a gluon Loss block — those type-check for a
+    single NDArray input): with moe_aux_weight > 0 it consumes
+    (logits, aux) model outputs and adds the Switch load-balancing term
+    (accepts_full_output opts into SPMDTrainer handing over the whole
+    output tuple)."""
 
-    def __init__(self):
-        super().__init__(1.0, 0)
+    accepts_full_output = True
+
+    def __init__(self, moe_aux_weight=0.0):
         self._ce = gluon.loss.SoftmaxCrossEntropyLoss()
+        self._aux_w = moe_aux_weight
 
-    def hybrid_forward(self, F, logits, labels):
-        return self._ce(logits[:, :-1].reshape((-1, logits.shape[-1])),
+    def __call__(self, logits, labels):
+        aux = None
+        if isinstance(logits, tuple):
+            logits, aux = logits
+        loss = self._ce(logits[:, :-1].reshape((-1, logits.shape[-1])),
                         labels[:, 1:].reshape((-1,)))
+        if aux is not None and self._aux_w:
+            loss = loss + self._aux_w * aux
+        return loss
 
 
 def synthetic_batches(batch, seq, steps, seed=0):
@@ -59,6 +72,9 @@ def main(argv=None):
     ap.add_argument("--dp", type=int, default=2)
     ap.add_argument("--tp", type=int, default=2)
     ap.add_argument("--sp", type=int, default=2)
+    ap.add_argument("--ep", type=int, default=1)
+    ap.add_argument("--experts", type=int, default=0,
+                    help="experts per MoE layer (0 = dense SwiGLU)")
     ap.add_argument("--batch-size", type=int, default=8)
     ap.add_argument("--seq-len", type=int, default=32)
     ap.add_argument("--steps", type=int, default=50)
@@ -69,15 +85,21 @@ def main(argv=None):
                     help="tokens to decode after training (0 = skip)")
     args = ap.parse_args(argv)
 
-    mesh = make_mesh(dp=args.dp, tp=args.tp, sp=args.sp)
+    mesh = make_mesh(dp=args.dp, tp=args.tp, sp=args.sp, ep=args.ep)
     print("mesh:", mesh)
 
     lm = transformer.llama_3_8b(vocab_size=VOCAB, mesh=mesh,
                                 width_factor=args.width_factor,
-                                depth_factor=args.depth_factor)
+                                depth_factor=args.depth_factor,
+                                num_experts=args.experts or None,
+                                return_moe_aux=bool(args.experts))
     lm.initialize()
     rules = transformer.transformer_lm_sharding_rules()
-    trainer = SPMDTrainer(lm, NextTokenLoss(), "adam", mesh, rules,
+    if args.experts:
+        from mxtpu.models import moe_sharding_rules
+        rules = moe_sharding_rules(rules)  # experts over "ep" first
+    loss_fn = NextTokenLoss(moe_aux_weight=0.01 if args.experts else 0.0)
+    trainer = SPMDTrainer(lm, loss_fn, "adam", mesh, rules,
                           {"learning_rate": args.lr},
                           batch_spec=P("dp", "sp"),
                           label_spec=P("dp", "sp"))

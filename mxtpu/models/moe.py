@@ -72,6 +72,20 @@ class SwitchMoE(HybridBlock):
             return y, aux
         return y
 
+    def decode_forward(self, x):
+        """Capacity-UNBOUNDED imperative forward for incremental decode:
+        a decode step routes only B tokens, so the training capacity
+        (ceil(S/E * cf)) would spuriously zero tokens the full-context
+        forward kept.  Inference MoE conventionally drops nothing."""
+        from .. import ndarray as nd
+
+        ctx = x.context
+        y, _ = nd.switch_moe(x, self.router_weight.data(ctx),
+                             self.experts_w1.data(ctx),
+                             self.experts_w2.data(ctx),
+                             capacity_factor=0.0, activation=self._act)
+        return y
+
 
 class MoEDecoderLayer(HybridBlock):
     """LlamaDecoderLayer with the SwiGLU FFN swapped for SwitchMoE
@@ -99,6 +113,15 @@ class MoEDecoderLayer(HybridBlock):
             y, aux = self.moe(self.ffn_norm(x))
             return x + y, aux
         return x + self.moe(self.ffn_norm(x))
+
+    def step(self, x, cache_k, cache_v, pos):
+        """One-token KV-cache decode (mirrors LlamaDecoderLayer.step;
+        the routed FFN runs capacity-unbounded — see decode_forward)."""
+        h, cache_k, cache_v = self.attn.step(self.attn_norm(x),
+                                             cache_k, cache_v, pos)
+        x = x + h
+        return x + self.moe.decode_forward(self.ffn_norm(x)), \
+            cache_k, cache_v
 
 
 def moe_sharding_rules(base=None):

@@ -316,18 +316,30 @@ class TransformerLM(HybridBlock):
     """
 
     def __init__(self, vocab_size, units, hidden_size, num_layers, num_heads,
-                 num_kv_heads=None, mesh=None, tie_weights=False, **kwargs):
+                 num_kv_heads=None, mesh=None, tie_weights=False,
+                 num_experts=None, capacity_factor=1.25,
+                 return_moe_aux=False, **kwargs):
         super().__init__(**kwargs)
         self._units = units
         self._tie = tie_weights
+        self._return_moe_aux = bool(return_moe_aux and num_experts)
         with self.name_scope():
             self.embed = nn.Embedding(vocab_size, units, prefix="embed_")
             self.layers = nn.HybridSequential(prefix="layers_")
             for i in range(num_layers):
-                self.layers.add(LlamaDecoderLayer(
-                    units, hidden_size, num_heads,
-                    num_kv_heads or num_heads, mesh=mesh,
-                    prefix="layer%d_" % i))
+                if num_experts:
+                    from .moe import MoEDecoderLayer
+                    self.layers.add(MoEDecoderLayer(
+                        units, hidden_size, num_heads,
+                        num_kv_heads or num_heads, num_experts,
+                        capacity_factor, mesh=mesh,
+                        return_aux=self._return_moe_aux,
+                        prefix="layer%d_" % i))
+                else:
+                    self.layers.add(LlamaDecoderLayer(
+                        units, hidden_size, num_heads,
+                        num_kv_heads or num_heads, mesh=mesh,
+                        prefix="layer%d_" % i))
             self.norm = RMSNorm(units, prefix="norm_")
             if not tie_weights:
                 self.lm_head = nn.Dense(vocab_size, use_bias=False,
@@ -336,13 +348,24 @@ class TransformerLM(HybridBlock):
 
     def hybrid_forward(self, F, token_ids):
         x = self.embed(token_ids)
+        aux_total = None
         for layer in self.layers:
-            x = layer(x)
+            if self._return_moe_aux:
+                x, aux = layer(x)
+                aux_total = aux if aux_total is None else aux_total + aux
+            else:
+                x = layer(x)
         x = self.norm(x)
         if self._tie:
             w = self.embed.weight.data(x.context)
-            return F.dot(x, w, transpose_b=True)
-        return self.lm_head(x)
+            logits = F.dot(x, w, transpose_b=True)
+        else:
+            logits = self.lm_head(x)
+        if self._return_moe_aux:
+            # mean over layers: the Switch load-balancing term, for the
+            # caller's loss (jit-safe — threaded through outputs)
+            return logits, aux_total / len(self.layers)
+        return logits
 
     # -- incremental decode --------------------------------------------
     def init_cache(self, batch_size, max_length, dtype="float32"):

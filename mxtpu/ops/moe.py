@@ -28,6 +28,10 @@ def switch_moe(x, router_w, w1, w2, capacity_factor=1.25,
     w1 (E, d, h); w2 (E, h, d).  Returns (y, aux_loss): y matches x's
     shape with dropped-token rows zeroed (callers add the residual), aux
     is the E * sum(f_e * p_e) load-balancing scalar.
+
+    capacity_factor <= 0 disables the capacity limit entirely (capacity
+    = S): the incremental-decode configuration, where a step sees only
+    B tokens and the training capacity would spuriously drop them.
     """
     orig_shape = x.shape
     d = orig_shape[-1]
@@ -42,7 +46,10 @@ def switch_moe(x, router_w, w1, w2, capacity_factor=1.25,
     gate = jnp.max(gates, axis=-1)                            # (S,)
     onehot = jax.nn.one_hot(idx, E, dtype=cdt)                # (S, E)
 
-    capacity = max(1, int(math.ceil(S / E * capacity_factor)))
+    if capacity_factor <= 0:
+        capacity = S  # unbounded: nothing can drop
+    else:
+        capacity = max(1, int(math.ceil(S / E * capacity_factor)))
     pos = jnp.cumsum(onehot, axis=0) * onehot                 # 1-based
     my_pos = jnp.sum(pos, axis=-1)                            # (S,)
     within = (my_pos >= 1) & (my_pos <= capacity)

@@ -127,8 +127,14 @@ class SPMDTrainer:
             try:
                 with autograd.pause(train_mode=True):
                     out = block(NDArray(batch))
-                    out0 = out[0] if isinstance(out, tuple) else out
-                    loss = loss_fn(out0, NDArray(label))
+                    # multi-output blocks: by default the loss sees the
+                    # FIRST output; a loss with accepts_full_output=True
+                    # receives the whole tuple (e.g. MoE auxiliary
+                    # load-balancing terms threaded through outputs)
+                    if isinstance(out, tuple) and not getattr(
+                            loss_fn, "accepts_full_output", False):
+                        out = out[0]
+                    loss = loss_fn(out, NDArray(label))
                     loss_scalar = loss.mean()._data
                 new_aux = tuple(p.data()._data for p in aux_params)
             finally:

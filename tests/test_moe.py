@@ -177,3 +177,42 @@ def test_moe_symbol_trace_and_unpack():
     ex = sym.bind(mx.cpu(), {k: feed[k] for k in sym.list_arguments()})
     got = ex.forward()[0].asnumpy()
     np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-6)
+
+
+def test_moe_transformer_lm_decode_parity():
+    """TransformerLM(num_experts=..) : KV-cache step must reproduce the
+    full-context forward through the routed FFN layers too."""
+    from mxtpu.models.transformer import TransformerLM
+
+    lm = TransformerLM(vocab_size=40, units=32, hidden_size=64,
+                       num_layers=2, num_heads=4, num_kv_heads=2,
+                       num_experts=4, capacity_factor=4.0)
+    lm.initialize()
+    ids = nd.array(np.random.RandomState(8).randint(0, 40, (2, 5)),
+                   dtype="int32")
+    full = lm(ids).asnumpy()
+    caches = lm.init_cache(2, 5)
+    for pos in range(5):
+        logits, caches = lm.step(ids[:, pos:pos + 1], caches, pos)
+    np.testing.assert_allclose(logits.asnumpy()[:, 0], full[:, -1],
+                               rtol=2e-4, atol=2e-5)
+
+
+def test_decode_forward_capacity_unbounded():
+    """Incremental decode must not inherit the training capacity: with a
+    zero router every token routes to expert 0, so at S=2/E=4 the
+    training path (capacity 1) zeroes a row while decode_forward keeps
+    both (the round-4 review's generation-divergence finding)."""
+    rng = np.random.RandomState(9)
+    blk = SwitchMoE(4, 8, 4, capacity_factor=1.25)
+    blk.initialize()
+    blk.router_weight.set_data(nd.array(np.zeros((4, 4), "f")))
+    x = nd.array(rng.randn(2, 1, 4).astype("f"))
+
+    y_train = blk(x).asnumpy()
+    nz_train = (np.abs(y_train).sum(axis=-1) > 1e-7).sum()
+    assert nz_train == 1  # capacity ceil(2/4*1.25)=1: one row dropped
+
+    y_dec = blk.decode_forward(x).asnumpy()
+    nz_dec = (np.abs(y_dec).sum(axis=-1) > 1e-7).sum()
+    assert nz_dec == 2  # decode drops nothing
