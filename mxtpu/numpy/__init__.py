@@ -419,6 +419,124 @@ class _Random:
         perm = jax.random.permutation(self._key(), a.shape[0])
         a._rebind(jnp.take(a._data, perm, axis=0))
 
+    def permutation(self, x):
+        if isinstance(x, int):
+            return ndarray(jax.random.permutation(self._key(), x))
+        arr = x._data if isinstance(x, NDArray) else jnp.asarray(x)
+        return ndarray(jax.random.permutation(self._key(), arr, axis=0))
+
+    # -- distribution tail (numpy.random parity; inverse-CDF or
+    # jax.random primitives over the global key ring) -------------------
+    @staticmethod
+    def _shape(size):
+        return () if size is None else tuple(onp.atleast_1d(size))
+
+    @staticmethod
+    def _pshape(size, *params):
+        """Output shape: explicit size, else the numpy-style broadcast
+        of the (possibly array-valued) distribution parameters — one
+        INDEPENDENT draw per output element."""
+        if size is not None:
+            return tuple(onp.atleast_1d(size))
+        shapes = [onp.shape(p._data if isinstance(p, NDArray) else p)
+                  for p in params]
+        return onp.broadcast_shapes(*shapes) if shapes else ()
+
+    def _u(self, size, *params):
+        """Uniform in the OPEN interval (0, 1): the inverse-CDF sampled
+        distributions below hit log(0)/division at the endpoints."""
+        tiny = onp.finfo("float32").tiny
+        return jax.random.uniform(self._key(),
+                                  self._pshape(size, *params),
+                                  minval=tiny, maxval=1.0)
+
+    def beta(self, a, b, size=None):
+        return ndarray(jax.random.beta(
+            self._key(), _unwrap(a), _unwrap(b),
+            self._pshape(size, a, b)))
+
+    def gamma(self, shape, scale=1.0, size=None):
+        return ndarray(jax.random.gamma(
+            self._key(), _unwrap(shape),
+            self._pshape(size, shape, scale)) * _unwrap(scale))
+
+    def exponential(self, scale=1.0, size=None):
+        return ndarray(jax.random.exponential(
+            self._key(), self._pshape(size, scale)) * _unwrap(scale))
+
+    def chisquare(self, df, size=None):
+        return ndarray(2.0 * jax.random.gamma(
+            self._key(), _unwrap(df) / 2.0, self._pshape(size, df)))
+
+    def f(self, dfnum, dfden, size=None):
+        shape = self._pshape(size, dfnum, dfden)
+        dfnum, dfden = _unwrap(dfnum), _unwrap(dfden)
+        num = jax.random.gamma(self._key(), dfnum / 2.0, shape) / dfnum
+        den = jax.random.gamma(self._key(), dfden / 2.0, shape) / dfden
+        return ndarray(num / den)
+
+    def geometric(self, p, size=None):
+        """Trials to first success, >= 1.  float32/int32 math: results
+        clamp at 2**31 - 1 (p below ~1e-7 saturates; numpy's int64 tail
+        needs x64 mode)."""
+        u = self._u(size, p)
+        vals = jnp.floor(jnp.log(u) / jnp.log1p(-_unwrap(p))) + 1
+        return ndarray(jnp.clip(vals, 1, 2 ** 31 - 1).astype(jnp.int32))
+
+    def gumbel(self, loc=0.0, scale=1.0, size=None):
+        return ndarray(_unwrap(loc) + _unwrap(scale) * jax.random.gumbel(
+            self._key(), self._pshape(size, loc, scale)))
+
+    def laplace(self, loc=0.0, scale=1.0, size=None):
+        return ndarray(
+            _unwrap(loc) + _unwrap(scale) * jax.random.laplace(
+                self._key(), self._pshape(size, loc, scale)))
+
+    def logistic(self, loc=0.0, scale=1.0, size=None):
+        return ndarray(
+            _unwrap(loc) + _unwrap(scale) * jax.random.logistic(
+                self._key(), self._pshape(size, loc, scale)))
+
+    def lognormal(self, mean=0.0, sigma=1.0, size=None):
+        return ndarray(jnp.exp(
+            _unwrap(mean) + _unwrap(sigma) * jax.random.normal(
+                self._key(), self._pshape(size, mean, sigma))))
+
+    def pareto(self, a, size=None):
+        return ndarray(jnp.power(self._u(size, a),
+                                 -1.0 / _unwrap(a)) - 1.0)
+
+    def power(self, a, size=None):
+        return ndarray(jnp.power(self._u(size, a), 1.0 / _unwrap(a)))
+
+    def rayleigh(self, scale=1.0, size=None):
+        return ndarray(_unwrap(scale) * jnp.sqrt(
+            -2.0 * jnp.log(self._u(size, scale))))
+
+    def weibull(self, a, size=None):
+        return ndarray(jnp.power(-jnp.log(self._u(size, a)),
+                                 1.0 / _unwrap(a)))
+
+    def poisson(self, lam=1.0, size=None):
+        return ndarray(jax.random.poisson(
+            self._key(), _unwrap(lam), self._pshape(size, lam) or None))
+
+    def multinomial(self, n, pvals, size=None):
+        """Counts over len(pvals) categories from n draws (numpy
+        semantics — unlike nd.random.multinomial, which samples
+        indices).  O(n + k) memory per sample via bincount — the draw
+        tensor is never one-hot expanded."""
+        p = pvals._data if isinstance(pvals, NDArray) else jnp.asarray(
+            pvals)
+        k = p.shape[-1]
+        shape = self._shape(size)
+        draws = jax.random.categorical(
+            self._key(), jnp.log(p), shape=shape + (n,))
+        flat = draws.reshape(-1, n)
+        counts = jax.vmap(
+            lambda d: jnp.bincount(d, length=k))(flat)
+        return ndarray(counts.reshape(shape + (k,)).astype(jnp.int32))
+
     def seed(self, s):
         from .. import random as _rnd
         _rnd.seed(s)

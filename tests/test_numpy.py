@@ -227,3 +227,64 @@ def test_np_round4_tail_surface():
     # apply_along_axis traces func1d written in mx.np ops
     s = np.apply_along_axis(lambda r: np.sum(r) * 2, 1, a)
     assert (s.asnumpy() == [12.0, 30.0]).all()
+
+
+def test_np_random_distribution_tail():
+    """numpy.random parity surface: moments sanity for the round-4
+    distribution additions (seeded, generous tolerances)."""
+    npr = np.random
+    npr.seed(1234)
+    n = 20000
+
+    g = npr.gamma(3.0, 2.0, size=n).asnumpy()
+    assert abs(g.mean() - 6.0) < 0.3          # k*theta
+    e = npr.exponential(2.0, size=n).asnumpy()
+    assert abs(e.mean() - 2.0) < 0.15
+    c = npr.chisquare(4.0, size=n).asnumpy()
+    assert abs(c.mean() - 4.0) < 0.3
+    b = npr.beta(2.0, 2.0, size=n).asnumpy()
+    assert abs(b.mean() - 0.5) < 0.05
+    p = npr.poisson(3.0, size=n).asnumpy()
+    assert abs(p.mean() - 3.0) < 0.2
+    gm = npr.geometric(0.25, size=n).asnumpy()
+    assert gm.min() >= 1 and abs(gm.mean() - 4.0) < 0.3
+    ln = npr.lognormal(0.0, 0.5, size=n).asnumpy()
+    assert abs(ln.mean() - onp.exp(0.125)) < 0.1
+    r = npr.rayleigh(1.0, size=n).asnumpy()
+    assert abs(r.mean() - onp.sqrt(onp.pi / 2)) < 0.1
+    w = npr.weibull(2.0, size=n).asnumpy()
+    assert abs(w.mean() - 0.8862) < 0.1
+    lp = npr.laplace(1.0, 2.0, size=n).asnumpy()
+    assert abs(lp.mean() - 1.0) < 0.2
+
+    perm = npr.permutation(10).asnumpy()
+    assert sorted(perm.tolist()) == list(range(10))
+
+    m = npr.multinomial(100, [0.2, 0.3, 0.5], size=(4,))
+    mn = m.asnumpy()
+    assert mn.shape == (4, 3)
+    assert (mn.sum(axis=-1) == 100).all()
+    assert abs(mn[:, 2].mean() - 50) < 15
+
+
+def test_np_random_array_params_and_independence():
+    """Array-valued distribution params broadcast like numpy, with one
+    INDEPENDENT draw per element (round-4 review findings)."""
+    npr = np.random
+    npr.seed(77)
+    lam = np.array([1.0, 100.0])
+    pv = npr.poisson(lam)
+    assert pv.shape == (2,)
+    assert float(pv[1]) > float(pv[0])  # rates 1 vs 100
+    gv = npr.gamma(np.array([1.0, 400.0]))
+    assert gv.shape == (2,) and float(gv[1]) > float(gv[0])
+    # identical params -> still independent draws
+    same = npr.pareto(np.array([1.0, 1.0, 1.0, 1.0]))
+    vals = same.asnumpy()
+    assert len(onp.unique(onp.round(vals, 6))) > 1, vals
+    # loc/scale family broadcasts too
+    lv = npr.laplace(np.array([0.0, 100.0]), 1.0)
+    assert abs(float(lv[1]) - float(lv[0])) > 10
+    # tiny p saturates instead of int32-wrapping to garbage
+    gsat = npr.geometric(1e-9, size=(4,)).asnumpy()
+    assert (gsat >= 1).all() and (gsat <= 2 ** 31 - 1).all()
