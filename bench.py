@@ -159,16 +159,28 @@ def _bench_bert():
     import jax
 
     platform = jax.devices()[0].platform
-    batch, seq = 32, 128
+    # CPU fallback: a 2-layer/batch-4 sub-config, explicitly labeled —
+    # BERT-base at batch 32 cannot finish on the 1-core host within the
+    # fallback budget, which left BENCH_r04.json with 1 of 3 metrics
+    # (VERDICT r4 item 4: every metric line must print in degraded mode)
+    cpu = platform == "cpu"
+    batch, seq = (4, 32) if cpu else (32, 128)
 
     class BertForMLM(HybridBlock):
         """BERT-base with the MLM head as the training output (exercises
-        the full encoder + vocab projection: MHA, LayerNorm, GELU path)."""
+        the full encoder + vocab projection: MHA, LayerNorm, GELU path).
+        On CPU fallback a labeled 2-layer sub-config substitutes."""
 
         def __init__(self, **kw):
             super().__init__(**kw)
             with self.name_scope():
-                self.bert = transformer.bert_base(max_length=seq, dropout=0.0)
+                if cpu:
+                    self.bert = transformer.BERTModel(
+                        units=128, hidden_size=512, num_layers=2,
+                        num_heads=4, max_length=seq, dropout=0.0)
+                else:
+                    self.bert = transformer.bert_base(max_length=seq,
+                                                      dropout=0.0)
 
         def hybrid_forward(self, F, tokens):
             _seq, _pooled, mlm = self.bert(tokens)
@@ -217,6 +229,10 @@ def _bench_bert():
         "flops_note": "6ND count omits QK^T/AV attention matmuls (~8% at "
                       "seq=128): reported MFU understates utilization",
     }
+    if cpu:
+        rec["config_note"] = ("CPU fallback runs a LABELED 2-layer/"
+                              "units-128 sub-config at batch 4 — plumbing "
+                              "evidence only, NOT a BERT-base number")
     print(json.dumps(rec), flush=True)
 
 
@@ -231,8 +247,19 @@ def _bench_attention():
 
     platform = jax.devices()[0].platform
     if platform == "cpu":
-        sys.stderr.write("attention bench skipped on CPU (interpret-mode "
-                         "Pallas is a correctness tool, not a benchmark)\n")
+        # structured skip record so every BENCH_r*.json carries one
+        # parseable line per metric under every tunnel condition
+        print(json.dumps({
+            "metric": "flash_attention_fwd_bwd_tflops_seq2048",
+            "value": None,
+            "unit": "TFLOP/s",
+            "vs_baseline": None,
+            "skipped": True,
+            "platform": platform,
+            "skip_reason": "interpret-mode Pallas on CPU is a correctness "
+                           "tool, not a benchmark — metric only "
+                           "meaningful on TPU",
+        }), flush=True)
         return
 
     B, H, D = 8, 16, 64

@@ -12,7 +12,7 @@ there is no second language boundary: JAX/XLA is the executor.
 from __future__ import annotations
 
 import os
-from typing import Any, Callable, Dict, NamedTuple, Optional, Sequence
+from typing import Any, Callable, Dict, NamedTuple, Optional, Sequence, Union
 
 __all__ = [
     "MXTPUError",
@@ -45,14 +45,16 @@ class OpSpec(NamedTuple):
         jax array or tuple of arrays.
     differentiable: whether autograd should record this op (e.g. ``argmax``
         is not differentiable; recording it would fail in jax.vjp).
-    num_outputs: static output count hint (None = infer from return value).
+    num_outputs: static output count hint (None = infer from return
+        value; a callable(static_kwargs) -> int serves ops whose arity
+        depends on a static param, e.g. _sample_multinomial get_prob).
     """
 
     name: str
     fn: Callable[..., Any]
     differentiable: bool = True
     aliases: Sequence[str] = ()
-    num_outputs: Optional[int] = None
+    num_outputs: Union[int, Callable[[dict], int], None] = None
 
 
 _OP_REGISTRY: Dict[str, OpSpec] = {}
@@ -62,7 +64,7 @@ def register_op(
     name: Optional[str] = None,
     differentiable: bool = True,
     aliases: Sequence[str] = (),
-    num_outputs: Optional[int] = None,
+    num_outputs: Union[int, Callable[[dict], int], None] = None,
 ):
     """Decorator registering a jax-level function as an mxtpu operator.
 

@@ -43,12 +43,16 @@ class SwitchMoE(HybridBlock):
 
     def __init__(self, units, hidden_size, num_experts,
                  capacity_factor=1.25, activation="swish",
-                 return_aux=False, **kwargs):
+                 return_aux=False, top_k=1, router_jitter=0.0,
+                 z_loss_weight=0.0, **kwargs):
         super().__init__(**kwargs)
         self._E = num_experts
         self._cf = capacity_factor
         self._act = activation
         self._return_aux = return_aux
+        self._top_k = top_k
+        self._jitter = router_jitter
+        self._z_loss = z_loss_weight
         with self.name_scope():
             self.router_weight = self.params.get(
                 "router_weight", shape=(num_experts, units),
@@ -65,7 +69,9 @@ class SwitchMoE(HybridBlock):
                        experts_w2):
         y, aux = F.switch_moe(x, router_weight, experts_w1, experts_w2,
                               capacity_factor=self._cf,
-                              activation=self._act)
+                              activation=self._act, top_k=self._top_k,
+                              router_jitter=self._jitter,
+                              z_loss_weight=self._z_loss)
         if not _is_tracer(aux):  # eager convenience only — never store
             self.aux_loss = aux  # a tracer on the block (jit leak)
         if self._return_aux:
@@ -83,7 +89,8 @@ class SwitchMoE(HybridBlock):
         y, _ = nd.switch_moe(x, self.router_weight.data(ctx),
                              self.experts_w1.data(ctx),
                              self.experts_w2.data(ctx),
-                             capacity_factor=0.0, activation=self._act)
+                             capacity_factor=0.0, activation=self._act,
+                             top_k=self._top_k)
         return y
 
 

@@ -144,8 +144,11 @@ class MultiHeadAttention(HybridBlock):
         if self._rotary:
             q = nd.rope(q, offset=pos)
             k = nd.rope(k, offset=pos)
-        cache_k[:, :, pos:pos + 1, :] = k  # slot-rebinding scatter
-        cache_v[:, :, pos:pos + 1, :] = v
+        # dynamic_update_slice write: pos may be a python int (eager
+        # generate) or a traced scalar (ShardedDecoder's single compiled
+        # step for every position)
+        cache_k = nd._internal_cache_write(cache_k, k, pos=pos)
+        cache_v = nd._internal_cache_write(cache_v, v, pos=pos)
         # GQA without materializing repeated caches: fold the rep axis
         # into the query rows and contract against the UNrepeated cache
         # (decode is bandwidth-bound; nd.repeat would copy the whole
@@ -381,8 +384,11 @@ class TransformerLM(HybridBlock):
         return self.lm_head(x)
 
     def step(self, token_ids, caches, pos):
-        """Decode ONE token per sequence: token_ids (B, 1) → logits
-        (B, 1, V); caches updated in place (slot rebinding)."""
+        """Decode ONE token per sequence: token_ids (B, 1) → (logits
+        (B, 1, V), new_caches).  Caches are FUNCTIONAL: the passed-in
+        list is not mutated — always thread the returned new_caches into
+        the next step (this is what lets ShardedDecoder trace the step
+        with a dynamic position)."""
         x = self.embed(token_ids)
         new_caches = []
         for layer, (ck, cv) in zip(self.layers, caches):

@@ -543,11 +543,17 @@ def invoke_op(name: str, args: tuple, kwargs: dict):
     res_cls = next((type(a) for a in nd_args if type(a) is not NDArray),
                    NDArray)
 
-    # inject runtime-state kwargs some ops need
+    # inject runtime-state kwargs some ops need.  _RNG_GATE ops consume
+    # RNG conditionally (switch_moe: only when router_jitter > 0) —
+    # gating the injection keeps the global key stream, and so seeded
+    # reproducibility of jitter-free MoE runs, identical to a model
+    # without MoE layers.  The gated params are keyword-only in the op
+    # signatures, so kwargs is the complete truth here.
     fn = spec.fn
-    if name in _NEEDS_TRAIN_FLAG:
+    rng_wanted = _RNG_GATE.get(name, _ALWAYS)(kwargs)
+    if name in _NEEDS_TRAIN_FLAG and rng_wanted:
         kwargs.setdefault("_training", autograd.is_training())
-    if name in _NEEDS_KEY:
+    if name in _NEEDS_KEY and rng_wanted:
         from .. import random as _rnd
         if kwargs.get("_key") is None and (
                 kwargs.get("_training") or kwargs.get("mode") == "always"):
@@ -599,8 +605,11 @@ def invoke_op(name: str, args: tuple, kwargs: dict):
 
 # ops whose behavior depends on autograd train/predict mode or RNG
 _NEEDS_TRAIN_FLAG = {"Dropout", "dropout", "BatchNorm", "batch_norm",
-                     "RNN", "rnn"}
-_NEEDS_KEY = {"Dropout", "dropout", "RNN", "rnn"}
+                     "RNN", "rnn", "switch_moe"}
+_NEEDS_KEY = {"Dropout", "dropout", "RNN", "rnn", "switch_moe"}
+_ALWAYS = lambda kw: True  # noqa: E731
+# per-op predicate deciding whether the RNG state kwargs get injected
+_RNG_GATE = {"switch_moe": lambda kw: bool(kw.get("router_jitter"))}
 
 # op-output taps installed by mx.monitor.Monitor (parity: executor monitor
 # callback — the reference taps op outputs in the engine)

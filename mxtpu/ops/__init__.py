@@ -19,6 +19,8 @@ from . import contrib  # noqa: F401
 from . import control_flow  # noqa: F401
 from . import custom  # noqa: F401
 from . import moe  # noqa: F401
+from . import random_ops  # noqa: F401
+from . import optimizer_ops  # noqa: F401
 
 try:  # pallas kernels (gated: interpret-mode on CPU, absent on old jax)
     from . import pallas  # noqa: F401

@@ -551,3 +551,117 @@ def ifft_op(data, compute_size=128):
     c = data.reshape(*data.shape[:-1], d, 2)
     z = c[..., 0] + 1j * c[..., 1]
     return (jnp.fft.ifft(z, axis=-1).real * d).astype(jnp.float32)
+
+
+# ---------------------------------------------------------------------------
+# round-5 tail (VERDICT r4 item 2)
+
+@register_op("AdaptiveAvgPooling2D",
+             aliases=("_contrib_AdaptiveAvgPooling2D",))
+def adaptive_avg_pooling2d(data, output_size=(1, 1)):
+    """Adaptive average pooling to a fixed output grid
+    (src/operator/contrib/adaptive_avg_pooling.cc).  Bin boundaries use
+    the floor/ceil split of the reference kernel; implemented as a
+    masked mean over static output cells, so it stays jit-static for any
+    input size."""
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    B, C, H, W = data.shape
+    oh, ow = output_size
+    import numpy as _onp
+    hstart = _onp.floor(_onp.arange(oh) * H / oh).astype(int)
+    hend = _onp.ceil((_onp.arange(oh) + 1) * H / oh).astype(int)
+    wstart = _onp.floor(_onp.arange(ow) * W / ow).astype(int)
+    wend = _onp.ceil((_onp.arange(ow) + 1) * W / ow).astype(int)
+    mh = (_onp.arange(H)[None, :] >= hstart[:, None]) \
+        & (_onp.arange(H)[None, :] < hend[:, None])       # (oh, H)
+    mw = (_onp.arange(W)[None, :] >= wstart[:, None]) \
+        & (_onp.arange(W)[None, :] < wend[:, None])       # (ow, W)
+    mh = jnp.asarray(mh, data.dtype) / jnp.asarray(
+        (hend - hstart)[:, None], data.dtype)
+    mw = jnp.asarray(mw, data.dtype) / jnp.asarray(
+        (wend - wstart)[:, None], data.dtype)
+    # mean over each bin: two contractions ride the MXU
+    return jnp.einsum("bchw,oh,pw->bcop", data, mh, mw)
+
+
+@register_op("bipartite_matching", differentiable=False, num_outputs=2,
+             aliases=("_contrib_bipartite_matching",))
+def bipartite_matching(data, is_ascend=False, threshold=0.0, topk=-1):
+    """Greedy bipartite matching over a score matrix (bounding_box.cc
+    BipartiteMatching; the SSD target-assignment primitive).  Returns
+    (row_match, col_match): for each row the matched col (or -1), and
+    for each col the matched row (or -1).  Supports a leading batch dim
+    like the reference."""
+    batched = data.ndim == 3
+    scores = data if batched else data[None]
+    B, N, M = scores.shape
+    k = N if topk <= 0 else min(topk, N)
+    big = jnp.asarray(_np_inf_like(scores.dtype), scores.dtype)
+
+    def one(s):
+        s0 = -s if is_ascend else s
+
+        def body(carry, _):
+            s_cur, row_m, col_m = carry
+            flat = jnp.argmax(s_cur)
+            i, j = flat // M, flat % M
+            # the threshold comparison is unconditional (the reference
+            # always applies it — an explicit 0.0 is a real cutoff);
+            # exhausted cells sit at -big and always fail it
+            ok = s_cur[i, j] > (-threshold if is_ascend else threshold)
+            row_m = jnp.where(ok, row_m.at[i].set(j.astype(row_m.dtype)),
+                              row_m)
+            col_m = jnp.where(ok, col_m.at[j].set(i.astype(col_m.dtype)),
+                              col_m)
+            s_cur = s_cur.at[i, :].set(-big).at[:, j].set(-big)
+            return (s_cur, row_m, col_m), None
+
+        init = (s0, jnp.full((N,), -1, jnp.float32),
+                jnp.full((M,), -1, jnp.float32))
+        (_, row_m, col_m), _ = jax.lax.scan(body, init, None, length=k)
+        return row_m, col_m
+
+    row, col = jax.vmap(one)(scores)
+    if not batched:
+        row, col = row[0], col[0]
+    return row, col
+
+
+def _np_inf_like(dtype):
+    import numpy as _onp
+    return _onp.finfo(_onp.dtype(dtype)).max / 2
+
+
+@register_op("gradientmultiplier", aliases=("_contrib_gradientmultiplier",))
+def gradientmultiplier(data, scalar=1.0):
+    """Identity forward, gradient scaled by ``scalar``
+    (contrib/gradient_multiplier_op.cc — the GRL building block)."""
+
+    @jax.custom_vjp
+    def op(x):
+        return x
+
+    def fwd(x):
+        return x, None
+
+    def bwd(_, g):
+        return (g * scalar,)
+
+    op.defvjp(fwd, bwd)
+    return op(data)
+
+
+@register_op("allclose", differentiable=False,
+             aliases=("_contrib_allclose",))
+def allclose(a, b, rtol=1e-5, atol=1e-8, equal_nan=False):
+    """1.0 iff allclose (contrib/allclose_op.cc)."""
+    return jnp.allclose(a, b, rtol=rtol, atol=atol,
+                        equal_nan=equal_nan).astype(jnp.float32)
+
+
+@register_op("quadratic", aliases=("_contrib_quadratic",))
+def quadratic(data, a=0.0, b=0.0, c=0.0):
+    """a*x^2 + b*x + c — the reference's operator-tutorial op
+    (contrib/quadratic_op.cc), kept so tutorial code ports verbatim."""
+    return a * jnp.square(data) + b * data + c

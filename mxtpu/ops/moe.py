@@ -1,12 +1,14 @@
 """Mixture-of-Experts ops (SURVEY §2.3 row 59 — EP/MoE, absent in the
-reference; built TPU-first: static-capacity Switch routing with one-hot
+reference; built TPU-first: static-capacity routing with one-hot
 dispatch/combine einsums, the GShard/Switch-Transformer formulation that
 GSPMD turns into expert all-to-alls when the expert dimension is sharded
 over the mesh "ep" axis).
 
-The routing decision (top-1 argmax) is discrete; gradients flow through
-the selected gate probability (standard Switch straight-through) and the
-load-balancing auxiliary loss keeps the router trainable.
+Routing: top-1 (Switch, default) or top-k (GShard top-2) — the discrete
+choice gets gradients through the selected gate probabilities
+(straight-through) plus the load-balancing auxiliary loss; optional
+router z-loss (ST-MoE) and input jitter (Switch appendix) stabilize
+training at scale.
 """
 
 from __future__ import annotations
@@ -21,13 +23,31 @@ from ..base import register_op
 
 @register_op("switch_moe", num_outputs=2)
 def switch_moe(x, router_w, w1, w2, capacity_factor=1.25,
-               activation="swish"):
-    """Switch-Transformer FFN.
+               activation="swish", top_k=1, normalize_gates=True, *,
+               router_jitter=0.0, z_loss_weight=0.0, _training=False,
+               _key=None):
+    """Routed expert FFN (Switch top-1 / GShard top-k).
+
+    router_jitter onward is keyword-only: invoke_op's RNG-key injection
+    is gated on kwargs["router_jitter"], so a positional spelling would
+    silently disable the jitter it asks for.
 
     x (B, T, d) or (S, d); router_w (E, d) — Dense (out, in) layout;
-    w1 (E, d, h); w2 (E, h, d).  Returns (y, aux_loss): y matches x's
-    shape with dropped-token rows zeroed (callers add the residual), aux
-    is the E * sum(f_e * p_e) load-balancing scalar.
+    w1 (E, d, h); w2 (E, h, d).  Returns (y, aux): y matches x's shape
+    with dropped-token rows zeroed (callers add the residual); aux is
+    the E * sum(f_e * p_e) load-balancing scalar plus, when
+    z_loss_weight > 0, the router z-loss (mean logsumexp(logits)^2 —
+    ST-MoE's logit-magnitude regularizer).
+
+    top_k > 1: each token is dispatched to its k best experts; capacity
+    is filled first-choice-first (GShard's priority order), and with
+    normalize_gates the k selected probabilities are renormalized to
+    sum to 1.
+
+    router_jitter: multiplicative uniform noise on the router INPUT in
+    (1-eps, 1+eps), training only (Switch Transformer appendix B) —
+    needs the injected RNG key (the op is registered key-needing, like
+    Dropout).
 
     capacity_factor <= 0 disables the capacity limit entirely (capacity
     = S): the incremental-decode configuration, where a step sees only
@@ -38,24 +58,42 @@ def switch_moe(x, router_w, w1, w2, capacity_factor=1.25,
     xf = x.reshape(-1, d)
     S = xf.shape[0]
     E = router_w.shape[0]
+    k = int(top_k)
     cdt = jnp.float32
 
-    logits = jnp.dot(xf.astype(cdt), router_w.astype(cdt).T)  # (S, E)
+    xr = xf.astype(cdt)
+    if router_jitter and _training and _key is not None:
+        noise = jax.random.uniform(_key, xr.shape, cdt,
+                                   1.0 - router_jitter,
+                                   1.0 + router_jitter)
+        xr = xr * noise
+    logits = jnp.dot(xr, router_w.astype(cdt).T)              # (S, E)
     gates = jax.nn.softmax(logits, axis=-1)
-    idx = jnp.argmax(gates, axis=-1)                          # (S,)
-    gate = jnp.max(gates, axis=-1)                            # (S,)
-    onehot = jax.nn.one_hot(idx, E, dtype=cdt)                # (S, E)
 
     if capacity_factor <= 0:
-        capacity = S  # unbounded: nothing can drop
+        capacity = S * k  # unbounded: nothing can drop
     else:
-        capacity = max(1, int(math.ceil(S / E * capacity_factor)))
-    pos = jnp.cumsum(onehot, axis=0) * onehot                 # 1-based
-    my_pos = jnp.sum(pos, axis=-1)                            # (S,)
+        # k-scaled per GShard: top-k dispatches k*S assignments, so the
+        # per-expert budget scales with k or second choices mass-drop
+        capacity = max(1, int(math.ceil(k * S / E * capacity_factor)))
+
+    topv, topi = jax.lax.top_k(gates, k)                      # (S, k)
+    if k > 1 and normalize_gates:
+        topv = topv / jnp.sum(topv, axis=-1, keepdims=True)
+
+    # (k, S, E) one-hots; capacity fills in choice-priority order: every
+    # token's first choice outranks any token's second choice (GShard)
+    oh = jax.nn.one_hot(jnp.swapaxes(topi, 0, 1), E, dtype=cdt)
+    flat = oh.reshape(k * S, E)                 # k-major: choice 0 first
+    pos = jnp.cumsum(flat, axis=0) * flat                     # 1-based
+    my_pos = jnp.sum(pos, axis=-1).reshape(k, S)
     within = (my_pos >= 1) & (my_pos <= capacity)
     slot = jax.nn.one_hot((my_pos - 1).astype(jnp.int32), capacity,
-                          dtype=cdt) * within[:, None].astype(cdt)
-    disp = onehot[:, :, None] * slot[:, None, :]              # (S, E, C)
+                          dtype=cdt) * within[..., None].astype(cdt)
+    # dispatch mask (S, E, C): sum over choices (disjoint slots)
+    disp = jnp.einsum("kse,ksc->sec", oh, slot)
+    # combine weights carry the per-choice gate values
+    comb = jnp.einsum("kse,ksc,sk->sec", oh, slot, topv)
 
     xe = jnp.einsum("sec,sd->ecd", disp, xf.astype(cdt))
     h = jnp.einsum("ecd,edh->ech", xe, w1.astype(cdt))
@@ -66,10 +104,14 @@ def switch_moe(x, router_w, w1, w2, capacity_factor=1.25,
     else:
         h = jax.nn.relu(h)
     ye = jnp.einsum("ech,ehd->ecd", h, w2.astype(cdt))
-    y = jnp.einsum("sec,ecd->sd", disp * gate[:, None, None], ye)
+    y = jnp.einsum("sec,ecd->sd", comb, ye)
 
-    # Switch load-balancing loss: E * sum_e fraction_e * router_prob_e
-    frac = jnp.mean(onehot, axis=0)
+    # load-balancing loss over FIRST choices (Switch; GShard uses the
+    # same first-choice fraction for top-2)
+    frac = jnp.mean(oh[0], axis=0)
     prob = jnp.mean(gates, axis=0)
     aux = E * jnp.sum(jax.lax.stop_gradient(frac) * prob)
+    if z_loss_weight:
+        z = jax.scipy.special.logsumexp(logits, axis=-1)
+        aux = aux + z_loss_weight * jnp.mean(jnp.square(z))
     return y.reshape(orig_shape).astype(x.dtype), aux.astype(jnp.float32)

@@ -1146,3 +1146,131 @@ def correlation(data1, data2, kernel_size=1, max_displacement=1,
     if stride1 > 1:
         out = out[:, :, ::stride1, ::stride1]
     return out
+
+
+# ---------------------------------------------------------------------------
+# round-5 tail (VERDICT r4 item 2): ROIPooling, SVMOutput, KL sparse-reg
+# identity, rnn_param_concat
+
+@register_op("ROIPooling", aliases=("roi_pooling",))
+def roi_pooling(data, rois, pooled_size=(7, 7), spatial_scale=1.0):
+    """Legacy max ROI pooling (src/operator/roi_pooling.cc): integer bin
+    boundaries (Fast-RCNN), unlike ROIAlign's bilinear sampling.  Empty
+    bins produce 0, matching the reference kernel."""
+    B, C, H, W = data.shape
+    ph, pw = pooled_size
+
+    def one_roi(roi):
+        bidx = roi[0].astype(jnp.int32)
+        x1 = jnp.round(roi[1] * spatial_scale).astype(jnp.int32)
+        y1 = jnp.round(roi[2] * spatial_scale).astype(jnp.int32)
+        x2 = jnp.round(roi[3] * spatial_scale).astype(jnp.int32)
+        y2 = jnp.round(roi[4] * spatial_scale).astype(jnp.int32)
+        rh = jnp.maximum(y2 - y1 + 1, 1)
+        rw = jnp.maximum(x2 - x1 + 1, 1)
+        i = jnp.arange(ph)
+        j = jnp.arange(pw)
+        hstart = y1 + jnp.floor(i * rh / ph).astype(jnp.int32)
+        hend = y1 + jnp.ceil((i + 1) * rh / ph).astype(jnp.int32)
+        wstart = x1 + jnp.floor(j * rw / pw).astype(jnp.int32)
+        wend = x1 + jnp.ceil((j + 1) * rw / pw).astype(jnp.int32)
+        hs = jnp.arange(H)
+        ws = jnp.arange(W)
+        mh = (hs[None, :] >= jnp.clip(hstart, 0, H)[:, None]) \
+            & (hs[None, :] < jnp.clip(hend, 0, H)[:, None])    # (ph, H)
+        mw = (ws[None, :] >= jnp.clip(wstart, 0, W)[:, None]) \
+            & (ws[None, :] < jnp.clip(wend, 0, W)[:, None])    # (pw, W)
+        mask = mh[:, None, :, None] & mw[None, :, None, :]     # (ph,pw,H,W)
+        img = data[bidx]                                       # (C, H, W)
+        neg = jnp.asarray(-jnp.inf, img.dtype)
+        vals = jnp.where(mask[:, :, None], img[None, None], neg)
+        out = vals.max(axis=(-1, -2))                          # (ph, pw, C)
+        out = jnp.where(jnp.isfinite(out), out, 0)
+        return jnp.transpose(out, (2, 0, 1))                   # (C, ph, pw)
+
+    return jax.vmap(one_roi)(rois)
+
+
+@functools.lru_cache(maxsize=16)
+def _svm_output_cvjp(margin, reg_coef, use_linear):
+    """custom_vjp one-vs-all SVM head (svm_output-inl.h): forward is the
+    identity prediction; backward wrt data is the hinge-loss gradient
+    (incoming cotangent ignored — same implicit-loss contract as
+    SoftmaxOutput)."""
+
+    @jax.custom_vjp
+    def op(data, label):
+        return data
+
+    def op_fwd(data, label):
+        return data, (data, label)
+
+    def op_bwd(res, g):
+        data, label = res
+        nclass = data.shape[-1]
+        t = 2.0 * jax.nn.one_hot(label.astype(jnp.int32), nclass,
+                                 dtype=data.dtype) - 1.0
+        slack = margin - t * data
+        if use_linear:          # L1-SVM: d/df max(0, m - t f) = -t [slack>0]
+            grad = -reg_coef * t * (slack > 0)
+        else:                   # L2-SVM: d/df max(0, m - t f)^2
+            grad = -2.0 * reg_coef * t * jnp.maximum(slack, 0)
+        return (grad.astype(data.dtype), None)
+
+    op.defvjp(op_fwd, op_bwd)
+    return op
+
+
+@register_op("SVMOutput", aliases=("svm_output",))
+def svm_output(data, label=None, margin=1.0,
+               regularization_coefficient=1.0, use_linear=False):
+    if label is None:
+        return data
+    return _svm_output_cvjp(float(margin),
+                            float(regularization_coefficient),
+                            bool(use_linear))(data, label)
+
+
+@functools.lru_cache(maxsize=16)
+def _kl_sparse_reg_cvjp(sparseness_target, penalty):
+    """Identity forward; backward adds the KL sparsity penalty gradient on
+    the mean activation (identity_attach_KL_sparse_reg-inl.h).
+    Divergence: the reference keeps a momentum-smoothed moving average of
+    the mean activation rho_hat across calls (mutable aux state); here
+    rho_hat is the current batch mean — functional, and identical in the
+    momentum=0 configuration."""
+
+    @jax.custom_vjp
+    def op(data):
+        return data
+
+    def op_fwd(data):
+        return data, data
+
+    def op_bwd(data, g):
+        rho_hat = jnp.clip(jnp.mean(data, axis=0), 1e-6, 1 - 1e-6)
+        kl_grad = penalty * (-sparseness_target / rho_hat
+                             + (1.0 - sparseness_target) / (1.0 - rho_hat))
+        return (g + kl_grad / data.shape[0],)
+
+    op.defvjp(op_fwd, op_bwd)
+    return op
+
+
+@register_op("IdentityAttachKLSparseReg",
+             aliases=("identity_attach_KL_sparse_reg",))
+def identity_attach_kl_sparse_reg(data, sparseness_target=0.1,
+                                  penalty=0.001, momentum=0.9):
+    return _kl_sparse_reg_cvjp(float(sparseness_target),
+                               float(penalty))(data)
+
+
+@register_op("rnn_param_concat", aliases=("_rnn_param_concat",))
+def rnn_param_concat(*data, dim=0, num_args=None):
+    """Concat specialized for RNN parameter packing (rnn_param_concat.cc
+    — same compute as Concat, but mixed-rank inputs flatten first when
+    packing along dim 0: the op's whole purpose is fusing 2-D weight
+    matrices and 1-D biases into the single packed RNN parameter)."""
+    if dim == 0 and len({d.ndim for d in data}) > 1:
+        return jnp.concatenate([d.reshape(-1) for d in data], axis=0)
+    return jnp.concatenate(list(data), axis=dim)

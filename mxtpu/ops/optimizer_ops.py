@@ -1,0 +1,434 @@
+"""Fused optimizer-update operators + AMP utility ops (registry names).
+
+Parity: ``src/operator/optimizer_op.cc`` (sgd_update, sgd_mom_update,
+mp_* master-weight variants, multi_* multi-tensor variants, nag, adam,
+ftrl, rmsprop, signsgd/signum, lamb_update_phase1/2, multi_lars,
+multi_sum_sq, preloaded_multi_*) and ``src/operator/contrib/adamw.cc``
+and ``src/operator/contrib/amp_graph_pass`` ops (amp_cast,
+amp_multicast) and ``all_finite.cc``.
+
+Functional divergence (documented): the reference mutates weight/state
+NDArrays in place and returns the weight only.  XLA arrays are
+immutable, so every op here RETURNS the updated arrays — weight first,
+then any updated state, as a tuple.  The Python Optimizer classes
+(mxtpu/optimizer/optimizer.py) remain the training path; these ops
+exist so symbolic/Module-path code that invokes the upstream names
+imperatively keeps working, and as jit-fusable building blocks.
+
+Scalar params follow upstream defaults; ``rescale_grad`` multiplies the
+raw gradient and ``clip_gradient`` (< 0 = off) clips AFTER rescale,
+matching the reference kernel order.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..base import register_op
+
+
+def _prep(grad, rescale_grad, clip_gradient, dtype=None):
+    g = grad.astype(dtype) if dtype is not None else grad
+    g = g * rescale_grad
+    if clip_gradient is not None and clip_gradient >= 0:
+        g = jnp.clip(g, -clip_gradient, clip_gradient)
+    return g
+
+
+# --------------------------------------------------------------------- SGD
+
+@register_op("sgd_update", differentiable=False)
+def sgd_update(weight, grad, lr, wd=0.0, rescale_grad=1.0,
+               clip_gradient=-1.0, lazy_update=True):
+    g = _prep(grad, rescale_grad, clip_gradient)
+    return weight - lr * (g + wd * weight)
+
+
+@register_op("sgd_mom_update", differentiable=False, num_outputs=2)
+def sgd_mom_update(weight, grad, mom, lr, momentum=0.0, wd=0.0,
+                   rescale_grad=1.0, clip_gradient=-1.0, lazy_update=True):
+    g = _prep(grad, rescale_grad, clip_gradient)
+    mom_new = momentum * mom - lr * (g + wd * weight)
+    return weight + mom_new, mom_new
+
+
+@register_op("mp_sgd_update", differentiable=False, num_outputs=2)
+def mp_sgd_update(weight, grad, weight32, lr, wd=0.0, rescale_grad=1.0,
+                  clip_gradient=-1.0, lazy_update=True):
+    """Multi-precision SGD: low-precision weight + fp32 master copy."""
+    g = _prep(grad, rescale_grad, clip_gradient, jnp.float32)
+    w32 = weight32 - lr * (g + wd * weight32)
+    return w32.astype(weight.dtype), w32
+
+
+@register_op("mp_sgd_mom_update", differentiable=False, num_outputs=3)
+def mp_sgd_mom_update(weight, grad, mom, weight32, lr, momentum=0.0,
+                      wd=0.0, rescale_grad=1.0, clip_gradient=-1.0,
+                      lazy_update=True):
+    g = _prep(grad, rescale_grad, clip_gradient, jnp.float32)
+    mom_new = momentum * mom - lr * (g + wd * weight32)
+    w32 = weight32 + mom_new
+    return w32.astype(weight.dtype), mom_new, w32
+
+
+def _interleaved(data, stride):
+    """Split the reference's flat interleaved input list [a0,b0,...,aN,bN]."""
+    groups = [data[i:i + stride] for i in range(0, len(data), stride)]
+    if groups and len(groups[-1]) != stride:
+        raise ValueError("multi-tensor op input count not divisible by %d"
+                         % stride)
+    return groups
+
+
+def _per_weight(val, i):
+    if isinstance(val, (tuple, list)):
+        return val[i]
+    return val
+
+
+@register_op("multi_sgd_update", differentiable=False)
+def multi_sgd_update(*data, lrs, wds, rescale_grad=1.0, clip_gradient=-1.0,
+                     num_weights=None):
+    """Fused multi-tensor SGD over interleaved [weight, grad] pairs.
+    num_weights is accepted for signature parity; the split is derived
+    from the input count (register_op returns the plain fn, so the
+    single-tensor ops compose directly)."""
+    outs = []
+    for i, (w, g) in enumerate(_interleaved(data, 2)):
+        outs.append(sgd_update(w, g, _per_weight(lrs, i),
+                               _per_weight(wds, i), rescale_grad,
+                               clip_gradient))
+    return tuple(outs)
+
+
+@register_op("multi_sgd_mom_update", differentiable=False)
+def multi_sgd_mom_update(*data, lrs, wds, momentum=0.0, rescale_grad=1.0,
+                         clip_gradient=-1.0, num_weights=None):
+    outs = []
+    for i, (w, g, m) in enumerate(_interleaved(data, 3)):
+        outs.extend(sgd_mom_update(w, g, m, _per_weight(lrs, i),
+                                   momentum, _per_weight(wds, i),
+                                   rescale_grad, clip_gradient))
+    return tuple(outs)
+
+
+@register_op("multi_mp_sgd_update", differentiable=False)
+def multi_mp_sgd_update(*data, lrs, wds, rescale_grad=1.0,
+                        clip_gradient=-1.0, num_weights=None):
+    outs = []
+    for i, (w, g, w32) in enumerate(_interleaved(data, 3)):
+        outs.extend(mp_sgd_update(w, g, w32, _per_weight(lrs, i),
+                                  _per_weight(wds, i), rescale_grad,
+                                  clip_gradient))
+    return tuple(outs)
+
+
+@register_op("multi_mp_sgd_mom_update", differentiable=False)
+def multi_mp_sgd_mom_update(*data, lrs, wds, momentum=0.0,
+                            rescale_grad=1.0, clip_gradient=-1.0,
+                            num_weights=None):
+    outs = []
+    for i, (w, g, m, w32) in enumerate(_interleaved(data, 4)):
+        outs.extend(mp_sgd_mom_update(w, g, m, w32, _per_weight(lrs, i),
+                                      momentum, _per_weight(wds, i),
+                                      rescale_grad, clip_gradient))
+    return tuple(outs)
+
+
+@register_op("preloaded_multi_sgd_update", differentiable=False)
+def preloaded_multi_sgd_update(*data, rescale_grad=1.0, clip_gradient=-1.0,
+                               num_weights=None):
+    """Like multi_sgd_update but lr/wd arrive as trailing 1-D tensors
+    (reference preloaded_multi_sgd_update: avoids re-setting attrs)."""
+    arrays, lrs, wds = data[:-2], data[-2], data[-1]
+    outs = []
+    for i, (w, g) in enumerate(_interleaved(arrays, 2)):
+        outs.append(sgd_update(w, g, lrs[i], wds[i], rescale_grad,
+                               clip_gradient))
+    return tuple(outs)
+
+
+@register_op("preloaded_multi_sgd_mom_update", differentiable=False)
+def preloaded_multi_sgd_mom_update(*data, momentum=0.0, rescale_grad=1.0,
+                                   clip_gradient=-1.0, num_weights=None):
+    arrays, lrs, wds = data[:-2], data[-2], data[-1]
+    outs = []
+    for i, (w, g, m) in enumerate(_interleaved(arrays, 3)):
+        outs.extend(sgd_mom_update(w, g, m, lrs[i], momentum, wds[i],
+                                   rescale_grad, clip_gradient))
+    return tuple(outs)
+
+
+@register_op("preloaded_multi_mp_sgd_update", differentiable=False)
+def preloaded_multi_mp_sgd_update(*data, rescale_grad=1.0,
+                                  clip_gradient=-1.0, num_weights=None):
+    arrays, lrs, wds = data[:-2], data[-2], data[-1]
+    outs = []
+    for i, (w, g, w32) in enumerate(_interleaved(arrays, 3)):
+        outs.extend(mp_sgd_update(w, g, w32, lrs[i], wds[i], rescale_grad,
+                                  clip_gradient))
+    return tuple(outs)
+
+
+@register_op("preloaded_multi_mp_sgd_mom_update", differentiable=False)
+def preloaded_multi_mp_sgd_mom_update(*data, momentum=0.0,
+                                      rescale_grad=1.0, clip_gradient=-1.0,
+                                      num_weights=None):
+    arrays, lrs, wds = data[:-2], data[-2], data[-1]
+    outs = []
+    for i, (w, g, m, w32) in enumerate(_interleaved(arrays, 4)):
+        outs.extend(mp_sgd_mom_update(w, g, m, w32, lrs[i], momentum,
+                                      wds[i], rescale_grad, clip_gradient))
+    return tuple(outs)
+
+
+# --------------------------------------------------------------------- NAG
+
+@register_op("nag_mom_update", differentiable=False, num_outputs=2)
+def nag_mom_update(weight, grad, mom, lr, momentum=0.0, wd=0.0,
+                   rescale_grad=1.0, clip_gradient=-1.0):
+    """Nesterov momentum (reference NAGMomUpdate kernel)."""
+    g = _prep(grad, rescale_grad, clip_gradient) + wd * weight
+    mom_new = momentum * mom + g
+    return weight - lr * (g + momentum * mom_new), mom_new
+
+
+@register_op("mp_nag_mom_update", differentiable=False, num_outputs=3)
+def mp_nag_mom_update(weight, grad, mom, weight32, lr, momentum=0.0,
+                      wd=0.0, rescale_grad=1.0, clip_gradient=-1.0):
+    g = _prep(grad, rescale_grad, clip_gradient,
+              jnp.float32) + wd * weight32
+    mom_new = momentum * mom + g
+    w32 = weight32 - lr * (g + momentum * mom_new)
+    return w32.astype(weight.dtype), mom_new, w32
+
+
+# -------------------------------------------------------------------- Adam
+
+@register_op("adam_update", differentiable=False, num_outputs=3)
+def adam_update(weight, grad, mean, var, lr, beta1=0.9, beta2=0.999,
+                epsilon=1e-8, wd=0.0, rescale_grad=1.0, clip_gradient=-1.0,
+                lazy_update=True):
+    """Reference adam_update: NO bias correction inside the op — the
+    Python optimizer folds the correction into lr (optimizer_op.cc
+    AdamUpdate)."""
+    g = _prep(grad, rescale_grad, clip_gradient) + wd * weight
+    mean_new = beta1 * mean + (1 - beta1) * g
+    var_new = beta2 * var + (1 - beta2) * jnp.square(g)
+    w = weight - lr * mean_new / (jnp.sqrt(var_new) + epsilon)
+    return w, mean_new, var_new
+
+
+@register_op("adamw_update", differentiable=False, num_outputs=3,
+             aliases=("_contrib_adamw_update",))
+def adamw_update(weight, grad, mean, var, rescale_grad, lr, beta1=0.9,
+                 beta2=0.999, epsilon=1e-8, wd=0.0, eta=1.0,
+                 clip_gradient=-1.0):
+    """AdamW with decoupled weight decay (contrib/adamw.cc).  Divergence
+    from adam_update: rescale_grad is a TENSOR (dynamic loss scale) and
+    wd decays the weight directly, outside the adaptive term."""
+    g = grad * jnp.asarray(rescale_grad)
+    if clip_gradient is not None and clip_gradient >= 0:
+        g = jnp.clip(g, -clip_gradient, clip_gradient)
+    mean_new = beta1 * mean + (1 - beta1) * g
+    var_new = beta2 * var + (1 - beta2) * jnp.square(g)
+    w = weight - eta * (lr * mean_new / (jnp.sqrt(var_new) + epsilon)
+                        + wd * weight)
+    return w, mean_new, var_new
+
+
+@register_op("mp_adamw_update", differentiable=False, num_outputs=4,
+             aliases=("_contrib_mp_adamw_update",))
+def mp_adamw_update(weight, grad, mean, var, weight32, rescale_grad, lr,
+                    beta1=0.9, beta2=0.999, epsilon=1e-8, wd=0.0, eta=1.0,
+                    clip_gradient=-1.0):
+    g = grad.astype(jnp.float32) * jnp.asarray(rescale_grad,
+                                               jnp.float32)
+    if clip_gradient is not None and clip_gradient >= 0:
+        g = jnp.clip(g, -clip_gradient, clip_gradient)
+    mean_new = beta1 * mean + (1 - beta1) * g
+    var_new = beta2 * var + (1 - beta2) * jnp.square(g)
+    w32 = weight32 - eta * (lr * mean_new / (jnp.sqrt(var_new) + epsilon)
+                            + wd * weight32)
+    return w32.astype(weight.dtype), mean_new, var_new, w32
+
+
+# ------------------------------------------------------------------- other
+
+@register_op("ftrl_update", differentiable=False, num_outputs=3)
+def ftrl_update(weight, grad, z, n, lr, lamda1=0.01, beta=1.0, wd=0.0,
+                rescale_grad=1.0, clip_gradient=-1.0):
+    """FTRL-proximal (optimizer_op.cc FTRLUpdate)."""
+    g = _prep(grad, rescale_grad, clip_gradient)
+    n_new = n + jnp.square(g)
+    sigma = (jnp.sqrt(n_new) - jnp.sqrt(n)) / lr
+    z_new = z + g - sigma * weight
+    w = jnp.where(
+        jnp.abs(z_new) <= lamda1, jnp.zeros_like(weight),
+        (jnp.sign(z_new) * lamda1 - z_new)
+        / ((beta + jnp.sqrt(n_new)) / lr + wd))
+    return w, z_new, n_new
+
+
+@register_op("rmsprop_update", differentiable=False, num_outputs=2)
+def rmsprop_update(weight, grad, n, lr, gamma1=0.95, epsilon=1e-8, wd=0.0,
+                   rescale_grad=1.0, clip_gradient=-1.0,
+                   clip_weights=-1.0):
+    g = _prep(grad, rescale_grad, clip_gradient) + wd * weight
+    n_new = gamma1 * n + (1 - gamma1) * jnp.square(g)
+    w = weight - lr * g / jnp.sqrt(n_new + epsilon)
+    if clip_weights is not None and clip_weights > 0:
+        w = jnp.clip(w, -clip_weights, clip_weights)
+    return w, n_new
+
+
+@register_op("rmspropalex_update", differentiable=False, num_outputs=4)
+def rmspropalex_update(weight, grad, n, g, delta, lr, gamma1=0.95,
+                       gamma2=0.9, epsilon=1e-8, wd=0.0, rescale_grad=1.0,
+                       clip_gradient=-1.0, clip_weights=-1.0):
+    """Graves' centered RMSProp variant (optimizer_op.cc
+    RMSPropAlexUpdate)."""
+    gr = _prep(grad, rescale_grad, clip_gradient) + wd * weight
+    n_new = gamma1 * n + (1 - gamma1) * jnp.square(gr)
+    g_new = gamma1 * g + (1 - gamma1) * gr
+    delta_new = (gamma2 * delta
+                 - lr * gr / jnp.sqrt(n_new - jnp.square(g_new) + epsilon))
+    w = weight + delta_new
+    if clip_weights is not None and clip_weights > 0:
+        w = jnp.clip(w, -clip_weights, clip_weights)
+    return w, n_new, g_new, delta_new
+
+
+@register_op("signsgd_update", differentiable=False)
+def signsgd_update(weight, grad, lr, wd=0.0, rescale_grad=1.0,
+                   clip_gradient=-1.0):
+    g = _prep(grad, rescale_grad, clip_gradient)
+    return weight - lr * (jnp.sign(g) + wd * weight)
+
+
+@register_op("signum_update", differentiable=False, num_outputs=2)
+def signum_update(weight, grad, mom, lr, momentum=0.0, wd=0.0,
+                  rescale_grad=1.0, clip_gradient=-1.0, wd_lh=0.0):
+    """Signum: sign of the momentum (optimizer_op.cc SignumUpdate; wd_lh
+    is the Loshchilov-Hutter decoupled decay)."""
+    g = _prep(grad, rescale_grad, clip_gradient)
+    mom_new = momentum * mom - (1 - momentum) * (g + wd * weight)
+    w = (1 - lr * wd_lh) * weight + lr * jnp.sign(mom_new)
+    return w, mom_new
+
+
+# -------------------------------------------------------------------- LAMB
+
+@register_op("lamb_update_phase1", differentiable=False, num_outputs=3)
+def lamb_update_phase1(weight, grad, mean, var, beta1=0.9, beta2=0.999,
+                       epsilon=1e-6, t=1, bias_correction=True, wd=0.0,
+                       rescale_grad=1.0, clip_gradient=-1.0):
+    """Phase 1 returns the raw update direction g' (plus new mean/var);
+    phase 2 applies the layerwise trust ratio.  Split mirrors the
+    reference exactly (optimizer_op.cc LambUpdatePhaseOne)."""
+    g = _prep(grad, rescale_grad, clip_gradient)
+    mean_new = beta1 * mean + (1 - beta1) * g
+    var_new = beta2 * var + (1 - beta2) * jnp.square(g)
+    if bias_correction:
+        mean_hat = mean_new / (1 - beta1 ** t)
+        var_hat = var_new / (1 - beta2 ** t)
+    else:
+        mean_hat, var_hat = mean_new, var_new
+    gp = mean_hat / (jnp.sqrt(var_hat) + epsilon) + wd * weight
+    return gp, mean_new, var_new
+
+
+@register_op("lamb_update_phase2", differentiable=False)
+def lamb_update_phase2(weight, g, r1, r2, lr, lower_bound=-1.0,
+                       upper_bound=-1.0):
+    """r1 = ||weight||, r2 = ||g|| (computed by the caller, typically via
+    multi_sum_sq → sqrt, as upstream does)."""
+    if lower_bound is not None and lower_bound >= 0:
+        r1 = jnp.maximum(r1, lower_bound)
+    if upper_bound is not None and upper_bound >= 0:
+        r1 = jnp.minimum(r1, upper_bound)
+    ratio = jnp.where((r1 > 0) & (r2 > 0), r1 / r2,
+                      jnp.ones_like(r1))
+    return weight - lr * ratio * g
+
+
+@register_op("mp_lamb_update_phase1", differentiable=False, num_outputs=3)
+def mp_lamb_update_phase1(weight, grad, mean, var, weight32, beta1=0.9,
+                          beta2=0.999, epsilon=1e-6, t=1,
+                          bias_correction=True, wd=0.0, rescale_grad=1.0,
+                          clip_gradient=-1.0):
+    return lamb_update_phase1(weight32, grad.astype(jnp.float32), mean,
+                              var, beta1, beta2, epsilon, t,
+                              bias_correction, wd, rescale_grad,
+                              clip_gradient)
+
+
+@register_op("mp_lamb_update_phase2", differentiable=False, num_outputs=2)
+def mp_lamb_update_phase2(weight, g, r1, r2, weight32, lr,
+                          lower_bound=-1.0, upper_bound=-1.0):
+    w32 = lamb_update_phase2(weight32, g, r1, r2, lr, lower_bound,
+                             upper_bound)
+    return w32.astype(weight.dtype), w32
+
+
+# ----------------------------------------------------------- LARS helpers
+
+@register_op("multi_sum_sq", differentiable=False)
+def multi_sum_sq(*arrays, num_arrays=None):
+    """Per-array sum of squares, one scalar per input (multi_sum_sq.cc);
+    feeds multi_lars / clip_global_norm-style logic."""
+    return tuple(jnp.sum(jnp.square(a.astype(jnp.float32))) for a in
+                 arrays)
+
+
+@register_op("multi_lars", differentiable=False)
+def multi_lars(lrs, weights_sum_sq, grads_sum_sq, wds, eta=0.001,
+               eps=1e-8, rescale_grad=1.0):
+    """Layerwise LARS lr adjustment over stacked per-layer scalars
+    (multi_lars.cc)."""
+    w_norm = jnp.sqrt(weights_sum_sq)
+    g_norm = jnp.sqrt(grads_sum_sq) * rescale_grad
+    trust = jnp.where(
+        (w_norm > 0) & (g_norm > 0),
+        eta * w_norm / (g_norm + wds * w_norm + eps),
+        jnp.ones_like(w_norm))
+    return lrs * trust
+
+
+# ---------------------------------------------------------------- AMP ops
+
+@register_op("amp_cast")
+def amp_cast(data, dtype="float16"):
+    """Graph-pass cast op (nnvm low_precision_pass amp_cast).  Gradient
+    flows through as a cast back (jax handles via autodiff of astype)."""
+    return data.astype(jnp.dtype(dtype))
+
+
+@register_op("amp_multicast")
+def amp_multicast(*data, num_outputs=None, cast_narrow=False):
+    """Cast all inputs to their common widest (or narrowest) float type."""
+    dts = [a.dtype for a in data]
+    target = dts[0]
+    for d in dts[1:]:
+        wider = jnp.promote_types(target, d)
+        target = wider
+    if cast_narrow:
+        target = min(dts, key=lambda d: jnp.dtype(d).itemsize)
+    return tuple(a.astype(target) for a in data)
+
+
+@register_op("all_finite", differentiable=False)
+def all_finite(data, init_output=True):
+    """1 iff every element is finite (all_finite.cc) — the grad-overflow
+    check in dynamic loss scaling."""
+    return jnp.all(jnp.isfinite(data.astype(jnp.float32))).astype(
+        jnp.float32)
+
+
+@register_op("multi_all_finite", differentiable=False)
+def multi_all_finite(*arrays, num_arrays=None, init_output=True):
+    ok = jnp.asarray(True)
+    for a in arrays:
+        ok = ok & jnp.all(jnp.isfinite(a.astype(jnp.float32)))
+    return ok.astype(jnp.float32)

@@ -1,0 +1,296 @@
+"""Random-sampling operators (registry names).
+
+Parity: ``src/operator/random/sample_op.cc`` + ``multisample_op.cc``
+(`_random_*` scalar-parameter draws, `_random_*_like`, and `_sample_*`
+tensor-parameter per-row draws) and ``src/operator/random/shuffle_op.cc``.
+
+The reference draws from stateful per-device Philox generators owned by
+the ResourceManager (``FResourceRequest kRandom``).  Here every op takes
+an optional ``_key``; when absent it draws from the global key-ring
+(``mxtpu.random.next_key()``, which is trace-aware so hybridized graphs
+get a fresh threaded key per call).  Numeric parity with Philox streams
+is impossible and not a goal (SURVEY.md §7 hard-part 5) — API parity +
+distribution statistics only.
+
+All ops are registered non-differentiable: the reference likewise marks
+sample ops with no FGradient (reparameterized gradients are available in
+mx.np via jax when needed).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..base import register_op
+from .. import random as _rnd
+
+
+def _key_of(_key):
+    k = _key if _key is not None else _rnd.next_key()
+    if not jnp.issubdtype(jnp.asarray(k).dtype, jax.dtypes.prng_key):
+        k = jax.random.wrap_key_data(jnp.asarray(k))
+    return k
+
+
+def _shape(shape):
+    if shape is None:
+        return ()
+    if isinstance(shape, int):
+        return (shape,)
+    return tuple(shape)
+
+
+def _dt(dtype):
+    return jnp.dtype(dtype if dtype not in (None, "None") else "float32")
+
+
+# --------------------------------------------------------------------------
+# scalar-parameter draws: _random_uniform etc. (sample_op.cc)
+
+@register_op("random_uniform", differentiable=False,
+             aliases=("_random_uniform",))
+def random_uniform(low=0.0, high=1.0, shape=None, dtype="float32",
+                   _key=None):
+    return jax.random.uniform(_key_of(_key), _shape(shape), _dt(dtype),
+                              minval=low, maxval=high)
+
+
+@register_op("random_normal", differentiable=False,
+             aliases=("_random_normal",))
+def random_normal(loc=0.0, scale=1.0, shape=None, dtype="float32",
+                  _key=None):
+    return loc + scale * jax.random.normal(_key_of(_key), _shape(shape),
+                                           _dt(dtype))
+
+
+@register_op("random_gamma", differentiable=False,
+             aliases=("_random_gamma",))
+def random_gamma(alpha=1.0, beta=1.0, shape=None, dtype="float32",
+                 _key=None):
+    return beta * jax.random.gamma(_key_of(_key), alpha, _shape(shape),
+                                   _dt(dtype))
+
+
+@register_op("random_exponential", differentiable=False,
+             aliases=("_random_exponential",))
+def random_exponential(lam=1.0, shape=None, dtype="float32", _key=None):
+    # the reference parameterizes by rate lambda: mean = 1/lam
+    return jax.random.exponential(_key_of(_key), _shape(shape),
+                                  _dt(dtype)) / lam
+
+
+@register_op("random_poisson", differentiable=False,
+             aliases=("_random_poisson",))
+def random_poisson(lam=1.0, shape=None, dtype="float32", _key=None):
+    return jax.random.poisson(_key_of(_key), lam,
+                              _shape(shape)).astype(_dt(dtype))
+
+
+def _nb_draw(key, k, p, shp, dt):
+    """NB(k, p) = Poisson(Gamma(k) * (1-p)/p) — the reference's two-stage
+    sampler (sample_op.h NegativeBinomialSampler)."""
+    kg, kp = jax.random.split(key)
+    rate = jax.random.gamma(kg, k, shp) * (1.0 - p) / p
+    return jax.random.poisson(kp, rate, shp).astype(dt)
+
+
+def _gnb_draw(key, mu, alpha, shp, dt):
+    """GNB(mu, alpha): Poisson with Gamma(1/alpha)-mixed rate scaled to
+    mean mu; alpha→0 degenerates to Poisson(mu)."""
+    kg, kp = jax.random.split(key)
+    rate = jax.random.gamma(kg, 1.0 / alpha, shp) * (mu * alpha)
+    return jax.random.poisson(kp, rate, shp).astype(dt)
+
+
+@register_op("random_negative_binomial", differentiable=False,
+             aliases=("_random_negative_binomial",))
+def random_negative_binomial(k=1, p=1.0, shape=None, dtype="float32",
+                             _key=None):
+    return _nb_draw(_key_of(_key), float(k), p, _shape(shape), _dt(dtype))
+
+
+@register_op("random_generalized_negative_binomial", differentiable=False,
+             aliases=("_random_generalized_negative_binomial",))
+def random_generalized_negative_binomial(mu=1.0, alpha=1.0, shape=None,
+                                         dtype="float32", _key=None):
+    if alpha == 0:
+        return jax.random.poisson(_key_of(_key), mu,
+                                  _shape(shape)).astype(_dt(dtype))
+    return _gnb_draw(_key_of(_key), mu, alpha, _shape(shape), _dt(dtype))
+
+
+@register_op("random_randint", differentiable=False,
+             aliases=("_random_randint",))
+def random_randint(low=0, high=None, shape=None, dtype="int32", _key=None):
+    return jax.random.randint(_key_of(_key), _shape(shape), low, high,
+                              jnp.dtype(dtype))
+
+
+# --------------------------------------------------------------------------
+# *_like variants: draw with the shape/dtype of a prototype array
+
+@register_op("random_uniform_like", differentiable=False,
+             aliases=("_random_uniform_like",))
+def random_uniform_like(data, low=0.0, high=1.0, _key=None):
+    return jax.random.uniform(_key_of(_key), data.shape, data.dtype, low,
+                              high)
+
+
+@register_op("random_normal_like", differentiable=False,
+             aliases=("_random_normal_like",))
+def random_normal_like(data, loc=0.0, scale=1.0, _key=None):
+    return loc + scale * jax.random.normal(_key_of(_key), data.shape,
+                                           data.dtype)
+
+
+@register_op("random_gamma_like", differentiable=False,
+             aliases=("_random_gamma_like",))
+def random_gamma_like(data, alpha=1.0, beta=1.0, _key=None):
+    return beta * jax.random.gamma(_key_of(_key), alpha, data.shape,
+                                   data.dtype)
+
+
+@register_op("random_exponential_like", differentiable=False,
+             aliases=("_random_exponential_like",))
+def random_exponential_like(data, lam=1.0, _key=None):
+    return jax.random.exponential(_key_of(_key), data.shape,
+                                  data.dtype) / lam
+
+
+@register_op("random_poisson_like", differentiable=False,
+             aliases=("_random_poisson_like",))
+def random_poisson_like(data, lam=1.0, _key=None):
+    return jax.random.poisson(_key_of(_key), lam,
+                              data.shape).astype(data.dtype)
+
+
+@register_op("random_negative_binomial_like", differentiable=False,
+             aliases=("_random_negative_binomial_like",))
+def random_negative_binomial_like(data, k=1, p=1.0, _key=None):
+    return _nb_draw(_key_of(_key), float(k), p, data.shape, data.dtype)
+
+
+@register_op("random_generalized_negative_binomial_like",
+             differentiable=False,
+             aliases=("_random_generalized_negative_binomial_like",))
+def random_generalized_negative_binomial_like(data, mu=1.0, alpha=1.0,
+                                              _key=None):
+    return _gnb_draw(_key_of(_key), mu, alpha, data.shape, data.dtype)
+
+
+# --------------------------------------------------------------------------
+# tensor-parameter per-row draws: _sample_uniform etc. (multisample_op.cc).
+# Parameter arrays of shape S produce output S + shape: one independent
+# draw block per leading element, exactly the reference contract.
+
+def _multisample(draw, params, shape, dtype, _key):
+    """Vectorize ``draw(key, *scalar_params) -> shape`` over the parameter
+    grid.  All params must share the leading shape (reference contract)."""
+    param_shape = tuple(params[0].shape)
+    n = 1
+    for d in param_shape:
+        n *= d
+    keys = jax.random.split(_key_of(_key), n)
+    if param_shape:
+        keys = keys.reshape(param_shape)
+    else:
+        keys = keys[0]
+    f = draw
+    for _ in param_shape:
+        f = jax.vmap(f)
+    return f(keys, *params)
+
+
+@register_op("sample_uniform", differentiable=False,
+             aliases=("_sample_uniform",))
+def sample_uniform(low, high, shape=None, dtype="float32", _key=None):
+    shp, dt = _shape(shape), _dt(dtype)
+    return _multisample(
+        lambda key, lo, hi: jax.random.uniform(key, shp, dt, lo, hi),
+        (jnp.asarray(low, dt), jnp.asarray(high, dt)), shp, dt, _key)
+
+
+@register_op("sample_normal", differentiable=False,
+             aliases=("_sample_normal",))
+def sample_normal(mu, sigma, shape=None, dtype="float32", _key=None):
+    shp, dt = _shape(shape), _dt(dtype)
+    return _multisample(
+        lambda key, m, s: m + s * jax.random.normal(key, shp, dt),
+        (jnp.asarray(mu, dt), jnp.asarray(sigma, dt)), shp, dt, _key)
+
+
+@register_op("sample_gamma", differentiable=False,
+             aliases=("_sample_gamma",))
+def sample_gamma(alpha, beta, shape=None, dtype="float32", _key=None):
+    shp, dt = _shape(shape), _dt(dtype)
+    return _multisample(
+        lambda key, a, b: b * jax.random.gamma(key, a, shp, dt),
+        (jnp.asarray(alpha, dt), jnp.asarray(beta, dt)), shp, dt, _key)
+
+
+@register_op("sample_exponential", differentiable=False,
+             aliases=("_sample_exponential",))
+def sample_exponential(lam, shape=None, dtype="float32", _key=None):
+    shp, dt = _shape(shape), _dt(dtype)
+    return _multisample(
+        lambda key, l: jax.random.exponential(key, shp, dt) / l,
+        (jnp.asarray(lam, dt),), shp, dt, _key)
+
+
+@register_op("sample_poisson", differentiable=False,
+             aliases=("_sample_poisson",))
+def sample_poisson(lam, shape=None, dtype="float32", _key=None):
+    shp, dt = _shape(shape), _dt(dtype)
+    return _multisample(
+        lambda key, l: jax.random.poisson(key, l, shp).astype(dt),
+        (jnp.asarray(lam, jnp.float32),), shp, dt, _key)
+
+
+@register_op("sample_negative_binomial", differentiable=False,
+             aliases=("_sample_negative_binomial",))
+def sample_negative_binomial(k, p, shape=None, dtype="float32", _key=None):
+    shp, dt = _shape(shape), _dt(dtype)
+    return _multisample(
+        lambda key, kk, pp: _nb_draw(key, kk, pp, shp, dt),
+        (jnp.asarray(k, jnp.float32), jnp.asarray(p, jnp.float32)),
+        shp, dt, _key)
+
+
+@register_op("sample_generalized_negative_binomial", differentiable=False,
+             aliases=("_sample_generalized_negative_binomial",))
+def sample_generalized_negative_binomial(mu, alpha, shape=None,
+                                         dtype="float32", _key=None):
+    shp, dt = _shape(shape), _dt(dtype)
+    return _multisample(
+        lambda key, m, a: _gnb_draw(key, m, a, shp, dt),
+        (jnp.asarray(mu, jnp.float32), jnp.asarray(alpha, jnp.float32)),
+        shp, dt, _key)
+
+
+@register_op("_sample_multinomial", differentiable=False,
+             num_outputs=lambda kw: 2 if kw.get("get_prob") else 1)
+def _sample_multinomial(data, shape=None, get_prob=False, dtype="int32",
+                        _key=None):
+    """Categorical draws from probability rows (reference
+    sample_multinomial_op.cc).  data: (..., K) probabilities; output
+    (..., *shape) indices; get_prob additionally returns log-probs (used
+    by REINFORCE-style loops upstream)."""
+    shp = _shape(shape)
+    n = 1
+    for d in shp:
+        n *= d
+    logits = jnp.log(jnp.clip(data, 1e-37, None))
+    idx = jax.random.categorical(_key_of(_key), logits[..., None, :],
+                                 shape=data.shape[:-1] + (n,), axis=-1)
+    out = idx.reshape(data.shape[:-1] + shp).astype(jnp.dtype(dtype))
+    if not get_prob:
+        return out
+    logp = jnp.take_along_axis(logits, idx.astype(jnp.int32), axis=-1)
+    return out, logp.reshape(data.shape[:-1] + shp)
+
+
+@register_op("shuffle", differentiable=False, aliases=("_shuffle",))
+def shuffle(data, _key=None):
+    """Random permutation along the first axis (shuffle_op.cc)."""
+    return jax.random.permutation(_key_of(_key), data, axis=0)

@@ -28,6 +28,7 @@ from .mesh import (DeviceMesh, make_mesh, init_process_group, rank,
 from . import collectives
 from .sharding import ShardingRules, PartitionSpec
 from .trainer import SPMDTrainer
+from .decode import ShardedDecoder
 from . import ring_attention
 from . import pipeline as pipeline_mod
 from .pipeline import pipeline, stack_stage_params, stage_sharding

@@ -119,9 +119,13 @@ class Symbol:
         node = _Node(spec.name, inputs, layout, static_kwargs, name,
                      attr, kw_sym_names=[k for k, _ in kw_syms])
         if spec.num_outputs is not None:
-            # declared static output count: tuple unpacking of a freshly
-            # built multi-output node works before any evaluation
-            node.num_outputs = spec.num_outputs
+            # declared output count: tuple unpacking of a freshly built
+            # multi-output node works before any evaluation.  A callable
+            # handles ops whose arity depends on static params (e.g.
+            # _sample_multinomial's get_prob log-prob output)
+            node.num_outputs = (spec.num_outputs(static_kwargs)
+                                if callable(spec.num_outputs)
+                                else spec.num_outputs)
         return Symbol(node)
 
     @property

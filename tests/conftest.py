@@ -4,7 +4,20 @@ TPU hardware.  Must set env before jax initialises."""
 
 import os
 
+# Bypass the axon TPU plugin: a wedged tunnel (observed rounds 3-5)
+# hangs backend init in make_c_api_client, freezing every plain
+# `pytest tests/` session this round.  The plugin registers from
+# sitecustomize BEFORE conftest runs and pins jax_platforms to
+# "axon,cpu" in the jax CONFIG (so setting the env var here is too
+# late) — override the config back to cpu-only before any backend
+# initializes.  Tests are CPU-mesh by design; the plugin is never
+# wanted in a test session.
+os.environ["PALLAS_AXON_POOL_IPS"] = ""
 os.environ["JAX_PLATFORMS"] = "cpu"
+if "jax" in __import__("sys").modules:
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (

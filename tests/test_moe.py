@@ -216,3 +216,219 @@ def test_decode_forward_capacity_unbounded():
     y_dec = blk.decode_forward(x).asnumpy()
     nz_dec = (np.abs(y_dec).sum(axis=-1) > 1e-7).sum()
     assert nz_dec == 2  # decode drops nothing
+
+
+# ---------------------------------------------------------------------------
+# round-5: top-k (GShard) routing, router jitter/z-loss, scale pins
+# (VERDICT r4 item 6)
+
+
+def test_top2_matches_two_expert_reference():
+    """At ample capacity, top-2 output == sum over the two best experts
+    of renormalized_gate_i * expert_i(x), computed independently with
+    numpy."""
+    rng = np.random.RandomState(21)
+    S, d, h, E = 6, 4, 8, 4
+    x = rng.randn(S, d).astype("f")
+    rw = rng.randn(E, d).astype("f")
+    w1 = rng.randn(E, d, h).astype("f") * 0.3
+    w2 = rng.randn(E, h, d).astype("f") * 0.3
+
+    y, aux = nd.switch_moe(nd.array(x), nd.array(rw), nd.array(w1),
+                           nd.array(w2), capacity_factor=100.0,
+                           top_k=2, activation="relu")
+    y = y.asnumpy()
+
+    logits = x @ rw.T
+    g = np.exp(logits - logits.max(-1, keepdims=True))
+    g = g / g.sum(-1, keepdims=True)
+    expect = np.zeros_like(x)
+    for s in range(S):
+        top2 = np.argsort(-g[s])[:2]
+        denom = g[s][top2].sum()
+        for e in top2:
+            he = np.maximum(x[s] @ w1[e], 0.0)
+            expect[s] += (g[s][e] / denom) * (he @ w2[e])
+    np.testing.assert_allclose(y, expect, rtol=2e-4, atol=2e-5)
+
+
+def test_top1_unchanged_by_topk_plumbing():
+    """top_k=1 must reproduce the round-4 Switch behavior exactly
+    (regression guard for the routing rewrite)."""
+    rng = np.random.RandomState(22)
+    S, d, h, E = 5, 4, 8, 3
+    args = [nd.array(rng.randn(S, d).astype("f")),
+            nd.array(rng.randn(E, d).astype("f")),
+            nd.array(rng.randn(E, d, h).astype("f")),
+            nd.array(rng.randn(E, h, d).astype("f"))]
+    y1, a1 = nd.switch_moe(*args, capacity_factor=2.0)
+    y2, a2 = nd.switch_moe(*args, capacity_factor=2.0, top_k=1)
+    np.testing.assert_array_equal(y1.asnumpy(), y2.asnumpy())
+    assert float(a1.asnumpy()) == float(a2.asnumpy())
+
+
+def test_first_choice_fills_capacity_before_second():
+    """GShard priority: with capacity 1 and a router that sends every
+    token's FIRST choice to expert 0, a token whose SECOND choice is
+    expert 0 must not displace any first-choice token."""
+    rng = np.random.RandomState(23)
+    S, d, h, E = 4, 4, 8, 2
+    # strictly positive tokens so x . rw[0] > 0 for every token: the
+    # router prefers expert 0 FIRST for all of them (a plain randn x
+    # flips the preference wherever sum(x) < 0)
+    x = (np.abs(rng.randn(S, d)) + 0.5).astype("f")
+    rw = np.zeros((E, d), "f")
+    rw[0] = 10.0
+    y, _ = nd.switch_moe(nd.array(x), nd.array(rw),
+                         nd.array(rng.randn(E, d, h).astype("f")),
+                         nd.array(rng.randn(E, h, d).astype("f")),
+                         capacity_factor=0.5, top_k=2)
+    y = y.asnumpy()
+    # k-scaled capacity = ceil(2*4/2*0.5) = 2: tokens 0,1 land both
+    # their first choice (e0) and second (e1); tokens 2,3 overflow BOTH
+    # experts because earlier tokens' first/second choices outrank them
+    # -> zero rows.
+    assert np.abs(y[2:]).sum() == 0.0
+    assert np.abs(y[0]).sum() > 0.0
+
+
+def test_router_zloss_increases_aux():
+    rng = np.random.RandomState(24)
+    args = [nd.array(rng.randn(6, 4).astype("f") * 3),
+            nd.array(rng.randn(4, 4).astype("f") * 3),
+            nd.array(rng.randn(4, 4, 8).astype("f")),
+            nd.array(rng.randn(4, 8, 4).astype("f"))]
+    _, a0 = nd.switch_moe(*args, capacity_factor=2.0)
+    _, a1 = nd.switch_moe(*args, capacity_factor=2.0,
+                          z_loss_weight=1e-2)
+    assert float(a1.asnumpy()) > float(a0.asnumpy())
+
+
+def test_router_jitter_training_only():
+    """Jitter perturbs routing only in training mode with a key; the
+    inference path is deterministic and jitter-free."""
+    rng = np.random.RandomState(25)
+    blk = SwitchMoE(4, 8, 4, capacity_factor=4.0, router_jitter=0.2)
+    blk.initialize()
+    x = nd.array(rng.randn(3, 2, 4).astype("f"))
+    y_pred1 = blk(x).asnumpy()
+    y_pred2 = blk(x).asnumpy()
+    np.testing.assert_array_equal(y_pred1, y_pred2)  # no jitter
+    mx.random.seed(1)
+    with autograd.record(train_mode=True):
+        y_tr1 = blk(x).asnumpy()
+    with autograd.record(train_mode=True):
+        y_tr2 = blk(x).asnumpy()
+    # with jitter active, two training forwards differ (different keys)
+    assert np.abs(y_tr1 - y_tr2).max() > 0
+
+
+def test_scale_pin_dispatch_and_drop_rate():
+    """S=1024, E=8 (VERDICT r4 item 6 scale pin): at capacity_factor=1
+    with a uniform random router, drops stay under 40% of tokens (the
+    balanced-routing expectation), at capacity_factor=2 under 5%, and
+    the dispatch einsum stays within the (S, E, C) memory envelope."""
+    rng = np.random.RandomState(26)
+    S, d, h, E = 1024, 16, 32, 8
+    x = rng.randn(S, d).astype("f")
+    rw = rng.randn(E, d).astype("f") * 0.05  # near-uniform router
+    w1 = rng.randn(E, d, h).astype("f") * 0.1
+    w2 = rng.randn(E, h, d).astype("f") * 0.1
+
+    def drop_rate(cf, k=1):
+        y, _ = nd.switch_moe(nd.array(x), nd.array(rw), nd.array(w1),
+                             nd.array(w2), capacity_factor=cf, top_k=k)
+        zeros = (np.abs(y.asnumpy()).sum(-1) < 1e-9).sum()
+        return zeros / S
+
+    assert drop_rate(1.0) < 0.40
+    assert drop_rate(2.0) < 0.05
+    # top-2: a token is zero only if BOTH choices overflowed
+    assert drop_rate(1.0, k=2) < 0.25
+    # memory envelope: the dispatch tensor is (S, E, C) fp32
+    import math as _math
+    C = _math.ceil(S / E * 1.0)
+    assert S * E * C * 4 < 20 * 2**20  # < 20 MiB at this shape
+
+
+def test_top2_ep_sharded_matches_replicated():
+    """ep=2 expert-sharded top-2 training step == replicated step."""
+    import jax
+
+    if len(jax.devices()) < 2:
+        pytest.skip("needs >=2 devices")
+    from mxtpu.parallel import make_mesh, SPMDTrainer, PartitionSpec as P
+
+    rng = np.random.RandomState(27)
+    d, h, E = 8, 16, 4
+    X = nd.array(rng.randn(8, 4, d).astype("f"))
+    y = nd.array(rng.randn(8, 4, d).astype("f") * 0.1)
+
+    class Wrap(gluon.HybridBlock):
+        def __init__(self, **kw):
+            super().__init__(**kw)
+            with self.name_scope():
+                self.moe = SwitchMoE(d, h, E, capacity_factor=4.0,
+                                     top_k=2, prefix="moe_")
+
+        def hybrid_forward(self, F, x):
+            return x + self.moe(x)
+
+    def run(rules, **mesh_kw):
+        mx.random.seed(31)
+        net = Wrap()
+        net.initialize()
+        tr = SPMDTrainer(net, gluon.loss.L2Loss(), "sgd",
+                         make_mesh(**mesh_kw), rules,
+                         optimizer_params={"learning_rate": 0.1},
+                         batch_spec=P(), label_spec=P())
+        return [float(tr.step(X, y).asnumpy()) for _ in range(2)]
+
+    rep = run(None, dp=1)
+    ep = run(moe_sharding_rules(), dp=1, ep=2)
+    np.testing.assert_allclose(rep, ep, rtol=1e-5)
+
+
+def test_tp_times_ep_composition():
+    """A TransformerLM with MoE layers trains on a tp=2 x ep=2 mesh with
+    composed rules (the tp x ep composition the round-4 review asked
+    for) and matches the replicated loss."""
+    import jax
+
+    if len(jax.devices()) < 4:
+        pytest.skip("needs >=4 devices")
+    from mxtpu.models import transformer
+    from mxtpu.parallel import make_mesh, SPMDTrainer, PartitionSpec as P
+    from mxtpu.models import moe_sharding_rules as msr
+
+    rng = np.random.RandomState(33)
+    ids = nd.array(rng.randint(0, 40, (4, 6)), dtype="int32")
+
+    class LMLoss:
+        accepts_full_output = True
+
+        def __init__(self):
+            self._ce = gluon.loss.SoftmaxCrossEntropyLoss()
+
+        def __call__(self, out, labels):
+            logits, aux = out
+            return self._ce(
+                logits[:, :-1].reshape((-1, logits.shape[-1])),
+                labels[:, 1:].reshape((-1,))) + 0.01 * aux
+
+    def run(mesh_kw, rules):
+        mx.random.seed(41)
+        lm = transformer.TransformerLM(
+            vocab_size=40, units=16, hidden_size=32, num_layers=2,
+            num_heads=4, num_kv_heads=2, num_experts=4,
+            capacity_factor=4.0, return_moe_aux=True)
+        lm.initialize()
+        tr = SPMDTrainer(lm, LMLoss(), "sgd", make_mesh(**mesh_kw),
+                         rules, optimizer_params={"learning_rate": 0.1},
+                         batch_spec=P(), label_spec=P())
+        return [float(tr.step(ids, ids).asnumpy()) for _ in range(2)]
+
+    rep = run(dict(dp=1), None)
+    rules = msr(transformer.transformer_lm_sharding_rules())
+    tpep = run(dict(dp=1, tp=2, ep=2), rules)
+    np.testing.assert_allclose(rep, tpep, rtol=1e-4)

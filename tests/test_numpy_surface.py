@@ -1,0 +1,277 @@
+"""mx.np / mx.npx surface parity sweep (VERDICT r4 item 3).
+
+The checked-in checklist below enumerates the upstream surface
+(python/mxnet/numpy/multiarray.py + _op.py and numpy_extension/ —
+canonical paths per SURVEY §2.2 row 26; the mount has been empty every
+round, so the list is the documented upstream numpy-API subset, TBV).
+Every name must exist on mx.np, or appear in NP_SKIP with a reason —
+the same completeness discipline as tests/test_op_sweep.py.
+
+The linalg/random sub-namespaces get per-name execution tests (not just
+existence): VERDICT r4 weakness 7 flagged them as dynamic proxies
+invisible to dir() and pinned by only 3 tested names.
+"""
+
+import numpy as onp
+import pytest
+
+import mxtpu as mx
+
+np = mx.np
+
+# -- the upstream mx.np export checklist ------------------------------------
+
+NP_NAMES = """
+abs absolute add all allclose amax amin angle any append arange arccos
+arccosh arcsin arcsinh arctan arctan2 arctanh argmax argmin argsort
+argwhere around array array_equal array_split asarray atleast_1d
+atleast_2d atleast_3d average bincount bitwise_and bitwise_not bitwise_or
+bitwise_xor blackman broadcast_arrays broadcast_to cbrt ceil clip
+column_stack compress concatenate conj copy copysign corrcoef cos cosh
+count_nonzero cov cross cumprod cumsum deg2rad degrees delete diag
+diag_indices_from diagflat diagonal diff divide divmod dot dsplit dstack
+ediff1d einsum empty empty_like equal exp expand_dims expm1 extract eye
+fabs fix flatnonzero flip fliplr flipud float_power floor floor_divide
+fmax fmin fmod frexp full full_like gcd gradient greater greater_equal
+hamming hanning histogram hsplit hstack hypot identity imag indices inner
+insert interp intersect1d invert isclose isfinite isin isinf isnan
+isneginf isposinf isscalar kron lcm ldexp less less_equal linspace log
+log10 log1p log2 logaddexp logical_and logical_not logical_or logical_xor
+logspace matmul max maximum may_share_memory mean median meshgrid min
+minimum mod moveaxis multiply nan_to_num nanargmax nanargmin nanmax
+nanmean nanmin nanprod nansum nanstd nanvar ndim negative nextafter
+nonzero not_equal ones ones_like outer pad percentile polyval positive
+power prod ptp quantile rad2deg radians ravel real reciprocal remainder
+repeat reshape resize rint roll rollaxis rot90 round row_stack
+searchsorted shape share_memory sign signbit sin sinh size sometrue sort
+split sqrt square squeeze stack std subtract sum swapaxes take
+take_along_axis tan tanh tensordot tile trace transpose tri tril
+tril_indices trim_zeros triu triu_indices true_divide trunc unique
+unravel_index var vdot vsplit vstack where zeros zeros_like
+""".split()
+
+NP_SKIP = {}  # every checklist name is currently implemented
+
+
+def test_np_checklist_complete():
+    missing = [n for n in NP_NAMES
+               if not hasattr(np, n) and n not in NP_SKIP]
+    assert not missing, f"mx.np missing upstream names: {missing}"
+
+
+def test_np_checklist_has_no_stale_skips():
+    stale = [n for n in NP_SKIP if hasattr(np, n)]
+    assert not stale, f"NP_SKIP lists implemented names: {stale}"
+
+
+# -- new round-5 tail names actually compute --------------------------------
+
+def test_np_tail_values():
+    a = np.array([[1.0, -2.0], [3.0, 0.0]])
+    onp.testing.assert_array_equal(
+        np.argwhere(a > 0).asnumpy(), [[0, 0], [1, 0]])
+    assert int(np.bitwise_and(np.array([6], dtype="int32"),
+                              np.array([3], dtype="int32"))[0]) == 2
+    assert int(np.bitwise_or(np.array([4], dtype="int32"),
+                             np.array([1], dtype="int32"))[0]) == 5
+    assert int(np.invert(np.array([0], dtype="int32"))[0]) == -1
+    onp.testing.assert_allclose(np.deg2rad(np.array([180.0])).asnumpy(),
+                                [onp.pi], rtol=1e-6)
+    onp.testing.assert_allclose(np.rad2deg(np.array([onp.pi])).asnumpy(),
+                                [180.0], rtol=1e-6)
+    assert int(np.nanargmax(np.array([1.0, onp.nan, 3.0]))) == 2
+    assert int(np.nanargmin(np.array([1.0, onp.nan, 3.0]))) == 0
+    onp.testing.assert_allclose(
+        np.nanstd(np.array([1.0, onp.nan, 3.0])).asnumpy(), 1.0)
+    r, c = np.tril_indices(3)
+    assert len(onp.asarray(r)) == 6
+    t = np.tri(3)
+    assert float(np.sum(t)) == 6.0
+    onp.testing.assert_array_equal(
+        np.row_stack((np.array([1.0, 2.0]),
+                      np.array([3.0, 4.0]))).asnumpy(),
+        [[1, 2], [3, 4]])
+    assert bool(np.sometrue(np.array([0.0, 1.0])))
+    assert np.isscalar(3.0)
+    w = np.hanning(8)
+    assert w.shape == (8,)
+
+
+# -- linalg: every enumerated name executes ---------------------------------
+
+_LINALG_SPD = onp.array([[4.0, 1.0], [1.0, 3.0]], "float32")
+
+
+def _spd():
+    return np.array(_LINALG_SPD)
+
+
+LINALG_CALLS = {
+    "norm": lambda: np.linalg.norm(_spd()),
+    "inv": lambda: np.linalg.inv(_spd()),
+    "det": lambda: np.linalg.det(_spd()),
+    "slogdet": lambda: np.linalg.slogdet(_spd()),
+    "svd": lambda: np.linalg.svd(_spd()),
+    "cholesky": lambda: np.linalg.cholesky(_spd()),
+    "qr": lambda: np.linalg.qr(_spd()),
+    "solve": lambda: np.linalg.solve(_spd(), np.array([1.0, 2.0])),
+    "lstsq": lambda: np.linalg.lstsq(_spd(), np.array([1.0, 2.0])),
+    "pinv": lambda: np.linalg.pinv(_spd()),
+    "eig": lambda: np.linalg.eig(_spd()),
+    "eigh": lambda: np.linalg.eigh(_spd()),
+    "eigvals": lambda: np.linalg.eigvals(_spd()),
+    "eigvalsh": lambda: np.linalg.eigvalsh(_spd()),
+    "matrix_power": lambda: np.linalg.matrix_power(_spd(), 2),
+    "matrix_rank": lambda: np.linalg.matrix_rank(_spd()),
+    "multi_dot": lambda: np.linalg.multi_dot(
+        [_spd(), _spd(), _spd()]),
+    "tensorinv": lambda: np.linalg.tensorinv(
+        np.array(onp.eye(4, dtype="float32").reshape(2, 2, 2, 2))),
+    "tensorsolve": lambda: np.linalg.tensorsolve(
+        np.array(onp.eye(4, dtype="float32").reshape(2, 2, 2, 2)),
+        np.array(onp.ones((2, 2), "float32"))),
+    "cond": lambda: np.linalg.cond(_spd()),
+    "tensordot": lambda: np.linalg.tensordot(_spd(), _spd()),
+    "kron": lambda: np.linalg.kron(_spd(), _spd()),
+    "outer": lambda: np.linalg.outer(np.array([1.0, 2.0]),
+                                     np.array([3.0, 4.0])),
+    "matmul": lambda: np.linalg.matmul(_spd(), _spd()),
+}
+
+
+def test_linalg_dir_enumerates_everything():
+    listed = set(dir(np.linalg))
+    assert set(LINALG_CALLS) <= listed
+    # and the test table covers the full advertised surface
+    assert set(n for n in listed if not n.startswith("_")) \
+        == set(LINALG_CALLS)
+
+
+def test_linalg_unknown_name_raises_namespaced_error():
+    with pytest.raises(AttributeError, match="mx.np.linalg"):
+        np.linalg.cholessky  # noqa: B018 — typo on purpose
+
+
+@pytest.mark.parametrize("name", sorted(LINALG_CALLS))
+def test_linalg_name_executes(name):
+    out = LINALG_CALLS[name]()
+    leaves = out if isinstance(out, (tuple, list)) else [out]
+    for leaf in leaves:
+        arr = onp.asarray(leaf.asnumpy() if hasattr(leaf, "asnumpy")
+                          else leaf)
+        assert onp.all(onp.isfinite(arr.astype("float64")))
+
+
+def test_linalg_values_match_numpy():
+    onp.testing.assert_allclose(
+        np.linalg.inv(_spd()).asnumpy(), onp.linalg.inv(_LINALG_SPD),
+        rtol=1e-5, atol=1e-6)
+    onp.testing.assert_allclose(
+        float(np.linalg.det(_spd())), float(onp.linalg.det(_LINALG_SPD)),
+        rtol=1e-5)
+    onp.testing.assert_allclose(
+        np.linalg.solve(_spd(), np.array([1.0, 2.0])).asnumpy(),
+        onp.linalg.solve(_LINALG_SPD, onp.array([1.0, 2.0], "float32")),
+        rtol=1e-5, atol=1e-6)
+
+
+# -- random: every public method draws with the right shape/statistics ------
+
+RANDOM_CALLS = {
+    "uniform": lambda: np.random.uniform(-1, 1, (400,)),
+    "normal": lambda: np.random.normal(0, 1, (400,)),
+    "randint": lambda: np.random.randint(0, 10, (400,)),
+    "rand": lambda: np.random.rand(400),
+    "randn": lambda: np.random.randn(400),
+    "choice": lambda: np.random.choice(np.array([1.0, 2.0, 3.0]), (400,)),
+    "permutation": lambda: np.random.permutation(400),
+    "beta": lambda: np.random.beta(2.0, 3.0, (400,)),
+    "gamma": lambda: np.random.gamma(2.0, 1.5, (400,)),
+    "exponential": lambda: np.random.exponential(2.0, (400,)),
+    "chisquare": lambda: np.random.chisquare(3.0, (400,)),
+    "f": lambda: np.random.f(4.0, 6.0, (400,)),
+    "geometric": lambda: np.random.geometric(0.3, (400,)),
+    "gumbel": lambda: np.random.gumbel(0.0, 1.0, (400,)),
+    "laplace": lambda: np.random.laplace(0.0, 1.0, (400,)),
+    "logistic": lambda: np.random.logistic(0.0, 1.0, (400,)),
+    "lognormal": lambda: np.random.lognormal(0.0, 0.5, (400,)),
+    "pareto": lambda: np.random.pareto(3.0, (400,)),
+    "power": lambda: np.random.power(3.0, (400,)),
+    "rayleigh": lambda: np.random.rayleigh(1.0, (400,)),
+    "weibull": lambda: np.random.weibull(2.0, (400,)),
+    "poisson": lambda: np.random.poisson(3.0, (400,)),
+    "multinomial": lambda: np.random.multinomial(
+        20, onp.array([0.2, 0.3, 0.5])),
+    "standard_normal": lambda: np.random.standard_normal((400,)),
+    "standard_exponential":
+        lambda: np.random.standard_exponential((400,)),
+    "standard_gamma": lambda: np.random.standard_gamma(2.0, (400,)),
+    "standard_cauchy": lambda: np.random.standard_cauchy((400,)),
+    "standard_t": lambda: np.random.standard_t(5.0, (400,)),
+    "triangular": lambda: np.random.triangular(0.0, 1.0, 3.0, (400,)),
+    "wald": lambda: np.random.wald(1.0, 2.0, (400,)),
+    "binomial": lambda: np.random.binomial(10, 0.4, (400,)),
+    "negative_binomial":
+        lambda: np.random.negative_binomial(5, 0.5, (400,)),
+    "multivariate_normal": lambda: np.random.multivariate_normal(
+        onp.zeros(2, "float32"), onp.eye(2, dtype="float32"), (400,)),
+    "dirichlet": lambda: np.random.dirichlet(
+        onp.array([2.0, 3.0, 4.0], "float32"), (400,)),
+}
+
+# E[X] of each draw above (None = skip the mean check)
+RANDOM_MEANS = {
+    "uniform": 0.0, "normal": 0.0, "randint": 4.5, "rand": 0.5,
+    "randn": 0.0, "choice": 2.0, "permutation": 199.5, "beta": 0.4,
+    "gamma": 3.0, "exponential": 2.0, "chisquare": 3.0,
+    "f": 6.0 / 4.0, "geometric": 1 / 0.3, "gumbel": 0.5772,
+    "laplace": 0.0, "logistic": 0.0,
+    "lognormal": float(onp.exp(0.125)), "pareto": 0.5, "power": 0.75,
+    "rayleigh": float(onp.sqrt(onp.pi / 2)),
+    "weibull": 0.8862, "poisson": 3.0, "multinomial": None,
+    "standard_normal": 0.0, "standard_exponential": 1.0,
+    "standard_gamma": 2.0, "standard_cauchy": None, "standard_t": 0.0,
+    "triangular": 4.0 / 3.0, "wald": 1.0, "binomial": 4.0,
+    "negative_binomial": 5.0, "multivariate_normal": 0.0,
+    "dirichlet": None,
+}
+
+
+def test_random_method_table_is_complete():
+    public = set(n for n in dir(np.random)
+                 if not n.startswith("_") and n not in ("seed", "shuffle"))
+    assert public == set(RANDOM_CALLS), (
+        "random methods without a sweep entry: "
+        f"{public - set(RANDOM_CALLS)}; stale entries: "
+        f"{set(RANDOM_CALLS) - public}")
+
+
+@pytest.mark.parametrize("name", sorted(RANDOM_CALLS))
+def test_random_name_draws(name):
+    mx.random.seed(11)
+    out = RANDOM_CALLS[name]()
+    arr = onp.asarray(out.asnumpy() if hasattr(out, "asnumpy") else out,
+                      dtype="float64")
+    assert arr.size >= 3
+    assert onp.all(onp.isfinite(arr))
+    expect = RANDOM_MEANS[name]
+    if expect is not None:
+        scale = max(abs(expect), 1.0)
+        assert abs(arr.mean() - expect) < 0.35 * scale, (
+            f"{name}: mean {arr.mean():.4f} far from {expect}")
+
+
+def test_random_shuffle_permutes_in_place():
+    mx.random.seed(3)
+    a = np.arange(32)
+    before = a.asnumpy().copy()
+    np.random.shuffle(a)
+    after = a.asnumpy()
+    assert sorted(after.tolist()) == sorted(before.tolist())
+    assert not (after == before).all()
+
+
+def test_multinomial_counts_sum_to_n():
+    mx.random.seed(5)
+    c = np.random.multinomial(50, onp.array([0.1, 0.4, 0.5]))
+    assert int(onp.asarray(c.asnumpy()).sum()) == 50

@@ -433,6 +433,228 @@ SKIP = {
 }
 
 
+# ---------------------------------------------------------------------------
+# round-5 tail (VERDICT r4 item 2): optimizer update ops, random sampling
+# ops, indexing/special-function tail, contrib tail
+
+def _KEY():
+    return jax.random.key(7)
+
+
+_OPT_W = lambda: (A(3, 4), A(3, 4))  # noqa: E731 — (weight, grad)
+
+CASES.update({
+    # special functions / elementwise tail
+    "digamma": C(lambda: (POS(3, 4, lo=1.0, hi=3.0),), rtol=5e-2),
+    "degrees": C(lambda: (A(3, 4),)),
+    "radians": C(lambda: (A(3, 4),)),
+    "nanprod": C(lambda: (POS(2, 3),), {"axis": 1}),
+    # indexing tail
+    "batch_take": C(lambda: (A(4, 5), IDX(4, n=5)), grad_args=(0,)),
+    "ravel_multi_index": C(
+        lambda: (jnp.asarray(R.randint(0, 3, (2, 6)).astype("int32")),),
+        {"shape": (3, 4)}, grad=False, bf16=False),
+    "unravel_index": C(
+        lambda: (jnp.asarray(R.randint(0, 12, (6,)).astype("int32")),),
+        {"shape": (3, 4)}, grad=False, bf16=False),
+    "argmax_channel": C(lambda: (A(3, 5),), grad=False),
+    "moments": C(lambda: (A(3, 4),), {"axes": (0,)}),
+    "choose_element_0index": C(lambda: (A(4, 5), IDX(4, n=5)), grad=False),
+    "fill_element_0index": C(lambda: (A(4, 5), A(4), IDX(4, n=5)),
+                             grad=False),
+    # nn tail
+    "ROIPooling": C(
+        lambda: (A(1, 2, 8, 8),
+                 jnp.asarray([[0, 0, 0, 5, 5], [0, 1, 2, 7, 6]],
+                             jnp.float32)),
+        {"pooled_size": (2, 2), "spatial_scale": 1.0}, grad_args=(0,)),
+    "rnn_param_concat": C(lambda: (A(6), A(4)), {"dim": 0}),
+    # contrib tail
+    "AdaptiveAvgPooling2D": C(lambda: (A(2, 3, 6, 6),),
+                              {"output_size": (2, 2)}),
+    "bipartite_matching": C(lambda: (POS(4, 5),),
+                            {"threshold": 0.6, "topk": 3}, grad=False,
+                            bf16=False),  # discrete argmax: bf16
+                                          # near-ties flip indices
+    "_internal_cache_write": C(
+        lambda: (A(2, 3, 8, 4), A(2, 3, 1, 4)), {"pos": 5}, grad=False),
+    "gradientmultiplier": C(lambda: (A(3, 4),), {"scalar": 1.0}),
+    "allclose": C(lambda: (A(3, 4), A(3, 4)), grad=False),
+    "quadratic": C(lambda: (A(3, 4),), {"a": 0.5, "b": -1.0, "c": 2.0}),
+    # AMP ops
+    "amp_cast": C(lambda: (A(3, 4),), {"dtype": "float32"}, grad=False),
+    "amp_multicast": C(lambda: (A(3, 4), A(3, 4)), grad=False),
+    "all_finite": C(lambda: (A(3, 4),), grad=False),
+    "multi_all_finite": C(lambda: (A(3, 4), A(2, 2)), grad=False),
+    # optimizer update ops (all non-differentiable by contract)
+    "sgd_update": C(_OPT_W, {"lr": 0.1, "wd": 0.01}, grad=False),
+    "sgd_mom_update": C(lambda: (A(3, 4), A(3, 4), A(3, 4)),
+                        {"lr": 0.1, "momentum": 0.9}, grad=False),
+    "mp_sgd_update": C(lambda: (A(3, 4), A(3, 4), A(3, 4)),
+                       {"lr": 0.1, "wd": 0.01}, grad=False, bf16=False),
+    "mp_sgd_mom_update": C(lambda: (A(3, 4), A(3, 4), A(3, 4), A(3, 4)),
+                           {"lr": 0.1, "momentum": 0.9}, grad=False,
+                           bf16=False),
+    "multi_sgd_update": C(lambda: (A(3, 4), A(3, 4), A(2, 2), A(2, 2)),
+                          {"lrs": (0.1, 0.2), "wds": (0.0, 0.01),
+                           "num_weights": 2}, grad=False),
+    "multi_sgd_mom_update": C(
+        lambda: (A(3, 4), A(3, 4), A(3, 4), A(2, 2), A(2, 2), A(2, 2)),
+        {"lrs": (0.1, 0.2), "wds": (0.0, 0.01), "momentum": 0.9,
+         "num_weights": 2}, grad=False),
+    "multi_mp_sgd_update": C(
+        lambda: (A(3, 4), A(3, 4), A(3, 4), A(2, 2), A(2, 2), A(2, 2)),
+        {"lrs": (0.1, 0.2), "wds": (0.0, 0.01), "num_weights": 2},
+        grad=False, bf16=False),
+    "multi_mp_sgd_mom_update": C(
+        lambda: (A(3, 4), A(3, 4), A(3, 4), A(3, 4),
+                 A(2, 2), A(2, 2), A(2, 2), A(2, 2)),
+        {"lrs": (0.1, 0.2), "wds": (0.0, 0.01), "momentum": 0.9,
+         "num_weights": 2}, grad=False, bf16=False),
+    "preloaded_multi_sgd_update": C(
+        lambda: (A(3, 4), A(3, 4), A(2, 2), A(2, 2),
+                 jnp.asarray([0.1, 0.2]), jnp.asarray([0.0, 0.01])),
+        {"num_weights": 2}, grad=False),
+    "preloaded_multi_sgd_mom_update": C(
+        lambda: (A(3, 4), A(3, 4), A(3, 4), A(2, 2), A(2, 2), A(2, 2),
+                 jnp.asarray([0.1, 0.2]), jnp.asarray([0.0, 0.01])),
+        {"momentum": 0.9, "num_weights": 2}, grad=False),
+    "preloaded_multi_mp_sgd_update": C(
+        lambda: (A(3, 4), A(3, 4), A(3, 4), A(2, 2), A(2, 2), A(2, 2),
+                 jnp.asarray([0.1, 0.2]), jnp.asarray([0.0, 0.01])),
+        {"num_weights": 2}, grad=False, bf16=False),
+    "preloaded_multi_mp_sgd_mom_update": C(
+        lambda: (A(3, 4), A(3, 4), A(3, 4), A(3, 4),
+                 A(2, 2), A(2, 2), A(2, 2), A(2, 2),
+                 jnp.asarray([0.1, 0.2]), jnp.asarray([0.0, 0.01])),
+        {"momentum": 0.9, "num_weights": 2}, grad=False, bf16=False),
+    "nag_mom_update": C(lambda: (A(3, 4), A(3, 4), A(3, 4)),
+                        {"lr": 0.1, "momentum": 0.9}, grad=False),
+    "mp_nag_mom_update": C(lambda: (A(3, 4), A(3, 4), A(3, 4), A(3, 4)),
+                           {"lr": 0.1, "momentum": 0.9}, grad=False,
+                           bf16=False),
+    "adam_update": C(lambda: (A(3, 4), A(3, 4), A(3, 4), POS(3, 4)),
+                     {"lr": 0.01}, grad=False),
+    "adamw_update": C(
+        lambda: (A(3, 4), A(3, 4), A(3, 4), POS(3, 4),
+                 jnp.ones(())),
+        {"lr": 0.01, "wd": 0.01, "eta": 1.0}, grad=False),
+    "mp_adamw_update": C(
+        lambda: (A(3, 4), A(3, 4), A(3, 4), POS(3, 4), A(3, 4),
+                 jnp.ones(())),
+        {"lr": 0.01, "wd": 0.01, "eta": 1.0}, grad=False, bf16=False),
+    "ftrl_update": C(lambda: (A(3, 4), A(3, 4), A(3, 4), POS(3, 4)),
+                     {"lr": 0.1}, grad=False),
+    "rmsprop_update": C(lambda: (A(3, 4), A(3, 4), POS(3, 4)),
+                        {"lr": 0.01}, grad=False),
+    "rmspropalex_update": C(
+        lambda: (A(3, 4), A(3, 4), POS(3, 4, lo=4.5, hi=6.0), UNIT(3, 4),
+                 A(3, 4)),
+        {"lr": 0.01}, grad=False),
+    "signsgd_update": C(_OPT_W, {"lr": 0.01}, grad=False),
+    "signum_update": C(lambda: (A(3, 4), A(3, 4), A(3, 4)),
+                       {"lr": 0.01, "momentum": 0.9}, grad=False),
+    "lamb_update_phase1": C(lambda: (A(3, 4), A(3, 4), A(3, 4), POS(3, 4)),
+                            {"t": 2}, grad=False),
+    "lamb_update_phase2": C(
+        lambda: (A(3, 4), A(3, 4), jnp.asarray(2.0), jnp.asarray(1.5)),
+        {"lr": 0.01}, grad=False),
+    "mp_lamb_update_phase1": C(
+        lambda: (A(3, 4), A(3, 4), A(3, 4), POS(3, 4), A(3, 4)),
+        {"t": 2}, grad=False, bf16=False),
+    "mp_lamb_update_phase2": C(
+        lambda: (A(3, 4), A(3, 4), jnp.asarray(2.0), jnp.asarray(1.5),
+                 A(3, 4)),
+        {"lr": 0.01}, grad=False, bf16=False),
+    "multi_sum_sq": C(lambda: (A(3, 4), A(2, 2)), {"num_arrays": 2},
+                      grad=False),
+    "multi_lars": C(lambda: (POS(4), POS(4), POS(4), POS(4)),
+                    {"eta": 0.001}, grad=False),
+    # random draws: explicit _key makes eager-vs-jit deterministic
+    "random_uniform": C(lambda: (), {"low": -1.0, "high": 1.0,
+                                     "shape": (3, 4), "_key": _KEY()},
+                        grad=False, bf16=False),
+    "random_normal": C(lambda: (), {"loc": 1.0, "scale": 2.0,
+                                    "shape": (3, 4), "_key": _KEY()},
+                       grad=False, bf16=False),
+    "random_gamma": C(lambda: (), {"alpha": 2.0, "beta": 1.5,
+                                   "shape": (3, 4), "_key": _KEY()},
+                      grad=False, bf16=False),
+    "random_exponential": C(lambda: (), {"lam": 2.0, "shape": (3, 4),
+                                         "_key": _KEY()},
+                            grad=False, bf16=False),
+    "random_poisson": C(lambda: (), {"lam": 3.0, "shape": (3, 4),
+                                     "_key": _KEY()},
+                        grad=False, bf16=False),
+    "random_negative_binomial": C(
+        lambda: (), {"k": 3, "p": 0.5, "shape": (3, 4), "_key": _KEY()},
+        grad=False, bf16=False),
+    "random_generalized_negative_binomial": C(
+        lambda: (), {"mu": 2.0, "alpha": 0.5, "shape": (3, 4),
+                     "_key": _KEY()}, grad=False, bf16=False),
+    "random_randint": C(lambda: (), {"low": 0, "high": 10,
+                                     "shape": (3, 4), "_key": _KEY()},
+                        grad=False, bf16=False),
+    "random_uniform_like": C(lambda: (A(3, 4),), {"_key": _KEY()},
+                             grad=False, bf16=False),
+    "random_normal_like": C(lambda: (A(3, 4),), {"_key": _KEY()},
+                            grad=False, bf16=False),
+    "random_gamma_like": C(lambda: (A(3, 4),), {"alpha": 2.0,
+                                                "_key": _KEY()},
+                           grad=False, bf16=False),
+    "random_exponential_like": C(lambda: (A(3, 4),), {"_key": _KEY()},
+                                 grad=False, bf16=False),
+    "random_poisson_like": C(lambda: (A(3, 4),), {"lam": 3.0,
+                                                  "_key": _KEY()},
+                             grad=False, bf16=False),
+    "random_negative_binomial_like": C(
+        lambda: (A(3, 4),), {"k": 3, "p": 0.5, "_key": _KEY()},
+        grad=False, bf16=False),
+    "random_generalized_negative_binomial_like": C(
+        lambda: (A(3, 4),), {"mu": 2.0, "alpha": 0.5, "_key": _KEY()},
+        grad=False, bf16=False),
+    "sample_uniform": C(lambda: (POS(3, lo=0.1, hi=0.4), POS(3, lo=1.0)),
+                        {"shape": (4,), "_key": _KEY()}, grad=False,
+                        bf16=False),
+    "sample_normal": C(lambda: (A(3), POS(3)),
+                       {"shape": (4,), "_key": _KEY()}, grad=False,
+                       bf16=False),
+    "sample_gamma": C(lambda: (POS(3), POS(3)),
+                      {"shape": (4,), "_key": _KEY()}, grad=False,
+                      bf16=False),
+    "sample_exponential": C(lambda: (POS(3),),
+                            {"shape": (4,), "_key": _KEY()}, grad=False,
+                            bf16=False),
+    "sample_poisson": C(lambda: (POS(3),),
+                        {"shape": (4,), "_key": _KEY()}, grad=False,
+                        bf16=False),
+    "sample_negative_binomial": C(
+        lambda: (POS(3, lo=1.0, hi=4.0), UNIT(3)),
+        {"shape": (4,), "_key": _KEY()}, grad=False, bf16=False),
+    "sample_generalized_negative_binomial": C(
+        lambda: (POS(3), POS(3, lo=0.3, hi=0.8)),
+        {"shape": (4,), "_key": _KEY()}, grad=False, bf16=False),
+    "_sample_multinomial": C(
+        lambda: (jnp.asarray([[0.2, 0.3, 0.5], [0.6, 0.2, 0.2]],
+                             jnp.float32),),
+        {"shape": (4,), "_key": _KEY()}, grad=False, bf16=False),
+    "shuffle": C(lambda: (A(5, 3),), {"_key": _KEY()}, grad=False,
+                 bf16=False),
+})
+
+SKIP.update({
+    "SVMOutput": "custom_vjp carries the IMPLICIT hinge-loss gradient "
+                 "(reference svm_output-inl.h contract): autodiff "
+                 "deliberately diverges from the forward's numeric "
+                 "jacobian; semantics pinned in tests/test_op_tail.py",
+    "IdentityAttachKLSparseReg": "custom_vjp ADDS the KL sparsity "
+                                 "penalty gradient to the cotangent "
+                                 "(implicit-regularizer contract); "
+                                 "semantics pinned in "
+                                 "tests/test_op_tail.py",
+})
+
+
 def _unique_ops():
     seen = {}
     for spec in base._OP_REGISTRY.values():

@@ -1,0 +1,78 @@
+"""ShardedDecoder: tp-sharded params + on-mesh KV caches must reproduce
+the replicated eager decode exactly (VERDICT r4 item 5).  Runs on the
+virtual 8-device CPU mesh from conftest.
+"""
+
+import numpy as np
+import pytest
+
+import mxtpu as mx
+from mxtpu import nd
+from mxtpu.models.transformer import llama_tiny
+from mxtpu.parallel import (ShardedDecoder, ShardingRules, make_mesh)
+from mxtpu.models.transformer import transformer_lm_sharding_rules
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    net = llama_tiny(vocab_size=50)
+    net.initialize()
+    return net
+
+
+def _mesh_tp2():
+    return make_mesh(dp=2, tp=2)
+
+
+def test_sharded_greedy_matches_replicated(tiny):
+    rng = np.random.RandomState(3)
+    B, Tp, new = 2, 4, 6
+    prompt = nd.array(rng.randint(0, 50, (B, Tp)), dtype="int32")
+
+    expect = tiny.generate(prompt, max_new_tokens=new).asnumpy()
+
+    mesh = _mesh_tp2()
+    dec = ShardedDecoder(tiny, mesh, transformer_lm_sharding_rules())
+    got = dec.generate(prompt, max_new_tokens=new).asnumpy()
+    np.testing.assert_array_equal(got, expect)
+
+
+def test_sharded_step_logits_match_full_context(tiny):
+    """Per-position logits through the sharded jitted step equal the
+    full-context forward (same check as the eager decode test, but over
+    the mesh)."""
+    rng = np.random.RandomState(5)
+    B, T = 2, 5
+    ids = nd.array(rng.randint(0, 50, (B, T)), dtype="int32")
+    full = tiny(ids).asnumpy()
+
+    mesh = _mesh_tp2()
+    dec = ShardedDecoder(tiny, mesh, transformer_lm_sharding_rules())
+    out = dec.generate(ids, max_new_tokens=1).asnumpy()
+    # greedy continuation from the full-context argmax must agree
+    np.testing.assert_array_equal(
+        out[:, -1], full[:, -1].argmax(axis=-1).astype(out.dtype))
+
+
+def test_single_compiled_step_serves_all_positions(tiny):
+    """The decode position is traced: one jit entry regardless of
+    sequence position (the whole point of the dynamic-slice cache
+    write)."""
+    rng = np.random.RandomState(7)
+    prompt = nd.array(rng.randint(0, 50, (2, 3)), dtype="int32")
+    mesh = _mesh_tp2()
+    dec = ShardedDecoder(tiny, mesh, transformer_lm_sharding_rules())
+    dec.generate(prompt, max_new_tokens=4)
+    assert len(dec._jit_cache) == 1
+
+
+def test_sharded_sampling_reproducible(tiny):
+    rng = np.random.RandomState(9)
+    prompt = nd.array(rng.randint(0, 50, (1, 3)), dtype="int32")
+    mesh = _mesh_tp2()
+    dec = ShardedDecoder(tiny, mesh, transformer_lm_sharding_rules())
+    a = dec.generate(prompt, max_new_tokens=5, temperature=0.8,
+                     seed=123).asnumpy()
+    b = dec.generate(prompt, max_new_tokens=5, temperature=0.8,
+                     seed=123).asnumpy()
+    np.testing.assert_array_equal(a, b)

@@ -1,0 +1,179 @@
+"""TPU-window watchdog (VERDICT r4 item 1).
+
+The axon TPU tunnel has been wedged (backend init hangs in
+``make_c_api_client``) for rounds 3 and 4, which left three rounds of
+perf work unmeasured.  This tool closes the "nothing pounces on a
+healthy window" gap:
+
+  * ``--once``    run one bounded health probe, append a timestamped
+                  record to the probe log, exit 0 iff healthy.
+  * ``--loop``    probe repeatedly (``--interval`` seconds apart); on the
+                  FIRST healthy probe run the full measurement battery,
+                  then exit.  ``--max-hours`` bounds the loop.
+  * ``--battery`` skip probing and run the battery immediately
+                  (for a manual run when the chip is known-healthy).
+
+The probe reuses ``bench.py --probe`` (jax.devices() + tiny jit + mxtpu
+import) under a hard subprocess timeout, so a wedged tunnel costs at
+most ``PROBE_TIMEOUT_S`` per attempt and can never hang the watchdog.
+
+Probe log: ``tpu_probe_log.jsonl`` at the repo root — one JSON line per
+probe {ts, ok, platform, probe_s, note}.  Committed with the repo, it is
+the auditable record of whether the tunnel ever offered a healthy
+window during a round.
+
+Measurement battery (priority order, each bounded):
+  1. ``bench.py``                      — full 3-metric battery
+  2. ``tools/profile_resnet.py`` A/B   — MXTPU_PALLAS_CONV_BWD=0 vs 1
+     (the round-3 adopt/reject decision for the fused conv backward)
+  3. flash-attention seq-{512,2048}    — included in bench.py metric 3
+
+Battery stdout/stderr land in ``perf_artifacts/`` with timestamps; the
+operator (or next session) turns them into PERF.md + the conv-bwd flag
+decision.  Upstream analogue: none (MXNet 1.x has no hardware watchdog);
+this is TPU-environment tooling.
+"""
+import argparse
+import datetime
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+LOG_PATH = os.path.join(REPO, "tpu_probe_log.jsonl")
+ART_DIR = os.path.join(REPO, "perf_artifacts")
+PROBE_TIMEOUT_S = 150
+BATTERY_BUDGET_S = {
+    "bench": 1200,
+    "profile_resnet_xla": 900,
+    "profile_resnet_pallas": 900,
+}
+
+
+def _now():
+    return datetime.datetime.now(datetime.timezone.utc).isoformat(
+        timespec="seconds")
+
+
+def _log(rec):
+    rec = {"ts": _now(), **rec}
+    with open(LOG_PATH, "a") as f:
+        f.write(json.dumps(rec) + "\n")
+    print(json.dumps(rec), flush=True)
+    return rec
+
+
+def probe_once():
+    """One bounded health probe.  Returns platform string or None."""
+    t0 = time.monotonic()
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.join(REPO, "bench.py"), "--probe"],
+            timeout=PROBE_TIMEOUT_S, stdout=subprocess.PIPE,
+            stderr=subprocess.PIPE, text=True)
+    except subprocess.TimeoutExpired:
+        _log({"ok": False, "platform": None,
+              "probe_s": round(time.monotonic() - t0, 1),
+              "note": "probe hung (timeout %ds) — tunnel wedged"
+                      % PROBE_TIMEOUT_S})
+        return None
+    dt = round(time.monotonic() - t0, 1)
+    platform = None
+    for ln in proc.stdout.splitlines():
+        ln = ln.strip()
+        if ln.startswith("{") and '"probe"' in ln:
+            try:
+                platform = json.loads(ln).get("platform")
+            except ValueError:
+                pass
+    if proc.returncode != 0 or platform is None:
+        _log({"ok": False, "platform": platform, "probe_s": dt,
+              "note": "probe rc=%d; stderr tail: %s"
+                      % (proc.returncode,
+                         (proc.stderr or "")[-200:].replace("\n", " "))})
+        return None
+    ok = platform in ("tpu", "axon")
+    _log({"ok": ok, "platform": platform, "probe_s": dt,
+          "note": "healthy TPU window" if ok
+                  else "backend up but platform=%s (no TPU)" % platform})
+    return platform if ok else None
+
+
+def _run_logged(name, cmd, timeout_s, env=None):
+    os.makedirs(ART_DIR, exist_ok=True)
+    stamp = _now().replace(":", "-")
+    out_path = os.path.join(ART_DIR, "%s_%s.out" % (name, stamp))
+    t0 = time.monotonic()
+    full_env = dict(os.environ)
+    if env:
+        full_env.update(env)
+    try:
+        proc = subprocess.run(cmd, timeout=timeout_s, text=True,
+                              stdout=subprocess.PIPE,
+                              stderr=subprocess.STDOUT, env=full_env)
+        rc, out = proc.returncode, proc.stdout
+    except subprocess.TimeoutExpired as e:
+        rc, out = -9, (e.output or "") + "\nTIMEOUT after %ds" % timeout_s
+    with open(out_path, "w") as f:
+        f.write(out or "")
+    _log({"battery": name, "rc": rc,
+          "elapsed_s": round(time.monotonic() - t0, 1),
+          "artifact": os.path.relpath(out_path, REPO)})
+    return rc, out
+
+
+def run_battery():
+    """The full measurement battery, in priority order."""
+    _log({"battery": "start",
+          "note": "healthy window — firing measurement battery"})
+    _run_logged("bench", [sys.executable, os.path.join(REPO, "bench.py")],
+                BATTERY_BUDGET_S["bench"])
+    prof = os.path.join(REPO, "tools", "profile_resnet.py")
+    # config index 0 = ("NHWC", 128, "bf16chain", False): the adopted
+    # round-3 bench config — the A/B axis is the Pallas conv backward.
+    _run_logged("profile_resnet_xla", [sys.executable, prof, "0"],
+                BATTERY_BUDGET_S["profile_resnet_xla"],
+                env={"MXTPU_PALLAS_CONV_BWD": "0"})
+    _run_logged("profile_resnet_pallas", [sys.executable, prof, "0"],
+                BATTERY_BUDGET_S["profile_resnet_pallas"],
+                env={"MXTPU_PALLAS_CONV_BWD": "1"})
+    _log({"battery": "done",
+          "note": "artifacts in perf_artifacts/ — compare the two "
+                  "profile_resnet runs to adopt/reject "
+                  "MXTPU_PALLAS_CONV_BWD (round-3 open decision)"})
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--once", action="store_true")
+    ap.add_argument("--loop", action="store_true")
+    ap.add_argument("--battery", action="store_true")
+    ap.add_argument("--interval", type=float, default=900,
+                    help="seconds between probes in --loop mode")
+    ap.add_argument("--max-hours", type=float, default=11,
+                    help="give up after this many hours in --loop mode")
+    args = ap.parse_args()
+
+    if args.battery:
+        run_battery()
+        return 0
+    if args.once or not args.loop:
+        return 0 if probe_once() else 1
+    deadline = time.monotonic() + args.max_hours * 3600
+    while time.monotonic() < deadline:
+        if probe_once():
+            run_battery()
+            return 0
+        remaining = deadline - time.monotonic()
+        if remaining <= args.interval:
+            break
+        time.sleep(args.interval)
+    _log({"ok": False, "note": "watchdog gave up after %.1fh — no healthy "
+                               "window observed" % args.max_hours})
+    return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
