@@ -93,6 +93,23 @@ class SwitchMoE(HybridBlock):
                              top_k=self._top_k)
         return y
 
+    def prefill_forward(self, x):
+        """Imperative forward for CHUNKED prefill: the TRAINING capacity
+        (not decode_forward's unbounded capacity = S*k, which at prompt
+        scale S = B*T would materialize O(S^2*E*k) dispatch tensors).
+        With the same S and capacity as hybrid_forward, prefill routing
+        is bit-identical to the full-context forward — exactly the
+        decode-parity contract."""
+        from .. import ndarray as nd
+
+        ctx = x.context
+        y, _ = nd.switch_moe(x, self.router_weight.data(ctx),
+                             self.experts_w1.data(ctx),
+                             self.experts_w2.data(ctx),
+                             capacity_factor=self._cf,
+                             activation=self._act, top_k=self._top_k)
+        return y
+
 
 class MoEDecoderLayer(HybridBlock):
     """LlamaDecoderLayer with the SwiGLU FFN swapped for SwitchMoE
@@ -128,6 +145,19 @@ class MoEDecoderLayer(HybridBlock):
                                              cache_k, cache_v, pos)
         x = x + h
         return x + self.moe.decode_forward(self.ffn_norm(x)), \
+            cache_k, cache_v
+
+    def prefill(self, x, cache_k, cache_v, start_pos=0):
+        """Chunked prompt ingestion (see Attention.prefill).  The routed
+        FFN uses the TRAINING capacity (prefill_forward): bounded
+        dispatch memory at prompt scale, and routing identical to the
+        full-context forward; only the one-token step() runs
+        capacity-unbounded."""
+        h, cache_k, cache_v = self.attn.prefill(self.attn_norm(x),
+                                                cache_k, cache_v,
+                                                start_pos)
+        x = x + h
+        return x + self.moe.prefill_forward(self.ffn_norm(x)), \
             cache_k, cache_v
 
 

@@ -168,6 +168,49 @@ class MultiHeadAttention(HybridBlock):
         out = out.reshape(B, 1, H * D)
         return self.out_proj(out), cache_k, cache_v
 
+    def prefill(self, x, cache_k, cache_v, start_pos=0):
+        """Process T tokens in ONE batched pass (vs T serial step()
+        calls): computes their K/V, writes the cache block at
+        [start_pos, start_pos+T), and returns causal attention outputs.
+        This is the standard chunked-prefill split — prompt ingestion is
+        compute-bound and belongs on the MXU as big matmuls; the serial
+        step() is only for the bandwidth-bound token-by-token phase.
+
+        x (B, T, C) -> (out (B, T, C), new_k, new_v).  Like step(),
+        functional: thread the returned caches forward."""
+        B, T, _ = x.shape
+        H, KV, D = self._heads, self._kv_heads, self._head_dim
+        Tmax = cache_k.shape[2]
+        qkv = self.qkv(x)
+        q = qkv[:, :, :H * D].reshape(B, T, H, D).transpose((0, 2, 1, 3))
+        k = qkv[:, :, H * D:(H + KV) * D].reshape(
+            B, T, KV, D).transpose((0, 2, 1, 3))
+        v = qkv[:, :, (H + KV) * D:].reshape(
+            B, T, KV, D).transpose((0, 2, 1, 3))
+        if self._rotary:
+            q = nd.rope(q, offset=start_pos)
+            k = nd.rope(k, offset=start_pos)
+        cache_k = nd._internal_cache_write(cache_k, k, pos=start_pos)
+        cache_v = nd._internal_cache_write(cache_v, v, pos=start_pos)
+        # GQA over the UNrepeated cache (same fold as step(): q head
+        # h = kv*rep + r, kv-major — matches hybrid_forward's repeat)
+        rep = H // KV
+        q_r = q.reshape(B * KV, rep * T, D)
+        keys = cache_k.reshape(B * KV, Tmax, D)
+        values = cache_v.reshape(B * KV, Tmax, D)
+        scores = nd.batch_dot(q_r, keys,
+                              transpose_b=True) / math.sqrt(D)
+        # query at sequence position start_pos+t sees keys <= its own
+        valid = (nd.arange(0, Tmax).reshape((1, Tmax))
+                 <= (nd.arange(0, T) + start_pos).reshape((T, 1)))
+        mask = valid.reshape((1, 1, T, Tmax)).astype("bool")
+        attn = nd.masked_softmax(
+            scores.reshape(B * KV, rep, T, Tmax), mask=mask)
+        out = nd.batch_dot(attn.reshape(B * KV, rep * T, Tmax), values)
+        out = out.reshape(B, KV, rep, T, D).transpose(
+            (0, 3, 1, 2, 4)).reshape(B, T, H * D)
+        return self.out_proj(out), cache_k, cache_v
+
 
 class TransformerEncoderLayer(HybridBlock):
     """Pre-LN encoder block (BERT uses post-LN originally; pre-LN is the
@@ -311,6 +354,17 @@ class LlamaDecoderLayer(HybridBlock):
         h = self.down_proj(nd.swish(self.gate_proj(h)) * self.up_proj(h))
         return x + h, cache_k, cache_v
 
+    def prefill(self, x, cache_k, cache_v, start_pos=0):
+        """Chunked prompt ingestion through this layer (T tokens in one
+        pass; see Attention.prefill)."""
+        h, cache_k, cache_v = self.attn.prefill(self.attn_norm(x),
+                                                cache_k, cache_v,
+                                                start_pos)
+        x = x + h
+        h = self.ffn_norm(x)
+        h = self.down_proj(nd.swish(self.gate_proj(h)) * self.up_proj(h))
+        return x + h, cache_k, cache_v
+
 
 class TransformerLM(HybridBlock):
     """Causal decoder LM (Llama architecture; stretch config 5).
@@ -396,6 +450,18 @@ class TransformerLM(HybridBlock):
             new_caches.append((ck, cv))
         return self._logits(x), new_caches
 
+    def prefill(self, token_ids, caches, start_pos=0):
+        """Ingest the whole prompt in ONE forward: token_ids (B, T) →
+        (logits (B, T, V), new_caches) with every layer's K/V cached at
+        [start_pos, start_pos+T).  One MXU-sized pass replaces T serial
+        step() calls — the standard prefill/decode split."""
+        x = self.embed(token_ids)
+        new_caches = []
+        for layer, (ck, cv) in zip(self.layers, caches):
+            x, ck, cv = layer.prefill(x, ck, cv, start_pos)
+            new_caches.append((ck, cv))
+        return self._logits(x), new_caches
+
     def generate(self, prompt_ids, max_new_tokens, max_length=None,
                  temperature=0.0, seed=None):
         """Greedy (temperature=0) or sampled autoregressive decode with a
@@ -403,9 +469,9 @@ class TransformerLM(HybridBlock):
         reference's example inference loops — new capability here).
 
         prompt_ids: (B, T_prompt) int NDArray.  Returns (B, T_prompt +
-        max_new_tokens) ids.  Every step runs fixed-shape kernels: the
-        prompt prefills the cache one position at a time with the same
-        compiled step the decode loop uses.
+        max_new_tokens) ids.  The prompt is ingested in ONE chunked
+        prefill forward (compute-bound, MXU-sized matmuls); the serial
+        fixed-shape step() only runs the bandwidth-bound decode phase.
 
         Decode expects REPLICATED parameters.  After sharded training,
         gather first (``p.set_data(nd.array(p.data().asnumpy()))`` per
@@ -425,10 +491,10 @@ class TransformerLM(HybridBlock):
             raise ValueError("max_length %d < prompt+new %d"
                              % (max_length, total))
         caches = self.init_cache(B, max_length)
-        tokens = [prompt_ids[:, i:i + 1] for i in range(Tp)]
-        logits = None
-        for pos in range(Tp):  # prefill (same compiled step as decode)
-            logits, caches = self.step(tokens[pos], caches, pos)
+        tokens = [prompt_ids]
+        # chunked prefill: the whole prompt in ONE forward (round-5);
+        # the serial step() loop below only runs the decode phase
+        logits, caches = self.prefill(prompt_ids, caches)
         for pos in range(Tp, total):
             if temperature and temperature > 0.0:
                 scaled = logits[:, -1] / temperature

@@ -109,3 +109,39 @@ roi_align = _np_op("ROIAlign")
 box_iou = _np_op("box_iou")
 box_nms = _np_op("box_nms")
 custom = _np_op("Custom")
+# round-5 tail: the remaining upstream npx names (python/mxnet/
+# numpy_extension _op surface, TBV — mount empty): batch_flatten,
+# shape/size introspection, waitall/seed session helpers, control flow,
+# detection ops, ROI pooling, CTC, multi-head-attention interleaved ops
+batch_flatten = _np_op("flatten")
+shape_array = _np_op("shape_array")
+size_array = _np_op("size_array")
+roi_pooling = _np_op("ROIPooling")
+ctc_loss = _np_op("ctc_loss")
+softmax_cross_entropy = _np_op("softmax_cross_entropy")
+multibox_prior = _np_op("multibox_prior")
+multibox_target = _np_op("multibox_target")
+multibox_detection = _np_op("multibox_detection")
+foreach = _np_op("foreach")
+while_loop = _np_op("while_loop")
+cond = _np_op("cond")
+interleaved_matmul_selfatt_qk = _np_op("interleaved_matmul_selfatt_qk")
+interleaved_matmul_selfatt_valatt = _np_op(
+    "interleaved_matmul_selfatt_valatt")
+interleaved_matmul_encdec_qk = _np_op("interleaved_matmul_encdec_qk")
+interleaved_matmul_encdec_valatt = _np_op(
+    "interleaved_matmul_encdec_valatt")
+# NOT provided: the sldwin_atten_* sliding-window attention family is
+# descoped — flash/ring attention cover the long-context use case
+
+
+def waitall():
+    """Parity: npx.waitall — drain the async queue."""
+    from ..ndarray import waitall as _w
+    return _w()
+
+
+def seed(seed_state, ctx="all"):
+    """Parity: npx.random.seed alias at the npx top level."""
+    from .. import random as _rnd
+    _rnd.seed(seed_state, ctx)

@@ -70,15 +70,22 @@ class ShardedDecoder:
             holder._rebind(jax.device_put(holder._data, sh))
         self._staged = True
 
-    # -- the compiled one-token step -------------------------------------
-    def _build_step(self, n_caches):
-        """Specialization happens entirely through the _jit_cache key +
-        jax.jit's own shape cache; only the cache count shapes the
-        in/out sharding trees here."""
+    # -- the compiled programs -------------------------------------------
+    def _build_program(self, body, n_caches, n_extra_inputs):
+        """Shared jit scaffolding for the decode programs: the param
+        holder swap/restore protocol, sharding trees (params by rules,
+        caches by cache_spec, everything else replicated) and cache
+        donation live HERE once — both the one-token step and the
+        chunked prefill specialize only the traced ``body``.
+
+        body(block, caches, *extra) -> (logits NDArray, new_caches).
+        Specialization happens through the _jit_cache key + jax.jit's
+        own shape cache; only the cache count shapes the sharding trees.
+        """
         block = self._block
         params = self._params
 
-        def step_fn(param_leaves, cache_leaves, token, pos):
+        def program(param_leaves, cache_leaves, *extra):
             saved = []
             for p, leaf in zip(params, param_leaves):
                 holder = p.data()
@@ -88,8 +95,7 @@ class ShardedDecoder:
                 with autograd.pause(train_mode=False):
                     caches = [(NDArray(ck), NDArray(cv))
                               for ck, cv in cache_leaves]
-                    logits, new_caches = block.step(
-                        NDArray(token), caches, NDArray(pos))
+                    logits, new_caches = body(block, caches, *extra)
             finally:
                 for holder, data in saved:
                     holder._data = data
@@ -104,19 +110,36 @@ class ShardedDecoder:
         cache_sh = tuple(
             (NamedSharding(jm, self._cache_spec),) * 2
             for _ in range(n_caches))
-        in_sh = (param_sh, cache_sh, rep, rep)
-        out_sh = (rep, cache_sh)
-        # donate the caches: each step's write superseded the old buffer
-        return jax.jit(step_fn, in_shardings=in_sh, out_shardings=out_sh,
-                       donate_argnums=(1,))
+        in_sh = (param_sh, cache_sh) + (rep,) * n_extra_inputs
+        # donate the caches: each write supersedes the old buffer
+        return jax.jit(program, in_shardings=in_sh,
+                       out_shardings=(rep, cache_sh), donate_argnums=(1,))
+
+    @staticmethod
+    def _step_body(block, caches, token, pos):
+        return block.step(NDArray(token), caches, NDArray(pos))
+
+    @staticmethod
+    def _prefill_body(block, caches, tokens):
+        return block.prefill(NDArray(tokens), caches)
 
     def _step_jitted(self, cache_leaves, token, pos):
-        key = (tuple(ck.shape for ck, _ in cache_leaves),
+        key = ("step", tuple(ck.shape for ck, _ in cache_leaves),
                cache_leaves[0][0].dtype, token.shape, token.dtype)
         if key not in self._jit_cache:
-            self._jit_cache[key] = self._build_step(len(cache_leaves))
+            self._jit_cache[key] = self._build_program(
+                self._step_body, len(cache_leaves), n_extra_inputs=2)
         param_leaves = tuple(p.data()._data for p in self._params)
         return self._jit_cache[key](param_leaves, cache_leaves, token, pos)
+
+    def _prefill_jitted(self, cache_leaves, tokens):
+        key = ("prefill", tuple(ck.shape for ck, _ in cache_leaves),
+               cache_leaves[0][0].dtype, tokens.shape, tokens.dtype)
+        if key not in self._jit_cache:
+            self._jit_cache[key] = self._build_program(
+                self._prefill_body, len(cache_leaves), n_extra_inputs=1)
+        param_leaves = tuple(p.data()._data for p in self._params)
+        return self._jit_cache[key](param_leaves, cache_leaves, tokens)
 
     # -- public API ------------------------------------------------------
     def generate(self, prompt_ids, max_new_tokens, max_length=None,
@@ -146,12 +169,10 @@ class ShardedDecoder:
             for ck, cv in self._block.init_cache(B, max_length,
                                                  cache_dtype))
 
-        tokens = [prompt_ids[:, i:i + 1] for i in range(Tp)]
-        raw_tok = [t._data.astype(jnp.int32) for t in tokens]
-        logits = None
-        for pos in range(Tp):  # prefill with the SAME compiled step
-            logits, cache_leaves = self._step_jitted(
-                cache_leaves, raw_tok[pos], jnp.int32(pos))
+        tokens = [prompt_ids]
+        # chunked prefill: one compiled forward ingests the whole prompt
+        logits, cache_leaves = self._prefill_jitted(
+            cache_leaves, prompt_ids._data.astype(jnp.int32))
         for pos in range(Tp, total):
             last = logits[:, -1]
             if temperature and temperature > 0.0:

@@ -57,9 +57,13 @@ class _Node:
 class Symbol:
     """One output of a graph node."""
 
-    def __init__(self, node: _Node, index: int = 0):
+    def __init__(self, node: _Node, index: int = 0, selected: bool = False):
         self._node = node
         self._index = index
+        # selected=True marks a handle produced by indexing a
+        # multi-output node (sym[i]): it stays a SINGLE output even when
+        # i == 0, unlike the base symbol which exposes all outputs
+        self._selected = selected
 
     # -- construction ----------------------------------------------------
     @staticmethod
@@ -187,18 +191,19 @@ class Symbol:
         return outs
 
     def _output_entries(self):
+        # the base symbol of a multi-output node exposes ALL its outputs
+        # (upstream: binding such a symbol yields every output); an
+        # explicitly-selected output (sym[i], incl. i == 0) stays one
+        if (not self._selected and self._index == 0
+                and self._node.num_outputs > 1):
+            return [(self._node, i) for i in range(self._node.num_outputs)]
         return [(self._node, self._index)]
 
     @property
     def num_outputs(self):
-        entries = self._output_entries()
-        if (len(entries) == 1 and entries[0][1] == 0
-                and entries[0][0].num_outputs > 1):
-            # base symbol of a multi-output node: iterate ITS outputs
-            # (mirrors __getitem__'s selection semantics, so tuple
-            # unpacking of a freshly built multi-output op works)
-            return entries[0][0].num_outputs
-        return len(entries)
+        # _output_entries already expands the base symbol of a
+        # multi-output node (and keeps sym[i] handles single)
+        return len(self._output_entries())
 
     def __getitem__(self, idx):
         if isinstance(idx, str):
@@ -216,9 +221,9 @@ class Symbol:
                 idx += node.num_outputs
             if not 0 <= idx < node.num_outputs:
                 raise IndexError(idx)
-            return Symbol(node, idx)
+            return Symbol(node, idx, selected=True)
         node, base = entries[idx]
-        return Symbol(node, base)
+        return Symbol(node, base, selected=True)
 
     def __iter__(self):
         return (self[i] for i in range(self.num_outputs))
@@ -262,21 +267,21 @@ class Symbol:
     def _substitute(self, mapping, memo):
         node = self._node
         if id(node) in memo:
-            return Symbol(memo[id(node)], self._index)
+            return Symbol(memo[id(node)], self._index, self._selected)
         if node.op is None:
             repl = mapping.get(node.name)
             if repl is not None:
                 memo[id(node)] = repl._node
-                return Symbol(repl._node, repl._index)
+                return Symbol(repl._node, repl._index, repl._selected)
             memo[id(node)] = node
-            return Symbol(node, self._index)
+            return Symbol(node, self._index, self._selected)
         new_inputs = [s._substitute(mapping, memo) for s in node.inputs]
         new_node = _Node(node.op, new_inputs, node.arg_layout, node.kwargs,
                          node.name, dict(node.attrs),
                          kw_sym_names=node.kw_sym_names)
         new_node.num_outputs = node.num_outputs
         memo[id(node)] = new_node
-        return Symbol(new_node, self._index)
+        return Symbol(new_node, self._index, self._selected)
 
     # -- execution --------------------------------------------------------
     def _eval_node_outputs(self, node, values):
@@ -518,7 +523,7 @@ class Symbol:
         return "<Symbol %s>" % self.name
 
     def __copy__(self):
-        return Symbol(self._node, self._index)
+        return Symbol(self._node, self._index, self._selected)
 
     def __deepcopy__(self, memo):
         return self._substitute({}, {})

@@ -275,3 +275,65 @@ def test_multinomial_counts_sum_to_n():
     mx.random.seed(5)
     c = np.random.multinomial(50, onp.array([0.1, 0.4, 0.5]))
     assert int(onp.asarray(c.asnumpy()).sum()) == 50
+
+
+# -- npx surface checklist (round-5) ----------------------------------------
+
+NPX_NAMES = """
+activation arange_like batch_dot batch_flatten batch_norm box_iou
+box_nms broadcast_like cast cond convolution ctc_loss custom
+deconvolution dropout embedding erf erfinv foreach fully_connected
+gamma gammaln gather_nd group_norm hard_sigmoid instance_norm
+interleaved_matmul_encdec_qk interleaved_matmul_encdec_valatt
+interleaved_matmul_selfatt_qk interleaved_matmul_selfatt_valatt
+is_np_array is_np_shape layer_norm leaky_relu load log_softmax
+masked_softmax multibox_detection multibox_prior multibox_target
+one_hot pick pooling relu reshape_like rms_norm rnn roi_align
+roi_pooling rope save scatter_nd seed sequence_last sequence_mask
+sequence_reverse set_np shape_array sigmoid size_array slice_like
+smooth_l1 softmax softmax_cross_entropy softsign stop_gradient topk
+use_np use_np_array use_np_shape waitall while_loop
+""".split()
+
+
+def test_npx_checklist_complete():
+    import mxtpu.numpy_extension as npx
+    missing = [n for n in NPX_NAMES if not hasattr(npx, n)]
+    assert not missing, f"mx.npx missing names: {missing}"
+
+
+def test_npx_ops_execute_on_np_arrays():
+    import mxtpu.numpy_extension as npx
+    x = np.array([[1.0, -2.0], [0.5, 3.0]])
+    out = npx.relu(x)
+    assert type(out) is type(x)
+    onp.testing.assert_array_equal(out.asnumpy(),
+                                   [[1.0, 0.0], [0.5, 3.0]])
+    flat = npx.batch_flatten(np.ones((2, 3, 4)))
+    assert flat.shape == (2, 12)
+    assert tuple(onp.asarray(npx.shape_array(x).asnumpy())) == (2, 2)
+    npx.seed(5)
+    npx.waitall()
+
+
+# -- symbolic variable-arity op (callable num_outputs) ----------------------
+
+def test_symbol_sample_multinomial_variable_arity():
+    """_sample_multinomial declares 1 output normally and 2 with
+    get_prob=True (callable OpSpec.num_outputs) — the symbol graph must
+    unpack accordingly."""
+    import jax
+    from mxtpu import symbol as sym
+
+    data = sym.Variable("data")
+    s1 = sym._sample_multinomial(data, shape=(3,),
+                                 _key=jax.random.key(0))
+    assert s1.num_outputs == 1
+    s2 = sym._sample_multinomial(data, shape=(3,), get_prob=True,
+                                 _key=jax.random.key(0))
+    assert s2.num_outputs == 2
+    ex = s2.bind(args={"data": mx.nd.array(
+        onp.asarray([[0.1, 0.9], [0.8, 0.2]], "float32"))})
+    outs = ex.forward()
+    assert len(outs) == 2
+    assert outs[0].shape == (2, 3) and outs[1].shape == (2, 3)

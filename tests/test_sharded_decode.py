@@ -55,15 +55,17 @@ def test_sharded_step_logits_match_full_context(tiny):
 
 
 def test_single_compiled_step_serves_all_positions(tiny):
-    """The decode position is traced: one jit entry regardless of
-    sequence position (the whole point of the dynamic-slice cache
-    write)."""
+    """The decode position is traced: exactly TWO compiled programs for
+    an entire generation — one chunked prefill (whole prompt) and one
+    decode step reused at every position (the whole point of the
+    dynamic-slice cache write)."""
     rng = np.random.RandomState(7)
     prompt = nd.array(rng.randint(0, 50, (2, 3)), dtype="int32")
     mesh = _mesh_tp2()
     dec = ShardedDecoder(tiny, mesh, transformer_lm_sharding_rules())
     dec.generate(prompt, max_new_tokens=4)
-    assert len(dec._jit_cache) == 1
+    assert len(dec._jit_cache) == 2
+    assert sum(1 for k in dec._jit_cache if k[0] == "prefill") == 1
 
 
 def test_sharded_sampling_reproducible(tiny):

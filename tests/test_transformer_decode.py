@@ -78,3 +78,65 @@ def test_tied_weights_decode():
         logits, caches = net.step(ids[:, pos:pos + 1], caches, pos)
     np.testing.assert_allclose(logits.asnumpy()[:, 0], full[:, -1],
                                rtol=2e-4, atol=2e-5)
+
+
+# -------------------------------------------- round-5: chunked prefill
+
+def test_prefill_matches_per_token_steps(tiny):
+    """One chunked prefill == T serial step() calls: same logits at
+    every position, same cache contents."""
+    rng = np.random.RandomState(11)
+    B, T = 2, 6
+    ids = nd.array(rng.randint(0, 50, (B, T)), dtype="int32")
+
+    step_caches = tiny.init_cache(B, T)
+    step_logits = []
+    for pos in range(T):
+        lg, step_caches = tiny.step(ids[:, pos:pos + 1], step_caches, pos)
+        step_logits.append(lg.asnumpy()[:, 0])
+
+    pre_logits, pre_caches = tiny.prefill(ids, tiny.init_cache(B, T))
+    pre_logits = pre_logits.asnumpy()
+    for pos in range(T):
+        np.testing.assert_allclose(pre_logits[:, pos], step_logits[pos],
+                                   rtol=2e-4, atol=2e-5)
+    for (sk, sv), (pk, pv) in zip(step_caches, pre_caches):
+        np.testing.assert_allclose(pk.asnumpy(), sk.asnumpy(),
+                                   rtol=1e-5, atol=1e-6)
+        np.testing.assert_allclose(pv.asnumpy(), sv.asnumpy(),
+                                   rtol=1e-5, atol=1e-6)
+
+
+def test_prefill_then_step_continues_correctly(tiny):
+    """Decode after a chunked prefill equals full-context logits."""
+    rng = np.random.RandomState(12)
+    B, T = 2, 5
+    ids = nd.array(rng.randint(0, 50, (B, T)), dtype="int32")
+    full = tiny(ids).asnumpy()
+
+    logits, caches = tiny.prefill(ids[:, :T - 1],
+                                  tiny.init_cache(B, T))
+    np.testing.assert_allclose(logits.asnumpy()[:, -1], full[:, T - 2],
+                               rtol=2e-4, atol=2e-5)
+    lg, _ = tiny.step(ids[:, T - 1:T], caches, T - 1)
+    np.testing.assert_allclose(lg.asnumpy()[:, 0], full[:, T - 1],
+                               rtol=2e-4, atol=2e-5)
+
+
+def test_prefill_with_nonzero_start_pos(tiny):
+    """Two-chunk prefill (chunk 2 at start_pos=3) == one-chunk prefill."""
+    rng = np.random.RandomState(13)
+    B, T = 2, 6
+    ids = nd.array(rng.randint(0, 50, (B, T)), dtype="int32")
+
+    one_logits, one_caches = tiny.prefill(ids, tiny.init_cache(B, T))
+
+    caches = tiny.init_cache(B, T)
+    _, caches = tiny.prefill(ids[:, :3], caches)
+    two_logits, caches = tiny.prefill(ids[:, 3:], caches, start_pos=3)
+    np.testing.assert_allclose(two_logits.asnumpy(),
+                               one_logits.asnumpy()[:, 3:],
+                               rtol=2e-4, atol=2e-5)
+    for (ak, av), (bk, bv) in zip(one_caches, caches):
+        np.testing.assert_allclose(ak.asnumpy(), bk.asnumpy(),
+                                   rtol=1e-5, atol=1e-6)
