@@ -17,3 +17,5 @@ from .transformer import (MultiHeadAttention, TransformerEncoderLayer,
                           bert_sharding_rules)
 from . import moe
 from .moe import SwitchMoE, MoEDecoderLayer, moe_sharding_rules
+from . import sampler
+from .sampler import BeamSearchSampler, beam_search

@@ -1,0 +1,144 @@
+"""Sequence samplers over the KV-cache decode path.
+
+Parity target: gluonnlp's BeamSearchSampler / SequenceSampler (the
+inference companions of the reference's transformer stack — upstream
+MXNet itself ships only example-level greedy loops).  TPU-first shape
+discipline: the beam state is a fixed (B*K) batch so every decode step
+reuses the same compiled kernels; beam reordering is a batch-axis
+gather on the caches.
+"""
+
+from __future__ import annotations
+
+import numpy as onp
+
+from .. import ndarray as nd
+from ..ndarray import NDArray
+
+__all__ = ["BeamSearchSampler", "beam_search"]
+
+_NEG_INF = -1e30
+
+
+class BeamSearchSampler:
+    """Length-normalized beam search (gluonnlp conventions).
+
+    Parameters
+    ----------
+    model : TransformerLM-like block (init_cache / prefill / step).
+    beam_size : beams per sequence (K).
+    alpha : length-penalty exponent; candidate ranking uses
+        score / ((5 + len) / 6)^alpha (GNMT / gluonnlp formula).
+    eos_id : optional token id that terminates a beam; finished beams
+        are frozen (their score stops accumulating) and padded with
+        eos_id.
+    """
+
+    def __init__(self, model, beam_size=4, alpha=0.6, eos_id=None):
+        self._model = model
+        self._K = int(beam_size)
+        self._alpha = float(alpha)
+        self._eos = eos_id
+
+    def _log_softmax(self, logits):
+        x = logits.astype(onp.float64)
+        x = x - x.max(axis=-1, keepdims=True)
+        return x - onp.log(onp.exp(x).sum(axis=-1, keepdims=True))
+
+    def _penalty(self, length):
+        return ((5.0 + length) / 6.0) ** self._alpha
+
+    def __call__(self, prompt_ids, max_new_tokens, max_length=None):
+        """Returns (samples, scores): samples (B, K, T_prompt + new) int
+        NDArray sorted by descending length-normalized score; scores
+        (B, K) numpy array of raw sequence log-probs."""
+        model = self._model
+        K = self._K
+        prompt_ids = prompt_ids if isinstance(prompt_ids, NDArray) \
+            else nd.array(prompt_ids)
+        B, Tp = prompt_ids.shape
+        total = Tp + max_new_tokens
+        max_length = max_length or total
+        if max_length < total:
+            raise ValueError("max_length %d < prompt+new %d"
+                             % (max_length, total))
+        if max_new_tokens <= 0:  # contract parity with generate()
+            beams = onp.repeat(prompt_ids.asnumpy()[:, None, :], K, axis=1)
+            return nd.array(beams, dtype="int32"), onp.zeros((B, K))
+
+        # prefill at batch B, then tile each sequence's caches K times:
+        # beam b*K+k decodes continuation k of sequence b
+        caches = model.init_cache(B, max_length)
+        logits, caches = model.prefill(prompt_ids, caches)
+        caches = [(nd.repeat(ck, repeats=K, axis=0),
+                   nd.repeat(cv, repeats=K, axis=0)) for ck, cv in caches]
+
+        logp = self._log_softmax(logits.asnumpy()[:, -1])      # (B, V)
+        V = logp.shape[-1]
+        top = onp.argsort(-logp, axis=-1)[:, :K]               # (B, K)
+        scores = onp.take_along_axis(logp, top, axis=-1)       # (B, K)
+        beams = onp.repeat(prompt_ids.asnumpy()[:, None, :], K, axis=1)
+        beams = onp.concatenate(
+            [beams, top[:, :, None].astype(beams.dtype)], axis=2)
+        finished = onp.zeros((B, K), bool)
+        if self._eos is not None:
+            finished |= (top == self._eos)
+
+        for pos in range(Tp, total - 1):
+            tok = nd.array(beams[:, :, -1].reshape(B * K, 1),
+                           dtype="int32")
+            logits, caches = model.step(tok, caches, pos)
+            logp = self._log_softmax(
+                logits.asnumpy()[:, 0]).reshape(B, K, V)
+            # frozen beams: only an eos continuation at logprob 0 (their
+            # score must not change, and they must stay selectable)
+            if self._eos is not None and finished.any():
+                frozen = onp.full((B, K, V), _NEG_INF)
+                frozen[:, :, self._eos] = 0.0
+                logp = onp.where(finished[:, :, None], frozen, logp)
+            cand = scores[:, :, None] + logp                   # (B, K, V)
+            # rank by length-normalized score, keep RAW scores
+            cur_len = beams.shape[2] - Tp + 1
+            norm = cand / self._penalty(cur_len)
+            flat = norm.reshape(B, K * V)
+            pick = onp.argsort(-flat, axis=-1)[:, :K]          # (B, K)
+            src_beam = pick // V
+            tok_next = pick % V
+            scores = onp.take_along_axis(cand.reshape(B, K * V), pick,
+                                         axis=-1)
+            # reorder beam histories + caches by origin beam
+            beams = onp.take_along_axis(
+                beams, src_beam[:, :, None], axis=1)
+            beams = onp.concatenate(
+                [beams, tok_next[:, :, None].astype(beams.dtype)],
+                axis=2)
+            if pos < total - 2:  # final iteration: caches die unused
+                gather = (onp.arange(B)[:, None] * K
+                          + src_beam).reshape(-1)
+                gidx = nd.array(gather, dtype="int32")
+                caches = [(nd.take(ck, gidx, axis=0),
+                           nd.take(cv, gidx, axis=0))
+                          for ck, cv in caches]
+            finished = onp.take_along_axis(finished, src_beam, axis=1)
+            if self._eos is not None:
+                finished |= (tok_next == self._eos)
+                if finished.all():
+                    pad = onp.full(
+                        (B, K, total - beams.shape[2]), self._eos,
+                        beams.dtype)
+                    beams = onp.concatenate([beams, pad], axis=2)
+                    break
+
+        # final ordering by length-normalized score
+        order = onp.argsort(
+            -scores / self._penalty(beams.shape[2] - Tp), axis=-1)
+        beams = onp.take_along_axis(beams, order[:, :, None], axis=1)
+        scores = onp.take_along_axis(scores, order, axis=-1)
+        return nd.array(beams, dtype="int32"), scores
+
+
+def beam_search(model, prompt_ids, max_new_tokens, beam_size=4,
+                alpha=0.6, eos_id=None, max_length=None):
+    """Functional convenience over BeamSearchSampler."""
+    return BeamSearchSampler(model, beam_size, alpha, eos_id)(
+        prompt_ids, max_new_tokens, max_length)

@@ -57,6 +57,10 @@ class _Node:
 class Symbol:
     """One output of a graph node."""
 
+    # class-level default: subclasses that skip __init__ (_GroupSymbol)
+    # still answer the _selected reads in copy/substitute paths
+    _selected = False
+
     def __init__(self, node: _Node, index: int = 0, selected: bool = False):
         self._node = node
         self._index = index
@@ -211,7 +215,7 @@ class Symbol:
             idx = names.index(idx)
         entries = self._output_entries()
         if (len(entries) == 1 and entries[0][0].num_outputs > 1
-                and entries[0][1] == 0):
+                and entries[0][1] == 0 and not self._selected):
             # select among THIS node's outputs (multi-output op, e.g.
             # split / control-flow): sym[i] -> i-th output.  Only from the
             # base (index-0) symbol — an already-selected output indexes
