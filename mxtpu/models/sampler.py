@@ -15,9 +15,45 @@ import numpy as onp
 from .. import ndarray as nd
 from ..ndarray import NDArray
 
-__all__ = ["BeamSearchSampler", "beam_search"]
+__all__ = ["BeamSearchSampler", "beam_search", "sample_next_token"]
 
 _NEG_INF = -1e30
+
+
+def sample_next_token(logits, key, temperature=1.0, top_k=0, top_p=0.0):
+    """Draw next-token ids from (B, V) logits with temperature plus
+    optional top-k and/or nucleus (top-p) truncation — the standard LM
+    sampling controls (no reference analogue; gluonnlp's
+    SequenceSampler exposes the same knobs).  Returns (B,) int32.
+
+    top_k > 0: keep only the k highest logits.  top_p in (0, 1]: keep
+    the smallest prefix of the probability-sorted vocabulary whose mass
+    reaches top_p (the top-1 token always stays).  Both filters compose
+    (k first, then p), jit-safe: fixed shapes, no host sync."""
+    import jax
+    import jax.numpy as jnp
+
+    x = logits.astype(jnp.float32)
+    if not temperature or temperature <= 0.0:
+        # temperature 0 means greedy by convention (same contract as
+        # generate()): no random draw at all
+        return jnp.argmax(x, axis=-1).astype(jnp.int32)
+    if temperature != 1.0:
+        x = x / temperature
+    if top_k and top_k > 0:
+        kth = jax.lax.top_k(x, min(int(top_k), x.shape[-1]))[0][..., -1:]
+        x = jnp.where(x < kth, _NEG_INF, x)
+    if top_p and 0.0 < top_p < 1.0:
+        sorted_x = jnp.sort(x, axis=-1)[..., ::-1]
+        probs = jax.nn.softmax(sorted_x, axis=-1)
+        cum = jnp.cumsum(probs, axis=-1)
+        # keep tokens while the mass BEFORE them is < top_p (so the
+        # first token is always kept and the prefix reaches top_p)
+        keep_sorted = (cum - probs) < top_p
+        cutoff = jnp.min(jnp.where(keep_sorted, sorted_x, jnp.inf),
+                         axis=-1, keepdims=True)
+        x = jnp.where(x < cutoff, _NEG_INF, x)
+    return jax.random.categorical(key, x, axis=-1).astype(jnp.int32)
 
 
 class BeamSearchSampler:
@@ -48,6 +84,16 @@ class BeamSearchSampler:
     def _penalty(self, length):
         return ((5.0 + length) / 6.0) ** self._alpha
 
+    @staticmethod
+    def _topk_desc(flat, k):
+        """Indices of the k largest entries per row, descending —
+        argpartition + small sort (O(n) vs a full-vocab argsort in the
+        serial decode loop)."""
+        part = onp.argpartition(-flat, k - 1, axis=-1)[:, :k]
+        vals = onp.take_along_axis(flat, part, axis=-1)
+        order = onp.argsort(-vals, axis=-1)
+        return onp.take_along_axis(part, order, axis=-1)
+
     def __call__(self, prompt_ids, max_new_tokens, max_length=None):
         """Returns (samples, scores): samples (B, K, T_prompt + new) int
         NDArray sorted by descending length-normalized score; scores
@@ -75,7 +121,7 @@ class BeamSearchSampler:
 
         logp = self._log_softmax(logits.asnumpy()[:, -1])      # (B, V)
         V = logp.shape[-1]
-        top = onp.argsort(-logp, axis=-1)[:, :K]               # (B, K)
+        top = self._topk_desc(logp, min(K, V))                 # (B, K)
         scores = onp.take_along_axis(logp, top, axis=-1)       # (B, K)
         beams = onp.repeat(prompt_ids.asnumpy()[:, None, :], K, axis=1)
         beams = onp.concatenate(
@@ -83,6 +129,8 @@ class BeamSearchSampler:
         finished = onp.zeros((B, K), bool)
         if self._eos is not None:
             finished |= (top == self._eos)
+        lengths = onp.ones((B, K))  # decoded tokens per beam (frozen
+        #                             beams stop growing)
 
         for pos in range(Tp, total - 1):
             tok = nd.array(beams[:, :, -1].reshape(B * K, 1),
@@ -97,15 +145,18 @@ class BeamSearchSampler:
                 frozen[:, :, self._eos] = 0.0
                 logp = onp.where(finished[:, :, None], frozen, logp)
             cand = scores[:, :, None] + logp                   # (B, K, V)
-            # rank by length-normalized score, keep RAW scores
-            cur_len = beams.shape[2] - Tp + 1
-            norm = cand / self._penalty(cur_len)
+            # rank by PER-BEAM length-normalized score (frozen beams
+            # keep their shorter length — this is where the GNMT
+            # penalty actually changes the ordering), keep RAW scores
+            cand_len = lengths + (~finished)                   # (B, K)
+            norm = cand / self._penalty(cand_len)[:, :, None]
             flat = norm.reshape(B, K * V)
-            pick = onp.argsort(-flat, axis=-1)[:, :K]          # (B, K)
+            pick = self._topk_desc(flat, K)                    # (B, K)
             src_beam = pick // V
             tok_next = pick % V
             scores = onp.take_along_axis(cand.reshape(B, K * V), pick,
                                          axis=-1)
+            lengths = onp.take_along_axis(cand_len, src_beam, axis=1)
             # reorder beam histories + caches by origin beam
             beams = onp.take_along_axis(
                 beams, src_beam[:, :, None], axis=1)
@@ -129,9 +180,8 @@ class BeamSearchSampler:
                     beams = onp.concatenate([beams, pad], axis=2)
                     break
 
-        # final ordering by length-normalized score
-        order = onp.argsort(
-            -scores / self._penalty(beams.shape[2] - Tp), axis=-1)
+        # final ordering by PER-BEAM length-normalized score
+        order = onp.argsort(-scores / self._penalty(lengths), axis=-1)
         beams = onp.take_along_axis(beams, order[:, :, None], axis=1)
         scores = onp.take_along_axis(scores, order, axis=-1)
         return nd.array(beams, dtype="int32"), scores

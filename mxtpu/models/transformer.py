@@ -463,7 +463,7 @@ class TransformerLM(HybridBlock):
         return self._logits(x), new_caches
 
     def generate(self, prompt_ids, max_new_tokens, max_length=None,
-                 temperature=0.0, seed=None):
+                 temperature=0.0, top_k=0, top_p=0.0, seed=None):
         """Greedy (temperature=0) or sampled autoregressive decode with a
         KV cache (parity target: gluonnlp SequenceSampler / the
         reference's example inference loops — new capability here).
@@ -473,17 +473,16 @@ class TransformerLM(HybridBlock):
         prefill forward (compute-bound, MXU-sized matmuls); the serial
         fixed-shape step() only runs the bandwidth-bound decode phase.
 
+        Sampling: temperature=0 (default) decodes greedily and IGNORES
+        top_k/top_p; with temperature > 0, draws go through
+        sampler.sample_next_token with optional top-k truncation and
+        nucleus (top_p) filtering.
+
         Decode expects REPLICATED parameters.  After sharded training,
         gather first (``p.set_data(nd.array(p.data().asnumpy()))`` per
         param — see examples/parallel/llama_train.py); eager decode over
         mesh-sharded weights would launch a collective per token.
         """
-        if seed is not None and temperature and temperature > 0.0:
-            # reproducible sampling; note this seeds the GLOBAL mxtpu
-            # key stream (mx.random.seed semantics)
-            from .. import random as _rnd
-            _rnd.seed(seed)
-
         B, Tp = prompt_ids.shape
         total = Tp + max_new_tokens
         max_length = max_length or total
@@ -495,11 +494,20 @@ class TransformerLM(HybridBlock):
         # chunked prefill: the whole prompt in ONE forward (round-5);
         # the serial step() loop below only runs the decode phase
         logits, caches = self.prefill(prompt_ids, caches)
+        if seed is not None and temperature and temperature > 0.0:
+            # reproducible sampling: seeds the GLOBAL mxtpu key stream
+            # (mx.random.seed semantics).  Seed AFTER the prefill — a
+            # first-ever forward finishes deferred parameter init, which
+            # draws ring keys and would shift the sampling stream
+            from .. import random as _rnd
+            _rnd.seed(seed)
         for pos in range(Tp, total):
             if temperature and temperature > 0.0:
-                scaled = logits[:, -1] / temperature
-                nxt = nd.random.multinomial(
-                    nd.softmax(scaled, axis=-1)).reshape((B, 1))
+                from .sampler import sample_next_token
+                from .. import random as _rnd
+                nxt = NDArray(sample_next_token(
+                    logits[:, -1]._data, _rnd.next_key(), temperature,
+                    top_k, top_p)).reshape((B, 1))
             else:
                 nxt = logits[:, -1].argmax(axis=-1).reshape(
                     (B, 1))

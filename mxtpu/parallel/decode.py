@@ -143,17 +143,25 @@ class ShardedDecoder:
 
     # -- public API ------------------------------------------------------
     def generate(self, prompt_ids, max_new_tokens, max_length=None,
-                 temperature=0.0, seed=None, cache_dtype="float32"):
+                 temperature=0.0, top_k=0, top_p=0.0, seed=None,
+                 cache_dtype="float32"):
         """Same contract as ``TransformerLM.generate`` but sharded: the
         params keep their mesh shardings; returns (B, T_prompt +
-        max_new_tokens) ids as a host NDArray."""
-        if not self._staged:
-            self._stage()
-        if seed is not None and temperature and temperature > 0.0:
-            _random.seed(seed)
-
+        max_new_tokens) ids as a host NDArray.  temperature=0 decodes
+        greedily and ignores top_k/top_p (same gating as generate)."""
         prompt_ids = prompt_ids if isinstance(prompt_ids, NDArray) \
             else nd_array(prompt_ids)
+        if not self._staged:
+            # resolve deferred parameter shapes with one imperative
+            # forward (same bootstrap as SPMDTrainer.step), then stage
+            from ..gluon.parameter import DeferredInitializationError
+            try:
+                for p in self._params:
+                    p.data()
+            except DeferredInitializationError:
+                with autograd.pause(train_mode=False):
+                    self._block(prompt_ids)
+            self._stage()
         B, Tp = prompt_ids.shape
         total = Tp + max_new_tokens
         max_length = max_length or total
@@ -173,12 +181,16 @@ class ShardedDecoder:
         # chunked prefill: one compiled forward ingests the whole prompt
         logits, cache_leaves = self._prefill_jitted(
             cache_leaves, prompt_ids._data.astype(jnp.int32))
+        if seed is not None and temperature and temperature > 0.0:
+            # after prefill: deferred init / staging must not shift the
+            # sampling stream (same ordering as TransformerLM.generate)
+            _random.seed(seed)
         for pos in range(Tp, total):
             last = logits[:, -1]
             if temperature and temperature > 0.0:
-                scaled = last / temperature
-                k = _random.next_key()
-                nxt = jax.random.categorical(k, scaled, axis=-1)
+                from ..models.sampler import sample_next_token
+                nxt = sample_next_token(last, _random.next_key(),
+                                        temperature, top_k, top_p)
             else:
                 nxt = jnp.argmax(last, axis=-1)
             nxt = nxt.reshape(B, 1).astype(jnp.int32)

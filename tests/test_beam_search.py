@@ -100,3 +100,128 @@ def test_eos_freezes_beam(tiny):
             assert all(t == eos for t in seq.tolist()[i:])  # padded
             hit = True
     assert hit
+
+
+# ----------------------------------------- top-k / top-p sampling knobs
+
+def test_sample_next_token_topk1_is_greedy():
+    import jax
+    import jax.numpy as jnp
+    from mxtpu.models.sampler import sample_next_token
+
+    logits = jnp.asarray(np.random.RandomState(7).randn(4, 20),
+                         jnp.float32)
+    out = sample_next_token(logits, jax.random.key(0), temperature=1.0,
+                            top_k=1)
+    np.testing.assert_array_equal(np.asarray(out),
+                                  np.asarray(logits).argmax(-1))
+
+
+def test_sample_next_token_topk_restricts_support():
+    import jax
+    import jax.numpy as jnp
+    from mxtpu.models.sampler import sample_next_token
+
+    logits = jnp.asarray(np.random.RandomState(8).randn(1, 50),
+                         jnp.float32)
+    allowed = set(np.asarray(logits[0]).argsort()[-5:].tolist())
+    draws = {int(sample_next_token(logits, jax.random.key(i),
+                                   temperature=2.0, top_k=5)[0])
+             for i in range(60)}
+    assert draws <= allowed
+    assert len(draws) > 1  # actually sampling, not greedy
+
+
+def test_sample_next_token_topp_restricts_support():
+    import jax
+    import jax.numpy as jnp
+    from mxtpu.models.sampler import sample_next_token
+
+    # one dominant token (p ~ .97): nucleus with top_p=0.5 keeps it only
+    logits = jnp.zeros((1, 10), jnp.float32).at[0, 3].set(5.0)
+    for i in range(20):
+        out = sample_next_token(logits, jax.random.key(i), top_p=0.5)
+        assert int(out[0]) == 3
+    # top_p=1.0 keeps everything: other tokens appear at high temp
+    draws = {int(sample_next_token(logits, jax.random.key(i),
+                                   temperature=50.0, top_p=1.0)[0])
+             for i in range(80)}
+    assert len(draws) > 3
+
+
+def test_generate_with_topk_topp_runs_and_reproduces(tiny):
+    rng = np.random.RandomState(9)
+    prompt = nd.array(rng.randint(0, 40, (2, 3)), dtype="int32")
+    a = tiny.generate(prompt, max_new_tokens=5, temperature=0.8,
+                      top_k=10, top_p=0.9, seed=5).asnumpy()
+    b = tiny.generate(prompt, max_new_tokens=5, temperature=0.8,
+                      top_k=10, top_p=0.9, seed=5).asnumpy()
+    np.testing.assert_array_equal(a, b)
+    assert a.shape == (2, 8)
+
+
+# ----------------- scripted-model pins (exact bookkeeping, no network)
+
+class _ScriptedLM:
+    """Deterministic fake model: prefill emits log-probs P0, every step
+    emits PSTEP — lets the test hand-compute every beam score."""
+
+    V = 3  # token 0 = eos
+
+    P0 = np.log(np.asarray([0.40, 0.45, 0.15]))
+    PSTEP = np.log(np.asarray([0.05, 0.90, 0.05]))
+
+    def init_cache(self, B, L, dtype="float32"):
+        return [(nd.zeros((B, 1, L, 1)), nd.zeros((B, 1, L, 1)))]
+
+    def prefill(self, ids, caches, start_pos=0):
+        B, T = ids.shape
+        logits = np.tile(self.P0, (B, T, 1)).astype("float32")
+        return nd.array(logits), caches
+
+    def step(self, tok, caches, pos):
+        B = tok.shape[0]
+        logits = np.tile(self.PSTEP, (B, 1, 1)).astype("float32")
+        return nd.array(logits), caches
+
+
+def test_length_penalty_uses_per_beam_lengths():
+    """A beam frozen at eos (length 1) vs a 3-token beam: alpha=1
+    favors the longer higher-total sequence, alpha=0 ranks raw scores —
+    the ordering must FLIP (this is exactly what a shared-constant
+    penalty cannot do)."""
+    lm = _ScriptedLM()
+    prompt = nd.array(np.zeros((1, 1)), dtype="int32")
+
+    b1, s1 = BeamSearchSampler(lm, beam_size=2, alpha=1.0, eos_id=0)(
+        prompt, max_new_tokens=3)
+    b0, s0 = BeamSearchSampler(lm, beam_size=2, alpha=0.0, eos_id=0)(
+        prompt, max_new_tokens=3)
+
+    long_score = _ScriptedLM.P0[1] + 2 * _ScriptedLM.PSTEP[1]  # -1.009
+    short_score = _ScriptedLM.P0[0]                            # -0.916
+    # alpha=1: long beam wins (|long|/penalty(3) < |short|/penalty(1))
+    assert abs(s1[0, 0] - long_score) < 1e-5
+    assert b1.asnumpy()[0, 0, 1:].tolist() == [1, 1, 1]
+    # alpha=0: raw scores rank — the short frozen beam wins
+    assert abs(s0[0, 0] - short_score) < 1e-5
+    assert b0.asnumpy()[0, 0, 1] == 0  # eos first, padded
+    assert all(t == 0 for t in b0.asnumpy()[0, 0, 1:].tolist())
+
+
+def test_seeded_sampling_reproducible_on_fresh_net():
+    """Deferred parameter init (first-ever forward) draws ring keys; the
+    seed must be applied AFTER prefill so the very first sampled
+    generate reproduces (review-found stream-shift regression)."""
+    from mxtpu.models.transformer import llama_tiny
+
+    mx.random.seed(0)
+    net = llama_tiny(vocab_size=40)
+    net.initialize()  # deferred: nothing materialized yet
+    rng = np.random.RandomState(10)
+    prompt = nd.array(rng.randint(0, 40, (2, 3)), dtype="int32")
+    a = net.generate(prompt, max_new_tokens=4, temperature=0.7,
+                     seed=11).asnumpy()   # first forward EVER
+    b = net.generate(prompt, max_new_tokens=4, temperature=0.7,
+                     seed=11).asnumpy()
+    np.testing.assert_array_equal(a, b)
