@@ -20,7 +20,9 @@ __all__ = ["BeamSearchSampler", "beam_search", "sample_next_token"]
 _NEG_INF = -1e30
 
 
-def sample_next_token(logits, key, temperature=1.0, top_k=0, top_p=0.0):
+def sample_next_token(logits, key, temperature=1.0, top_k=0, top_p=0.0,
+                      repetition_penalty=1.0, prev_ids=None,
+                      seen_mask=None):
     """Draw next-token ids from (B, V) logits with temperature plus
     optional top-k and/or nucleus (top-p) truncation — the standard LM
     sampling controls (no reference analogue; gluonnlp's
@@ -29,11 +31,29 @@ def sample_next_token(logits, key, temperature=1.0, top_k=0, top_p=0.0):
     top_k > 0: keep only the k highest logits.  top_p in (0, 1]: keep
     the smallest prefix of the probability-sorted vocabulary whose mass
     reaches top_p (the top-1 token always stays).  Both filters compose
-    (k first, then p), jit-safe: fixed shapes, no host sync."""
+    (k first, then p), jit-safe: fixed shapes, no host sync.
+
+    repetition_penalty > 1 with prev_ids (B, T) — or a precomputed
+    (B, V) boolean seen_mask, the fixed-shape form generation loops
+    should maintain: tokens already emitted get their logit divided (if
+    positive) or multiplied (if negative) by the penalty — the CTRL/HF
+    convention.  The penalty applies in greedy mode too (temperature=0
+    penalizes, then argmaxes); ``key`` may be None when greedy."""
     import jax
     import jax.numpy as jnp
 
     x = logits.astype(jnp.float32)
+    if repetition_penalty and repetition_penalty != 1.0:
+        seen = seen_mask
+        if seen is None and prev_ids is not None:
+            seen = jnp.zeros(x.shape, bool)
+            ids = jnp.asarray(prev_ids, jnp.int32)
+            seen = seen.at[
+                jnp.arange(x.shape[0])[:, None], ids].set(True)
+        if seen is not None:
+            x = jnp.where(seen,
+                          jnp.where(x > 0, x / repetition_penalty,
+                                    x * repetition_penalty), x)
     if not temperature or temperature <= 0.0:
         # temperature 0 means greedy by convention (same contract as
         # generate()): no random draw at all

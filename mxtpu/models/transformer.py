@@ -463,7 +463,8 @@ class TransformerLM(HybridBlock):
         return self._logits(x), new_caches
 
     def generate(self, prompt_ids, max_new_tokens, max_length=None,
-                 temperature=0.0, top_k=0, top_p=0.0, seed=None):
+                 temperature=0.0, top_k=0, top_p=0.0,
+                 repetition_penalty=1.0, seed=None):
         """Greedy (temperature=0) or sampled autoregressive decode with a
         KV cache (parity target: gluonnlp SequenceSampler / the
         reference's example inference loops — new capability here).
@@ -476,7 +477,9 @@ class TransformerLM(HybridBlock):
         Sampling: temperature=0 (default) decodes greedily and IGNORES
         top_k/top_p; with temperature > 0, draws go through
         sampler.sample_next_token with optional top-k truncation and
-        nucleus (top_p) filtering.
+        nucleus (top_p) filtering.  repetition_penalty != 1 applies in
+        BOTH modes (greedy penalizes already-emitted tokens, then
+        argmaxes) via a fixed-shape seen-token mask.
 
         Decode expects REPLICATED parameters.  After sharded training,
         gather first (``p.set_data(nd.array(p.data().asnumpy()))`` per
@@ -501,18 +504,39 @@ class TransformerLM(HybridBlock):
             # draws ring keys and would shift the sampling stream
             from .. import random as _rnd
             _rnd.seed(seed)
+        import jax.numpy as jnp
+        from .sampler import sample_next_token
+        from .. import random as _rnd
+
+        sampled = bool(temperature and temperature > 0.0)
+        penalized = bool(repetition_penalty
+                         and repetition_penalty != 1.0)
+        seen = None
+        if penalized:
+            # fixed-shape (B, V) mask — one scatter per emitted token,
+            # never a growing prev tensor (per-step recompiles)
+            V = logits.shape[-1]
+            seen = jnp.zeros((B, V), bool).at[
+                jnp.arange(B)[:, None],
+                prompt_ids._data.astype(jnp.int32)].set(True)
         for pos in range(Tp, total):
-            if temperature and temperature > 0.0:
-                from .sampler import sample_next_token
-                from .. import random as _rnd
+            if sampled or penalized:
+                # greedy-with-penalty also routes here: temperature=0
+                # penalizes then argmaxes (no ring key consumed)
                 nxt = NDArray(sample_next_token(
-                    logits[:, -1]._data, _rnd.next_key(), temperature,
-                    top_k, top_p)).reshape((B, 1))
+                    logits[:, -1]._data,
+                    _rnd.next_key() if sampled else None,
+                    temperature if sampled else 0.0, top_k, top_p,
+                    repetition_penalty, seen_mask=seen)).reshape((B, 1))
             else:
                 nxt = logits[:, -1].argmax(axis=-1).reshape(
                     (B, 1))
             nxt = nxt.astype(prompt_ids.dtype)
             tokens.append(nxt)
+            if penalized:
+                seen = seen.at[jnp.arange(B),
+                               nxt._data.astype(jnp.int32)[:, 0]].set(
+                    True)
             if pos < total - 1:
                 logits, caches = self.step(nxt, caches, pos)
         return nd.concat(*tokens, dim=1)

@@ -143,7 +143,8 @@ class ShardedDecoder:
 
     # -- public API ------------------------------------------------------
     def generate(self, prompt_ids, max_new_tokens, max_length=None,
-                 temperature=0.0, top_k=0, top_p=0.0, seed=None,
+                 temperature=0.0, top_k=0, top_p=0.0,
+                 repetition_penalty=1.0, seed=None,
                  cache_dtype="float32"):
         """Same contract as ``TransformerLM.generate`` but sharded: the
         params keep their mesh shardings; returns (B, T_prompt +
@@ -185,16 +186,32 @@ class ShardedDecoder:
             # after prefill: deferred init / staging must not shift the
             # sampling stream (same ordering as TransformerLM.generate)
             _random.seed(seed)
+        from ..models.sampler import sample_next_token
+
+        sampled = bool(temperature and temperature > 0.0)
+        penalized = bool(repetition_penalty
+                         and repetition_penalty != 1.0)
+        seen = None
+        if penalized:
+            # fixed-shape (B, V) mask (same discipline as generate():
+            # no growing prev tensor, no per-step recompiles)
+            V = logits.shape[-1]
+            seen = jnp.zeros((B, V), bool).at[
+                jnp.arange(B)[:, None],
+                prompt_ids._data.astype(jnp.int32)].set(True)
         for pos in range(Tp, total):
             last = logits[:, -1]
-            if temperature and temperature > 0.0:
-                from ..models.sampler import sample_next_token
-                nxt = sample_next_token(last, _random.next_key(),
-                                        temperature, top_k, top_p)
+            if sampled or penalized:
+                nxt = sample_next_token(
+                    last, _random.next_key() if sampled else None,
+                    temperature if sampled else 0.0, top_k, top_p,
+                    repetition_penalty, seen_mask=seen)
             else:
                 nxt = jnp.argmax(last, axis=-1)
             nxt = nxt.reshape(B, 1).astype(jnp.int32)
             tokens.append(NDArray(nxt.astype(prompt_ids.dtype)))
+            if penalized:
+                seen = seen.at[jnp.arange(B), nxt[:, 0]].set(True)
             if pos < total - 1:
                 logits, cache_leaves = self._step_jitted(
                     cache_leaves, nxt, jnp.int32(pos))

@@ -49,13 +49,17 @@ class SPMDTrainer:
     remat : rematerialize the forward in backward (jax.checkpoint) to trade
         FLOPs for HBM.
     donate : donate old param/state buffers (in-place update on device).
+    clip_gradient_norm : optional global-norm gradient clip fused into
+        the compiled step (parity: gluon.utils.clip_global_norm); the
+        norm reduces over ALL parameter shards on-device.
     """
 
     def __init__(self, block, loss_fn, optimizer, mesh: DeviceMesh,
                  rules: Optional[ShardingRules] = None,
                  optimizer_params: Optional[dict] = None,
                  batch_spec: P = P("dp"), label_spec: P = P("dp"),
-                 remat: bool = False, donate: bool = True):
+                 remat: bool = False, donate: bool = True,
+                 clip_gradient_norm: Optional[float] = None):
         self._block = block
         self._loss_fn = loss_fn
         self._mesh = mesh
@@ -64,6 +68,8 @@ class SPMDTrainer:
         self._label_spec = label_spec
         self._remat = remat
         self._donate = donate
+        self._clip_norm = (float(clip_gradient_norm)
+                           if clip_gradient_norm is not None else None)
         if isinstance(optimizer, str):
             optimizer = opt_mod.create(optimizer, **(optimizer_params or {}))
         cls = type(optimizer)
@@ -113,6 +119,7 @@ class SPMDTrainer:
         diff_params = self._diff_params
         aux_params = self._aux_params
         optimizer = self._optimizer
+        clip_norm = self._clip_norm
         wds = [self._optimizer._get_wd(i)
                for i in range(len(diff_params))]
 
@@ -153,6 +160,15 @@ class SPMDTrainer:
 
             (loss, new_aux), grads = jax.value_and_grad(
                 loss_of, has_aux=True)(diff_leaves)
+            if clip_norm is not None:
+                # global-norm clipping fused into the step (parity:
+                # gluon.utils.clip_global_norm, but on-device over the
+                # sharded grads — XLA reduces across the mesh for free)
+                gnorm = jnp.sqrt(sum(
+                    jnp.sum(jnp.square(g.astype(jnp.float32)))
+                    for g in grads))
+                scale = jnp.minimum(1.0, clip_norm / (gnorm + 1e-6))
+                grads = [g * scale.astype(g.dtype) for g in grads]
             new_leaves = []
             new_states = []
             for leaf, g, st, wd in zip(diff_leaves, grads, opt_states, wds):
