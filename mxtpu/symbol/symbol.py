@@ -214,12 +214,17 @@ class Symbol:
             names = self.list_outputs()
             idx = names.index(idx)
         entries = self._output_entries()
+        # NOTE: an index-0 handle of a multi-output node — selected or
+        # not — indexes among the NODE's outputs (the control-flow API
+        # contract: foreach returns node[0] and callers do outs[-1]);
+        # only handles at index > 0 index themselves.  The _selected
+        # flag matters for _output_entries (binding arity), not here.
         if (len(entries) == 1 and entries[0][0].num_outputs > 1
-                and entries[0][1] == 0 and not self._selected):
+                and entries[0][1] == 0):
             # select among THIS node's outputs (multi-output op, e.g.
-            # split / control-flow): sym[i] -> i-th output.  Only from the
-            # base (index-0) symbol — an already-selected output indexes
-            # itself like any single-output symbol.
+            # split / control-flow): sym[i] -> i-th output.  Applies to
+            # ANY index-0 handle, selected or not (see NOTE above);
+            # handles at index > 0 fall through and index themselves.
             node, _ = entries[0]
             if idx < 0:
                 idx += node.num_outputs
