@@ -35,6 +35,14 @@ from .sharding import ShardingRules
 __all__ = ["ShardedDecoder"]
 
 
+def _bucket(n, base=8):
+    """Smallest power-of-two >= n (floor `base`)."""
+    b = base
+    while b < n:
+        b *= 2
+    return b
+
+
 class ShardedDecoder:
     """Jitted KV-cache decode over a mesh with tp-sharded parameters.
 
@@ -52,15 +60,35 @@ class ShardedDecoder:
 
     def __init__(self, block, mesh: DeviceMesh,
                  rules: Optional[ShardingRules] = None,
-                 cache_spec: P = P(None, "tp", None, None)):
+                 cache_spec: P = P(None, "tp", None, None),
+                 bucket_prefill: bool = True):
         self._block = block
         self._mesh = mesh
         self._rules = rules or ShardingRules()
         self._cache_spec = cache_spec
+        self._bucket_prefill = bucket_prefill
         self._params = sorted(block.collect_params().values(),
                               key=lambda p: p.name)
         self._staged = False
         self._jit_cache: Dict[Any, Any] = {}
+
+    def _block_has_moe(self):
+        """Bucketed prefill is disabled for MoE blocks: padded tokens
+        would participate in capacity-limited expert routing and could
+        evict REAL tokens (attention masks pads out; routing does not).
+        """
+        from ..models.moe import SwitchMoE
+
+        stack = [self._block]
+        while stack:
+            b = stack.pop()
+            if isinstance(b, SwitchMoE):
+                return True
+            children = getattr(b, "_children", None)
+            if children:
+                stack.extend(children.values()
+                             if hasattr(children, "values") else children)
+        return False
 
     # -- staging ---------------------------------------------------------
     def _stage(self):
@@ -165,7 +193,13 @@ class ShardedDecoder:
             self._stage()
         B, Tp = prompt_ids.shape
         total = Tp + max_new_tokens
-        max_length = max_length or total
+        bucketing = self._bucket_prefill and not self._block_has_moe()
+        if max_length is None:
+            # bucket the CACHE length too: the jit-cache key includes
+            # the (B, KV, max_length, D) cache shapes, so without this a
+            # varying default max_length would recompile per request
+            # and defeat the prefill bucketing entirely
+            max_length = _bucket(total) if bucketing else total
         if max_length < total:
             raise ValueError("max_length %d < prompt+new %d"
                              % (max_length, total))
@@ -179,9 +213,22 @@ class ShardedDecoder:
                                                  cache_dtype))
 
         tokens = [prompt_ids]
-        # chunked prefill: one compiled forward ingests the whole prompt
-        logits, cache_leaves = self._prefill_jitted(
-            cache_leaves, prompt_ids._data.astype(jnp.int32))
+        # chunked prefill: one compiled forward ingests the whole
+        # prompt.  With bucket_prefill, the prompt is right-padded to a
+        # power-of-two bucket so serving traffic with varied prompt
+        # lengths reuses a handful of compiled prefills instead of one
+        # per length.  Right padding is safe by construction: padded
+        # QUERIES' logits are ignored (we read position Tp-1), padded
+        # KEYS sit at positions > Tp-1 which the causal masks of both
+        # prefill and decode exclude until the decode step's own
+        # dynamic-slice write overwrites them with the real token.
+        raw = prompt_ids._data.astype(jnp.int32)
+        if bucketing:
+            Tb = min(_bucket(Tp), max_length)
+            if Tb > Tp:
+                raw = jnp.pad(raw, ((0, 0), (0, Tb - Tp)))
+        logits, cache_leaves = self._prefill_jitted(cache_leaves, raw)
+        logits = logits[:, :Tp]  # padded-query logits are garbage
         if seed is not None and temperature and temperature > 0.0:
             # after prefill: deferred init / staging must not shift the
             # sampling stream (same ordering as TransformerLM.generate)

@@ -78,3 +78,56 @@ def test_sharded_sampling_reproducible(tiny):
     b = dec.generate(prompt, max_new_tokens=5, temperature=0.8,
                      seed=123).asnumpy()
     np.testing.assert_array_equal(a, b)
+
+
+def test_bucketed_prefill_reuses_compiled_program(tiny):
+    """Prompts of lengths 3 and 5 share the padded-to-8 prefill program
+    (one prefill + one step entry total), and bucketing changes no
+    output."""
+    rng = np.random.RandomState(21)
+    mesh = _mesh_tp2()
+    dec = ShardedDecoder(tiny, mesh, transformer_lm_sharding_rules())
+    dec_ref = ShardedDecoder(tiny, mesh, transformer_lm_sharding_rules(),
+                             bucket_prefill=False)
+    # NO explicit max_length: the default cache length buckets too, so
+    # prompt lengths whose totals land in the same power-of-two bucket
+    # share one prefill AND one step program (totals 6 and 8 -> cache 8)
+    for Tp in (3, 5):
+        prompt = nd.array(rng.randint(0, 50, (2, Tp)), dtype="int32")
+        got = dec.generate(prompt, max_new_tokens=3).asnumpy()
+        want = dec_ref.generate(prompt, max_new_tokens=3).asnumpy()
+        np.testing.assert_array_equal(got, want)
+    prefills = [k for k in dec._jit_cache if k[0] == "prefill"]
+    assert len(prefills) == 1  # both lengths hit the T=8 bucket
+    assert len([k for k in dec._jit_cache if k[0] == "step"]) == 1
+    assert len([k for k in dec_ref._jit_cache if k[0] == "prefill"]) == 2
+
+
+def test_bucketed_prefill_matches_eager_generate(tiny):
+    rng = np.random.RandomState(22)
+    prompt = nd.array(rng.randint(0, 50, (2, 5)), dtype="int32")
+    expect = tiny.generate(prompt, max_new_tokens=6).asnumpy()
+    dec = ShardedDecoder(tiny, _mesh_tp2(),
+                         transformer_lm_sharding_rules())
+    got = dec.generate(prompt, max_new_tokens=6).asnumpy()
+    np.testing.assert_array_equal(got, expect)
+
+
+def test_moe_block_disables_bucketing():
+    """Padded tokens would join capacity-limited expert routing, so MoE
+    blocks must opt out of prefill bucketing automatically."""
+    from mxtpu.models.transformer import TransformerLM
+
+    mx.random.seed(9)
+    lm = TransformerLM(vocab_size=40, units=16, hidden_size=32,
+                       num_layers=2, num_heads=4, num_kv_heads=2,
+                       num_experts=4, capacity_factor=4.0)
+    lm.initialize()
+    mesh = _mesh_tp2()
+    dec = ShardedDecoder(lm, mesh, transformer_lm_sharding_rules())
+    assert dec._block_has_moe()
+    prompt = nd.array(np.random.RandomState(23).randint(0, 40, (2, 3)),
+                      dtype="int32")
+    expect = lm.generate(prompt, max_new_tokens=3).asnumpy()
+    got = dec.generate(prompt, max_new_tokens=3).asnumpy()
+    np.testing.assert_array_equal(got, expect)
