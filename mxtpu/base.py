@@ -90,6 +90,20 @@ def register_op(
     return wrap
 
 
+def register_alias(alias: str, canonical: str) -> None:
+    """Register an extra registry name for an EXISTING op, with the same
+    duplicate protection as register_op and the alias recorded on the
+    spec (so registry introspection can associate the names)."""
+    if alias in _OP_REGISTRY:
+        raise ValueError(f"operator alias {alias!r} registered twice")
+    spec = _OP_REGISTRY[canonical]
+    new = spec._replace(aliases=tuple(spec.aliases) + (alias,))
+    for k, v in list(_OP_REGISTRY.items()):
+        if v is spec:  # keep ONE spec object per op (unique-op dedup)
+            _OP_REGISTRY[k] = new
+    _OP_REGISTRY[alias] = new
+
+
 def get_op(name: str) -> OpSpec:
     try:
         return _OP_REGISTRY[name]

@@ -1045,3 +1045,47 @@ def _internal_cache_write(cache, new, pos=0):
         else jnp.int32(pos)
     return jax.lax.dynamic_update_slice_in_dim(
         cache, new.astype(cache.dtype), start, axis=2)
+
+
+# ---------------------------------------------------------------------------
+# upstream mx.np internal op names (python/mxnet/numpy calls lower to
+# `_npi_*`-registered kernels in the reference — src/operator/numpy/**).
+# Aliased here ONLY where our canonical op already has exact numpy
+# call semantics (same positional signature, same broadcasting, same
+# result dtype), so code addressing ops by _npi_ name keeps working.
+# Deliberately NOT aliased: the comparison family (upstream _npi_
+# comparisons return bool; the legacy ops return float per MXNet
+# convention) and structural ops whose kwarg names differ.
+
+from ..base import register_alias as _register_alias  # noqa: E402
+
+_NPI_EXACT = {
+    "_npi_add": "add", "_npi_subtract": "subtract",
+    "_npi_multiply": "multiply", "_npi_true_divide": "divide",
+    "_npi_mod": "mod", "_npi_power": "power",
+    "_npi_maximum": "maximum", "_npi_minimum": "minimum",
+    "_npi_arctan2": "arctan2", "_npi_hypot": "hypot",
+    "_npi_exp": "exp", "_npi_expm1": "expm1", "_npi_log": "log",
+    "_npi_log2": "log2", "_npi_log10": "log10", "_npi_log1p": "log1p",
+    "_npi_sqrt": "sqrt", "_npi_cbrt": "cbrt", "_npi_square": "square",
+    "_npi_reciprocal": "reciprocal", "_npi_absolute": "abs",
+    "_npi_sign": "sign", "_npi_negative": "negative",
+    "_npi_sin": "sin", "_npi_cos": "cos", "_npi_tan": "tan",
+    "_npi_arcsin": "arcsin", "_npi_arccos": "arccos",
+    "_npi_arctan": "arctan", "_npi_sinh": "sinh", "_npi_cosh": "cosh",
+    "_npi_tanh": "tanh", "_npi_arcsinh": "arcsinh",
+    "_npi_arccosh": "arccosh", "_npi_arctanh": "arctanh",
+    "_npi_floor": "floor", "_npi_ceil": "ceil", "_npi_trunc": "trunc",
+    "_npi_rint": "rint", "_npi_degrees": "degrees",
+    "_npi_radians": "radians", "_npi_where": "where",
+    "_npi_stack": "stack",
+}
+for _npi, _canon in _NPI_EXACT.items():
+    _register_alias(_npi, _canon)
+
+
+@register_op("_npi_einsum")
+def _npi_einsum(*operands, subscripts="", equation=""):
+    """Upstream _npi_einsum calling convention (subscripts= kwarg);
+    delegates to the canonical einsum op."""
+    return einsum_op(*operands, equation=subscripts or equation)

@@ -67,6 +67,7 @@ class ShardedDecoder:
         self._rules = rules or ShardingRules()
         self._cache_spec = cache_spec
         self._bucket_prefill = bucket_prefill
+        self._has_moe = None  # computed once on first generate()
         self._params = sorted(block.collect_params().values(),
                               key=lambda p: p.name)
         self._staged = False
@@ -76,18 +77,23 @@ class ShardedDecoder:
         """Bucketed prefill is disabled for MoE blocks: padded tokens
         would participate in capacity-limited expert routing and could
         evict REAL tokens (attention masks pads out; routing does not).
+        The tree walk runs once; the block is fixed at construction.
         """
+        if self._has_moe is not None:
+            return self._has_moe
         from ..models.moe import SwitchMoE
 
         stack = [self._block]
         while stack:
             b = stack.pop()
             if isinstance(b, SwitchMoE):
+                self._has_moe = True
                 return True
             children = getattr(b, "_children", None)
             if children:
                 stack.extend(children.values()
                              if hasattr(children, "values") else children)
+        self._has_moe = False
         return False
 
     # -- staging ---------------------------------------------------------

@@ -478,6 +478,8 @@ CASES.update({
                                           # near-ties flip indices
     "_internal_cache_write": C(
         lambda: (A(2, 3, 8, 4), A(2, 3, 1, 4)), {"pos": 5}, grad=False),
+    "_npi_einsum": C(lambda: (A(2, 3), A(3, 4)),
+                     {"subscripts": "ij,jk->ik"}),
     "gradientmultiplier": C(lambda: (A(3, 4),), {"scalar": 1.0}),
     "allclose": C(lambda: (A(3, 4), A(3, 4)), grad=False),
     "quadratic": C(lambda: (A(3, 4),), {"a": 0.5, "b": -1.0, "c": 2.0}),
