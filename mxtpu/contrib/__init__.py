@@ -10,3 +10,4 @@ from . import onnx  # noqa: F401
 from . import quantization  # noqa: F401
 from . import svrg_optimization  # noqa: F401
 from . import text  # noqa: F401
+from . import orbax_ckpt  # noqa: F401 — sharded checkpointing adapter

@@ -1085,7 +1085,8 @@ for _npi, _canon in _NPI_EXACT.items():
 
 
 @register_op("_npi_einsum")
-def _npi_einsum(*operands, subscripts="", equation=""):
-    """Upstream _npi_einsum calling convention (subscripts= kwarg);
+def _npi_einsum(*operands, subscripts="", equation="", optimize=0):
+    """Upstream _npi_einsum calling convention (subscripts= kwarg plus
+    an optimize flag, accepted and ignored — XLA plans the contraction);
     delegates to the canonical einsum op."""
     return einsum_op(*operands, equation=subscripts or equation)
