@@ -1,0 +1,87 @@
+"""tools/tpu_watch.py: probe logging + battery trigger, driven against
+stub bench/profile scripts (the real probe intentionally hangs for
+minutes on a wedged tunnel — the stubs exercise the watchdog logic)."""
+
+import importlib.util
+import json
+import os
+
+def _load_watch(tmp_path, monkeypatch, bench_body):
+    spec = importlib.util.spec_from_file_location(
+        "tpu_watch_under_test",
+        os.path.join(os.path.dirname(__file__), "..", "tools",
+                     "tpu_watch.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    repo = tmp_path / "repo"
+    (repo / "tools").mkdir(parents=True)
+    (repo / "bench.py").write_text(bench_body)
+    (repo / "tools" / "profile_resnet.py").write_text(
+        "import json\nprint(json.dumps({'img_per_sec': 1.0}))\n")
+    monkeypatch.setattr(mod, "REPO", str(repo))
+    monkeypatch.setattr(mod, "LOG_PATH", str(repo / "probe_log.jsonl"))
+    monkeypatch.setattr(mod, "ART_DIR", str(repo / "perf_artifacts"))
+    monkeypatch.setattr(mod, "PROBE_TIMEOUT_S", 2)
+    return mod, repo
+
+
+HEALTHY = """
+import json, sys
+if "--probe" in sys.argv:
+    print(json.dumps({"probe": "ok", "platform": "tpu"}))
+else:
+    print(json.dumps({"metric": "m", "value": 1, "unit": "u",
+                      "vs_baseline": None}))
+"""
+
+CPU_ONLY = """
+import json, sys
+print(json.dumps({"probe": "ok", "platform": "cpu"}))
+"""
+
+HANG = """
+import sys, time
+time.sleep(600)
+"""
+
+
+def _log_lines(repo):
+    with open(repo / "probe_log.jsonl") as f:
+        return [json.loads(ln) for ln in f]
+
+
+def test_healthy_probe_logged(tmp_path, monkeypatch):
+    mod, repo = _load_watch(tmp_path, monkeypatch, HEALTHY)
+    assert mod.probe_once() == "tpu"
+    rec = _log_lines(repo)[-1]
+    assert rec["ok"] is True and rec["platform"] == "tpu"
+
+
+def test_cpu_fallback_probe_is_not_healthy(tmp_path, monkeypatch):
+    """A backend that fails FAST into CPU must not trigger the battery
+    (an unlabeled CPU number is not a TPU measurement)."""
+    mod, repo = _load_watch(tmp_path, monkeypatch, CPU_ONLY)
+    assert mod.probe_once() is None
+    rec = _log_lines(repo)[-1]
+    assert rec["ok"] is False and rec["platform"] == "cpu"
+
+
+def test_wedged_probe_times_out_and_logs(tmp_path, monkeypatch):
+    mod, repo = _load_watch(tmp_path, monkeypatch, HANG)
+    assert mod.probe_once() is None
+    rec = _log_lines(repo)[-1]
+    assert rec["ok"] is False and "hung" in rec["note"]
+
+
+def test_battery_writes_artifacts(tmp_path, monkeypatch):
+    mod, repo = _load_watch(tmp_path, monkeypatch, HEALTHY)
+    monkeypatch.setattr(mod, "BATTERY_BUDGET_S",
+                        {k: 30 for k in mod.BATTERY_BUDGET_S})
+    mod.run_battery()
+    arts = os.listdir(repo / "perf_artifacts")
+    for name in ("bench", "profile_resnet_xla", "profile_resnet_pallas"):
+        assert any(a.startswith(name + "_") for a in arts), (name, arts)
+    recs = _log_lines(repo)
+    assert any(r.get("battery") == "done" for r in recs)
+    bench_art = [a for a in arts if a.startswith("bench")][0]
+    assert '"metric"' in (repo / "perf_artifacts" / bench_art).read_text()
