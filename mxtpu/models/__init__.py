@@ -18,4 +18,5 @@ from .transformer import (MultiHeadAttention, TransformerEncoderLayer,
 from . import moe
 from .moe import SwitchMoE, MoEDecoderLayer, moe_sharding_rules
 from . import sampler
-from .sampler import BeamSearchSampler, beam_search
+from .sampler import (BeamSearchSampler, SequenceSampler,
+                      beam_search)

@@ -10,14 +10,40 @@ gather on the caches.
 
 from __future__ import annotations
 
+import jax
 import numpy as onp
 
 from .. import ndarray as nd
 from ..ndarray import NDArray
 
-__all__ = ["BeamSearchSampler", "beam_search", "sample_next_token"]
+__all__ = ["BeamSearchSampler", "SequenceSampler", "beam_search",
+           "sample_next_token"]
 
 _NEG_INF = -1e30
+
+
+def _prepare(model, prompt_ids, max_new_tokens, max_length, K):
+    """Shared sampler preamble: coerce the prompt, validate lengths,
+    handle the max_new_tokens<=0 contract, prefill once at batch B and
+    tile each sequence's caches K times (row b*K+k = continuation k of
+    sequence b).  Returns (prompt_ids, B, Tp, total, logits, caches) or
+    a (samples, scores) early-return tuple flagged by done=True."""
+    prompt_ids = prompt_ids if isinstance(prompt_ids, NDArray)         else nd.array(prompt_ids)
+    B, Tp = prompt_ids.shape
+    total = Tp + max_new_tokens
+    max_length = max_length or total
+    if max_length < total:
+        raise ValueError("max_length %d < prompt+new %d"
+                         % (max_length, total))
+    if max_new_tokens <= 0:  # contract parity with generate()
+        beams = onp.repeat(prompt_ids.asnumpy()[:, None, :], K, axis=1)
+        return (True, (nd.array(beams, dtype="int32"),
+                       onp.zeros((B, K))), None)
+    caches = model.init_cache(B, max_length)
+    logits, caches = model.prefill(prompt_ids, caches)
+    caches = [(nd.repeat(ck, repeats=K, axis=0),
+               nd.repeat(cv, repeats=K, axis=0)) for ck, cv in caches]
+    return (False, None, (prompt_ids, B, Tp, total, logits, caches))
 
 
 def sample_next_token(logits, key, temperature=1.0, top_k=0, top_p=0.0,
@@ -120,24 +146,11 @@ class BeamSearchSampler:
         (B, K) numpy array of raw sequence log-probs."""
         model = self._model
         K = self._K
-        prompt_ids = prompt_ids if isinstance(prompt_ids, NDArray) \
-            else nd.array(prompt_ids)
-        B, Tp = prompt_ids.shape
-        total = Tp + max_new_tokens
-        max_length = max_length or total
-        if max_length < total:
-            raise ValueError("max_length %d < prompt+new %d"
-                             % (max_length, total))
-        if max_new_tokens <= 0:  # contract parity with generate()
-            beams = onp.repeat(prompt_ids.asnumpy()[:, None, :], K, axis=1)
-            return nd.array(beams, dtype="int32"), onp.zeros((B, K))
-
-        # prefill at batch B, then tile each sequence's caches K times:
-        # beam b*K+k decodes continuation k of sequence b
-        caches = model.init_cache(B, max_length)
-        logits, caches = model.prefill(prompt_ids, caches)
-        caches = [(nd.repeat(ck, repeats=K, axis=0),
-                   nd.repeat(cv, repeats=K, axis=0)) for ck, cv in caches]
+        done, early, state = _prepare(model, prompt_ids, max_new_tokens,
+                                      max_length, K)
+        if done:
+            return early
+        prompt_ids, B, Tp, total, logits, caches = state
 
         logp = self._log_softmax(logits.asnumpy()[:, -1])      # (B, V)
         V = logp.shape[-1]
@@ -212,3 +225,95 @@ def beam_search(model, prompt_ids, max_new_tokens, beam_size=4,
     """Functional convenience over BeamSearchSampler."""
     return BeamSearchSampler(model, beam_size, alpha, eos_id)(
         prompt_ids, max_new_tokens, max_length)
+
+
+class SequenceSampler:
+    """K independent sampled continuations per prompt (parity:
+    gluonnlp SequenceSampler).  Same cache-tiling machinery as beam
+    search, but rows never interact: each of the B*K rows draws its own
+    next token through ``sample_next_token`` and accumulates its own
+    log-prob; eos-finished rows freeze and pad.
+
+    Returns (samples (B, K, T_prompt + new), scores (B, K)) with scores
+    = accumulated log-probs of the sampled tokens, rows sorted by
+    descending score.
+    """
+
+    def __init__(self, model, n_samples=4, temperature=1.0, top_k=0,
+                 top_p=0.0, repetition_penalty=1.0, eos_id=None):
+        self._model = model
+        self._K = int(n_samples)
+        self._temp = float(temperature)
+        self._top_k = top_k
+        self._top_p = top_p
+        self._rep = repetition_penalty
+        self._eos = eos_id
+
+    def __call__(self, prompt_ids, max_new_tokens, max_length=None,
+                 seed=None):
+        import jax.numpy as jnp
+
+        from .. import random as _rnd
+
+        model = self._model
+        K = self._K
+        done, early, state = _prepare(model, prompt_ids, max_new_tokens,
+                                      max_length, K)
+        if done:
+            return early
+        prompt_ids, B, Tp, total, logits, caches = state
+        sampled = bool(self._temp and self._temp > 0.0)
+        if seed is not None and sampled:
+            # after prefill: deferred init draws keys; greedy consumes
+            # no RNG (same contract as generate())
+            _rnd.seed(seed)
+
+        penalized = bool(self._rep and self._rep != 1.0)
+        last = jnp.repeat(logits._data[:, -1], K, axis=0)  # (B*K, V)
+        V = last.shape[-1]
+        seen = None
+        if penalized:
+            seen = jnp.zeros((B * K, V), bool).at[
+                jnp.arange(B * K)[:, None],
+                jnp.repeat(prompt_ids._data.astype(jnp.int32), K,
+                           axis=0)].set(True)
+        beams = onp.repeat(prompt_ids.asnumpy()[:, None, :], K, axis=1)
+        scores = onp.zeros((B, K))
+        finished = onp.zeros((B, K), bool)
+
+        for pos in range(Tp, total):
+            nxt = sample_next_token(last,
+                                    _rnd.next_key() if sampled else None,
+                                    self._temp, self._top_k, self._top_p,
+                                    self._rep, seen_mask=seen)  # (B*K,)
+            logp = jax.nn.log_softmax(
+                last.astype(jnp.float32), axis=-1)
+            tok_logp = onp.asarray(jnp.take_along_axis(
+                logp, nxt[:, None].astype(jnp.int32),
+                axis=-1))[:, 0].reshape(B, K)
+            tok = onp.asarray(nxt).reshape(B, K)
+            if self._eos is not None:
+                tok = onp.where(finished, self._eos, tok)
+                tok_logp = onp.where(finished, 0.0, tok_logp)
+            scores += tok_logp
+            beams = onp.concatenate(
+                [beams, tok[:, :, None].astype(beams.dtype)], axis=2)
+            if penalized:
+                seen = seen.at[jnp.arange(B * K),
+                               jnp.asarray(tok.reshape(-1))].set(True)
+            if self._eos is not None:
+                finished |= (tok == self._eos)
+                if finished.all() and pos < total - 1:
+                    pad = onp.full((B, K, total - beams.shape[2]),
+                                   self._eos, beams.dtype)
+                    beams = onp.concatenate([beams, pad], axis=2)
+                    break
+            if pos < total - 1:
+                step_tok = nd.array(tok.reshape(B * K, 1), dtype="int32")
+                logits, caches = model.step(step_tok, caches, pos)
+                last = logits._data[:, -1]
+
+        order = onp.argsort(-scores, axis=-1)
+        beams = onp.take_along_axis(beams, order[:, :, None], axis=1)
+        scores = onp.take_along_axis(scores, order, axis=-1)
+        return nd.array(beams, dtype="int32"), scores
