@@ -65,34 +65,63 @@ def _log(rec):
     return rec
 
 
-def probe_once():
-    """One bounded health probe.  Returns platform string or None."""
-    t0 = time.monotonic()
+def _bounded_communicate(proc, timeout_s, reap_s=15):
+    """communicate() with a bounded post-kill reap.  Returns
+    (rc, out, err, timed_out): on timeout the child is killed and
+    reaped for at most ``reap_s`` — a child stuck in uninterruptible
+    tunnel I/O survives SIGKILL, and an unbounded wait there froze the
+    whole watchdog loop for 5 hours once; any output captured during
+    the reap is preserved for diagnostics."""
     try:
-        proc = subprocess.run(
-            [sys.executable, os.path.join(REPO, "bench.py"), "--probe"],
-            timeout=PROBE_TIMEOUT_S, stdout=subprocess.PIPE,
-            stderr=subprocess.PIPE, text=True)
+        out, err = proc.communicate(timeout=timeout_s)
+        return proc.returncode, out, err, False
     except subprocess.TimeoutExpired:
+        proc.kill()
+        out, err = "", ""
+        try:
+            out, err = proc.communicate(timeout=reap_s)
+        except subprocess.TimeoutExpired:
+            pass  # unkillable (D-state) child: abandon, keep looping
+        return -9, out or "", err or "", True
+
+
+def probe_once():
+    """One bounded health probe.  Returns platform string or None.
+
+    Popen + bounded post-kill wait, NOT subprocess.run(timeout=...):
+    a probe child stuck in uninterruptible tunnel I/O survives
+    SIGKILL until the I/O completes, and run()'s kill-then-wait then
+    blocks the whole watchdog loop (observed: one wedged child froze
+    probing for 5 hours).  Here the reap wait is bounded too — a
+    lingering child is abandoned (reaped later by init) and the probe
+    still logs on schedule."""
+    t0 = time.monotonic()
+    proc = subprocess.Popen(
+        [sys.executable, os.path.join(REPO, "bench.py"), "--probe"],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True)
+    rc, out, err, timed_out = _bounded_communicate(proc, PROBE_TIMEOUT_S)
+    if timed_out:
         _log({"ok": False, "platform": None,
               "probe_s": round(time.monotonic() - t0, 1),
-              "note": "probe hung (timeout %ds) — tunnel wedged"
-                      % PROBE_TIMEOUT_S})
+              "note": "probe hung (timeout %ds) — tunnel wedged; "
+                      "stderr tail: %s"
+                      % (PROBE_TIMEOUT_S,
+                         (err or "")[-200:].replace("\n", " "))})
         return None
+
     dt = round(time.monotonic() - t0, 1)
     platform = None
-    for ln in proc.stdout.splitlines():
+    for ln in (out or "").splitlines():
         ln = ln.strip()
         if ln.startswith("{") and '"probe"' in ln:
             try:
                 platform = json.loads(ln).get("platform")
             except ValueError:
                 pass
-    if proc.returncode != 0 or platform is None:
+    if rc != 0 or platform is None:
         _log({"ok": False, "platform": platform, "probe_s": dt,
               "note": "probe rc=%d; stderr tail: %s"
-                      % (proc.returncode,
-                         (proc.stderr or "")[-200:].replace("\n", " "))})
+                      % (rc, (err or "")[-200:].replace("\n", " "))})
         return None
     ok = platform in ("tpu", "axon")
     _log({"ok": ok, "platform": platform, "probe_s": dt,
@@ -109,13 +138,11 @@ def _run_logged(name, cmd, timeout_s, env=None):
     full_env = dict(os.environ)
     if env:
         full_env.update(env)
-    try:
-        proc = subprocess.run(cmd, timeout=timeout_s, text=True,
-                              stdout=subprocess.PIPE,
-                              stderr=subprocess.STDOUT, env=full_env)
-        rc, out = proc.returncode, proc.stdout
-    except subprocess.TimeoutExpired as e:
-        rc, out = -9, (e.output or "") + "\nTIMEOUT after %ds" % timeout_s
+    proc = subprocess.Popen(cmd, text=True, stdout=subprocess.PIPE,
+                            stderr=subprocess.STDOUT, env=full_env)
+    rc, out, _, timed_out = _bounded_communicate(proc, timeout_s)
+    if timed_out:
+        out = (out or "") + "\nTIMEOUT after %ds" % timeout_s
     with open(out_path, "w") as f:
         f.write(out or "")
     _log({"battery": name, "rc": rc,
