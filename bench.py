@@ -303,10 +303,123 @@ def _bench_attention():
     print(json.dumps(rec), flush=True)
 
 
+def _bench_continuous_decode():
+    """Serving throughput (round-6 tentpole): continuous batching with
+    slot-based KV cache reuse vs static run-to-completion batches, under
+    mixed-length Poisson arrivals — the workload where a static batch
+    pays max(prompt) padding and max(new) decode for every member while
+    the slot pool backfills freed rows mid-flight.  Reports useful
+    (requested) tokens/sec for both schedulers; the CPU fallback runs a
+    LABELED tiny config (plumbing evidence, per bench conventions)."""
+    import numpy as np
+    import jax
+    import mxtpu as mx
+    from mxtpu import nd
+    from mxtpu.models import transformer
+    from mxtpu.parallel import (ContinuousBatchingEngine, ShardedDecoder,
+                                make_mesh)
+    from mxtpu.parallel.decode import _bucket
+
+    platform = jax.devices()[0].platform
+    cpu = platform == "cpu"
+    mx.random.seed(7)
+    if cpu:
+        lm = transformer.llama_tiny(vocab_size=256)
+        slots, n_req, max_len = 4, 10, 64
+        plo, phi, glo, ghi, vocab = 4, 24, 4, 16, 256
+    else:
+        # real-architecture reduced config (llama geometry, head_dim
+        # 128) sized to decode comfortably within the child budget —
+        # this metric prints LAST, so it must fit the remaining slice
+        lm = transformer.llama_3_8b(vocab_size=32000, width_factor=0.25,
+                                    depth_factor=0.25)
+        slots, n_req, max_len = 8, 16, 256
+        plo, phi, glo, ghi, vocab = 16, 96, 16, 64, 32000
+    lm.initialize()
+    mesh = make_mesh(dp=1)
+    rules = transformer.transformer_lm_sharding_rules()
+
+    R = np.random.RandomState(0)
+    plens = R.randint(plo, phi + 1, n_req)
+    news = R.randint(glo, ghi + 1, n_req).tolist()
+    prompts = [nd.array(R.randint(0, vocab, (1, int(t))), dtype="int32")
+               for t in plens]
+    # Poisson arrivals measured in scheduler iterations: requests trickle
+    # in while earlier ones decode, so short requests meet long ones
+    arrivals = np.cumsum(R.poisson(2, size=n_req))
+    useful = float(sum(news))
+
+    eng = ContinuousBatchingEngine(lm, mesh, rules, num_slots=slots,
+                                   max_length=max_len)
+
+    def run_continuous():
+        it, nxt = 0, 0
+        t0 = time.perf_counter()
+        while nxt < n_req or eng.pending or eng.active:
+            while nxt < n_req and arrivals[nxt] <= it:
+                eng.submit(prompts[nxt], news[nxt])
+                nxt += 1
+            if eng.pending or eng.active:
+                eng.step()
+            it += 1
+        eng.run()  # collect/clear results
+        return time.perf_counter() - t0
+
+    dec = ShardedDecoder(lm, mesh, rules)
+
+    def run_static():
+        # run-to-completion batches in arrival order: every member pays
+        # the batch max prompt (right-padded) and max decode length
+        t0 = time.perf_counter()
+        for s in range(0, n_req, slots):
+            bp, bn = prompts[s:s + slots], news[s:s + slots]
+            tmax = max(p.shape[1] for p in bp)
+            arr = np.zeros((len(bp), tmax), np.int32)
+            for i, p in enumerate(bp):
+                arr[i, :p.shape[1]] = p.asnumpy()[0]
+            dec.generate(nd.array(arr, dtype="int32"),
+                         max_new_tokens=max(bn),
+                         max_length=_bucket(tmax + max(bn)))
+        return time.perf_counter() - t0
+
+    run_continuous()           # compile warmup (programs live on eng)
+    cont_dt = run_continuous()
+    run_static()               # compile warmup (programs live on dec)
+    static_dt = run_static()
+    cont_tps = useful / cont_dt
+    static_tps = useful / static_dt
+
+    rec = {
+        "metric": "decode_tokens_per_sec_continuous",
+        "value": round(cont_tps, 2),
+        "unit": "tokens/sec",
+        "vs_baseline": None,
+        "platform": platform,
+        "static_batch_tokens_per_sec": round(static_tps, 2),
+        "speedup_vs_static": round(cont_tps / static_tps, 3),
+        "config": {"num_slots": slots, "requests": n_req,
+                   "prompt_len": [plo, phi], "new_tokens": [glo, ghi],
+                   "max_length": max_len,
+                   "arrivals": "poisson(2)/iteration"},
+        "compiled_programs": len(eng._dec._jit_cache),
+        "baseline_note": "no upstream analogue (reference has no serving "
+                         "path); static-batch column is this repo's own "
+                         "run-to-completion ShardedDecoder and IGNORES "
+                         "arrival delays (an upper bound for static — "
+                         "the engine pays the Poisson trickle)",
+    }
+    if cpu:
+        rec["config_note"] = ("CPU fallback runs a LABELED llama_tiny "
+                              "config — plumbing evidence only, NOT a "
+                              "TPU serving number")
+    print(json.dumps(rec), flush=True)
+
+
 def _child_main():
     _bench_resnet()
     _bench_bert()
     _bench_attention()
+    _bench_continuous_decode()
 
 
 def _probe_main():

@@ -93,21 +93,44 @@ class SwitchMoE(HybridBlock):
                              top_k=self._top_k)
         return y
 
-    def prefill_forward(self, x):
+    def prefill_forward(self, x, total_len=None):
         """Imperative forward for CHUNKED prefill: the TRAINING capacity
         (not decode_forward's unbounded capacity = S*k, which at prompt
         scale S = B*T would materialize O(S^2*E*k) dispatch tensors).
-        With the same S and capacity as hybrid_forward, prefill routing
-        is bit-identical to the full-context forward — exactly the
-        decode-parity contract."""
+
+        The per-expert capacity budgets from the FULL prompt length
+        (``total_len``; ADVICE r5) — a chunk of T tokens out of a
+        total_len-token prompt gets ceil(k * B*total_len / E * cf)
+        slots, the same number the full-context forward computes, so a
+        small chunk is never squeezed into a spuriously tiny capacity.
+        Single-chunk prefill (total_len == T) therefore routes
+        bit-identically to the full-context forward.  Multi-chunk
+        prefill shares the capacity NUMBER but not the competition:
+        tokens only contend with their own chunk for expert slots, so
+        when capacity binds a later chunk may keep tokens the
+        full-context forward dropped (see docs/inference.md)."""
+        import math
+
         from .. import ndarray as nd
 
         ctx = x.context
+        B, T = x.shape[0], x.shape[1]
+        total = int(total_len) if total_len is not None else T
+        if total < T:
+            raise ValueError(
+                "prefill total_len %d < chunk length %d" % (total, T))
+        k = int(self._top_k)
+        if self._cf <= 0:
+            capacity = None  # unbounded — switch_moe's own formula
+        else:
+            capacity = max(1, int(math.ceil(
+                k * B * total / self._E * self._cf)))
         y, _ = nd.switch_moe(x, self.router_weight.data(ctx),
                              self.experts_w1.data(ctx),
                              self.experts_w2.data(ctx),
                              capacity_factor=self._cf,
-                             activation=self._act, top_k=self._top_k)
+                             activation=self._act, top_k=self._top_k,
+                             capacity=capacity)
         return y
 
 
@@ -147,17 +170,35 @@ class MoEDecoderLayer(HybridBlock):
         return x + self.moe.decode_forward(self.ffn_norm(x)), \
             cache_k, cache_v
 
-    def prefill(self, x, cache_k, cache_v, start_pos=0):
+    def step_slots(self, x, cache_k, cache_v, pos):
+        """Per-slot-position decode step (continuous batching): ``pos``
+        is a (B,) vector.  The routed FFN runs capacity-unbounded, so
+        inactive pool slots — which still flow through the step with
+        garbage activations — can never evict a live slot's token from
+        an expert."""
+        h, cache_k, cache_v = self.attn.step_slots(self.attn_norm(x),
+                                                   cache_k, cache_v,
+                                                   pos)
+        x = x + h
+        return x + self.moe.decode_forward(self.ffn_norm(x)), \
+            cache_k, cache_v
+
+    def prefill(self, x, cache_k, cache_v, start_pos=0, total_len=None):
         """Chunked prompt ingestion (see Attention.prefill).  The routed
-        FFN uses the TRAINING capacity (prefill_forward): bounded
-        dispatch memory at prompt scale, and routing identical to the
-        full-context forward; only the one-token step() runs
-        capacity-unbounded."""
+        FFN uses the TRAINING capacity budgeted from the FULL prompt
+        length (prefill_forward): bounded dispatch memory at prompt
+        scale; only the one-token step() runs capacity-unbounded.
+        ``total_len`` defaults to start_pos + T — exact for single-chunk
+        prefill and for the FINAL chunk of a multi-chunk ingestion;
+        earlier chunks should pass the known full prompt length."""
         h, cache_k, cache_v = self.attn.prefill(self.attn_norm(x),
                                                 cache_k, cache_v,
                                                 start_pos)
         x = x + h
-        return x + self.moe.prefill_forward(self.ffn_norm(x)), \
+        total = total_len if total_len is not None \
+            else start_pos + x.shape[1]
+        return x + self.moe.prefill_forward(self.ffn_norm(x),
+                                            total_len=total), \
             cache_k, cache_v
 
 

@@ -48,7 +48,7 @@ def _prepare(model, prompt_ids, max_new_tokens, max_length, K):
 
 def sample_next_token(logits, key, temperature=1.0, top_k=0, top_p=0.0,
                       repetition_penalty=1.0, prev_ids=None,
-                      seen_mask=None):
+                      seen_mask=None, active_mask=None):
     """Draw next-token ids from (B, V) logits with temperature plus
     optional top-k and/or nucleus (top-p) truncation — the standard LM
     sampling controls (no reference analogue; gluonnlp's
@@ -64,7 +64,16 @@ def sample_next_token(logits, key, temperature=1.0, top_k=0, top_p=0.0,
     should maintain: tokens already emitted get their logit divided (if
     positive) or multiplied (if negative) by the penalty — the CTRL/HF
     convention.  The penalty applies in greedy mode too (temperature=0
-    penalizes, then argmaxes); ``key`` may be None when greedy."""
+    penalizes, then argmaxes); ``key`` may be None when greedy.
+
+    Continuous-batching form: ``key`` may be a BATCH of per-row keys
+    (shape (B,) typed key array) — row b draws with key[b], so every
+    cache slot keeps its own reproducible stream; a per-row draw with
+    key k is bit-identical to an isolated (1, V) draw with the same k.
+    ``active_mask`` (B,) bool marks live slots: inactive rows return 0,
+    never consume randomness semantics, and are excluded from the
+    seen-mask penalty so a dead lane's garbage logits cannot pollute
+    the fixed-shape bookkeeping."""
     import jax
     import jax.numpy as jnp
 
@@ -77,13 +86,19 @@ def sample_next_token(logits, key, temperature=1.0, top_k=0, top_p=0.0,
             seen = seen.at[
                 jnp.arange(x.shape[0])[:, None], ids].set(True)
         if seen is not None:
+            if active_mask is not None:
+                seen = seen & jnp.asarray(active_mask,
+                                          bool).reshape(-1, 1)
             x = jnp.where(seen,
                           jnp.where(x > 0, x / repetition_penalty,
                                     x * repetition_penalty), x)
     if not temperature or temperature <= 0.0:
         # temperature 0 means greedy by convention (same contract as
         # generate()): no random draw at all
-        return jnp.argmax(x, axis=-1).astype(jnp.int32)
+        out = jnp.argmax(x, axis=-1).astype(jnp.int32)
+        if active_mask is not None:
+            out = jnp.where(jnp.asarray(active_mask, bool), out, 0)
+        return out
     if temperature != 1.0:
         x = x / temperature
     if top_k and top_k > 0:
@@ -99,7 +114,18 @@ def sample_next_token(logits, key, temperature=1.0, top_k=0, top_p=0.0,
         cutoff = jnp.min(jnp.where(keep_sorted, sorted_x, jnp.inf),
                          axis=-1, keepdims=True)
         x = jnp.where(x < cutoff, _NEG_INF, x)
-    return jax.random.categorical(key, x, axis=-1).astype(jnp.int32)
+    if getattr(key, "ndim", 0) >= 1:
+        # per-row keys: each row's draw is bit-identical to an isolated
+        # single-row categorical with that key (threefry counts bits
+        # per-lane), which is what slot-parity with generate() needs
+        out = jax.vmap(
+            lambda k, row: jax.random.categorical(k, row))(key, x)
+        out = out.astype(jnp.int32)
+    else:
+        out = jax.random.categorical(key, x, axis=-1).astype(jnp.int32)
+    if active_mask is not None:
+        out = jnp.where(jnp.asarray(active_mask, bool), out, 0)
+    return out
 
 
 class BeamSearchSampler:
@@ -154,7 +180,14 @@ class BeamSearchSampler:
 
         logp = self._log_softmax(logits.asnumpy()[:, -1])      # (B, V)
         V = logp.shape[-1]
-        top = self._topk_desc(logp, min(K, V))                 # (B, K)
+        if K > V:
+            # fail up front with the actual constraint instead of
+            # silently truncating the initial top-k and crashing in the
+            # beam-reorder gather later (ADVICE r5)
+            raise ValueError(
+                "beam_size %d exceeds vocabulary size %d: beam search "
+                "needs K distinct continuations per step" % (K, V))
+        top = self._topk_desc(logp, K)                         # (B, K)
         scores = onp.take_along_axis(logp, top, axis=-1)       # (B, K)
         beams = onp.repeat(prompt_ids.asnumpy()[:, None, :], K, axis=1)
         beams = onp.concatenate(

@@ -168,6 +168,43 @@ class MultiHeadAttention(HybridBlock):
         out = out.reshape(B, 1, H * D)
         return self.out_proj(out), cache_k, cache_v
 
+    def step_slots(self, x, cache_k, cache_v, pos):
+        """One-token decode with PER-ROW positions: x (B, 1, C), pos
+        (B,) int vector — row b writes its K/V at position pos[b] and
+        attends under its own causal/occupancy mask.  This is the
+        continuous-batching form of step(): every pool slot sits at its
+        own sequence depth, yet the program keeps fixed shapes so ONE
+        compiled step serves every position combination."""
+        B = x.shape[0]
+        H, KV, D = self._heads, self._kv_heads, self._head_dim
+        Tmax = cache_k.shape[2]
+        qkv = self.qkv(x)  # (B, 1, (H+2KV)*D)
+        q = qkv[:, :, :H * D].reshape(B, 1, H, D).transpose((0, 2, 1, 3))
+        k = qkv[:, :, H * D:(H + KV) * D].reshape(
+            B, 1, KV, D).transpose((0, 2, 1, 3))
+        v = qkv[:, :, (H + KV) * D:].reshape(
+            B, 1, KV, D).transpose((0, 2, 1, 3))
+        if self._rotary:
+            q = nd.rope(q, offset=pos)  # (B,) offset: per-row rotation
+            k = nd.rope(k, offset=pos)
+        cache_k = nd._internal_cache_write_rows(cache_k, k, pos=pos)
+        cache_v = nd._internal_cache_write_rows(cache_v, v, pos=pos)
+        # same GQA fold as step(); the validity mask is per-ROW here
+        rep = H // KV
+        q_r = q.reshape(B * KV, rep, D)            # (B*KV, rep, D)
+        keys = cache_k.reshape(B * KV, Tmax, D)
+        values = cache_v.reshape(B * KV, Tmax, D)
+        scores = nd.batch_dot(q_r, keys,
+                              transpose_b=True) / math.sqrt(D)
+        valid = (nd.arange(0, Tmax).reshape((1, Tmax))
+                 <= pos.reshape((B, 1)))           # (B, Tmax)
+        attn = nd.masked_softmax(
+            scores.reshape(B, KV, rep, Tmax),
+            mask=valid.reshape((B, 1, 1, Tmax)).astype("bool"))
+        out = nd.batch_dot(attn.reshape(B * KV, rep, Tmax), values)
+        out = out.reshape(B, 1, H * D)
+        return self.out_proj(out), cache_k, cache_v
+
     def prefill(self, x, cache_k, cache_v, start_pos=0):
         """Process T tokens in ONE batched pass (vs T serial step()
         calls): computes their K/V, writes the cache block at
@@ -354,9 +391,23 @@ class LlamaDecoderLayer(HybridBlock):
         h = self.down_proj(nd.swish(self.gate_proj(h)) * self.up_proj(h))
         return x + h, cache_k, cache_v
 
-    def prefill(self, x, cache_k, cache_v, start_pos=0):
+    def step_slots(self, x, cache_k, cache_v, pos):
+        """One-token decode with per-row positions (continuous
+        batching); pos is a (B,) vector — see Attention.step_slots."""
+        h, cache_k, cache_v = self.attn.step_slots(self.attn_norm(x),
+                                                   cache_k, cache_v,
+                                                   pos)
+        x = x + h
+        h = self.ffn_norm(x)
+        h = self.down_proj(nd.swish(self.gate_proj(h)) * self.up_proj(h))
+        return x + h, cache_k, cache_v
+
+    def prefill(self, x, cache_k, cache_v, start_pos=0, total_len=None):
         """Chunked prompt ingestion through this layer (T tokens in one
-        pass; see Attention.prefill)."""
+        pass; see Attention.prefill).  ``total_len`` (the full prompt
+        length) only matters for routed-FFN capacity — dense layers
+        accept and ignore it so TransformerLM.prefill can thread it
+        uniformly."""
         h, cache_k, cache_v = self.attn.prefill(self.attn_norm(x),
                                                 cache_k, cache_v,
                                                 start_pos)
@@ -450,17 +501,45 @@ class TransformerLM(HybridBlock):
             new_caches.append((ck, cv))
         return self._logits(x), new_caches
 
-    def prefill(self, token_ids, caches, start_pos=0):
-        """Ingest the whole prompt in ONE forward: token_ids (B, T) →
-        (logits (B, T, V), new_caches) with every layer's K/V cached at
-        [start_pos, start_pos+T).  One MXU-sized pass replaces T serial
-        step() calls — the standard prefill/decode split."""
+    def step_slots(self, token_ids, caches, pos):
+        """Decode ONE token per cache SLOT, each at its own position:
+        token_ids (B, 1), pos (B,) int vector → (logits (B, 1, V),
+        new_caches).  The continuous-batching step: row b writes at
+        pos[b] and attends only its own [0, pos[b]] prefix.  Same
+        functional-cache contract as step()."""
         x = self.embed(token_ids)
         new_caches = []
         for layer, (ck, cv) in zip(self.layers, caches):
-            x, ck, cv = layer.prefill(x, ck, cv, start_pos)
+            x, ck, cv = layer.step_slots(x, ck, cv, pos)
             new_caches.append((ck, cv))
         return self._logits(x), new_caches
+
+    def prefill(self, token_ids, caches, start_pos=0, total_len=None):
+        """Ingest the whole prompt in ONE forward: token_ids (B, T) →
+        (logits (B, T, V), new_caches) with every layer's K/V cached at
+        [start_pos, start_pos+T).  One MXU-sized pass replaces T serial
+        step() calls — the standard prefill/decode split.  For routed
+        (MoE) layers ``total_len`` declares the FULL prompt length so
+        expert capacity budgets from the whole prompt even when this
+        call ingests only a chunk (defaults to start_pos + T)."""
+        x = self.embed(token_ids)
+        new_caches = []
+        for layer, (ck, cv) in zip(self.layers, caches):
+            x, ck, cv = layer.prefill(x, ck, cv, start_pos,
+                                      total_len=total_len)
+            new_caches.append((ck, cv))
+        return self._logits(x), new_caches
+
+    def write_cache_slot(self, caches, slot_caches, slot, pos=0):
+        """Copy one sequence's per-layer (k, v) caches (batch 1, length
+        T) into row ``slot`` of the pool caches at column ``pos`` — the
+        compiled slot-prefill write of the continuous-batching engine.
+        ``slot`` may be a traced scalar; returns new pool caches
+        (functional, like step/prefill)."""
+        return [
+            (nd._internal_cache_write_slot(ck, sk, slot=slot, pos=pos),
+             nd._internal_cache_write_slot(cv, sv, slot=slot, pos=pos))
+            for (ck, cv), (sk, sv) in zip(caches, slot_caches)]
 
     def generate(self, prompt_ids, max_new_tokens, max_length=None,
                  temperature=0.0, top_k=0, top_p=0.0,

@@ -182,17 +182,29 @@ def rms_norm(data, gamma, eps=1e-6):
 @register_op("rope", aliases=("_contrib_rope",))
 def rope(data, base=10000.0, offset=0, scale=1.0):
     """Rotary position embedding over the last dim of (B, H, T, D) or
-    (B, T, D). Pairs are (x[..., :D/2], x[..., D/2:]) — the Llama layout."""
+    (B, T, D). Pairs are (x[..., :D/2], x[..., D/2:]) — the Llama layout.
+
+    ``offset`` may be a scalar (python int or traced — every row sits at
+    the same position) or a (B,) vector: row b's positions start at
+    offset[b] (continuous-batching decode, where each cache slot is at
+    its own depth)."""
     dt = data.dtype
     x = data.astype(jnp.float32)
     D = x.shape[-1]
     T = x.shape[-2]
     half = D // 2
     freqs = base ** (-jnp.arange(0, half, dtype=jnp.float32) / half)
-    pos = (jnp.arange(T, dtype=jnp.float32) + offset) * scale
-    ang = pos[:, None] * freqs[None, :]          # (T, D/2)
+    if getattr(offset, "ndim", 0) >= 1:
+        off = jnp.asarray(offset, jnp.float32).reshape(-1)   # (B,)
+        pos = (jnp.arange(T, dtype=jnp.float32)[None, :]
+               + off[:, None]) * scale                       # (B, T)
+        ang = pos[..., None] * freqs                         # (B, T, D/2)
+        shape = (x.shape[0],) + (1,) * (x.ndim - 3) + (T, half)
+    else:
+        pos = (jnp.arange(T, dtype=jnp.float32) + offset) * scale
+        ang = pos[:, None] * freqs[None, :]                  # (T, D/2)
+        shape = (1,) * (x.ndim - 2) + (T, half)
     sin, cos = jnp.sin(ang), jnp.cos(ang)
-    shape = (1,) * (x.ndim - 2) + (T, half)
     sin = sin.reshape(shape)
     cos = cos.reshape(shape)
     x1, x2 = x[..., :half], x[..., half:]

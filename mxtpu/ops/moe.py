@@ -23,7 +23,8 @@ from ..base import register_op
 
 @register_op("switch_moe", num_outputs=2)
 def switch_moe(x, router_w, w1, w2, capacity_factor=1.25,
-               activation="swish", top_k=1, normalize_gates=True, *,
+               activation="swish", top_k=1, normalize_gates=True,
+               capacity=None, *,
                router_jitter=0.0, z_loss_weight=0.0, _training=False,
                _key=None):
     """Routed expert FFN (Switch top-1 / GShard top-k).
@@ -52,6 +53,12 @@ def switch_moe(x, router_w, w1, w2, capacity_factor=1.25,
     capacity_factor <= 0 disables the capacity limit entirely (capacity
     = S): the incremental-decode configuration, where a step sees only
     B tokens and the training capacity would spuriously drop them.
+
+    capacity (static int, optional): explicit per-expert slot count
+    overriding the capacity_factor formula.  Chunked prefill uses this
+    to budget from the FULL prompt length rather than the chunk it
+    happens to see (ADVICE r5), so a small chunk is never squeezed into
+    a spuriously tiny capacity.
     """
     orig_shape = x.shape
     d = orig_shape[-1]
@@ -70,7 +77,9 @@ def switch_moe(x, router_w, w1, w2, capacity_factor=1.25,
     logits = jnp.dot(xr, router_w.astype(cdt).T)              # (S, E)
     gates = jax.nn.softmax(logits, axis=-1)
 
-    if capacity_factor <= 0:
+    if capacity is not None:
+        capacity = max(1, int(capacity))
+    elif capacity_factor <= 0:
         capacity = S * k  # unbounded: nothing can drop
     else:
         # k-scaled per GShard: top-k dispatches k*S assignments, so the

@@ -87,13 +87,34 @@ def _per_weight(val, i):
     return val
 
 
-@register_op("multi_sgd_update", differentiable=False)
+def _outputs_per_weight(mult):
+    """num_outputs hint for the multi-tensor families (the
+    _sample_multinomial callable pattern): symbolic-graph use needs the
+    arity BEFORE evaluation, and for these ops it is mult outputs per
+    weight.  Upstream requires the num_weights attr on every multi_*
+    op, so symbolic callers must pass it."""
+
+    def count(kw):
+        nw = kw.get("num_weights")
+        if nw is None:
+            raise ValueError(
+                "multi-tensor update ops need num_weights to declare "
+                "their output arity in symbolic graphs (upstream "
+                "requires the attr too)")
+        return mult * int(nw)
+
+    return count
+
+
+@register_op("multi_sgd_update", differentiable=False,
+             num_outputs=_outputs_per_weight(1))
 def multi_sgd_update(*data, lrs, wds, rescale_grad=1.0, clip_gradient=-1.0,
                      num_weights=None):
     """Fused multi-tensor SGD over interleaved [weight, grad] pairs.
-    num_weights is accepted for signature parity; the split is derived
-    from the input count (register_op returns the plain fn, so the
-    single-tensor ops compose directly)."""
+    num_weights is REQUIRED in symbolic graphs (declares the output
+    arity before evaluation); imperatively it may be omitted — the
+    split is derived from the input count (register_op returns the
+    plain fn, so the single-tensor ops compose directly)."""
     outs = []
     for i, (w, g) in enumerate(_interleaved(data, 2)):
         outs.append(sgd_update(w, g, _per_weight(lrs, i),
@@ -102,7 +123,8 @@ def multi_sgd_update(*data, lrs, wds, rescale_grad=1.0, clip_gradient=-1.0,
     return tuple(outs)
 
 
-@register_op("multi_sgd_mom_update", differentiable=False)
+@register_op("multi_sgd_mom_update", differentiable=False,
+             num_outputs=_outputs_per_weight(2))
 def multi_sgd_mom_update(*data, lrs, wds, momentum=0.0, rescale_grad=1.0,
                          clip_gradient=-1.0, num_weights=None):
     outs = []
@@ -113,7 +135,8 @@ def multi_sgd_mom_update(*data, lrs, wds, momentum=0.0, rescale_grad=1.0,
     return tuple(outs)
 
 
-@register_op("multi_mp_sgd_update", differentiable=False)
+@register_op("multi_mp_sgd_update", differentiable=False,
+             num_outputs=_outputs_per_weight(2))
 def multi_mp_sgd_update(*data, lrs, wds, rescale_grad=1.0,
                         clip_gradient=-1.0, num_weights=None):
     outs = []
@@ -124,7 +147,8 @@ def multi_mp_sgd_update(*data, lrs, wds, rescale_grad=1.0,
     return tuple(outs)
 
 
-@register_op("multi_mp_sgd_mom_update", differentiable=False)
+@register_op("multi_mp_sgd_mom_update", differentiable=False,
+             num_outputs=_outputs_per_weight(3))
 def multi_mp_sgd_mom_update(*data, lrs, wds, momentum=0.0,
                             rescale_grad=1.0, clip_gradient=-1.0,
                             num_weights=None):
@@ -136,7 +160,8 @@ def multi_mp_sgd_mom_update(*data, lrs, wds, momentum=0.0,
     return tuple(outs)
 
 
-@register_op("preloaded_multi_sgd_update", differentiable=False)
+@register_op("preloaded_multi_sgd_update", differentiable=False,
+             num_outputs=_outputs_per_weight(1))
 def preloaded_multi_sgd_update(*data, rescale_grad=1.0, clip_gradient=-1.0,
                                num_weights=None):
     """Like multi_sgd_update but lr/wd arrive as trailing 1-D tensors
@@ -149,7 +174,8 @@ def preloaded_multi_sgd_update(*data, rescale_grad=1.0, clip_gradient=-1.0,
     return tuple(outs)
 
 
-@register_op("preloaded_multi_sgd_mom_update", differentiable=False)
+@register_op("preloaded_multi_sgd_mom_update", differentiable=False,
+             num_outputs=_outputs_per_weight(2))
 def preloaded_multi_sgd_mom_update(*data, momentum=0.0, rescale_grad=1.0,
                                    clip_gradient=-1.0, num_weights=None):
     arrays, lrs, wds = data[:-2], data[-2], data[-1]
@@ -160,7 +186,8 @@ def preloaded_multi_sgd_mom_update(*data, momentum=0.0, rescale_grad=1.0,
     return tuple(outs)
 
 
-@register_op("preloaded_multi_mp_sgd_update", differentiable=False)
+@register_op("preloaded_multi_mp_sgd_update", differentiable=False,
+             num_outputs=_outputs_per_weight(2))
 def preloaded_multi_mp_sgd_update(*data, rescale_grad=1.0,
                                   clip_gradient=-1.0, num_weights=None):
     arrays, lrs, wds = data[:-2], data[-2], data[-1]
@@ -171,7 +198,8 @@ def preloaded_multi_mp_sgd_update(*data, rescale_grad=1.0,
     return tuple(outs)
 
 
-@register_op("preloaded_multi_mp_sgd_mom_update", differentiable=False)
+@register_op("preloaded_multi_mp_sgd_mom_update", differentiable=False,
+             num_outputs=_outputs_per_weight(3))
 def preloaded_multi_mp_sgd_mom_update(*data, momentum=0.0,
                                       rescale_grad=1.0, clip_gradient=-1.0,
                                       num_weights=None):
@@ -374,7 +402,16 @@ def mp_lamb_update_phase2(weight, g, r1, r2, weight32, lr,
 
 # ----------------------------------------------------------- LARS helpers
 
-@register_op("multi_sum_sq", differentiable=False)
+def _multi_sum_sq_outputs(kw):
+    na = kw.get("num_arrays")
+    if na is None:
+        raise ValueError("multi_sum_sq needs num_arrays to declare its "
+                         "output arity in symbolic graphs")
+    return int(na)
+
+
+@register_op("multi_sum_sq", differentiable=False,
+             num_outputs=_multi_sum_sq_outputs)
 def multi_sum_sq(*arrays, num_arrays=None):
     """Per-array sum of squares, one scalar per input (multi_sum_sq.cc);
     feeds multi_lars / clip_global_norm-style logic."""
@@ -405,7 +442,16 @@ def amp_cast(data, dtype="float16"):
     return data.astype(jnp.dtype(dtype))
 
 
-@register_op("amp_multicast")
+def _amp_multicast_outputs(kw):
+    n = kw.get("num_outputs")
+    if n is None:
+        raise ValueError("amp_multicast needs num_outputs to declare "
+                         "its output arity in symbolic graphs (the "
+                         "reference requires the attr too)")
+    return int(n)
+
+
+@register_op("amp_multicast", num_outputs=_amp_multicast_outputs)
 def amp_multicast(*data, num_outputs=None, cast_narrow=False):
     """Cast all inputs to their common widest (or narrowest) float type."""
     dts = [a.dtype for a in data]

@@ -1047,6 +1047,34 @@ def _internal_cache_write(cache, new, pos=0):
         cache, new.astype(cache.dtype), start, axis=2)
 
 
+@register_op("_internal_cache_write_rows", differentiable=False)
+def _internal_cache_write_rows(cache, new, pos):
+    """Per-row KV-cache write: row b of ``new`` (B, KV, 1, D) lands at
+    position ``pos[b]`` of cache row b (continuous-batching decode,
+    where every slot sits at its own sequence position).  ``pos`` is a
+    (B,) int vector, python or traced — the scatter keeps shapes static
+    so ONE compiled step serves every position combination."""
+    p = jnp.asarray(pos, jnp.int32).reshape(-1)
+    rows = jnp.arange(cache.shape[0])
+    return cache.at[rows, :, p, :].set(new[:, :, 0, :].astype(cache.dtype))
+
+
+@register_op("_internal_cache_write_slot", differentiable=False)
+def _internal_cache_write_slot(cache, new, slot=0, pos=0):
+    """Write a single sequence's cache block ``new`` (1, KV, T, D) into
+    pool row ``slot`` of ``cache`` (B, KV, T_max, D) at column ``pos``
+    (slot-prefill of the continuous-batching engine).  ``slot``/``pos``
+    may be traced scalars: one compiled slot-prefill per prompt bucket
+    serves every slot."""
+    s = slot.astype(jnp.int32) if hasattr(slot, "astype") \
+        else jnp.int32(slot)
+    p = pos.astype(jnp.int32) if hasattr(pos, "astype") \
+        else jnp.int32(pos)
+    zero = jnp.int32(0)
+    return jax.lax.dynamic_update_slice(
+        cache, new.astype(cache.dtype), (s, zero, p, zero))
+
+
 # ---------------------------------------------------------------------------
 # upstream mx.np internal op names (python/mxnet/numpy calls lower to
 # `_npi_*`-registered kernels in the reference — src/operator/numpy/**).

@@ -21,6 +21,9 @@ Components:
 - ring_attention: sequence-parallel blockwise attention via shard_map +
                ppermute (long-context path; absent in the reference,
                required for TPU scale)
+- decode/serving: ShardedDecoder (jitted KV-cache decode over the mesh)
+               and ContinuousBatchingEngine (iteration-level scheduling
+               over a slot pool — Orca/vLLM-style serving, static-shape)
 """
 
 from .mesh import (DeviceMesh, make_mesh, init_process_group, rank,
@@ -29,6 +32,7 @@ from . import collectives
 from .sharding import ShardingRules, PartitionSpec
 from .trainer import SPMDTrainer
 from .decode import ShardedDecoder
+from .serving import ContinuousBatchingEngine, Request
 from . import ring_attention
 from . import pipeline as pipeline_mod
 from .pipeline import pipeline, stack_stage_params, stage_sharding

@@ -72,6 +72,46 @@ class ShardedDecoder:
                               key=lambda p: p.name)
         self._staged = False
         self._jit_cache: Dict[Any, Any] = {}
+        self._validate_kv_sharding()
+
+    def _iter_blocks(self):
+        """DFS over the block tree (shared by every construction-time
+        inspection: MoE detection, kv-head validation)."""
+        stack = [self._block]
+        while stack:
+            b = stack.pop()
+            yield b
+            children = getattr(b, "_children", None)
+            if children:
+                stack.extend(children.values()
+                             if hasattr(children, "values") else children)
+
+    def _validate_kv_sharding(self):
+        """The default cache_spec shards the kv-head axis over "tp"; a
+        head count not divisible by the shard count would surface as an
+        opaque GSPMD partitioning failure deep inside the first compiled
+        step (ADVICE r5).  Catch it at construction with the actual
+        constraint spelled out."""
+        spec = self._cache_spec
+        axes = ()
+        if len(spec) > 1 and spec[1] is not None:
+            axes = spec[1] if isinstance(spec[1], tuple) else (spec[1],)
+        shards = 1
+        for a in axes:
+            shards *= self._mesh.axis_sizes.get(a, 1)
+        if shards <= 1:
+            return
+        for b in self._iter_blocks():
+            kv = getattr(b, "_kv_heads", None)
+            if kv is not None and kv % shards != 0:
+                raise ValueError(
+                    "KV cache sharding %r splits the %d kv heads of "
+                    "block %r over %d shards, which does not divide "
+                    "evenly — this would fail inside GSPMD at the first "
+                    "decode step.  Use a model whose num_kv_heads is "
+                    "divisible by the tp axis, or pass "
+                    "cache_spec=PartitionSpec() to replicate the caches."
+                    % (tuple(spec), kv, getattr(b, "name", b), shards))
 
     def _block_has_moe(self):
         """Bucketed prefill is disabled for MoE blocks: padded tokens
@@ -83,18 +123,9 @@ class ShardedDecoder:
             return self._has_moe
         from ..models.moe import SwitchMoE
 
-        stack = [self._block]
-        while stack:
-            b = stack.pop()
-            if isinstance(b, SwitchMoE):
-                self._has_moe = True
-                return True
-            children = getattr(b, "_children", None)
-            if children:
-                stack.extend(children.values()
-                             if hasattr(children, "values") else children)
-        self._has_moe = False
-        return False
+        self._has_moe = any(isinstance(b, SwitchMoE)
+                            for b in self._iter_blocks())
+        return self._has_moe
 
     # -- staging ---------------------------------------------------------
     def _stage(self):
@@ -157,6 +188,27 @@ class ShardedDecoder:
     def _prefill_body(block, caches, tokens):
         return block.prefill(NDArray(tokens), caches)
 
+    @staticmethod
+    def _step_slots_body(block, caches, token, pos):
+        """Pool decode step: pos is a (B,) vector — every slot at its
+        own position, one compiled program for all combinations."""
+        return block.step_slots(NDArray(token), caches, NDArray(pos))
+
+    @staticmethod
+    def _slot_prefill_body(block, caches, tokens, slot):
+        """Compiled slot prefill: run the (1, Tb) prompt through the
+        block's chunked prefill against a FRESH batch-1 scratch cache
+        of length Tb, then write the scratch K/V into pool row ``slot``
+        (a traced scalar — one program per bucket serves every slot).
+        The scratch cache is an in-program constant; XLA fuses the
+        zero-init away."""
+        tokens = NDArray(tokens)
+        dt = str(caches[0][0].dtype)
+        scratch = block.init_cache(1, tokens.shape[1], dt)
+        logits, scratch = block.prefill(tokens, scratch)
+        return logits, block.write_cache_slot(caches, scratch,
+                                              NDArray(slot))
+
     def _step_jitted(self, cache_leaves, token, pos):
         key = ("step", tuple(ck.shape for ck, _ in cache_leaves),
                cache_leaves[0][0].dtype, token.shape, token.dtype)
@@ -175,6 +227,44 @@ class ShardedDecoder:
         param_leaves = tuple(p.data()._data for p in self._params)
         return self._jit_cache[key](param_leaves, cache_leaves, tokens)
 
+    def _step_slots_jitted(self, cache_leaves, token, pos):
+        key = ("step_slots", tuple(ck.shape for ck, _ in cache_leaves),
+               cache_leaves[0][0].dtype, token.shape, token.dtype)
+        if key not in self._jit_cache:
+            self._jit_cache[key] = self._build_program(
+                self._step_slots_body, len(cache_leaves),
+                n_extra_inputs=2)
+        param_leaves = tuple(p.data()._data for p in self._params)
+        return self._jit_cache[key](param_leaves, cache_leaves, token, pos)
+
+    def _slot_prefill_jitted(self, cache_leaves, tokens, slot):
+        key = ("slot_prefill",
+               tuple(ck.shape for ck, _ in cache_leaves),
+               cache_leaves[0][0].dtype, tokens.shape, tokens.dtype)
+        if key not in self._jit_cache:
+            self._jit_cache[key] = self._build_program(
+                self._slot_prefill_body, len(cache_leaves),
+                n_extra_inputs=2)
+        param_leaves = tuple(p.data()._data for p in self._params)
+        return self._jit_cache[key](param_leaves, cache_leaves, tokens,
+                                    slot)
+
+    def _ensure_staged(self, sample_ids):
+        """Resolve deferred parameter shapes (one imperative forward if
+        needed — same bootstrap as SPMDTrainer.step) and stage the
+        params onto the mesh.  Shared by generate() and the
+        continuous-batching engine."""
+        if self._staged:
+            return
+        from ..gluon.parameter import DeferredInitializationError
+        try:
+            for p in self._params:
+                p.data()
+        except DeferredInitializationError:
+            with autograd.pause(train_mode=False):
+                self._block(sample_ids)
+        self._stage()
+
     # -- public API ------------------------------------------------------
     def generate(self, prompt_ids, max_new_tokens, max_length=None,
                  temperature=0.0, top_k=0, top_p=0.0,
@@ -186,17 +276,7 @@ class ShardedDecoder:
         greedily and ignores top_k/top_p (same gating as generate)."""
         prompt_ids = prompt_ids if isinstance(prompt_ids, NDArray) \
             else nd_array(prompt_ids)
-        if not self._staged:
-            # resolve deferred parameter shapes with one imperative
-            # forward (same bootstrap as SPMDTrainer.step), then stage
-            from ..gluon.parameter import DeferredInitializationError
-            try:
-                for p in self._params:
-                    p.data()
-            except DeferredInitializationError:
-                with autograd.pause(train_mode=False):
-                    self._block(prompt_ids)
-            self._stage()
+        self._ensure_staged(prompt_ids)
         B, Tp = prompt_ids.shape
         total = Tp + max_new_tokens
         bucketing = self._bucket_prefill and not self._block_has_moe()

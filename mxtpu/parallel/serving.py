@@ -1,0 +1,400 @@
+"""Continuous-batching decode engine with slot-based KV cache reuse.
+
+``ShardedDecoder.generate`` is strictly run-to-completion: one fixed
+batch allocates a fresh KV cache, every sequence decodes to
+max_new_tokens, and only then does new work get in — a single long
+request pins the whole batch and short requests pay worst-case latency.
+This module adds the standard serving fix (Orca iteration-level
+scheduling + vLLM-style cache-slot reuse, adapted to the static-shape
+discipline TPUs want):
+
+- ONE persistent pool of ``num_slots`` cache rows over one on-mesh
+  sharded KV cache (allocated once, donated between steps, never
+  reallocated per request);
+- per-slot ``pos``/active state threaded through a single compiled
+  per-row-position decode step (``TransformerLM.step_slots``): finished
+  sequences free their row MID-FLIGHT and queued requests join at the
+  next iteration boundary;
+- admission via a compiled SLOT PREFILL: the prompt is right-padded to
+  the existing power-of-two buckets, run through the block's chunked
+  prefill against a batch-1 scratch cache, and written into the slot's
+  pool region with ``dynamic_update_slice`` — the slot index is traced,
+  so one program per bucket serves every slot;
+- an inactive-slot mask keeps dead lanes out of sampling and the
+  fixed-shape repetition-penalty bookkeeping.
+
+Compile-count guarantee: admission/eviction is host-side bookkeeping —
+the device only ever sees (#prefill buckets) slot-prefill programs plus
+ONE pooled decode step, bounded by the bucket count, not by traffic.
+
+Per-request parity: each slot keeps its own RNG stream (root
+``jax.random.key(seed)``, counter fold-in — exactly the global
+key-ring's derivation), its own seen-token penalty row, and attends
+only its own [0, pos] prefix, so every request's token stream is
+IDENTICAL to an isolated ``ShardedDecoder.generate`` call with the same
+seed (asserted in tests/test_serving.py).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as onp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from .. import random as _random
+from ..ndarray import NDArray, array as nd_array
+from .decode import ShardedDecoder, _bucket
+from .mesh import DeviceMesh
+from .sharding import ShardingRules
+
+__all__ = ["ContinuousBatchingEngine", "Request"]
+
+
+class Request:
+    """One generation request (host-side record)."""
+
+    __slots__ = ("rid", "prompt", "max_new_tokens", "temperature",
+                 "top_k", "top_p", "repetition_penalty", "seed",
+                 "eos_id")
+
+    def __init__(self, rid, prompt, max_new_tokens, temperature=0.0,
+                 top_k=0, top_p=0.0, repetition_penalty=1.0, seed=None,
+                 eos_id=None):
+        self.rid = rid
+        self.prompt = prompt            # (1, Tp) int32 numpy
+        self.max_new_tokens = int(max_new_tokens)
+        self.temperature = float(temperature or 0.0)
+        self.top_k = int(top_k or 0)
+        self.top_p = float(top_p or 0.0)
+        self.repetition_penalty = float(repetition_penalty or 1.0)
+        self.seed = seed
+        self.eos_id = eos_id
+
+    @property
+    def sampled(self):
+        return self.temperature > 0.0
+
+    @property
+    def penalized(self):
+        return self.repetition_penalty != 1.0
+
+    @property
+    def sample_config(self):
+        """Slots sharing a config batch into ONE pooled sampling call."""
+        return (self.temperature, self.top_k, self.top_p,
+                self.repetition_penalty)
+
+
+def _slot_keys(seed):
+    """Per-slot RNG stream: a private _KeyRing instance, so a slot's
+    draws use EXACTLY the derivation ``mx.random.seed(seed)`` +
+    ``next_key()`` would — which is what makes an engine slot's samples
+    bit-identical to an isolated ``generate(..., seed=seed)``."""
+    return _random._KeyRing(int(seed))
+
+
+class _Slot:
+    """Host-side state of one cache row.  ``emitted`` holds references
+    to the pool-wide (B,) token vector of each iteration — row ``row``
+    is this slot's token; materializing per-slot streams is deferred to
+    finish time so the steady-state loop dispatches O(1) host ops per
+    iteration, not O(slots)."""
+
+    __slots__ = ("req", "row", "pos", "emitted", "keys")
+
+    def __init__(self, req, row, pos, first_tokens, keys):
+        self.req = req
+        self.row = row
+        self.pos = pos             # cache position of the LAST sampled
+        #                            token (the next step writes here)
+        self.emitted = [first_tokens]  # list of (B,) device vectors
+        self.keys = keys
+
+
+class ContinuousBatchingEngine:
+    """Iteration-level scheduler over a fixed pool of KV-cache slots.
+
+    Parameters
+    ----------
+    block : TransformerLM-like block (init_cache / prefill / step_slots /
+        write_cache_slot).
+    mesh / rules / cache_spec : as ShardedDecoder — training shardings
+        are consumed in place, caches live on-mesh over the kv-head axis.
+    num_slots : pool size B (the compiled step's batch dimension).
+    max_length : per-slot cache length; every request must satisfy
+        prompt + max_new_tokens <= max_length.
+    bucket_prefill : right-pad prompts to power-of-two buckets so mixed
+        prompt lengths share a handful of compiled slot-prefills
+        (disabled automatically for MoE blocks, same as ShardedDecoder).
+    """
+
+    def __init__(self, block, mesh: DeviceMesh,
+                 rules: Optional[ShardingRules] = None,
+                 num_slots: int = 4, max_length: int = 256,
+                 cache_dtype: str = "float32",
+                 cache_spec: P = P(None, "tp", None, None),
+                 bucket_prefill: bool = True):
+        self._dec = ShardedDecoder(block, mesh, rules, cache_spec,
+                                   bucket_prefill)
+        self._block = block
+        self._mesh = mesh
+        self._num_slots = int(num_slots)
+        self._max_length = int(max_length)
+        self._cache_dtype = cache_dtype
+        self._pool = None                       # cache leaves, lazy
+        self._slots: List[Optional[_Slot]] = [None] * self._num_slots
+        self._queue: List[Request] = []
+        self._results: Dict[int, Any] = {}
+        self._next_rid = 0
+        self._seen = None                       # (B, V) penalty rows
+        self._last_tokens = None                # (B,) pooled last draw
+        self._prompt_dtype = None
+        self._steps = 0
+        self._tokens_generated = 0
+
+    # -- introspection ---------------------------------------------------
+    @property
+    def num_slots(self):
+        return self._num_slots
+
+    @property
+    def free_slots(self):
+        return sum(1 for s in self._slots if s is None)
+
+    @property
+    def pending(self):
+        return len(self._queue)
+
+    @property
+    def active(self):
+        return self._num_slots - self.free_slots
+
+    @property
+    def stats(self):
+        return {"steps": self._steps,
+                "tokens_generated": self._tokens_generated,
+                "compiled_programs": sorted(
+                    k[0] for k in self._dec._jit_cache)}
+
+    # -- request intake --------------------------------------------------
+    def submit(self, prompt_ids, max_new_tokens, temperature=0.0,
+               top_k=0, top_p=0.0, repetition_penalty=1.0, seed=None,
+               eos_id=None) -> int:
+        """Queue one request; returns its id.  Sampling knobs follow the
+        ``generate`` contract (temperature=0 greedy; seed reproduces)."""
+        prompt_ids = prompt_ids if isinstance(prompt_ids, NDArray) \
+            else nd_array(prompt_ids)
+        if prompt_ids.ndim != 2 or prompt_ids.shape[0] != 1:
+            raise ValueError(
+                "submit() takes ONE request: prompt_ids must be "
+                "(1, T_prompt), got %r" % (prompt_ids.shape,))
+        Tp = prompt_ids.shape[1]
+        if Tp + int(max_new_tokens) > self._max_length:
+            raise ValueError(
+                "request needs %d cache positions > slot max_length %d"
+                % (Tp + int(max_new_tokens), self._max_length))
+        if self._prompt_dtype is None:
+            self._prompt_dtype = prompt_ids.dtype
+        rid = self._next_rid
+        self._next_rid += 1
+        prompt = onp.asarray(prompt_ids.asnumpy(), dtype=onp.int32)
+        self._queue.append(Request(
+            rid, prompt, max_new_tokens, temperature, top_k, top_p,
+            repetition_penalty, seed, eos_id))
+        return rid
+
+    # -- pool plumbing ---------------------------------------------------
+    def _ensure_pool(self, sample_prompt):
+        self._dec._ensure_staged(sample_prompt)
+        if self._pool is not None:
+            return
+        jm = self._mesh.jax_mesh
+        cache_sh = NamedSharding(jm, self._dec._cache_spec)
+        self._pool = tuple(
+            (jax.device_put(ck._data, cache_sh),
+             jax.device_put(cv._data, cache_sh))
+            for ck, cv in self._block.init_cache(
+                self._num_slots, self._max_length, self._cache_dtype))
+
+    def _ensure_seen(self, vocab):
+        if self._seen is None or self._seen.shape[-1] != vocab:
+            self._seen = jnp.zeros((self._num_slots, vocab), bool)
+
+    # -- admission -------------------------------------------------------
+    def _finish(self, slot_idx_or_none, req, emitted, row):
+        prompt = jnp.asarray(req.prompt, jnp.int32)
+        if emitted:
+            toks = jnp.stack(emitted)[:, row].reshape(1, -1)
+            out = jnp.concatenate([prompt, toks], axis=1)
+        else:
+            out = prompt
+        dt = self._prompt_dtype or onp.int32
+        self._results[req.rid] = NDArray(out.astype(jnp.dtype(dt)))
+        if slot_idx_or_none is not None:
+            self._slots[slot_idx_or_none] = None
+
+    def _admit(self, req, slot_idx):
+        """Compiled slot-prefill + first-token sample; mirrors the
+        prefill half of ShardedDecoder.generate exactly (bucketed
+        right-padding, seed applied AFTER prefill, first draw from the
+        prompt's last real logit row)."""
+        from ..models.sampler import sample_next_token
+
+        Tp = req.prompt.shape[1]
+        bucketing = (self._dec._bucket_prefill
+                     and not self._dec._block_has_moe())
+        raw = jnp.asarray(req.prompt, jnp.int32)
+        if bucketing:
+            Tb = min(_bucket(Tp), self._max_length)
+            if Tb > Tp:
+                raw = jnp.pad(raw, ((0, 0), (0, Tb - Tp)))
+        logits, self._pool = self._dec._slot_prefill_jitted(
+            self._pool, raw, jnp.int32(slot_idx))
+        last = logits[:, Tp - 1]                       # (1, V)
+        keys = None
+        if req.seed is not None and req.sampled:
+            # seed AFTER prefill — the ordering generate() guarantees
+            keys = _slot_keys(req.seed)
+        elif req.sampled:
+            keys = _slot_keys(onp.random.randint(0, 2**31 - 1))
+        self._ensure_seen(last.shape[-1])
+        if req.penalized:
+            row = jnp.zeros((last.shape[-1],), bool).at[
+                jnp.asarray(req.prompt[0], jnp.int32)].set(True)
+            self._seen = self._seen.at[slot_idx].set(row)
+        tok = sample_next_token(
+            last, keys.next_key() if req.sampled else None,
+            req.temperature, req.top_k, req.top_p,
+            req.repetition_penalty,
+            seen_mask=self._seen[slot_idx:slot_idx + 1]
+            if req.penalized else None)
+        tok = tok.astype(jnp.int32)                    # (1,)
+        if req.penalized:
+            self._seen = self._seen.at[slot_idx, tok[0]].set(True)
+        if self._last_tokens is None:
+            self._last_tokens = jnp.zeros((self._num_slots,), jnp.int32)
+        self._last_tokens = self._last_tokens.at[slot_idx].set(tok[0])
+        slot = _Slot(req, slot_idx, Tp, self._last_tokens, keys)
+        if self._slot_done(slot):
+            self._finish(None, req, slot.emitted, slot_idx)
+            return
+        self._slots[slot_idx] = slot
+
+    def _slot_done(self, slot):
+        if len(slot.emitted) >= slot.req.max_new_tokens:
+            return True
+        if slot.req.eos_id is not None:
+            # eos needs a host read; only requests that opted into an
+            # eos token pay the sync
+            return int(jax.device_get(
+                slot.emitted[-1][slot.row])) == slot.req.eos_id
+        return False
+
+    # -- one scheduler iteration ----------------------------------------
+    def step(self):
+        """One iteration: admit queued requests into free slots, then
+        run ONE pooled decode step for every active slot.  Returns the
+        list of request ids finished this iteration."""
+        from ..models.sampler import sample_next_token
+
+        if self._queue:
+            self._ensure_pool(nd_array(self._queue[0].prompt))
+        finished_before = set(self._results)
+        # admission at the iteration boundary (Orca-style): joiners
+        # prefill now and take part in the very next pooled step
+        for i in range(self._num_slots):
+            if not self._queue:
+                break
+            if self._slots[i] is None:
+                req = self._queue.pop(0)
+                if req.max_new_tokens <= 0:
+                    self._finish(None, req, [], 0)
+                    continue
+                self._admit(req, i)
+
+        active = [i for i, s in enumerate(self._slots) if s is not None]
+        if active:
+            pos = onp.zeros((self._num_slots,), onp.int32)
+            for i in active:
+                pos[i] = self._slots[i].pos
+            logits, self._pool = self._dec._step_slots_jitted(
+                self._pool, self._last_tokens.reshape(-1, 1),
+                jnp.asarray(pos))
+            last = logits[:, 0]                          # (B, V)
+            self._sample_pool(last, active, sample_next_token)
+            self._steps += 1
+            self._tokens_generated += len(active)
+            for i in active:
+                s = self._slots[i]
+                s.pos += 1
+                s.emitted.append(self._last_tokens)
+                if self._slot_done(s):
+                    self._finish(i, s.req, s.emitted, s.row)
+        return [r for r in self._results if r not in finished_before]
+
+    def _sample_pool(self, last, active, sample_next_token):
+        """Pooled per-slot sampling: slots sharing a sampling config
+        batch into one call with PER-SLOT keys and an active mask, so a
+        drawn row is bit-identical to the isolated single-request draw
+        and dead lanes never touch the seen-mask bookkeeping.  Updates
+        the pooled (B,) last-token vector — the steady state costs ONE
+        sampling call and no per-slot dispatches."""
+        B = self._num_slots
+        groups: Dict[Any, List[int]] = {}
+        for i in active:
+            groups.setdefault(self._slots[i].req.sample_config,
+                              []).append(i)
+        next_tokens = None
+        for (temp, top_k, top_p, rep), members in groups.items():
+            mask = onp.zeros((B,), bool)
+            mask[members] = True
+            mask = jnp.asarray(mask)
+            keys = None
+            if temp > 0.0:
+                dummy = jax.random.key(0)
+                per_row = [self._slots[i].keys.next_key()
+                           if i in members and self._slots[i].keys
+                           else dummy for i in range(B)]
+                keys = jax.random.wrap_key_data(jnp.stack(
+                    [jax.random.key_data(k) for k in per_row]))
+            out = sample_next_token(
+                last, keys, temp, top_k, top_p, rep,
+                seen_mask=self._seen if rep != 1.0 else None,
+                active_mask=mask)
+            next_tokens = out if next_tokens is None \
+                else jnp.where(mask, out, next_tokens)
+            if rep != 1.0:
+                idx = jnp.asarray(members, jnp.int32)
+                self._seen = self._seen.at[idx, out[idx]].set(True)
+        self._last_tokens = next_tokens.astype(jnp.int32)
+
+    def take_result(self, rid):
+        """Pop one finished request's output (step()-driven use; run()
+        drains everything at once)."""
+        return self._results.pop(rid)
+
+    # -- drain -----------------------------------------------------------
+    def run(self):
+        """Drain the queue and every active slot; returns {request id →
+        (1, T_prompt + generated) NDArray}."""
+        # non-convergence watchdog, sized ONCE from the total
+        # outstanding work (every iteration with any active slot emits
+        # at least one token, so a healthy run can never exceed this)
+        outstanding = sum(r.max_new_tokens for r in self._queue) + sum(
+            s.req.max_new_tokens - len(s.emitted)
+            for s in self._slots if s is not None)
+        limit = 4 * (outstanding + len(self._queue)
+                     + self._num_slots + 1)
+        guard = 0
+        while self._queue or any(s is not None for s in self._slots):
+            self.step()
+            guard += 1
+            if guard > limit:
+                raise RuntimeError(
+                    "continuous-batching run() failed to converge — "
+                    "scheduler bug (slots: %r)" % (self._slots,))
+        out, self._results = self._results, {}
+        return out

@@ -27,6 +27,12 @@ import numpy as np
 import pytest
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "slow: heavier tests excluded from the tier-1 "
+        "'not slow' budget run")
+
+
 @pytest.fixture
 def rnd_seed():
     """Parity: tests/python/unittest/common.py with_seed() — deterministic

@@ -478,6 +478,12 @@ CASES.update({
                                           # near-ties flip indices
     "_internal_cache_write": C(
         lambda: (A(2, 3, 8, 4), A(2, 3, 1, 4)), {"pos": 5}, grad=False),
+    "_internal_cache_write_rows": C(
+        lambda: (A(2, 3, 8, 4), A(2, 3, 1, 4)),
+        {"pos": jnp.asarray([5, 2])}, grad=False),
+    "_internal_cache_write_slot": C(
+        lambda: (A(2, 3, 8, 4), A(1, 3, 4, 4)), {"slot": 1, "pos": 2},
+        grad=False),
     "_npi_einsum": C(lambda: (A(2, 3), A(3, 4)),
                      {"subscripts": "ij,jk->ik"}),
     "gradientmultiplier": C(lambda: (A(3, 4),), {"scalar": 1.0}),
