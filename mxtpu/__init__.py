@@ -44,6 +44,7 @@ _LAZY = {
     "visualization": ".visualization",
     "symbol": ".symbol",
     "sym": ".symbol",
+    "analysis": ".analysis",
     "module": ".module",
     "mod": ".module",
     "model": ".model",

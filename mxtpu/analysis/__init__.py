@@ -1,0 +1,37 @@
+"""mxtpu.analysis — static analyses over the Symbol/CachedOp graph IR,
+the op registry, and sharding rules (parity: the nnvm graph-pass layer —
+InferShape/InferType/PlanMemory ran as static analyses before execution;
+see PAPER.md §1 layer 6 and src/executor/graph_executor.cc in the
+reference).
+
+Four shipped passes, each returning a :class:`Report` of located
+:class:`Diagnostic` records instead of silent Nones or deep-in-XLA
+failures:
+
+- ``verify_graph(sym, **shapes)`` — duplicate node names, cycles,
+  dangling arguments, full shape+dtype propagation with per-node error
+  capture.
+- ``check_sharding(rules, params, mesh)`` — PartitionSpec divisibility,
+  axis reuse, unknown axes, dead/shadowed rules, estimated reshards.
+- ``audit_registry()`` — num_outputs vs abstract eval, differentiable
+  ops admit jax.vjp, alias-table integrity.
+- ``trace_lint(paths)`` — AST lint for host-sync/retrace hazards in
+  jit-traced code paths.
+
+CLI: ``python -m mxtpu.analysis`` (see docs/analysis.md).  Custom passes
+register via :func:`register_pass` and run via :func:`run_pass`.
+"""
+
+from .diagnostics import (Diagnostic, Report, Severity, get_pass,
+                          list_passes, register_pass, run_pass)
+from .graph_verify import verify_graph
+from .registry_audit import audit_registry
+from .sharding_check import check_sharding
+from .trace_lint import lint_source, trace_lint
+
+__all__ = [
+    "Diagnostic", "Report", "Severity",
+    "register_pass", "get_pass", "list_passes", "run_pass",
+    "verify_graph", "check_sharding", "audit_registry", "trace_lint",
+    "lint_source",
+]

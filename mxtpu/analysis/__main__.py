@@ -1,0 +1,79 @@
+"""CLI for the analysis passes: ``python -m mxtpu.analysis``.
+
+Subcommands:
+
+- ``registry``           audit the full op registry
+- ``lint [PATH ...]``    trace-safety lint (default: the mxtpu package)
+- ``graph FILE.json``    verify a saved symbol.json (``--shape name=2,3``
+  repeatable for input shapes)
+- ``all``                registry + lint (the repo self-lint; default)
+
+Exit status is 1 when diagnostics at or above ``--fail-on`` (default
+``error``) were produced, so the command slots into CI directly.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from . import (Report, Severity, audit_registry, trace_lint, verify_graph)
+
+
+def _parse_shape_args(pairs):
+    shapes = {}
+    for p in pairs or ():
+        if "=" not in p:
+            raise SystemExit(f"--shape expects name=d0,d1,...  got {p!r}")
+        name, dims = p.split("=", 1)
+        shapes[name] = tuple(int(d) for d in dims.split(",") if d != "")
+    return shapes
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m mxtpu.analysis",
+        description="static graph verifier, sharding checker, registry "
+                    "audit, and trace-safety lint")
+    ap.add_argument("command", nargs="?", default="all",
+                    choices=["all", "registry", "lint", "graph"])
+    ap.add_argument("paths", nargs="*",
+                    help="lint: files/dirs; graph: one symbol.json")
+    ap.add_argument("--shape", action="append", metavar="NAME=D0,D1",
+                    help="input shape hint for `graph` (repeatable)")
+    ap.add_argument("--json", action="store_true",
+                    help="emit diagnostics as JSON")
+    ap.add_argument("--fail-on", default="error",
+                    choices=["error", "warning", "info"],
+                    help="exit non-zero at this severity or above")
+    ap.add_argument("--include-unverified", action="store_true",
+                    help="registry: report R004 for unverifiable ops")
+    args = ap.parse_args(argv)
+
+    report = Report()
+    if args.command in ("all", "registry"):
+        import mxtpu.ndarray  # noqa: F401 — populate the registry
+        report.extend(audit_registry(
+            include_unverified=args.include_unverified))
+    if args.command in ("all", "lint"):
+        report.extend(trace_lint(args.paths or None))
+    if args.command == "graph":
+        if len(args.paths) != 1:
+            raise SystemExit("graph: exactly one symbol.json path")
+        from ..symbol import load
+        sym = load(args.paths[0])
+        report.extend(verify_graph(
+            sym, known_shapes=_parse_shape_args(args.shape)))
+
+    if args.json:
+        print(report.to_json())
+    else:
+        print(report)
+
+    threshold = Severity[args.fail_on.upper()]
+    failing = report.filter(min_severity=threshold)
+    return 1 if len(failing) else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,202 @@
+"""Diagnostics core for mxtpu.analysis: located findings + pass registry.
+
+The reference ran NNVM graph passes (InferShape, InferType, PlanMemory)
+that *failed loudly per node* inside C++; our JAX-level stack either
+swallows defects (``infer_shape`` → ``(None, None, None)``) or surfaces
+them as opaque GSPMD/XLA errors at compile time.  Every analysis pass in
+this package instead emits :class:`Diagnostic` records — (code, severity,
+subject, message, location) — collected into a :class:`Report` the caller
+can filter, print, or fail a build on.
+
+Severity contract (docs/analysis.md):
+
+- ``ERROR``   — a definite defect; the graph/registry/rules will misbehave.
+- ``WARNING`` — likely defect or strong heuristic hit; review required.
+- ``INFO``    — advisory (e.g. estimated reshard points, unverifiable ops).
+
+Self-lint ("passes clean") means **zero ERROR diagnostics**.
+"""
+
+from __future__ import annotations
+
+import enum
+import json
+from typing import Any, Callable, Dict, List, Optional
+
+__all__ = ["Severity", "Diagnostic", "Report", "register_pass", "get_pass",
+           "list_passes", "run_pass"]
+
+
+class Severity(enum.IntEnum):
+    INFO = 0
+    WARNING = 1
+    ERROR = 2
+
+    def __str__(self):
+        return self.name.lower()
+
+
+class Diagnostic:
+    """One located finding produced by an analysis pass.
+
+    subject: the exact node/rule/op name the finding is about — the
+    acceptance contract is that every seeded defect is reported with the
+    name a user would grep for.
+    """
+
+    __slots__ = ("pass_name", "code", "severity", "subject", "message",
+                 "location", "details")
+
+    def __init__(self, pass_name: str, code: str, severity: Severity,
+                 subject: str, message: str,
+                 location: Optional[str] = None,
+                 details: Optional[Dict[str, Any]] = None):
+        self.pass_name = pass_name
+        self.code = code
+        self.severity = Severity(severity)
+        self.subject = subject
+        self.message = message
+        self.location = location
+        self.details = dict(details or {})
+
+    def to_dict(self) -> Dict[str, Any]:
+        d = {"pass": self.pass_name, "code": self.code,
+             "severity": str(self.severity), "subject": self.subject,
+             "message": self.message}
+        if self.location:
+            d["location"] = self.location
+        if self.details:
+            d["details"] = {k: repr(v) if not isinstance(
+                v, (str, int, float, bool, list, dict, type(None))) else v
+                for k, v in self.details.items()}
+        return d
+
+    def __str__(self):
+        loc = f"{self.location}: " if self.location else ""
+        return (f"{loc}{str(self.severity)} {self.code} [{self.subject}] "
+                f"{self.message}")
+
+    def __repr__(self):
+        return f"<Diagnostic {self}>"
+
+
+class Report:
+    """Ordered collection of diagnostics from one or more passes."""
+
+    def __init__(self, diagnostics: Optional[List[Diagnostic]] = None):
+        self.diagnostics: List[Diagnostic] = list(diagnostics or [])
+
+    # -- building --------------------------------------------------------
+    def add(self, *args, **kwargs) -> Diagnostic:
+        d = args[0] if len(args) == 1 and isinstance(args[0], Diagnostic) \
+            else Diagnostic(*args, **kwargs)
+        self.diagnostics.append(d)
+        return d
+
+    def extend(self, other: "Report") -> "Report":
+        self.diagnostics.extend(other.diagnostics)
+        return self
+
+    # -- querying --------------------------------------------------------
+    def __iter__(self):
+        return iter(self.diagnostics)
+
+    def __len__(self):
+        return len(self.diagnostics)
+
+    def __bool__(self):
+        # a Report is always truthy as a container; use .ok for pass/fail
+        return True
+
+    @property
+    def errors(self) -> List[Diagnostic]:
+        return [d for d in self.diagnostics if d.severity == Severity.ERROR]
+
+    @property
+    def warnings(self) -> List[Diagnostic]:
+        return [d for d in self.diagnostics
+                if d.severity == Severity.WARNING]
+
+    @property
+    def infos(self) -> List[Diagnostic]:
+        return [d for d in self.diagnostics if d.severity == Severity.INFO]
+
+    @property
+    def ok(self) -> bool:
+        """True when the pass found no ERROR-level defects."""
+        return not self.errors
+
+    def filter(self, code: Optional[str] = None,
+               subject: Optional[str] = None,
+               min_severity: Optional[Severity] = None,
+               pass_name: Optional[str] = None) -> "Report":
+        out = self.diagnostics
+        if code is not None:
+            out = [d for d in out if d.code == code]
+        if subject is not None:
+            out = [d for d in out if d.subject == subject]
+        if min_severity is not None:
+            out = [d for d in out if d.severity >= min_severity]
+        if pass_name is not None:
+            out = [d for d in out if d.pass_name == pass_name]
+        return Report(list(out))
+
+    def subjects(self) -> List[str]:
+        return [d.subject for d in self.diagnostics]
+
+    # -- rendering -------------------------------------------------------
+    def summary(self) -> str:
+        return ("%d error(s), %d warning(s), %d info"
+                % (len(self.errors), len(self.warnings), len(self.infos)))
+
+    def to_json(self) -> str:
+        return json.dumps([d.to_dict() for d in self.diagnostics], indent=2)
+
+    def __str__(self):
+        if not self.diagnostics:
+            return "clean (no diagnostics)"
+        lines = [str(d) for d in sorted(
+            self.diagnostics, key=lambda d: -int(d.severity))]
+        lines.append(self.summary())
+        return "\n".join(lines)
+
+    def __repr__(self):
+        return f"<Report {self.summary()}>"
+
+
+# -- pass registry -------------------------------------------------------
+# Parity: nnvm::ApplyPass(graph, "InferShape") looked passes up by name in
+# a global registry; custom passes register the same way here.
+
+_PASS_REGISTRY: Dict[str, Callable[..., Report]] = {}
+
+
+def register_pass(name: Optional[str] = None):
+    """Decorator registering a callable(...) -> Report as a named pass."""
+
+    def wrap(fn: Callable[..., Report]) -> Callable[..., Report]:
+        pname = name or fn.__name__
+        if pname in _PASS_REGISTRY:
+            raise ValueError(f"analysis pass {pname!r} registered twice")
+        _PASS_REGISTRY[pname] = fn
+        return fn
+
+    return wrap
+
+
+def get_pass(name: str) -> Callable[..., Report]:
+    try:
+        return _PASS_REGISTRY[name]
+    except KeyError:
+        import difflib
+        close = difflib.get_close_matches(name, _PASS_REGISTRY, n=3)
+        hint = f"; close matches: {', '.join(close)}" if close else ""
+        raise KeyError(f"no analysis pass named {name!r}{hint}") from None
+
+
+def list_passes() -> List[str]:
+    return sorted(_PASS_REGISTRY)
+
+
+def run_pass(name: str, *args, **kwargs) -> Report:
+    return get_pass(name)(*args, **kwargs)

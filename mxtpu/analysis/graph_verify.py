@@ -1,0 +1,153 @@
+"""verify_graph: static structural + shape/dtype verification of a Symbol.
+
+Parity: the reference ran nnvm's InferShape/InferType passes inside
+GraphExecutor::Init and aborted with a per-node message ("Error in
+operator fc1: ..."); our `Symbol.infer_shape` historically swallowed the
+same failures into ``(None, None, None)``.  This pass walks the graph
+once and reports everything it finds as located diagnostics:
+
+==========  ========  =====================================================
+code        severity  meaning
+==========  ========  =====================================================
+G001        ERROR     duplicate node name (two distinct nodes share a name)
+G002        ERROR     cycle through the named node (manual _Node wiring)
+G003        WARNING   caller-provided shape for a name not in the graph
+G004        INFO      graph input with no shape information
+G005        ERROR     per-node shape/dtype inference failure (the exception
+                      `_infer_shape_impl` used to swallow)
+G006        WARNING   an output's shape could not be determined
+==========  ========  =====================================================
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from .diagnostics import Diagnostic, Report, Severity, register_pass
+
+__all__ = ["verify_graph"]
+
+_PASS = "verify_graph"
+
+
+def _walk_nodes(roots, report):
+    """Iterative coloring DFS over _Node objects.
+
+    Returns the list of reachable nodes; records a G002 diagnostic per
+    back edge instead of looping forever (Symbol._topo's `seen` check
+    happens to terminate on cycles but silently produces a broken
+    order — a verifier must *name* the offending node)."""
+    WHITE, GRAY, BLACK = 0, 1, 2
+    color = {}
+    nodes = []
+    index = {}
+
+    for root in roots:
+        if color.get(id(root), WHITE) == BLACK:
+            continue
+        # stack of (node, iterator-over-input-nodes)
+        stack = [(root, iter([s._node for s in root.inputs]))]
+        color[id(root)] = GRAY
+        index[id(root)] = root
+        while stack:
+            node, it = stack[-1]
+            child = next(it, None)
+            if child is None:
+                stack.pop()
+                color[id(node)] = BLACK
+                nodes.append(node)
+                continue
+            c = color.get(id(child), WHITE)
+            if c == GRAY:
+                report.add(Diagnostic(
+                    _PASS, "G002", Severity.ERROR, child.name,
+                    "cycle detected through node %r (op %s); the graph "
+                    "is not a DAG — topological execution order is "
+                    "undefined" % (child.name, child.op or "null")))
+            elif c == WHITE:
+                color[id(child)] = GRAY
+                index[id(child)] = child
+                stack.append((child,
+                              iter([s._node for s in child.inputs])))
+        # GRAY leftovers only exist if we aborted; loop always drains
+    return nodes
+
+
+def verify_graph(sym, known_shapes: Optional[dict] = None,
+                 **shape_kwargs) -> Report:
+    """Verify a Symbol graph; returns a Report of located diagnostics.
+
+    known_shapes / **shape_kwargs: name → shape hints, same convention as
+    ``sym.infer_shape`` (``__shape__`` attrs on variables are honored
+    too).  Structural checks (duplicate names, cycles) run even when no
+    shapes are given; propagation diagnostics need at least the data
+    shapes to say anything useful.
+    """
+    report = Report()
+    known = dict(known_shapes or {})
+    known.update(shape_kwargs)
+
+    nodes = _walk_nodes(sym._roots(), report)
+    if not report.ok:
+        # a cyclic graph has no meaningful topo order; shape propagation
+        # (which uses Symbol._topo) would walk a broken order — stop here
+        return report
+
+    # G001: duplicate node names (distinct node objects sharing a name).
+    # Composed graphs share the *same* node object across handles — that
+    # is fine; two different nodes with one name break name-keyed
+    # binding (`_execute` feeds both from one input_arrays slot).
+    by_name = {}
+    for n in nodes:
+        by_name.setdefault(n.name, []).append(n)
+    for name, group in sorted(by_name.items()):
+        if len(group) > 1:
+            kinds = ", ".join(g.op or "variable" for g in group)
+            report.add(Diagnostic(
+                _PASS, "G001", Severity.ERROR, name,
+                "%d distinct nodes named %r (%s); name-keyed binding "
+                "and arg lists will silently collide" %
+                (len(group), name, kinds)))
+
+    var_names = {n.name for n in nodes if n.op is None}
+
+    # G003: caller supplied a shape for a name the graph does not have
+    # (dangling/unused argument — the classic typo'd bind dict entry)
+    for name in sorted(known):
+        if name not in var_names:
+            report.add(Diagnostic(
+                _PASS, "G003", Severity.WARNING, name,
+                "shape provided for %r but the graph has no such "
+                "input; argument is unused" % name))
+
+    # shape + dtype propagation with per-node error capture
+    res = sym._propagate({k: v for k, v in known.items()
+                          if k in var_names})
+
+    for err in res.errors:
+        report.add(Diagnostic(
+            _PASS, "G005", Severity.ERROR, err.node,
+            "shape/dtype inference failed at node %r (op %s): %s" %
+            (err.node, err.op, err.error),
+            details={"op": err.op, "error": err.error}))
+
+    # G004: inputs that never got a shape (blocks downstream inference)
+    for n in nodes:
+        if n.op is None and res.var_shapes.get(n.name) is None:
+            report.add(Diagnostic(
+                _PASS, "G004", Severity.INFO, n.name,
+                "input %r has no shape information (no __shape__ attr, "
+                "not provided); downstream shapes stay unknown" % n.name))
+
+    # G006: outputs whose shapes remain unknown despite no recorded error
+    for node, idx in sym._output_entries():
+        if res.shapes.get((id(node), idx)) is None:
+            report.add(Diagnostic(
+                _PASS, "G006", Severity.WARNING, node.name,
+                "shape of output %d of node %r (op %s) could not be "
+                "determined" % (idx, node.name, node.op or "null")))
+
+    return report
+
+
+register_pass(_PASS)(verify_graph)

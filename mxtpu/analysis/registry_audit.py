@@ -1,0 +1,208 @@
+"""audit_registry: metadata consistency checks over _OP_REGISTRY.
+
+The registry is the single source of truth for both `mx.nd.*` and
+`mx.sym.*`; wrong metadata corrupts *graphs*, not just calls: a wrong
+``num_outputs`` makes tuple-unpacking of a symbol silently mis-wire, and
+``differentiable=True`` on a vjp-rejecting op turns `backward()` into a
+deep JAX traceback.  This pass abstractly evaluates every op it can
+(jax.eval_shape on sample shapes — no FLOPs, CPU-safe) and cross-checks:
+
+==========  ========  =====================================================
+code        severity  meaning
+==========  ========  =====================================================
+R001        ERROR     alias table broken: a name maps to a spec whose
+                      canonical name maps to a DIFFERENT spec object
+R002        ERROR     declared num_outputs contradicts abstract eval
+R003        ERROR     differentiable=True but jax.vjp rejects the op
+R004        INFO      op could not be abstractly evaluated on any sample
+                      shape (requires structured/static args) — unverified
+==========  ========  =====================================================
+
+Sample-shape protocol: positional parameters without defaults are array
+inputs (the invoke_op convention: arrays positional, statics keyword);
+each op is tried on 2-D, then 3-D, then 4-D, then 1-D float32 samples
+until one abstract-evals.  Ops needing required keyword-only args,
+integer inputs, or runtime-injected state (rng key) land in R004.
+"""
+
+from __future__ import annotations
+
+import inspect
+from typing import Iterable, Optional
+
+from ..base import _OP_REGISTRY
+from .diagnostics import Diagnostic, Report, Severity, register_pass
+
+__all__ = ["audit_registry"]
+
+_PASS = "audit_registry"
+
+# candidate sample shapes, tried in order until abstract eval succeeds
+_SHAPE_CANDIDATES = ((2, 4), (2, 3, 4), (2, 3, 4, 4), (4,))
+
+
+def _required_arity(fn):
+    """(n_required_positional, has_varargs, has_required_kwonly)."""
+    try:
+        sig = inspect.signature(fn)
+    except (TypeError, ValueError):
+        return None
+    n = 0
+    varargs = False
+    kwonly_required = False
+    for p in sig.parameters.values():
+        if p.kind in (p.POSITIONAL_ONLY, p.POSITIONAL_OR_KEYWORD):
+            if p.default is p.empty:
+                n += 1
+        elif p.kind == p.VAR_POSITIONAL:
+            varargs = True
+        elif p.kind == p.KEYWORD_ONLY and p.default is p.empty:
+            kwonly_required = True
+    return n, varargs, kwonly_required
+
+
+_SIGNATURE_ERROR_HINTS = ("required positional", "unexpected keyword",
+                          "missing", "takes", "required argument")
+
+
+def _try_abstract_eval(fn, arity):
+    """First successful (structs, out) over the shape candidates, else
+    (None, last_error).  Signature-level TypeErrors bail immediately —
+    a different input rank cannot supply a missing static kwarg, and the
+    retries are the dominant cost of auditing a 300-op registry."""
+    import jax
+    import jax.numpy as jnp
+
+    last = None
+    last_msg = None
+    for shape in _SHAPE_CANDIDATES:
+        structs = tuple(jax.ShapeDtypeStruct(shape, jnp.float32)
+                        for _ in range(arity))
+        try:
+            out = jax.eval_shape(lambda *a: fn(*a), *structs)
+            return structs, out
+        except TypeError as exc:
+            msg = str(exc)
+            if any(h in msg for h in _SIGNATURE_ERROR_HINTS):
+                return None, exc
+            if last_msg is not None and msg == last_msg:
+                return None, exc  # shape-independent failure
+            last, last_msg = exc, msg
+        except Exception as exc:
+            msg = str(exc)
+            if last_msg is not None and msg == last_msg:
+                return None, exc  # same error on a different rank
+            last, last_msg = exc, msg
+    return None, last
+
+
+def audit_registry(ops: Optional[Iterable[str]] = None,
+                   include_unverified: bool = False) -> Report:
+    """Audit registered operators; returns a Report.
+
+    ops: optional subset of registry names to audit (default: every
+    unique spec).  include_unverified: emit an R004 INFO per op that
+    could not be abstractly evaluated (off by default — roughly a third
+    of the registry takes structured args).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    report = Report()
+
+    if ops is None:
+        names = list(_OP_REGISTRY)
+    else:
+        names = [n for n in ops]
+
+    # -- R001: alias table has exactly one spec object per op ------------
+    seen_specs = {}
+    for name in names:
+        spec = _OP_REGISTRY.get(name)
+        if spec is None:
+            report.add(Diagnostic(
+                _PASS, "R001", Severity.ERROR, name,
+                "requested op %r is not in the registry" % name))
+            continue
+        canonical = _OP_REGISTRY.get(spec.name)
+        if canonical is not spec:
+            report.add(Diagnostic(
+                _PASS, "R001", Severity.ERROR, name,
+                "alias table broken: %r maps to a spec whose canonical "
+                "name %r maps to a different spec object" %
+                (name, spec.name)))
+        seen_specs.setdefault(id(spec), spec)
+
+    specs = list(seen_specs.values())
+
+    for spec in sorted(specs, key=lambda s: s.name):
+        arity = _required_arity(spec.fn)
+        if arity is None:
+            continue
+        n_req, varargs, kwonly_required = arity
+        if kwonly_required or (varargs and n_req == 0) or n_req == 0:
+            if include_unverified:
+                report.add(Diagnostic(
+                    _PASS, "R004", Severity.INFO, spec.name,
+                    "op %r not abstractly verified (required keyword "
+                    "args / varargs-only / nullary)" % spec.name))
+            continue
+
+        structs, out = _try_abstract_eval(spec.fn, n_req)
+        if structs is None:
+            if include_unverified:
+                report.add(Diagnostic(
+                    _PASS, "R004", Severity.INFO, spec.name,
+                    "op %r not abstractly verified on sample shapes "
+                    "(%s)" % (spec.name, repr(out)[:120])))
+            continue
+
+        outs = out if isinstance(out, tuple) else (out,)
+
+        # -- R002: declared num_outputs vs abstract reality --------------
+        declared = spec.num_outputs
+        if callable(declared):
+            try:
+                declared = declared({})
+            except Exception:
+                declared = None  # arity genuinely depends on kwargs
+        if declared is not None and declared != len(outs):
+            report.add(Diagnostic(
+                _PASS, "R002", Severity.ERROR, spec.name,
+                "op %r declares num_outputs=%d but abstract eval on "
+                "shape %s produced %d output(s); symbolic tuple "
+                "unpacking will mis-wire" %
+                (spec.name, declared, structs[0].shape, len(outs)),
+                details={"declared": declared, "observed": len(outs)}))
+
+        # -- R003: differentiable ops must admit jax.vjp -----------------
+        # only checkable when every output is inexact (a float cotangent
+        # exists); integer outputs on a differentiable op are legal for
+        # shape-dependent index outputs, so skip those
+        if spec.differentiable and all(
+                jnp.issubdtype(o.dtype, jnp.inexact) for o in outs):
+            fn = spec.fn
+
+            def _vjp_probe(*arrs):
+                res, vjp_fn = jax.vjp(lambda *a: fn(*a), *arrs)
+                if isinstance(res, tuple):
+                    cts = tuple(jnp.ones(o.shape, o.dtype) for o in res)
+                else:
+                    cts = jnp.ones(res.shape, res.dtype)
+                return vjp_fn(cts)
+
+            try:
+                jax.eval_shape(_vjp_probe, *structs)
+            except Exception as exc:
+                report.add(Diagnostic(
+                    _PASS, "R003", Severity.ERROR, spec.name,
+                    "op %r is registered differentiable=True but "
+                    "jax.vjp rejects it (%s); autograd recording would "
+                    "fail — register with differentiable=False" %
+                    (spec.name, repr(exc)[:200]),
+                    details={"error": repr(exc)}))
+
+    return report
+
+
+register_pass(_PASS)(audit_registry)

@@ -1,0 +1,191 @@
+"""check_sharding: static validation of ShardingRules against params+mesh.
+
+A bad PartitionSpec today surfaces as an opaque GSPMD error deep inside
+XLA compilation ("sharding annotation ... dimension 0 is not divisible");
+this pass evaluates the rule list against the actual parameter shapes and
+mesh *before* any device_put or jit, and names the exact rule/param:
+
+==========  ========  =====================================================
+code        severity  meaning
+==========  ========  =====================================================
+S001        ERROR     spec has more axes than the matched param has dims
+S002        ERROR     spec names a mesh axis the mesh does not have
+S003        ERROR     mesh-axis size does not divide the param dimension
+S004        ERROR     one mesh axis used on two dimensions of one spec
+S005        WARNING   dead rule: its pattern matches no param
+S006        WARNING   shadowed rule: matches params but never wins
+                      (an earlier rule always matches first)
+S007        INFO      estimated reshard point: params in one layer group
+                      place the same mesh axis on different dims
+==========  ========  =====================================================
+
+S007 is a heuristic: Megatron column→row pairs (q_proj ('tp', None) then
+out_proj (None, 'tp')) intentionally alternate and compile to a single
+all-reduce — treat the INFO as "look here", not "defect".
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Dict, Union
+
+from .diagnostics import Diagnostic, Report, Severity, register_pass
+
+__all__ = ["check_sharding"]
+
+_PASS = "check_sharding"
+
+
+def _mesh_axis_sizes(mesh) -> Dict[str, int]:
+    """Accepts a DeviceMesh, a jax Mesh, or a plain {axis: size} dict
+    (handy for CPU-only tests with no real device mesh)."""
+    if isinstance(mesh, dict):
+        return {str(k): int(v) for k, v in mesh.items()}
+    jm = getattr(mesh, "jax_mesh", mesh)
+    return {str(k): int(v) for k, v in dict(jm.shape).items()}
+
+
+def _spec_entries(spec):
+    """Flatten one PartitionSpec into (dim, axis_name) pairs; a tuple
+    entry shards one dim over several mesh axes."""
+    out = []
+    for dim, entry in enumerate(spec):
+        if entry is None:
+            continue
+        names = entry if isinstance(entry, (tuple, list)) else (entry,)
+        for name in names:
+            out.append((dim, str(name)))
+    return out
+
+
+def check_sharding(rules, params: Dict[str, Union[tuple, object]],
+                   mesh) -> Report:
+    """Validate `rules` (a ShardingRules) against named params and a mesh.
+
+    params: name → array-like (anything with .shape) or a bare shape
+    tuple.  mesh: DeviceMesh / jax Mesh / {axis: size} dict.
+    """
+    report = Report()
+    axis_sizes = _mesh_axis_sizes(mesh)
+    rule_list = rules.iter_rules()
+
+    shapes = {}
+    for name, p in params.items():
+        shapes[name] = tuple(getattr(p, "shape", p))
+
+    # per-rule match bookkeeping for dead/shadowed detection: one scan
+    # per (rule, param); the winner is the first matching index (same
+    # first-match contract as ShardingRules.spec_for)
+    compiled = [re.compile(pat) for pat, _ in rule_list]
+    matches = [[] for _ in rule_list]   # names the pattern matches at all
+    wins = [[] for _ in rule_list]      # names where the rule is first
+    winner_of = {}                      # name -> rule index (or None)
+    for name in shapes:
+        first = None
+        for i, pat in enumerate(compiled):
+            if pat.search(name):
+                matches[i].append(name)
+                if first is None:
+                    first = i
+        winner_of[name] = first
+        if first is not None:
+            wins[first].append(name)
+
+    # -- per-param spec validation ---------------------------------------
+    for name in sorted(shapes):
+        idx = winner_of[name]
+        if idx is None:
+            continue  # replicate default — always valid
+        pattern, spec = rule_list[idx]
+        shape = shapes[name]
+        subject = name
+        if len(spec) > len(shape):
+            report.add(Diagnostic(
+                _PASS, "S001", Severity.ERROR, subject,
+                "rule %r spec %s has %d axes but param %r has only "
+                "%d dims %s" % (pattern, spec, len(spec), name,
+                                len(shape), shape),
+                details={"rule": pattern}))
+            continue
+        used = {}
+        for dim, axis in _spec_entries(spec):
+            if axis not in axis_sizes:
+                report.add(Diagnostic(
+                    _PASS, "S002", Severity.ERROR, subject,
+                    "rule %r spec %s names mesh axis %r which the mesh "
+                    "does not define (axes: %s)" %
+                    (pattern, spec, axis, sorted(axis_sizes)),
+                    details={"rule": pattern, "axis": axis}))
+                continue
+            if axis in used:
+                report.add(Diagnostic(
+                    _PASS, "S004", Severity.ERROR, subject,
+                    "rule %r spec %s uses mesh axis %r on dims %d and "
+                    "%d of param %r; a mesh axis may shard at most one "
+                    "dim" % (pattern, spec, axis, used[axis], dim, name),
+                    details={"rule": pattern, "axis": axis}))
+                continue
+            used[axis] = dim
+            size = axis_sizes[axis]
+            if size > 1 and shape[dim] % size != 0:
+                report.add(Diagnostic(
+                    _PASS, "S003", Severity.ERROR, subject,
+                    "rule %r shards dim %d of param %r (shape %s) over "
+                    "mesh axis %r of size %d, which does not divide %d" %
+                    (pattern, dim, name, shape, axis, size, shape[dim]),
+                    details={"rule": pattern, "axis": axis, "dim": dim}))
+
+    # -- dead / shadowed rules -------------------------------------------
+    for i, (pattern, spec) in enumerate(rule_list):
+        if not matches[i]:
+            report.add(Diagnostic(
+                _PASS, "S005", Severity.WARNING, pattern,
+                "dead rule: pattern %r (spec %s) matches none of the "
+                "%d params" % (pattern, spec, len(shapes))))
+        elif not wins[i]:
+            shadowers = sorted({winner_of[n] for n in matches[i]})
+            report.add(Diagnostic(
+                _PASS, "S006", Severity.WARNING, pattern,
+                "shadowed rule: pattern %r matches %s but earlier "
+                "rule(s) %s always match first" %
+                (pattern, matches[i][:3],
+                 [rule_list[j][0] for j in shadowers if j is not None]),
+                details={"shadowed_by": [rule_list[j][0]
+                                         for j in shadowers
+                                         if j is not None]}))
+
+    # -- estimated reshard points (heuristic, INFO) ----------------------
+    # group params by their layer (drop the submodule + leaf components:
+    # "attn.q_proj.weight" → "attn"); if two params in one group place
+    # the SAME mesh axis on DIFFERENT dims, the activations flowing
+    # between them likely change layout
+    groups: Dict[str, list] = {}
+    for name in shapes:
+        idx = winner_of[name]
+        if idx is None:
+            continue
+        parts = name.split(".")
+        prefix = ".".join(parts[:-2]) if len(parts) > 2 else parts[0]
+        groups.setdefault(prefix, []).append(name)
+    for prefix, names in sorted(groups.items()):
+        placements: Dict[str, Dict[int, str]] = {}
+        for name in names:
+            _, spec = rule_list[winner_of[name]]
+            for dim, axis in _spec_entries(spec):
+                placements.setdefault(axis, {})[dim] = name
+        for axis, by_dim in sorted(placements.items()):
+            if len(by_dim) > 1 and len(set(by_dim.values())) > 1:
+                parts = ", ".join("%s@dim%d" % (n, d)
+                                  for d, n in sorted(by_dim.items()))
+                report.add(Diagnostic(
+                    _PASS, "S007", Severity.INFO, prefix,
+                    "estimated reshard point in %r: mesh axis %r is "
+                    "placed on different dims (%s); expect a layout "
+                    "change (or an intentional Megatron column/row "
+                    "pair) between these params" % (prefix, axis, parts),
+                    details={"axis": axis}))
+
+    return report
+
+
+register_pass(_PASS)(check_sharding)

@@ -1,0 +1,397 @@
+"""trace_lint: AST lint for host-sync / retrace hazards in traced code.
+
+Inside a jit/vmap/scan trace, touching concrete values breaks or silently
+de-optimizes: ``.item()`` / ``.asnumpy()`` force a device→host sync (and
+raise ConcretizationTypeError under jit), ``np.asarray`` on a tracer
+fails, ``float()/int()/bool()`` concretize, and Python ``if``/``while``
+on array values either raises or bakes the branch into the compiled
+program (a retrace per distinct value).  The reference never had this
+hazard class — imperative MXNet synced eagerly everywhere — but a
+TPU-native stack lives or dies by keeping the traced path pure.
+
+Traced scopes (where the rules apply):
+
+- functions decorated with / passed by name into a JAX tracing
+  combinator (``jax.jit``, ``vmap``, ``pmap``, ``grad``, ``lax.scan``,
+  ``lax.cond``, ``while_loop``, ``fori_loop``, ``switch``, ``remat``,
+  ``checkpoint``, ``eval_shape``, ``vjp``, ``pallas_call``, ...),
+  including lambdas inline in those calls;
+- functions registered as operators via ``@register_op`` — the op
+  registry IS the jit path (CachedOp jits the whole dispatch walk);
+- any function nested inside a traced scope.
+
+Taint model: positional parameters without defaults are array inputs
+(the invoke_op convention — arrays positional, statics keyword); names
+assigned from tainted expressions become tainted.  Rules:
+
+==========  ========  =====================================================
+code        severity  meaning
+==========  ========  =====================================================
+L001        ERROR     .item()/.asnumpy()/.tolist() on a tainted value in a
+                      traced scope (host sync / concretization)
+L002        ERROR     numpy host conversion (np.asarray/np.array/
+                      onp.asarray/...) of a tainted value in a traced scope
+L003        ERROR     float()/int()/bool() of a tainted value in a traced
+                      scope (concretizes the tracer)
+L004        WARNING   Python if/while branches on a tainted value (use
+                      lax.cond/where; raises under jit, retraces at best)
+==========  ========  =====================================================
+
+False-positive escape hatch: append ``# trace-ok`` (optionally
+``# trace-ok: reason``) to the flagged line.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import Iterable, List, Optional, Set, Union
+
+from .diagnostics import Diagnostic, Report, Severity, register_pass
+
+__all__ = ["trace_lint", "lint_source"]
+
+_PASS = "trace_lint"
+
+# call names (last dotted component) that trace their function arguments
+_TRACING_COMBINATORS = {
+    "jit", "pjit", "vmap", "pmap", "grad", "value_and_grad", "jacfwd",
+    "jacrev", "hessian", "scan", "cond", "while_loop", "fori_loop",
+    "switch", "associative_scan", "checkpoint", "remat", "eval_shape",
+    "vjp", "jvp", "linearize", "custom_vjp", "custom_jvp", "shard_map",
+    "pallas_call", "named_call", "xmap", "make_jaxpr",
+}
+
+# decorator names that mark a function as an op impl (jit path)
+_OP_DECORATORS = {"register_op"}
+
+_HOST_SYNC_METHODS = {"item", "asnumpy", "tolist"}
+_NUMPY_MODULES = {"np", "onp", "numpy"}
+_NUMPY_HOST_FNS = {"asarray", "array", "ascontiguousarray", "copy",
+                   "asanyarray"}
+_CAST_BUILTINS = {"float", "int", "bool", "complex"}
+# attribute/call forms on a tainted name that are trace-safe (static
+# metadata, not values)
+_SAFE_ATTRS = {"shape", "ndim", "dtype", "size", "aval", "sharding",
+               "weak_type"}
+
+
+def _dotted_name(node: ast.AST) -> Optional[str]:
+    """'jax.lax.scan' for Attribute chains, 'jit' for bare Names."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _last_component(node: ast.AST) -> Optional[str]:
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return None
+
+
+class _TracedScopeFinder(ast.NodeVisitor):
+    """Collects function/lambda AST nodes that run under a JAX trace."""
+
+    def __init__(self):
+        self.traced: Set[ast.AST] = set()
+        self.traced_names: Set[str] = set()
+        self._defs = {}  # name -> [FunctionDef nodes]
+
+    def visit_FunctionDef(self, node):
+        self._defs.setdefault(node.name, []).append(node)
+        for dec in node.decorator_list:
+            base = dec.func if isinstance(dec, ast.Call) else dec
+            last = _last_component(base)
+            if last in _TRACING_COMBINATORS or last in _OP_DECORATORS:
+                self.traced.add(node)
+            # functools.partial(jax.jit, ...) style decorators
+            if isinstance(dec, ast.Call) and last == "partial":
+                for a in dec.args:
+                    if _last_component(a) in _TRACING_COMBINATORS:
+                        self.traced.add(node)
+        self.generic_visit(node)
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_Call(self, node):
+        last = _last_component(node.func)
+        if last in _TRACING_COMBINATORS:
+            for arg in list(node.args) + [kw.value for kw in
+                                          node.keywords]:
+                if isinstance(arg, ast.Lambda):
+                    self.traced.add(arg)
+                elif isinstance(arg, ast.Name):
+                    self.traced_names.add(arg.id)
+        self.generic_visit(node)
+
+    def resolve(self):
+        for name in self.traced_names:
+            for d in self._defs.get(name, ()):
+                self.traced.add(d)
+        return self.traced
+
+
+def _tainted_params(fn: Union[ast.FunctionDef, ast.Lambda]) -> Set[str]:
+    """Array-input heuristic: positionals without defaults + *varargs.
+    Params WITH defaults are static op params (invoke_op passes statics
+    by keyword); `self`/`cls` are never arrays."""
+    a = fn.args
+    names = [p.arg for p in a.posonlyargs + a.args]
+    n_defaults = len(a.defaults)
+    if n_defaults:
+        names = names[:-n_defaults]
+    out = {n for n in names if n not in ("self", "cls")}
+    if a.vararg is not None:
+        out.add(a.vararg.arg)
+    return out
+
+
+class _Taint(ast.NodeVisitor):
+    """Does this expression reference a tainted name as a *value*?
+
+    Attribute reads of static metadata (x.shape, x.ndim, ...) and calls
+    like len()/isinstance() do not propagate taint."""
+
+    def __init__(self, tainted: Set[str]):
+        self.tainted = tainted
+        self.hit = False
+
+    def visit_Name(self, node):
+        if node.id in self.tainted:
+            self.hit = True
+
+    def visit_Attribute(self, node):
+        if node.attr in _SAFE_ATTRS:
+            return  # x.shape / x.ndim — static under trace
+        self.generic_visit(node)
+
+    def visit_Call(self, node):
+        fname = _last_component(node.func)
+        if fname in ("len", "isinstance", "hasattr", "getattr", "type",
+                     "id"):
+            return  # metadata-only calls
+        self.generic_visit(node)
+
+
+def _is_tainted(expr: ast.AST, tainted: Set[str]) -> bool:
+    t = _Taint(tainted)
+    t.visit(expr)
+    return t.hit
+
+
+class _ScopeLinter(ast.NodeVisitor):
+    """Lints one traced function body with simple forward taint flow."""
+
+    def __init__(self, fname: str, lines: List[str], report: Report,
+                 tainted: Set[str]):
+        self.fname = fname
+        self.lines = lines
+        self.report = report
+        self.tainted = set(tainted)
+
+    # -- helpers ---------------------------------------------------------
+    def _suppressed(self, node, span_node=None) -> bool:
+        # honor "# trace-ok" anywhere on the lines the flagged
+        # expression spans (multi-line calls / conditions included)
+        span = span_node if span_node is not None else node
+        start = span.lineno
+        end = getattr(span, "end_lineno", None) or start
+        for ln in range(start, min(end, len(self.lines)) + 1):
+            if 0 < ln <= len(self.lines) and \
+                    "# trace-ok" in self.lines[ln - 1]:
+                return True
+        return False
+
+    def _emit(self, node, code, severity, subject, message,
+              span_node=None):
+        if self._suppressed(node, span_node):
+            return
+        self.report.add(Diagnostic(
+            _PASS, code, severity, subject, message,
+            location="%s:%d" % (self.fname, node.lineno)))
+
+    def _taint_target(self, target):
+        for n in ast.walk(target):
+            if isinstance(n, ast.Name):
+                self.tainted.add(n.id)
+
+    # -- taint propagation ------------------------------------------------
+    def visit_Assign(self, node):
+        self.generic_visit(node)
+        if _is_tainted(node.value, self.tainted):
+            for t in node.targets:
+                self._taint_target(t)
+
+    def visit_AnnAssign(self, node):
+        self.generic_visit(node)
+        if node.value is not None and _is_tainted(node.value,
+                                                  self.tainted):
+            self._taint_target(node.target)
+
+    def visit_AugAssign(self, node):
+        self.generic_visit(node)
+        if _is_tainted(node.value, self.tainted):
+            self._taint_target(node.target)
+
+    def visit_For(self, node):
+        if _is_tainted(node.iter, self.tainted):
+            self._taint_target(node.target)
+        self.generic_visit(node)
+
+    # -- rules ------------------------------------------------------------
+    def visit_Call(self, node):
+        func = node.func
+        # L001: tainted.item() / .asnumpy() / .tolist()
+        if isinstance(func, ast.Attribute) and \
+                func.attr in _HOST_SYNC_METHODS:
+            if _is_tainted(func.value, self.tainted):
+                self._emit(
+                    node, "L001", Severity.ERROR, func.attr,
+                    ".%s() on a traced value forces a host sync and "
+                    "raises under jit; keep the value on device "
+                    "(jnp ops / lax.cond)" % func.attr)
+        # L002: np.asarray(tainted) etc
+        if isinstance(func, ast.Attribute) and \
+                func.attr in _NUMPY_HOST_FNS:
+            root = _dotted_name(func.value)
+            if root in _NUMPY_MODULES and node.args and \
+                    _is_tainted(node.args[0], self.tainted):
+                self._emit(
+                    node, "L002", Severity.ERROR,
+                    "%s.%s" % (root, func.attr),
+                    "%s.%s() of a traced value fails under jit "
+                    "(tracers are not numpy-convertible); use jnp "
+                    "equivalents" % (root, func.attr))
+        # L003: float(tainted) / int(...) / bool(...)
+        if isinstance(func, ast.Name) and func.id in _CAST_BUILTINS:
+            if node.args and _is_tainted(node.args[0], self.tainted):
+                self._emit(
+                    node, "L003", Severity.ERROR, func.id,
+                    "%s() of a traced value concretizes the tracer and "
+                    "raises under jit" % func.id)
+        self.generic_visit(node)
+
+    def _check_branch(self, node, kind):
+        if _is_tainted(node.test, self.tainted):
+            self._emit(
+                node, "L004", Severity.WARNING, kind,
+                "Python `%s` on a traced value raises under jit "
+                "(TracerBoolConversionError) or forces a retrace per "
+                "value; use lax.cond / lax.while_loop / jnp.where"
+                % kind, span_node=node.test)
+
+    def visit_If(self, node):
+        self._check_branch(node, "if")
+        self.generic_visit(node)
+
+    def visit_While(self, node):
+        self._check_branch(node, "while")
+        self.generic_visit(node)
+
+    def visit_IfExp(self, node):
+        self._check_branch(node, "if")
+        self.generic_visit(node)
+
+    def visit_Assert(self, node):
+        # assert on a traced value is the same hazard as `if`
+        if _is_tainted(node.test, self.tainted):
+            self._emit(
+                node, "L004", Severity.WARNING, "assert",
+                "`assert` on a traced value raises under jit; use "
+                "checkify or move the check outside the traced scope")
+        # no generic_visit: message expr is host-side anyway
+
+    # nested defs: handled by the outer pass (nested scopes of a traced
+    # fn are traced too and linted with inherited taint); skip re-walk
+    def visit_FunctionDef(self, node):
+        sub = _ScopeLinter(self.fname, self.lines, self.report,
+                           self.tainted | _tainted_params(node))
+        for stmt in node.body:
+            sub.visit(stmt)
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_Lambda(self, node):
+        sub = _ScopeLinter(self.fname, self.lines, self.report,
+                           self.tainted | _tainted_params(node))
+        sub.visit(node.body)
+
+
+def lint_source(source: str, filename: str = "<string>") -> Report:
+    """Lint one Python source string; returns a Report."""
+    report = Report()
+    try:
+        tree = ast.parse(source, filename=filename)
+    except SyntaxError as exc:
+        report.add(Diagnostic(
+            _PASS, "L000", Severity.ERROR, filename,
+            "cannot parse: %s" % exc,
+            location="%s:%s" % (filename, exc.lineno or 0)))
+        return report
+    lines = source.splitlines()
+
+    finder = _TracedScopeFinder()
+    finder.visit(tree)
+    traced = finder.resolve()
+
+    # drop traced scopes nested inside another traced scope: the outer
+    # scope's linter already walks them (with inherited taint); linting
+    # them standalone too would report every hazard twice
+    nested = set()
+    for fn in traced:
+        for sub in ast.walk(fn):
+            if sub is not fn and sub in traced:
+                nested.add(sub)
+    traced -= nested
+
+    for fn in traced:
+        tainted = _tainted_params(fn)
+        linter = _ScopeLinter(filename, lines, report, tainted)
+        body = fn.body if isinstance(fn.body, list) else [fn.body]
+        for stmt in body:
+            linter.visit(stmt)
+    return report
+
+
+def trace_lint(paths: Union[str, Iterable[str], None] = None) -> Report:
+    """Lint .py files under the given paths (default: the mxtpu package
+    directory — the repo self-lint)."""
+    if paths is None:
+        paths = [os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__)))]
+    elif isinstance(paths, str):
+        paths = [paths]
+
+    files: List[str] = []
+    for p in paths:
+        if os.path.isfile(p):
+            files.append(p)
+            continue
+        for root, dirs, names in os.walk(p):
+            dirs[:] = [d for d in dirs
+                       if d not in ("__pycache__", "_build", ".git")]
+            files.extend(os.path.join(root, n) for n in sorted(names)
+                         if n.endswith(".py"))
+
+    report = Report()
+    for f in sorted(files):
+        try:
+            with open(f, encoding="utf-8") as fh:
+                src = fh.read()
+        except OSError as exc:
+            report.add(Diagnostic(
+                _PASS, "L000", Severity.WARNING, f,
+                "unreadable: %s" % exc))
+            continue
+        report.extend(lint_source(src, filename=f))
+    return report
+
+
+register_pass(_PASS)(trace_lint)

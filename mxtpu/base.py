@@ -108,7 +108,13 @@ def get_op(name: str) -> OpSpec:
     try:
         return _OP_REGISTRY[name]
     except KeyError:
-        raise MXTPUError(f"operator {name!r} is not registered") from None
+        import difflib
+        close = difflib.get_close_matches(name, _OP_REGISTRY, n=3,
+                                          cutoff=0.6)
+        hint = ("; did you mean %s?" % " or ".join(repr(c) for c in close)
+                if close else "")
+        raise MXTPUError(
+            f"operator {name!r} is not registered{hint}") from None
 
 
 def list_ops():

@@ -86,6 +86,26 @@ class CachedOp:
 
         return fn
 
+    # -- static analysis -------------------------------------------------
+    @property
+    def num_compiles(self) -> int:
+        """Distinct (shape, dtype, train-flag) signatures jitted so far —
+        a growing count across steps means retraces (shape churn or
+        host-value branching; see mxtpu.analysis.trace_lint)."""
+        return len(self._jit_cache)
+
+    def verify(self, input_names=("data",), **shape_kwargs):
+        """Statically verify the block's traced graph BEFORE compiling:
+        traces the block to a Symbol (the same trace export uses) and
+        runs mxtpu.analysis.verify_graph over it.  Returns the
+        diagnostic Report — a pre-flight for the opaque XLA errors a bad
+        graph would otherwise produce at first call."""
+        from .analysis import verify_graph
+        from .symbol import trace_block
+
+        sym = trace_block(self._block, input_names)
+        return verify_graph(sym, **shape_kwargs)
+
     # -- call ------------------------------------------------------------
     def __call__(self, *args):
         # First call runs imperatively: resolves deferred-shape params and

@@ -111,7 +111,7 @@ def cond_op(pred, *inputs, then_func=None, else_func=None):
             raw, single = _unwrap_struct(out)
             # single-output branches return a bare array so the op has ONE
             # output (a 1-tuple would make autograd expect tuple cotangents)
-            return raw[0] if single else raw
+            return raw[0] if single else raw  # trace-ok: static struct flag
         return run
 
     return lax.cond(p, mk(then_func), mk(else_func), tuple(inputs))

@@ -90,7 +90,7 @@ def convolution(x, weight, bias=None, kernel=(), stride=(), dilate=(),
         x_nhwc = jnp.transpose(x, (0, 2, 3, 1))
         w_hwio = jnp.transpose(weight, (2, 3, 1, 0))  # OIHW -> HWIO
         if _pallas_conv_bwd_active(ndim, kernel, stride, dilate, pad,
-                                   num_group, x, weight):
+                                   num_group, x, weight):  # trace-ok: shape/env decision
             from .pallas import conv_bwd
             y = conv_bwd.conv3x3_s1(x_nhwc, w_hwio)
         else:

@@ -37,6 +37,22 @@ class ShardingRules:
         self._rules.extend(other._rules)
         return self
 
+    def iter_rules(self) -> List[Tuple[str, PartitionSpec]]:
+        """Ordered (pattern_string, spec) view of the rule list, for
+        introspection and mxtpu.analysis.check_sharding."""
+        return [(pat.pattern, spec) for pat, spec in self._rules]
+
+    def first_match(self, name: str):
+        """Index of the winning rule for `name` (first-match scan), or
+        None when the name falls through to the replicate default."""
+        for i, (pat, _) in enumerate(self._rules):
+            if pat.search(name):
+                return i
+        return None
+
+    def __len__(self):
+        return len(self._rules)
+
     def spec_for(self, name: str, ndim: int) -> PartitionSpec:
         for pat, spec in self._rules:
             if pat.search(name):

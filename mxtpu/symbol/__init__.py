@@ -5,7 +5,7 @@ mirroring how the reference generates both namespaces from the C registry
 (symbol/register.py)."""
 
 from .symbol import (Symbol, Variable, var, Group, load, load_json,
-                     zeros, ones, arange)
+                     zeros, ones, arange, InferError)
 from . import contrib  # noqa: F401
 from . import symbol as _sym_mod
 import sys as _sys

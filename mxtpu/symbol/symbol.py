@@ -16,7 +16,7 @@ model-zoo-style files with known ops import.
 from __future__ import annotations
 
 import json
-from typing import Any, Dict, List, Optional, Sequence
+from typing import Any, Dict, List, NamedTuple, Optional, Sequence, Tuple
 
 import numpy as onp
 
@@ -32,6 +32,25 @@ def _auto_name(hint):
     n = _name_counter.get(hint, 0)
     _name_counter[hint] = n + 1
     return "%s%d" % (hint.lower(), n)
+
+
+class InferError(NamedTuple):
+    """One node's recorded inference failure: the (name, op, error) triple
+    `_infer_shape_impl` used to swallow with a bare ``except Exception``."""
+
+    node: str
+    op: Optional[str]
+    error: str
+
+
+class _InferResult(NamedTuple):
+    """Internal result of one `_propagate` walk over the graph."""
+
+    shapes: Dict[Tuple[int, int], Optional[tuple]]
+    dtypes: Dict[Tuple[int, int], Any]
+    errors: List[InferError]
+    ok: bool
+    var_shapes: Dict[str, tuple]
 
 
 class _Node:
@@ -352,22 +371,23 @@ class Symbol:
     def infer_shape_partial(self, **kwargs):
         return self._infer_shape_impl(partial=True, **kwargs)
 
-    def _infer_shape_impl(self, partial=False, known_shapes=None, **kwargs):
-        """Forward shape propagation: topo walk, per-node jax.eval_shape,
-        with parameter-shape rules for weight-carrying ops (the eval_shape
-        equivalent of the reference's FInferShape protocol).
+    def _propagate(self, known_shapes=None, known_dtypes=None):
+        """Single forward propagation walk shared by infer_shape,
+        infer_type, and mxtpu.analysis.verify_graph: per-node
+        jax.eval_shape with parameter-shape rules for weight-carrying ops
+        (the eval_shape equivalent of the reference's FInferShape
+        protocol), dtype threading (variables honor ``__dtype__``), and
+        per-node error capture into InferError records instead of the old
+        silent ``ok = False``.
 
-        known_shapes: optional dict of name → shape for internal callers —
-        unlike **kwargs it cannot collide with a variable literally named
-        "partial" / "known_shapes"."""
+        Returns an _InferResult; never raises on a per-node failure."""
         import jax
         import jax.numpy as jnp
         from .. import ndarray as ndpkg
 
-        arg_names = self.list_arguments()
-        aux_names = self.list_auxiliary_states()
-        known = {k: tuple(v) for k, v in (known_shapes or kwargs).items()
+        known = {k: tuple(v) for k, v in (known_shapes or {}).items()
                  if v is not None}
+        kdtypes = dict(known_dtypes or {})
         # variables may declare __shape__ attrs
         for node in self._topo():
             if node.op is None and node.name not in known:
@@ -377,17 +397,50 @@ class Symbol:
 
         shapes = {}   # (id(node), idx) -> shape
         dtypes = {}
+        errors: List[InferError] = []
 
         def node_input_entries(node):
             return [(s, shapes.get((id(s._node), s._index))) for s in
                     node.inputs]
 
+        def fallback_dtypes(node):
+            # dtype-only propagation when this node cannot be abstractly
+            # evaluated (unknown input shapes or a recorded failure):
+            # Cast-like ops take their static dtype param, everything
+            # else promotes the known input dtypes
+            dt = node.kwargs.get("dtype")
+            if dt is not None and node.op in ("Cast", "cast", "amp_cast"):
+                try:
+                    dt = jnp.dtype(dt)
+                except TypeError:
+                    dt = None
+            else:
+                ins = [dtypes.get((id(s._node), s._index))
+                       for s in node.inputs]
+                ins = [d for d in ins if d is not None]
+                try:
+                    dt = jnp.result_type(*ins) if ins else None
+                except Exception:
+                    dt = None
+            if dt is not None:
+                for i in range(node.num_outputs):
+                    dtypes.setdefault((id(node), i), dt)
+
         ok = True
         for node in self._topo():
             if node.op is None:
+                dt = kdtypes.get(node.name)
+                if dt is None:
+                    a = node.attrs.get("__dtype__")
+                    if a:
+                        try:
+                            dt = jnp.dtype(str(a))
+                        except TypeError:
+                            dt = None
+                dtypes[(id(node), 0)] = (jnp.dtype(dt) if dt is not None
+                                         else jnp.float32)
                 if node.name in known:
                     shapes[(id(node), 0)] = tuple(known[node.name])
-                    dtypes[(id(node), 0)] = jnp.float32
                 continue
             entries = node_input_entries(node)
             unknown = [s for s, shp in entries if shp is None]
@@ -405,6 +458,7 @@ class Symbol:
                 unknown = [s for s, shp in entries if shp is None]
             if unknown:
                 ok = False
+                fallback_dtypes(node)
                 continue  # downstream shapes stay unknown
             # abstract-eval this single node
             structs = []
@@ -421,27 +475,72 @@ class Symbol:
 
             try:
                 outs = jax.eval_shape(run_node, *structs)
-            except Exception:
+            except Exception as exc:
                 ok = False
+                errors.append(InferError(node.name, node.op, repr(exc)))
+                fallback_dtypes(node)
                 continue
             for i, o in enumerate(outs):
                 shapes[(id(node), i)] = tuple(o.shape)
                 dtypes[(id(node), i)] = o.dtype
 
-        out_shapes = [shapes.get((id(n), i))
+        return _InferResult(shapes, dtypes, errors, ok, known)
+
+    def _infer_shape_impl(self, partial=False, known_shapes=None, **kwargs):
+        """Forward shape propagation via _propagate.
+
+        known_shapes: optional dict of name → shape for internal callers —
+        unlike **kwargs it cannot collide with a variable literally named
+        "partial" / "known_shapes"."""
+        arg_names = self.list_arguments()
+        aux_names = self.list_auxiliary_states()
+        res = self._propagate(known_shapes or kwargs)
+        self._infer_errors = list(res.errors)
+        out_shapes = [res.shapes.get((id(n), i))
                       for n, i in self._output_entries()]
-        if not partial and (not ok or any(o is None for o in out_shapes)):
+        if not partial and (not res.ok
+                            or any(o is None for o in out_shapes)):
             return None, None, None
-        arg_shapes = [known.get(n) for n in arg_names]
-        aux_shapes = [known.get(n) for n in aux_names]
+        arg_shapes = [res.var_shapes.get(n) for n in arg_names]
+        aux_shapes = [res.var_shapes.get(n) for n in aux_names]
         return arg_shapes, out_shapes, aux_shapes
 
+    @property
+    def inference_errors(self) -> List[InferError]:
+        """Per-node failures recorded by the most recent
+        infer_shape/infer_shape_partial call on THIS handle: a list of
+        (node, op, error) triples explaining why inference returned
+        ``(None, None, None)`` (empty when the walk was clean)."""
+        return list(getattr(self, "_infer_errors", ()))
+
     def infer_type(self, **kwargs):
+        """(parity: infer_type).  Reuses the propagation walk: variables
+        honor ``__dtype__`` attrs and caller-supplied dtypes; op outputs
+        take their abstract-eval dtype, falling back to input-dtype
+        promotion where shapes are unknown (float32 only as last resort).
+        """
         arg_names = self.list_arguments()
-        dt = onp.float32
-        return ([dt] * len(arg_names),
-                [dt] * self.num_outputs,
-                [dt] * len(self.list_auxiliary_states()))
+        aux_names = self.list_auxiliary_states()
+        kdt = {}
+        for k, v in kwargs.items():
+            if v is not None:
+                kdt[k] = onp.dtype(v)
+        res = self._propagate(known_dtypes=kdt)
+
+        def _np(dt):
+            if dt is None:
+                return onp.float32
+            return onp.dtype(dt).type
+
+        name_dt = {}
+        for node in self._topo():
+            if node.op is None:
+                name_dt[node.name] = _np(res.dtypes.get((id(node), 0)))
+        out_types = [_np(res.dtypes.get((id(n), i)))
+                     for n, i in self._output_entries()]
+        return ([name_dt.get(n, onp.float32) for n in arg_names],
+                out_types,
+                [name_dt.get(n, onp.float32) for n in aux_names])
 
     # -- binding ----------------------------------------------------------
     def simple_bind(self, ctx=None, grad_req="write", **shape_kwargs):
@@ -682,7 +781,9 @@ def Variable(name, attr=None, shape=None, lr_mult=None, wd_mult=None,
     if shape is not None:
         attrs["__shape__"] = str(tuple(shape))
     if dtype is not None:
-        attrs["__dtype__"] = str(dtype)
+        # normalized name ("float16", not "<class 'numpy.float16'>") so
+        # _propagate can jnp.dtype() it back
+        attrs["__dtype__"] = onp.dtype(dtype).name
     if lr_mult is not None:
         attrs["__lr_mult__"] = str(lr_mult)
     if wd_mult is not None:

@@ -1,0 +1,370 @@
+"""Seeded-defect matrix for mxtpu.analysis: one test per diagnostic
+class, each asserting the pass reports the EXACT node/rule/op name
+(ISSUE 2 acceptance criterion)."""
+
+import numpy as np
+import pytest
+
+import mxtpu as mx
+from mxtpu import symbol as sym
+from mxtpu.analysis import (Severity, audit_registry, check_sharding,
+                            lint_source, list_passes, run_pass,
+                            verify_graph)
+from mxtpu.base import MXTPUError, _OP_REGISTRY, get_op, register_op
+from mxtpu.parallel.sharding import PartitionSpec, ShardingRules
+from mxtpu.symbol.symbol import Symbol, _Node
+
+
+def _mlp():
+    data = sym.Variable("data")
+    fc1 = sym.FullyConnected(data, num_hidden=8, name="fc1")
+    act = sym.Activation(fc1, act_type="relu", name="act")
+    return sym.FullyConnected(act, num_hidden=3, name="fc2")
+
+
+# -- verify_graph ------------------------------------------------------
+
+def test_verify_graph_clean():
+    rep = verify_graph(_mlp(), data=(4, 10))
+    assert rep.ok and not rep.warnings, str(rep)
+
+
+def test_verify_graph_shape_mismatch_names_node():
+    """A wrong weight shape is reported at the node that fails, with the
+    op and the captured exception (the error infer_shape used to
+    swallow)."""
+    rep = verify_graph(_mlp(), data=(4, 10), fc1_weight=(8, 99))
+    hits = rep.filter(code="G005")
+    assert [d.subject for d in hits] == ["fc1"]
+    assert hits.diagnostics[0].details["op"] == "FullyConnected"
+    assert "99" in hits.diagnostics[0].message
+
+
+def test_verify_graph_cycle_names_node():
+    a = sym.Variable("a")
+    n1 = _Node("relu", [a], [None], {}, "n_fwd", {})
+    n2 = _Node("relu", [Symbol(n1)], [None], {}, "n_back", {})
+    n1.inputs = [Symbol(n2)]  # manual back edge: not a DAG any more
+    rep = verify_graph(Symbol(n2))
+    cycles = rep.filter(code="G002")
+    assert len(cycles) >= 1
+    assert {d.subject for d in cycles} <= {"n_fwd", "n_back"}
+    assert not rep.ok
+
+
+def test_verify_graph_unused_arg_names_arg():
+    rep = verify_graph(_mlp(), data=(4, 10), bogus_input=(3,))
+    assert [d.subject for d in rep.filter(code="G003")] == ["bogus_input"]
+
+
+def test_verify_graph_duplicate_names():
+    x1, x2 = sym.Variable("x"), sym.Variable("x")
+    rep = verify_graph(x1 + x2)
+    dups = rep.filter(code="G001")
+    assert [d.subject for d in dups] == ["x"]
+    assert not rep.ok
+
+
+def test_verify_graph_unshaped_input_is_info():
+    rep = verify_graph(_mlp())  # no shapes at all
+    assert rep.ok  # structural health — only INFO/WARNING advisories
+    assert "data" in [d.subject for d in rep.filter(code="G004")]
+
+
+# -- infer_shape satellite: recorded per-node errors -------------------
+
+def test_infer_shape_records_why_it_failed():
+    net = _mlp()
+    out = net.infer_shape(data=(4, 10), fc1_weight=(8, 99))
+    assert out == (None, None, None)
+    errs = net.inference_errors
+    assert len(errs) == 1
+    assert errs[0].node == "fc1"
+    assert errs[0].op == "FullyConnected"
+    assert "99" in errs[0].error
+    # a clean follow-up call resets the record
+    net.infer_shape_partial(data=(4, 10))
+    assert net.inference_errors == []
+
+
+# -- dtype threading satellite ----------------------------------------
+
+def test_infer_type_honors_variable_dtype():
+    x = sym.Variable("x", shape=(2, 3), dtype="float16")
+    y = sym.Activation(x, act_type="relu", name="r")
+    arg_t, out_t, _ = y.infer_type()
+    assert arg_t == [np.float16]
+    assert out_t == [np.float16]
+
+
+def test_infer_type_kwargs_override():
+    x = sym.Variable("x", shape=(2, 3))
+    y = sym.Activation(x, act_type="relu")
+    arg_t, out_t, _ = y.infer_type(x="float16")
+    assert arg_t == [np.float16]
+    assert out_t == [np.float16]
+
+
+def test_infer_type_promotes_without_shapes():
+    # no shapes anywhere: the dtype-only fallback still promotes
+    a = sym.Variable("a", dtype="float16")
+    b = sym.Variable("b", dtype="float32")
+    c = a + b
+    _, out_t, _ = c.infer_type()
+    assert out_t == [np.float32]
+
+
+# -- check_sharding ----------------------------------------------------
+
+def _mesh():
+    return {"dp": 2, "tp": 4}
+
+
+def test_sharding_non_dividing_names_param_and_rule():
+    rules = ShardingRules([(r"\.weight$", PartitionSpec("tp", None))])
+    rep = check_sharding(rules, {"enc.weight": (30, 8)}, _mesh())
+    bad = rep.filter(code="S003")
+    assert [d.subject for d in bad] == ["enc.weight"]
+    assert bad.diagnostics[0].details["rule"] == r"\.weight$"
+    assert not rep.ok
+
+
+def test_sharding_dead_rule_names_pattern():
+    rules = ShardingRules([
+        (r"\.weight$", PartitionSpec("tp", None)),
+        (r"never_matches_anything", PartitionSpec("tp")),
+    ])
+    rep = check_sharding(rules, {"enc.weight": (32, 8)}, _mesh())
+    assert [d.subject for d in rep.filter(code="S005")] == \
+        ["never_matches_anything"]
+
+
+def test_sharding_shadowed_rule_names_both():
+    rules = ShardingRules([
+        (r"weight", PartitionSpec("tp", None)),
+        (r"enc\.weight", PartitionSpec(None, "tp")),  # never wins
+    ])
+    rep = check_sharding(rules, {"enc.weight": (32, 8)}, _mesh())
+    sh = rep.filter(code="S006")
+    assert [d.subject for d in sh] == [r"enc\.weight"]
+    assert sh.diagnostics[0].details["shadowed_by"] == ["weight"]
+
+
+def test_sharding_axis_reuse_and_unknown_axis():
+    rules = ShardingRules([
+        (r"dup\.weight", PartitionSpec("tp", "tp")),
+        (r"ghost\.weight", PartitionSpec("model", None)),
+    ])
+    rep = check_sharding(
+        rules, {"dup.weight": (32, 8), "ghost.weight": (32, 8)}, _mesh())
+    assert [d.subject for d in rep.filter(code="S004")] == ["dup.weight"]
+    s2 = rep.filter(code="S002")
+    assert [d.subject for d in s2] == ["ghost.weight"]
+    assert s2.diagnostics[0].details["axis"] == "model"
+
+
+def test_sharding_spec_rank_exceeds():
+    rules = ShardingRules([(r"\.bias$", PartitionSpec("tp", None))])
+    rep = check_sharding(rules, {"enc.bias": (32,)}, _mesh())
+    assert [d.subject for d in rep.filter(code="S001")] == ["enc.bias"]
+
+
+def test_sharding_reshard_estimate_is_info():
+    rules = ShardingRules([
+        (r"\.q_proj\.weight", PartitionSpec("tp", None)),
+        (r"\.out_proj\.weight", PartitionSpec(None, "tp")),
+    ])
+    rep = check_sharding(rules, {"attn.q_proj.weight": (64, 32),
+                                 "attn.out_proj.weight": (32, 64)},
+                         _mesh())
+    assert rep.ok
+    assert [d.subject for d in rep.filter(code="S007")] == ["attn"]
+
+
+def test_sharding_accepts_device_mesh():
+    from mxtpu.parallel.mesh import make_mesh
+    mesh = make_mesh(dp=2, tp=4)
+    rules = ShardingRules([(r"\.weight$", PartitionSpec("tp", None))])
+    rep = check_sharding(rules, {"enc.weight": (30, 8)}, mesh)
+    assert [d.subject for d in rep.filter(code="S003")] == ["enc.weight"]
+
+
+# -- audit_registry ----------------------------------------------------
+
+def test_audit_flags_wrong_num_outputs():
+    @register_op("_test_wrong_arity_op", num_outputs=3)
+    def _wrong(x):
+        return x, x
+
+    try:
+        rep = audit_registry(ops=["_test_wrong_arity_op"])
+        bad = rep.filter(code="R002")
+        assert [d.subject for d in bad] == ["_test_wrong_arity_op"]
+        assert bad.diagnostics[0].details == {"declared": 3,
+                                              "observed": 2}
+    finally:
+        _OP_REGISTRY.pop("_test_wrong_arity_op")
+
+
+def test_audit_flags_false_differentiable():
+    import jax
+
+    @register_op("_test_fake_diff_op", differentiable=True)
+    def _fake(x):
+        # pure_callback has no vjp rule: recording this op on the
+        # autograd tape would explode exactly like the audit says
+        return jax.pure_callback(
+            lambda a: a, jax.ShapeDtypeStruct((2, 4), np.float32), x)
+
+    try:
+        rep = audit_registry(ops=["_test_fake_diff_op"])
+        assert [d.subject for d in rep.filter(code="R003")] == \
+            ["_test_fake_diff_op"]
+    finally:
+        _OP_REGISTRY.pop("_test_fake_diff_op")
+
+
+def test_audit_flags_broken_alias_table():
+    from mxtpu.base import OpSpec
+
+    @register_op("_test_alias_canon")
+    def _canon(x):
+        return x
+
+    # a SECOND spec object claiming the same canonical name, reachable
+    # under a different registry key — the one-spec-per-op invariant
+    # register_alias maintains is broken here on purpose
+    _OP_REGISTRY["_test_alias_dup"] = OpSpec("_test_alias_canon",
+                                             lambda x: x)
+    try:
+        rep = audit_registry(ops=["_test_alias_dup"])
+        assert [d.subject for d in rep.filter(code="R001")] == \
+            ["_test_alias_dup"]
+    finally:
+        _OP_REGISTRY.pop("_test_alias_dup")
+        _OP_REGISTRY.pop("_test_alias_canon")
+
+
+# -- trace_lint --------------------------------------------------------
+
+_SEEDED_SRC = '''
+import jax
+import numpy as np
+
+@jax.jit
+def hazard(x, mode="fast"):
+    v = x.sum()
+    a = v.item()
+    b = np.asarray(x)
+    c = float(v)
+    if v > 0:
+        return x
+    return -x
+'''
+
+
+def test_trace_lint_flags_each_hazard_with_location():
+    rep = lint_source(_SEEDED_SRC, "seeded.py")
+    codes = sorted(d.code for d in rep)
+    assert codes == ["L001", "L002", "L003", "L004"]
+    by_code = {d.code: d for d in rep}
+    assert by_code["L001"].location == "seeded.py:8"
+    assert by_code["L001"].subject == "item"
+    assert by_code["L002"].subject == "np.asarray"
+    assert by_code["L003"].subject == "float"
+    assert by_code["L004"].severity == Severity.WARNING
+
+
+def test_trace_lint_register_op_is_traced_scope():
+    src = (
+        "from mxtpu.base import register_op\n"
+        "@register_op('fake')\n"
+        "def fake(x, scale=1.0):\n"
+        "    return float(x) * scale\n"
+    )
+    rep = lint_source(src, "op.py")
+    assert [d.code for d in rep] == ["L003"]
+
+
+def test_trace_lint_static_kwargs_not_tainted():
+    src = (
+        "import jax\n"
+        "@jax.jit\n"
+        "def f(x, axis=1):\n"
+        "    if axis > 0:\n"       # static param: no finding
+        "        return x\n"
+        "    return -x\n"
+    )
+    assert len(lint_source(src, "s.py")) == 0
+
+
+def test_trace_lint_suppression_comment():
+    src = (
+        "import jax\n"
+        "@jax.jit\n"
+        "def f(x):\n"
+        "    return float(x)  # trace-ok: test escape hatch\n"
+    )
+    assert len(lint_source(src, "s.py")) == 0
+
+
+def test_trace_lint_untraced_function_is_ignored():
+    src = "def eager(x):\n    return float(x.sum())\n"
+    assert len(lint_source(src, "s.py")) == 0
+
+
+# -- satellites: get_op suggestions, pass registry, CachedOp.verify ----
+
+def test_get_op_suggests_close_matches():
+    with pytest.raises(MXTPUError, match="FullyConnected"):
+        get_op("FullyConected")
+    # far-off names still raise, without a bogus suggestion
+    with pytest.raises(MXTPUError):
+        get_op("zzzz_nothing_close_zzzz")
+
+
+def test_pass_registry_runs_by_name():
+    assert {"verify_graph", "check_sharding", "audit_registry",
+            "trace_lint"} <= set(list_passes())
+    rep = run_pass("verify_graph", _mlp(), data=(4, 10))
+    assert rep.ok
+
+
+def test_cached_op_verify():
+    from mxtpu.cached_op import CachedOp
+    from mxtpu.gluon import nn
+
+    net = nn.Dense(4, in_units=8)
+    net.initialize()
+    op = CachedOp(net)
+    rep = op.verify(data=(2, 8))
+    assert rep.ok, str(rep)
+    assert op.num_compiles == 0
+
+
+# -- CLI ---------------------------------------------------------------
+
+def test_cli_graph_verifies_saved_symbol(tmp_path, capsys):
+    from mxtpu.analysis.__main__ import main
+
+    net = _mlp()
+    path = tmp_path / "net-symbol.json"
+    net.save(str(path))
+    rc = main(["graph", str(path), "--shape", "data=4,10"])
+    assert rc == 0
+    rc = main(["graph", str(path), "--shape", "data=4,10",
+               "--shape", "fc1_weight=8,99", "--json"])
+    out = capsys.readouterr().out
+    assert rc == 1
+    assert "G005" in out and "fc1" in out
+
+
+def test_cli_lint_path(tmp_path, capsys):
+    from mxtpu.analysis.__main__ import main
+
+    bad = tmp_path / "bad.py"
+    bad.write_text(_SEEDED_SRC)
+    rc = main(["lint", str(bad)])
+    out = capsys.readouterr().out
+    assert rc == 1
+    assert "L001" in out

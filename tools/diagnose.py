@@ -11,6 +11,13 @@ import platform
 import sys
 import time
 
+# `python tools/diagnose.py` puts tools/ (not the repo root) on sys.path;
+# make the in-repo mxtpu importable so the MXTPU/analysis sections report
+# real data instead of IMPORT FAILED
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO_ROOT not in sys.path:
+    sys.path.insert(0, _REPO_ROOT)
+
 
 def check_python():
     print("----------Python Info----------")
@@ -77,6 +84,31 @@ def check_devices(timeout_s=60):
         print("devices      : FAILED (%s: %s)" % (type(e).__name__, e))
 
 
+def check_analysis(full=False):
+    """Run the repo's own static analyses (trace-safety lint; with
+    --full also the op-registry audit, ~20s of abstract evals) and print
+    the summary — the bug-report equivalent of the reference's
+    operator-registry dump."""
+    print("----------Static Analysis----------")
+    try:
+        from mxtpu.analysis import audit_registry, trace_lint
+        lint = trace_lint()
+        print("trace lint     :", lint.summary())
+        for d in lint.errors:
+            print("  ", d)
+        if full:
+            import mxtpu.ndarray  # noqa: F401 — populate the registry
+            reg = audit_registry()
+            print("registry audit :", reg.summary())
+            for d in reg.errors:
+                print("  ", d)
+        else:
+            print("registry audit : skipped (pass --full, or run "
+                  "`python -m mxtpu.analysis registry`)")
+    except Exception as e:
+        print("analysis       : FAILED (%s: %s)" % (type(e).__name__, e))
+
+
 def check_environment():
     print("----------Environment----------")
     for k, v in sorted(os.environ.items()):
@@ -86,11 +118,13 @@ def check_environment():
 
 
 def main():
+    full = "--full" in sys.argv[1:]
     check_python()
     check_os()
     check_libraries()
     check_environment()
     check_mxtpu()
+    check_analysis(full=full)
     check_devices()
 
 
