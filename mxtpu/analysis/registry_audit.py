@@ -174,6 +174,18 @@ def audit_registry(ops: Optional[Iterable[str]] = None,
                 "unpacking will mis-wire" %
                 (spec.name, declared, structs[0].shape, len(outs)),
                 details={"declared": declared, "observed": len(outs)}))
+        elif spec.num_outputs is None and len(outs) > 1:
+            # the engine bulker (and symbolic unpacking) treat an
+            # undeclared arity as "exactly one output"; a silent
+            # multi-output op would hand callers a single lazy handle
+            # for a tuple result
+            report.add(Diagnostic(
+                _PASS, "R002", Severity.ERROR, spec.name,
+                "op %r returns %d outputs but declares no num_outputs; "
+                "engine.bulk assumes undeclared ops are single-output — "
+                "declare num_outputs=%d in register_op" %
+                (spec.name, len(outs), len(outs)),
+                details={"declared": None, "observed": len(outs)}))
 
         # -- R003: differentiable ops must admit jax.vjp -----------------
         # only checkable when every output is inexact (a float cotangent

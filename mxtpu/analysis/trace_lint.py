@@ -35,7 +35,18 @@ L003        ERROR     float()/int()/bool() of a tainted value in a traced
                       scope (concretizes the tracer)
 L004        WARNING   Python if/while branches on a tainted value (use
                       lax.cond/where; raises under jit, retraces at best)
+L005        WARNING   sync point inside an ``engine.bulk`` region: a call
+                      that forces the pending segment (.asnumpy()/.item()/
+                      float()/print()/wait_all()...) splits the fused
+                      program — the ops after it start a new segment
 ==========  ========  =====================================================
+
+The L005 rule lints ``with ... bulk(...):`` bodies rather than traced
+scopes: the bulk region is an explicit request to fuse, so every mid-
+region flush is a fusion-breaker worth surfacing (docs/engine.md has the
+sync-point matrix).  It reports at WARNING severity — the default
+``--fail-on error`` CI gate ignores it; opt in with ``--fail-on
+warning``.
 
 False-positive escape hatch: append ``# trace-ok`` (optionally
 ``# trace-ok: reason``) to the flagged line.
@@ -74,6 +85,20 @@ _CAST_BUILTINS = {"float", "int", "bool", "complex"}
 # metadata, not values)
 _SAFE_ATTRS = {"shape", "ndim", "dtype", "size", "aval", "sharding",
                "weak_type"}
+
+
+def _trace_ok_suppressed(lines: List[str], node: ast.AST,
+                         span_node: Optional[ast.AST] = None) -> bool:
+    """Honor "# trace-ok" anywhere on the lines the flagged expression
+    spans (multi-line calls / conditions included) — shared by every
+    rule so the suppression convention stays consistent."""
+    span = span_node if span_node is not None else node
+    start = span.lineno
+    end = getattr(span, "end_lineno", None) or start
+    for ln in range(start, min(end, len(lines)) + 1):
+        if 0 < ln <= len(lines) and "# trace-ok" in lines[ln - 1]:
+            return True
+    return False
 
 
 def _dotted_name(node: ast.AST) -> Optional[str]:
@@ -198,16 +223,7 @@ class _ScopeLinter(ast.NodeVisitor):
 
     # -- helpers ---------------------------------------------------------
     def _suppressed(self, node, span_node=None) -> bool:
-        # honor "# trace-ok" anywhere on the lines the flagged
-        # expression spans (multi-line calls / conditions included)
-        span = span_node if span_node is not None else node
-        start = span.lineno
-        end = getattr(span, "end_lineno", None) or start
-        for ln in range(start, min(end, len(self.lines)) + 1):
-            if 0 < ln <= len(self.lines) and \
-                    "# trace-ok" in self.lines[ln - 1]:
-                return True
-        return False
+        return _trace_ok_suppressed(self.lines, node, span_node)
 
     def _emit(self, node, code, severity, subject, message,
               span_node=None):
@@ -324,6 +340,67 @@ class _ScopeLinter(ast.NodeVisitor):
         sub.visit(node.body)
 
 
+# sync-point call forms flagged inside a bulk region (L005)
+_BULK_SYNC_METHODS = {"asnumpy", "item", "asscalar", "tolist",
+                      "wait_to_read", "wait_to_write"}
+_BULK_SYNC_CALLS = {"wait_all", "waitall"}
+_BULK_SYNC_BUILTINS = {"float", "int", "bool", "print"}
+
+
+class _BulkRegionLinter(ast.NodeVisitor):
+    """L005: flag explicit sync points written inside a ``with ...
+    bulk(...):`` body — each one flushes (and splits) the fused segment
+    the region asked for.  Heuristic trigger: any with-item whose context
+    expression is a call to a function named ``bulk``."""
+
+    def __init__(self, fname: str, lines: List[str], report: Report):
+        self.fname = fname
+        self.lines = lines
+        self.report = report
+        self._depth = 0  # > 0 while inside a bulk region
+
+    def _emit(self, node, subject, what):
+        if _trace_ok_suppressed(self.lines, node):
+            return
+        self.report.add(Diagnostic(
+            _PASS, "L005", Severity.WARNING, subject,
+            "%s inside an engine.bulk region flushes the pending "
+            "segment — the fused program splits here; move the sync "
+            "point outside the region (or suppress with `# trace-ok`)"
+            % what,
+            location="%s:%d" % (self.fname, node.lineno)))
+
+    def visit_With(self, node):
+        is_bulk = any(
+            isinstance(item.context_expr, ast.Call)
+            and _last_component(item.context_expr.func) == "bulk"
+            for item in node.items)
+        if is_bulk:
+            self._depth += 1
+        self.generic_visit(node)
+        if is_bulk:
+            self._depth -= 1
+
+    visit_AsyncWith = visit_With
+
+    def visit_Call(self, node):
+        if self._depth > 0:
+            func = node.func
+            if isinstance(func, ast.Attribute) and \
+                    func.attr in _BULK_SYNC_METHODS:
+                self._emit(node, func.attr, ".%s()" % func.attr)
+            else:
+                last = _last_component(func)
+                if last in _BULK_SYNC_CALLS:
+                    self._emit(node, last, "%s()" % last)
+                elif isinstance(func, ast.Name) and \
+                        func.id in _BULK_SYNC_BUILTINS and any(
+                            not isinstance(a, ast.Constant)
+                            for a in node.args):
+                    self._emit(node, func.id, "%s()" % func.id)
+        self.generic_visit(node)
+
+
 def lint_source(source: str, filename: str = "<string>") -> Report:
     """Lint one Python source string; returns a Report."""
     report = Report()
@@ -357,6 +434,8 @@ def lint_source(source: str, filename: str = "<string>") -> Report:
         body = fn.body if isinstance(fn.body, list) else [fn.body]
         for stmt in body:
             linter.visit(stmt)
+
+    _BulkRegionLinter(filename, lines, report).visit(tree)
     return report
 
 

@@ -44,7 +44,15 @@ def is_training() -> bool:
 
 
 def set_recording(flag: bool) -> bool:
-    prev, _STATE.recording = _STATE.recording, bool(flag)
+    prev = _STATE.recording
+    if prev != bool(flag):
+        # autograd boundary = bulk sync point: a bulk segment is
+        # recording-homogeneous (it enters the tape as ONE fused vjp node
+        # or not at all), so crossing record()/pause() flushes pending
+        # bulked ops before the state flips
+        from . import engine
+        engine.flush_bulk()
+    _STATE.recording = bool(flag)
     return prev
 
 
@@ -130,8 +138,15 @@ def backward(heads, head_grads=None, retain_graph=False, train_mode=True):
     Mirrors Imperative::Backward: topological walk of recorded nodes from
     the heads, per-node vjp, gradient accumulation honoring grad_req
     ('write' overwrites, 'add' accumulates across backward calls).
+
+    A pending bulk segment flushes first (sync point): lazy heads
+    materialize and any recorded segment lands on the tape as one fused
+    vjp node before the walk starts.
     """
+    from . import engine
     from .ndarray import NDArray  # circular-at-import, fine at runtime
+
+    engine.flush_bulk()
 
     if isinstance(heads, NDArray):
         heads = [heads]

@@ -48,6 +48,13 @@ class OpSpec(NamedTuple):
     num_outputs: static output count hint (None = infer from return
         value; a callable(static_kwargs) -> int serves ops whose arity
         depends on a static param, e.g. _sample_multinomial get_prob).
+        The engine bulker relies on ``None`` meaning exactly ONE output
+        (registry audit rule R002 enforces it), so multi-output ops MUST
+        declare their arity.
+    bulkable: whether the engine may defer this op into a bulk segment
+        (engine.bulk).  False for ops that take function-valued arguments
+        or re-enter the dispatcher (control flow, Custom) — they dispatch
+        per-op even inside a bulk region.
     """
 
     name: str
@@ -55,6 +62,7 @@ class OpSpec(NamedTuple):
     differentiable: bool = True
     aliases: Sequence[str] = ()
     num_outputs: Union[int, Callable[[dict], int], None] = None
+    bulkable: bool = True
 
 
 _OP_REGISTRY: Dict[str, OpSpec] = {}
@@ -65,6 +73,7 @@ def register_op(
     differentiable: bool = True,
     aliases: Sequence[str] = (),
     num_outputs: Union[int, Callable[[dict], int], None] = None,
+    bulkable: bool = True,
 ):
     """Decorator registering a jax-level function as an mxtpu operator.
 
@@ -77,7 +86,7 @@ def register_op(
     def wrap(fn: Callable[..., Any]) -> Callable[..., Any]:
         opname = name or fn.__name__
         spec = OpSpec(opname, fn, differentiable, tuple(aliases),
-                      num_outputs)
+                      num_outputs, bulkable)
         if opname in _OP_REGISTRY:
             raise ValueError(f"operator {opname!r} registered twice")
         _OP_REGISTRY[opname] = spec

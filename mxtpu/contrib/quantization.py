@@ -36,7 +36,7 @@ def _q_scale(mn, mx):
     return jnp.maximum(jnp.maximum(jnp.abs(mn), jnp.abs(mx)), 1e-8) / 127.0
 
 
-@register_op("_contrib_quantize_v2", differentiable=False)
+@register_op("_contrib_quantize_v2", differentiable=False, num_outputs=3)
 def quantize_v2(x, min_calib_range=None, max_calib_range=None):
     """fp32 → (int8, min, max) (parity: quantize_v2-inl.h, symmetric
     int8 mode).  Without calib ranges, uses the tensor's own min/max."""

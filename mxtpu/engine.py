@@ -1,34 +1,69 @@
 """Engine facade (parity: src/engine/ — ThreadedEnginePerDevice etc.).
 
 The reference's dependency engine schedules every op asynchronously with
-read/write variable tracking (ThreadedVar serializing writers).  On TPU this
-entire ~6k-LoC subsystem is absorbed by PJRT: `jax` dispatch is already
-async (the Python thread enqueues, XLA executes in order on the device), and
-data dependencies are exact because arrays are immutable values.  What
-remains useful from the reference API:
+read/write variable tracking (ThreadedVar serializing writers).  On TPU
+most of that ~6k-LoC subsystem is absorbed by PJRT: `jax` dispatch is
+already async and data dependencies are exact because arrays are
+immutable values.  What this module keeps — and now actually implements —
+from the reference API:
 
- - ``wait_all()``  <- MXNDArrayWaitAll: barrier on all outstanding work.
+ - ``wait_all()``  <- MXNDArrayWaitAll: barrier on all outstanding work
+   (flushes any pending bulk segment first).
  - NaiveEngine sync-debug mode  <- MXNET_ENGINE_TYPE=NaiveEngine: here
    ``MXTPU_ENGINE_TYPE=NaiveEngine`` (or ``MXTPU_SYNC=1``) makes every op
    block_until_ready, giving deterministic, exception-at-callsite behavior
-   for debugging (async exception propagation otherwise surfaces late, the
-   exact issue tests/python/unittest/test_exc_handling.py covers).
- - ``bulk`` context manager  <- engine op bulking: a no-op here because XLA
-   fusion under jit is the real bulking mechanism; kept for API compat.
+   for debugging.  Sync mode disables bulking entirely.
+ - ``bulk`` context manager  <- engine op bulking (MXNET_ENGINE_BULK_SIZE /
+   Imperative::BulkStatus): REAL here since this PR.  Under ``bulk(size)``
+   (or the ``MXTPU_ENGINE_BULK_SIZE`` ambient opt-in) eager ops are not
+   dispatched individually; they append nodes to a per-thread
+   ``BulkSegment`` and return *lazy* NDArray handles.  The segment
+   compiles ONCE via ``jax.jit`` — keyed by an (op-sequence, input
+   shapes/dtypes, static-kwargs, liveness) signature cached across
+   flushes like cached_op's jit cache — and executes fused on the first
+   sync point.  Python/dispatch overhead is paid once per segment instead
+   of once per op, the exact win the reference's op bulking bought
+   (SURVEY §2.1 #1), and it is host-side, so it holds on CPU too.
+
+Sync points (every one of them flushes; ``docs/engine.md`` has the full
+matrix): reading ``NDArray._data`` in any form (``asnumpy``/``item``/
+``float()``/printing/``.shape``-driven control flow/in-place arithmetic),
+``wait_all()``, ``set_sync(True)``, autograd recording-state transitions
+(``record()``/``pause()`` boundaries) and ``backward()``, exceeding the
+bulk size, and ops the bulker cannot record (flush-free fallthrough —
+their inputs force any needed flush through ``_data``).
+
+Correctness contract: bulked execution is bit-identical to
+``MXTPU_SYNC=1`` per-op execution (tests/test_engine_bulk.py asserts it
+over an op-sweep slice).  If the fused trace fails to compile (e.g. a
+data-dependent-shape op like boolean-mask getitem landed in a segment),
+the segment replays eagerly through the normal per-op dispatch path —
+never wrong answers, never spurious errors — and the signature is
+negative-cached so the next identical segment replays immediately.
 """
 
 from __future__ import annotations
 
 import contextlib
 import os
+import threading
+import weakref
+from typing import Any, Dict, List, Optional, Tuple
 
-from .base import env_bool
+from .base import MXTPUError, env_bool, env_int
 
-__all__ = ["is_sync", "set_sync", "wait_all", "bulk"]
+__all__ = [
+    "is_sync", "set_sync", "wait_all", "bulk", "flush_bulk",
+    "current_segment", "bulk_size", "bulk_stats", "reset_bulk_stats",
+]
 
 _SYNC = env_bool("MXTPU_SYNC") or os.environ.get(
     "MXTPU_ENGINE_TYPE", os.environ.get("MXNET_ENGINE_TYPE", "")
 ) == "NaiveEngine"
+
+# Ambient opt-in (parity: MXNET_ENGINE_BULK_SIZE): when > 0, every eager
+# op bulks by default, no explicit bulk() context needed.
+_AMBIENT_BULK = env_int("MXTPU_ENGINE_BULK_SIZE", 0)
 
 
 def is_sync() -> bool:
@@ -36,27 +71,582 @@ def is_sync() -> bool:
 
 
 def set_sync(flag: bool):
+    """Toggle NaiveEngine-style synchronous dispatch.  Enabling sync
+    mid-bulk flushes the calling thread's pending segment first and then
+    disables bulking — no lazy handle survives into sync mode."""
     global _SYNC
+    if flag:
+        flush_bulk()
     _SYNC = bool(flag)
 
 
 def wait_all():
     """Block until all enqueued device work is complete (parity:
-    MXNDArrayWaitAll).  PJRT executes per-device in submission order, so
-    blocking on every live array is a sufficient barrier; it also surfaces
-    any deferred device error here, matching the reference's semantics of
-    async exceptions raising at the wait point.  Errors are deliberately
-    NOT swallowed — a failed effect or poisoned buffer raises here, like
-    the reference's engine rethrowing stored exceptions on WaitAll."""
+    MXNDArrayWaitAll).  Flushes the calling thread's pending bulk segment
+    first — a barrier over lazily-recorded, never-dispatched ops would
+    otherwise be vacuous.  PJRT executes per-device in submission order,
+    so blocking on every live array is a sufficient barrier; it also
+    surfaces any deferred device error here, matching the reference's
+    semantics of async exceptions raising at the wait point."""
     import jax
 
+    flush_bulk()
     jax.effects_barrier()
     # one batched wait over every live buffer (cheap flag-checks for
     # already-ready arrays) rather than a python loop of sequential blocks
     jax.block_until_ready(jax.live_arrays())
 
 
+# ---------------------------------------------------------------------------
+# bulk segment machinery
+# ---------------------------------------------------------------------------
+
+# Sentinel stored in NDArray._tape_node for outputs of recorded-eligible
+# bulked ops before their segment flushes: truthy so autograd._on_tape
+# sees them, replaced by the real (TapeNode, idx) at flush.  backward()
+# can never observe it — backward flushes first.
+PENDING_TAPE = ("<pending-bulk-segment>", 0)
+
+
+class _BulkLocal(threading.local):
+    def __init__(self):
+        self.size = _AMBIENT_BULK
+        self.segment: Optional[BulkSegment] = None
+        self.replaying = False
+
+
+_BULK = _BulkLocal()
+
+_STATS = {
+    "flushes": 0,          # segments executed (any mode)
+    "cache_hits": 0,       # flushes served by an already-compiled program
+    "cache_misses": 0,     # flushes that compiled a new fused program
+    "bulked_ops": 0,       # ops recorded into segments
+    "fallthroughs": 0,     # ops the bulker declined (dispatched per-op)
+    "eager_replays": 0,    # flushes that ran the per-op replay fallback
+}
+
+_SEG_CACHE: Dict[Any, Any] = {}   # signature -> compiled callable | "eager"
+_EAGER = "eager"                  # negative-cache marker
+
+
+def bulk_stats() -> dict:
+    """Segment-cache and bulking counters (surfaced by tools/diagnose.py
+    and the eager-dispatch bench)."""
+    out = dict(_STATS)
+    out["cache_size"] = len(_SEG_CACHE)
+    return out
+
+
+def reset_bulk_stats():
+    for k in _STATS:
+        _STATS[k] = 0
+
+
+def bulk_size() -> int:
+    """The calling thread's active bulk size (0 = bulking off)."""
+    return 0 if _SYNC else getattr(_BULK, "size", _AMBIENT_BULK)
+
+
+def current_segment() -> Optional["BulkSegment"]:
+    """The calling thread's open segment, creating one if bulking is
+    enabled.  None when bulking is off (the common fast path)."""
+    st = _BULK
+    if st.replaying:
+        return None
+    seg = st.segment
+    if seg is not None:
+        if _SYNC:
+            # sync mode engaged (possibly from another thread) while this
+            # thread had an open segment: flush it and go per-op — "sync
+            # disables bulking entirely" must hold on every thread
+            st.segment = None
+            if not seg.closed:
+                seg.flush()
+            return None
+        if not seg.closed:
+            return seg
+        st.segment = None
+    if _SYNC or st.size <= 0:
+        return None
+    seg = BulkSegment(st.size)
+    st.segment = seg
+    return seg
+
+
+def flush_bulk():
+    """Flush the calling thread's pending bulk segment (no-op when there
+    is none).  Exceptions from the deferred ops surface HERE — the flush
+    site is where bulked errors raise."""
+    seg = _BULK.segment
+    if seg is not None:
+        _BULK.segment = None
+        if not seg.closed:
+            seg.flush()
+        # a closed-with-error segment already raised at its sync point;
+        # only a lazy HANDLE forcing it re-raises (it has no value)
+
+
 @contextlib.contextmanager
 def bulk(size: int = 15):
-    """Parity shim for mx.engine.bulk — XLA fusion supersedes op bulking."""
-    yield
+    """Bulk eager ops into engine segments (parity: mx.engine.bulk /
+    MXNET_ENGINE_BULK_SIZE).  Inside the context up to ``size`` ops are
+    recorded lazily and compiled+executed as one fused program at the
+    first sync point (or at context exit).  ``size <= 0`` disables
+    bulking inside the context.  Nesting is allowed: entering flushes the
+    current segment and the inner size applies until the inner context
+    exits, which flushes again and restores the outer size."""
+    st = _BULK
+    prev = st.size
+    flush_bulk()
+    st.size = int(size)
+    try:
+        yield
+    finally:
+        try:
+            flush_bulk()
+        finally:
+            st.size = prev
+
+
+class _Unfreezable(TypeError):
+    pass
+
+
+class _SegmentClosed(RuntimeError):
+    """Raised by the record-side mutators when the segment was flushed
+    concurrently (a cross-thread force); the recorder falls through to
+    per-op dispatch — its inputs are concrete by then."""
+
+
+def _freeze_static(v):
+    """Hashable, value-semantics signature token for a static op param.
+    Raises _Unfreezable for values that cannot be part of a compile-cache
+    key (arbitrary arrays, unhashable objects) — the op falls through to
+    per-op dispatch."""
+    if v is None:
+        return v
+    if isinstance(v, (bool, int, float, complex, str, bytes)):
+        # type-qualified: python's cross-type numeric equality
+        # (2 == 2.0 == True, equal hashes) would otherwise collide
+        # signatures of segments that compile to different dtypes
+        return (type(v).__name__, v)
+    if isinstance(v, slice):
+        return ("__slice__", _freeze_static(v.start),
+                _freeze_static(v.stop), _freeze_static(v.step))
+    if isinstance(v, (list, tuple)):
+        return ("__seq__", isinstance(v, list),
+                tuple(_freeze_static(x) for x in v))
+    if isinstance(v, dict):
+        items = tuple((k, _freeze_static(x)) for k, x in v.items())
+        try:
+            return ("__map__", tuple(sorted(items)))
+        except TypeError:  # mixed-type keys: order by repr, still stable
+            return ("__map__", tuple(sorted(items, key=repr)))
+    try:
+        hash(v)
+    except TypeError:
+        raise _Unfreezable(repr(type(v))) from None
+    return ("__obj__", type(v).__name__, v)  # np.float32(2) != np.int32(2)
+
+
+class _LazyRef:
+    """What a lazy NDArray's ``_lazy_`` slot holds: a pointer to one
+    output of one node of a pending segment."""
+
+    __slots__ = ("segment", "node", "out")
+
+    def __init__(self, segment, node, out):
+        self.segment = segment
+        self.node = node
+        self.out = out
+
+
+class _Input:
+    """One external (concrete) array input of a segment."""
+
+    __slots__ = ("value", "handle", "on_tape", "diff")
+
+    def __init__(self, value, handle, on_tape):
+        self.value = value
+        self.handle = handle      # source NDArray (tape identity), or None
+        self.on_tape = on_tape
+        # diff: consumed by at least one tape-eligible node.  Only these
+        # become vjp primals — an on-tape input feeding nothing but
+        # non-differentiable ops must NOT get a zero gradient written
+        # over its .grad (per-op dispatch never records it at all).
+        self.diff = False
+
+
+class _NodeProg:
+    """The compile-relevant description of one recorded op.  Captured by
+    cached fused closures, so it must NOT hold NDArray handles or input
+    values — only the op callable, resolved arg specs ('x' external /
+    'r' ref / 'c' const), static kwargs, and flags."""
+
+    __slots__ = ("fn", "name", "run_args", "kw_args", "statics", "n_outs",
+                 "eligible", "sig")
+
+    def __init__(self, fn, name, run_args, kw_args, statics, n_outs,
+                 eligible, sig):
+        self.fn = fn
+        self.name = name
+        self.run_args = run_args      # list of ('x', i) | ('r', n, o) | ('c', v)
+        self.kw_args = kw_args        # list of (key, spec)
+        self.statics = statics        # dict of static python kwargs
+        self.n_outs = n_outs
+        self.eligible = eligible      # records onto the autograd tape
+        self.sig = sig                # hashable per-node signature
+
+
+class BulkSegment:
+    """Per-thread recorder of deferred eager ops (parity:
+    Imperative::BulkStatus + the engine's bulked opr segments)."""
+
+    def __init__(self, limit: int):
+        self.limit = limit
+        self.progs: List[_NodeProg] = []
+        self.inputs: List[_Input] = []
+        self._input_ids: Dict[int, int] = {}
+        self.out_refs: List[List[List[weakref.ref]]] = []
+        self.recording = False        # autograd was recording at record time
+        self.closed = False
+        self.error: Optional[BaseException] = None
+        self._lock = threading.RLock()
+
+    # -- recording --------------------------------------------------------
+    # The mutators serialize against flush() on the segment lock: a
+    # cross-thread force mid-record must not tear the snapshot _execute
+    # reads, and ops must not land in an already-flushed segment (they
+    # would silently never run).  Post-closure they raise _SegmentClosed
+    # and the recorder dispatches per-op instead.
+
+    def add_input(self, value, handle, on_tape) -> int:
+        with self._lock:
+            if self.closed:
+                raise _SegmentClosed
+            # dedup key includes the handle identity for on-tape inputs:
+            # two distinct NDArrays sharing one buffer are distinct tape
+            # leaves — collapsing them would misroute the second one's
+            # gradient (per-op dispatch keeps them apart too)
+            key = (id(value), id(handle) if on_tape else None)
+            idx = self._input_ids.get(key)
+            if idx is None:
+                idx = len(self.inputs)
+                self.inputs.append(_Input(value, handle, on_tape))
+                self._input_ids[key] = idx
+            return idx
+
+    def add_node(self, prog: _NodeProg) -> int:
+        with self._lock:
+            if self.closed:
+                raise _SegmentClosed
+            self.progs.append(prog)
+            self.out_refs.append([[] for _ in range(prog.n_outs)])
+            if prog.eligible:
+                self.recording = True
+            _STATS["bulked_ops"] += 1
+            return len(self.progs) - 1
+
+    def add_ref(self, node: int, out: int, handle) -> None:
+        with self._lock:
+            if self.closed:
+                raise _SegmentClosed
+            self.out_refs[node][out].append(weakref.ref(handle))
+
+    def mark_diff_inputs(self, indices) -> None:
+        """Flag external inputs as consumed by a tape-eligible node
+        (callers hold the segment lock via the reentrant record path)."""
+        for i in indices:
+            self.inputs[i].diff = True
+
+    def rollback_inputs(self, n0: int) -> None:
+        """Drop inputs appended by an aborted record attempt: orphans
+        would pollute the compile-cache signature (and the vjp primal
+        set) of every later flush of this segment."""
+        with self._lock:
+            if len(self.inputs) > n0:
+                del self.inputs[n0:]
+                for k, i in list(self._input_ids.items()):
+                    if i >= n0:
+                        del self._input_ids[k]
+
+    @property
+    def full(self) -> bool:
+        return len(self.progs) >= self.limit
+
+    # -- flush ------------------------------------------------------------
+    def flush(self):
+        with self._lock:
+            if self.closed:
+                if self.error is not None:
+                    raise MXTPUError(
+                        "bulk segment previously failed; the lazy handle "
+                        "has no value") from self.error
+                return
+            self.closed = True
+            if not self.progs:
+                return
+            _STATS["flushes"] += 1
+            try:
+                self._execute()
+            except Exception as e:
+                self.error = e
+                raise
+
+    def _execute(self):
+        import jax
+
+        # Resolve live handles up front (strong refs — no GC races between
+        # mask computation and binding).  A handle only counts as live if
+        # it STILL references this segment output: one rebound after
+        # record time (copyto/out=/_rebind) must not be overwritten with
+        # the stale segment value.
+        live: List[List[List[Any]]] = []
+        for ni, refs_per_out in enumerate(self.out_refs):
+            per_node = []
+            for oi, refs in enumerate(refs_per_out):
+                hs = []
+                for r in refs:
+                    h = r()
+                    if h is None:
+                        continue
+                    lz = h._lazy_
+                    if (lz is None or lz.segment is not self
+                            or lz.node != ni or lz.out != oi):
+                        continue
+                    hs.append(h)
+                per_node.append(hs)
+            live.append(per_node)
+        live_mask = tuple(tuple(bool(hs) for hs in per_node)
+                          for per_node in live)
+
+        diff_idx = tuple(i for i, e in enumerate(self.inputs)
+                         if e.on_tape and e.diff) if self.recording else ()
+        # (node, out) emission order of the fused program's returns
+        tape_out, plain_out = [], []
+        for i, prog in enumerate(self.progs):
+            for j in range(prog.n_outs):
+                if not live_mask[i][j]:
+                    continue
+                (tape_out if (self.recording and prog.eligible)
+                 else plain_out).append((i, j))
+        recorded = bool(tape_out)
+
+        sig = (
+            tuple(p.sig for p in self.progs),
+            tuple((tuple(e.value.shape), str(e.value.dtype),
+                   bool(getattr(e.value, "weak_type", False)))
+                  for e in self.inputs),
+            live_mask, diff_idx, recorded,
+        )
+
+        compiled = _SEG_CACHE.get(sig)
+        if compiled is _EAGER:
+            _STATS["cache_hits"] += 1
+            _STATS["eager_replays"] += 1
+            return self._replay(live)
+        if compiled is None:
+            compiled = self._build(jax, recorded, diff_idx, tape_out,
+                                   plain_out)
+            hit = False
+        else:
+            hit = True
+
+        try:
+            self._run_compiled(jax, compiled, recorded, diff_idx, tape_out,
+                               plain_out, live)
+        except Exception:
+            # The fused trace/compile rejected the segment (e.g. a
+            # data-dependent-shape op).  Replay per-op: identical
+            # semantics to unbulked dispatch.  A genuine user error
+            # re-raises from the replay with the per-op message.
+            _STATS["eager_replays"] += 1
+            self._replay(live)
+            # replay succeeded.  Negative-cache ONLY a fresh compile
+            # failure (the segment is jit-hostile); a failure of an
+            # already-proven cached program is transient (OOM, a
+            # post-run binding error) and must not permanently demote
+            # this signature to per-op replay.
+            if not hit:
+                _SEG_CACHE[sig] = _EAGER
+            return
+        if hit:
+            _STATS["cache_hits"] += 1
+        else:
+            _STATS["cache_misses"] += 1
+            _SEG_CACHE[sig] = compiled
+
+    # -- compiled path ----------------------------------------------------
+    def _build(self, jax, recorded, diff_idx, tape_out, plain_out):
+        progs = tuple(self.progs)
+        nondiff_idx = tuple(i for i in range(len(self.inputs))
+                            if i not in diff_idx)
+
+        def run_nodes(ext):
+            env = []
+            for p in progs:
+                args = [_resolve(s, ext, env) for s in p.run_args]
+                kw = {k: _resolve(s, ext, env) for k, s in p.kw_args}
+                kw.update(p.statics)
+                res = p.fn(*args, **kw)
+                res = (tuple(res) if isinstance(res, (tuple, list))
+                       else (res,))
+                if len(res) != p.n_outs:
+                    raise MXTPUError(
+                        "bulked op %r produced %d output(s) but its "
+                        "registration declares %d; fix num_outputs in "
+                        "register_op (the registry audit R002 rule "
+                        "enforces this)" % (p.name, len(res), p.n_outs))
+                if recorded and not p.eligible:
+                    # parity with per-op recording: a non-recorded op is
+                    # a gradient barrier
+                    res = tuple(jax.lax.stop_gradient(r) for r in res)
+                env.append(res)
+            return env
+
+        if recorded:
+            def fused(diff_vals, nondiff_vals):
+                ext = [None] * (len(diff_vals) + len(nondiff_vals))
+                for k, i in enumerate(diff_idx):
+                    ext[i] = diff_vals[k]
+                for k, i in enumerate(nondiff_idx):
+                    ext[i] = nondiff_vals[k]
+                env = run_nodes(ext)
+                return (tuple(env[i][j] for i, j in tape_out),
+                        tuple(env[i][j] for i, j in plain_out))
+        else:
+            def fused(ext):
+                env = run_nodes(ext)
+                return tuple(env[i][j] for i, j in plain_out)
+
+        return jax.jit(fused)
+
+    def _run_compiled(self, jax, compiled, recorded, diff_idx, tape_out,
+                      plain_out, live):
+        if not recorded:
+            vals = compiled(tuple(e.value for e in self.inputs))
+            self._bind(plain_out, vals, live)
+            return
+
+        from . import autograd
+
+        nondiff_idx = tuple(i for i in range(len(self.inputs))
+                            if i not in diff_idx)
+        diff_vals = tuple(self.inputs[i].value for i in diff_idx)
+        nondiff_vals = tuple(self.inputs[i].value for i in nondiff_idx)
+        # vjp over the jitted fused program: the forward executes as one
+        # compiled call, the backward is the XLA transpose — the whole
+        # segment becomes ONE tape node.  Non-diff inputs are closed
+        # over (not vjp primals) so the transpose never computes
+        # cotangents nobody can receive — the per-op path's
+        # diff-args-only vjp, fused.
+        tape_vals, vjp_fn, plain_vals = jax.vjp(
+            lambda d: compiled(d, nondiff_vals), diff_vals, has_aux=True)
+        self._bind(plain_out, plain_vals, live)
+        tape_handles = self._bind(tape_out, tape_vals, live)
+
+        def seg_vjp(out_cots):
+            cots = (tuple(out_cots) if isinstance(out_cots, (tuple, list))
+                    else (out_cots,))
+            (d_diff,) = vjp_fn(cots)
+            return list(d_diff)
+
+        node_inputs = [self.inputs[i].handle for i in diff_idx]
+        autograd.record_node(
+            seg_vjp, node_inputs, tape_handles,
+            "bulk_segment[%d ops]" % len(self.progs))
+
+    def _bind(self, order, vals, live):
+        """Write flushed values into the surviving lazy handles; returns
+        one representative handle per bound output (tape identity)."""
+        primary = []
+        for (i, j), val in zip(order, vals):
+            first = None
+            for h in live[i][j]:
+                h._data_ = val
+                h._lazy_ = None
+                if h._tape_node is PENDING_TAPE:
+                    h._tape_node = None
+                if first is None:
+                    first = h
+            primary.append(first)
+        return primary
+
+    # -- eager replay fallback -------------------------------------------
+    def _replay(self, live):
+        """Re-execute the segment through the normal per-op dispatch path
+        (bulking suppressed) — bit-identical to never having bulked.
+        Used when the fused trace cannot compile."""
+        from . import autograd
+        from .ndarray.ndarray import NDArray, invoke_op
+
+        st = _BULK
+        prev = st.replaying
+        st.replaying = True
+        # replay under the segment's record-time autograd state (a
+        # cross-thread force could otherwise replay under the forcing
+        # thread's state); direct slot write — set_recording would
+        # recursively flush
+        prev_rec = autograd._STATE.recording
+        autograd._STATE.recording = self.recording
+        try:
+            env: List[Tuple[Any, ...]] = []
+            for prog in self.progs:
+                args = []
+                for s in prog.run_args:
+                    tag = s[0]
+                    if tag == "x":
+                        e = self.inputs[s[1]]
+                        # the handle carries tape identity, but only
+                        # while it still holds the record-time buffer —
+                        # a handle rebound since (in-place mutation of a
+                        # concrete input) must not leak its NEW value
+                        # into the deferred op.  (On-tape handles cannot
+                        # have been rebound: _check_inplace_record
+                        # raises on mutation while recording.)
+                        if e.handle is not None and \
+                                e.handle._data_ is e.value:
+                            args.append(e.handle)
+                        else:
+                            args.append(NDArray(e.value))
+                    elif tag == "r":
+                        args.append(env[s[1]][s[2]])
+                    else:
+                        args.append(s[1])
+                kw = dict(prog.statics)
+                for k, s in prog.kw_args:
+                    if s[0] == "x":
+                        kw[k] = self.inputs[s[1]].value
+                    else:
+                        kw[k] = env[s[1]][s[2]]
+                out = invoke_op(prog.name, tuple(args), kw)
+                env.append(tuple(out) if isinstance(out, (tuple, list))
+                           else (out,))
+            for i, prog in enumerate(self.progs):
+                for j in range(prog.n_outs):
+                    src = env[i][j]
+                    tn = src._tape_node
+                    for k, h in enumerate(live[i][j]):
+                        h._data_ = src._data_
+                        h._lazy_ = None
+                        if tn is not None and k == 0:
+                            # transplant the per-op tape identity onto the
+                            # surviving handle so cotangent routing (keyed
+                            # by object id) reaches it
+                            tn[0].outputs[tn[1]] = h
+                            h._tape_node = tn
+                        elif h._tape_node is PENDING_TAPE:
+                            h._tape_node = None
+        finally:
+            autograd._STATE.recording = prev_rec
+            st.replaying = prev
+
+
+def _resolve(spec, ext, env):
+    tag = spec[0]
+    if tag == "x":
+        return ext[spec[1]]
+    if tag == "r":
+        return env[spec[1]][spec[2]]
+    return spec[1]   # 'c': baked static value

@@ -15,6 +15,7 @@ import warnings
 from .. import optimizer as opt
 from ..base import MXTPUError
 from ..kvstore import KVStore, create as kv_create
+from ..ndarray.ndarray import NDArray, invoke_op
 from .parameter import Parameter, ParameterDict
 
 __all__ = ["Trainer"]
@@ -226,6 +227,7 @@ class Trainer:
         self._update(ignore_stale_grad)
 
     def _update(self, ignore_stale_grad=False):
+        local = []  # (index, param) updated in-process (not on kvstore)
         for i, param in enumerate(self._params):
             if param.grad_req == "null":
                 continue
@@ -235,10 +237,113 @@ class Trainer:
                 self._kvstore.pull(idx, out=param.list_data(), priority=-i)
                 param._consume_sparse_row_ids()
                 continue
-            for upd, arr, grad in zip(self._updaters, param.list_data(),
-                                      param.list_grad()):
-                upd(i, grad, arr)
-            param._consume_sparse_row_ids()  # grad consumed: new id epoch
+            local.append((i, param))
+        if local and not self._fused_sgd_update(local):
+            for i, param in local:
+                for upd, arr, grad in zip(self._updaters, param.list_data(),
+                                          param.list_grad()):
+                    upd(i, grad, arr)
+                param._consume_sparse_row_ids()  # grad consumed: new epoch
+        else:
+            for _, param in local:
+                param._consume_sparse_row_ids()
+
+    # -- fused multi-tensor update path ----------------------------------
+    def _fusable_sgd(self, local):
+        """Whether the optimizer-update loop can route through the fused
+        multi_sgd_update / multi_mp_sgd_update registry ops: plain SGD
+        (subclasses may override the rule), one device, dense weights and
+        grads.  Anything else falls back to the per-param updaters."""
+        if type(self._optimizer) is not opt.SGD:
+            return False
+        if len(self._updaters) != 1 or len(self._contexts) > 1:
+            return False
+        for _, param in local:
+            if param._grad_stype != "default":
+                return False
+            w, g = param.list_data()[0], param.list_grad()[0]
+            if w.stype != "default" or g.stype != "default":
+                return False
+        return True
+
+    def _fused_sgd_update(self, local):
+        """One engine dispatch per same-dtype parameter group instead of
+        one per parameter (parity: the reference's aggregate SGD update
+        via multi_sgd_update — MXNET_OPTIMIZER_AGGREGATION_SIZE), routed
+        through the registered preloaded_multi_(mp_)sgd(_mom)_update
+        fused ops.  The preloaded variants take lr/wd as trailing 1-D
+        tensors, which keeps the update bit-identical to the per-param
+        jitted rule (a python-float lr would constant-fold differently
+        under XLA) AND keeps the compiled signature stable across lr
+        schedule changes.  Under ``engine.bulk`` the whole update loop is
+        ONE bulked segment.  Returns False when not applicable."""
+        if not self._fusable_sgd(local):
+            return False
+        import jax.numpy as jnp
+
+        optimizer = self._optimizer
+        upd = self._updaters[0]
+
+        groups = {}  # weight dtype -> list of (index, weight, grad)
+        for i, param in local:
+            w = param.list_data()[0]
+            if i not in upd.states:
+                upd.states[i] = optimizer.create_state_multi_precision(
+                    i, w)
+                upd.states_synced[i] = True
+            groups.setdefault(str(w.dtype), []).append(
+                (i, w, param.list_grad()[0]))
+
+        momentum = optimizer.momentum
+        clip = (optimizer.clip_gradient
+                if optimizer.clip_gradient is not None else -1.0)
+        pending_states = []
+        for dtype, group in groups.items():
+            mp = optimizer.multi_precision and dtype == "bfloat16"
+            lrs, wds, data = [], [], []
+            for i, w, g in group:
+                optimizer._update_count(i)
+                lrs.append(optimizer._get_lr(i))
+                wds.append(optimizer._get_wd(i))
+                state = upd.states[i]
+                data.extend((w, g))
+                if mp:
+                    w32, mom = state
+                    if momentum != 0.0:
+                        data.append(NDArray(mom))
+                    data.append(NDArray(w32))
+                elif momentum != 0.0:
+                    data.append(NDArray(state))
+            data.append(NDArray(jnp.asarray(lrs, jnp.float32)))
+            data.append(NDArray(jnp.asarray(wds, jnp.float32)))
+            op_name = "preloaded_multi_%ssgd%s" % (
+                "mp_" if mp else "",
+                "_mom_update" if momentum != 0.0 else "_update")
+            kwargs = {"rescale_grad": optimizer.rescale_grad,
+                      "clip_gradient": clip, "num_weights": len(group)}
+            if momentum != 0.0:
+                kwargs["momentum"] = momentum
+            outs = invoke_op(op_name, tuple(data), kwargs)
+            if isinstance(outs, NDArray):
+                outs = (outs,)
+            stride = len(outs) // len(group)
+            for k, (i, w, _g) in enumerate(group):
+                res = outs[k * stride:(k + 1) * stride]
+                w._rebind_from(res[0])
+                pending_states.append((i, mp, res))
+        # state readback AFTER every group dispatched: reading ._data
+        # forces a bulk flush, so doing it per-group would split the
+        # bulked update into one segment per dtype group.  Here the
+        # first read flushes ONE segment holding the whole loop.
+        # (momentum=0 non-mp groups have no state and stay fully lazy.)
+        for i, mp, res in pending_states:
+            if mp and momentum != 0.0:
+                upd.states[i] = (res[2]._data, res[1]._data)
+            elif mp:
+                upd.states[i] = (res[1]._data, None)
+            elif momentum != 0.0:
+                upd.states[i] = res[1]._data
+        return True
 
     def save_states(self, fname):
         """Save optimizer/updater states (parity: save_states)."""

@@ -39,10 +39,18 @@ def _place(arr, ctx: Optional[Context]):
 
 
 class NDArray:
-    """Imperative tensor wrapping a jax.Array (or tracer, under hybridize)."""
+    """Imperative tensor wrapping a jax.Array (or tracer, under hybridize).
 
-    __slots__ = ("_data", "_ctx", "_grad", "_grad_req", "_tape_node",
-                 "__weakref__")
+    Under ``engine.bulk`` an NDArray can be *lazy*: ``_lazy_`` points at
+    one output of a pending bulk segment and ``_data_`` is None until the
+    segment flushes.  Every read of ``_data`` (the property below) is
+    therefore a sync point — asnumpy/item/float()/printing/shape access/
+    in-place arithmetic all force the owning segment to compile and run
+    before returning a concrete buffer.  Code that never bulks pays one
+    attribute check."""
+
+    __slots__ = ("_data_", "_lazy_", "_ctx", "_grad", "_grad_req",
+                 "_tape_node", "__weakref__")
 
     # numpy interop priority (parity: __array_priority__ in reference)
     __array_priority__ = 1000.0
@@ -59,6 +67,27 @@ class NDArray:
         self._tape_node = None
 
     # -- raw access ------------------------------------------------------
+    @property
+    def _data(self):
+        if self._lazy_ is not None:
+            self._force()
+        return self._data_
+
+    @_data.setter
+    def _data(self, value):
+        self._data_ = value
+        self._lazy_ = None
+
+    def _force(self):
+        """Flush the bulk segment backing this lazy handle (sync point)."""
+        lz = self._lazy_
+        if lz is not None:
+            lz.segment.flush()
+            if self._lazy_ is not None:  # defensive: flush must bind us
+                self._lazy_ = None
+                raise MXTPUError(
+                    "bulk segment flush did not materialize this NDArray")
+
     @property
     def data(self):
         return self._data
@@ -241,6 +270,22 @@ class NDArray:
             except AttributeError:
                 pass
         return self
+
+    def _rebind_from(self, other: "NDArray"):
+        """Adopt ``other``'s buffer, lazily when possible: a pending bulk
+        result transfers to this slot without forcing a flush (the fused
+        trainer update path stays lazy end-to-end).  Not for use inside
+        autograd.record() — tape identity stays with ``other``."""
+        lz = other._lazy_
+        if lz is not None:
+            try:
+                lz.segment.add_ref(lz.node, lz.out, self)
+            except engine._SegmentClosed:
+                return self._rebind(other._data)
+            self._data_ = None
+            self._lazy_ = lz
+            return self
+        return self._rebind(other._data_)
 
     def __setitem__(self, key, value):
         self._check_inplace_record()
@@ -513,14 +558,224 @@ def _wrap_result(res, ctx, cls=None):
     return cls(res, ctx=ctx)
 
 
+try:
+    from jax.core import Tracer as _Tracer
+except ImportError:  # pragma: no cover - jax layout drift
+    from jax._src.core import Tracer as _Tracer
+
+# sentinel: "this op was not bulked, dispatch it normally"
+_NOT_BULKED = object()
+
+
+def _new_lazy_handle(cls, lazyref):
+    """A lazy NDArray bound to one pending bulk-segment output.  Bypasses
+    __init__ (there is no buffer yet); both NDArray flavours are
+    slots+methods only, so direct slot initialization is complete."""
+    h = cls.__new__(cls)
+    h._data_ = None
+    h._lazy_ = lazyref
+    h._ctx = None
+    h._grad = None
+    h._grad_req = "null"
+    h._tape_node = None
+    return h
+
+
+def _bulk_record(seg, name: str, spec, args: tuple, kwargs: dict):
+    """Append one eager op to the open bulk segment and return lazy
+    handles, or _NOT_BULKED when the op must dispatch per-op (out=/ctx=
+    requested, tracer inputs, unfreezable statics, ...).  Fallthrough
+    needs no explicit flush: a fallthrough op reading a lazy input forces
+    the segment through the ``_data`` property."""
+    if kwargs.get("out") is not None or kwargs.get("ctx") is not None:
+        engine._STATS["fallthroughs"] += 1
+        return _NOT_BULKED
+
+    n_outs = spec.num_outputs
+    if callable(n_outs):
+        try:
+            n_outs = int(n_outs({k: v for k, v in kwargs.items()
+                                 if not isinstance(v, NDArray)}))
+        except Exception:
+            engine._STATS["fallthroughs"] += 1
+            return _NOT_BULKED
+        if n_outs == 1:
+            # a declared-arity op returning a 1-tuple is indistinguishable
+            # from a bare-array op post-hoc; keep per-op dispatch for the
+            # tuple-shaped return
+            engine._STATS["fallthroughs"] += 1
+            return _NOT_BULKED
+    elif n_outs is None:
+        # registry invariant (audit rule R002): an op that declares no
+        # num_outputs returns exactly one array
+        n_outs = 1
+
+    recording = autograd.is_recording()
+    kwargs = dict(kwargs)
+    # explicit out=None / ctx=None are dispatch directives, not op
+    # params — strip them exactly like the per-op path's pops (leaving
+    # them would hand the op fn an unexpected kwarg inside the trace)
+    kwargs.pop("out", None)
+    kwargs.pop("ctx", None)
+    # resolve runtime-state injection at RECORD time: the train flag is
+    # the record-time truth, and the RNG key stream is consumed in
+    # program order exactly as per-op dispatch would (bit-exact seeded
+    # runs).  The key itself is drawn only AFTER every bulkability check
+    # passes — a fallthrough op must not burn a key the normal dispatch
+    # path will draw again.
+    rng_wanted = _RNG_GATE.get(name, _ALWAYS)(kwargs)
+    if name in _NEEDS_TRAIN_FLAG and rng_wanted:
+        kwargs.setdefault("_training", autograd.is_training())
+    need_key = (name in _NEEDS_KEY and rng_wanted
+                and kwargs.get("_key") is None
+                and (kwargs.get("_training")
+                     or kwargs.get("mode") == "always"))
+
+    # pre-force foreign lazies OUTSIDE our segment lock (taking another
+    # segment's lock while holding ours could deadlock against a thread
+    # doing the reverse)
+    for a in args:
+        if isinstance(a, NDArray):
+            lz = a._lazy_
+            if lz is not None and lz.segment is not seg:
+                a._force()
+    for v in kwargs.values():
+        if isinstance(v, NDArray):
+            lz = v._lazy_
+            if lz is not None and lz.segment is not seg:
+                v._force()
+
+    run_args, sig_args = [], []
+    res_cls = NDArray
+    node_on_tape = False
+    tape_inputs = []   # ext input indices whose source NDArray is on tape
+    n_inputs0 = None
+    try:
+        # the whole record commits atomically against a cross-thread
+        # flush: ops must not land in a flushed segment (they would
+        # never run), and flush's snapshot must not tear mid-append
+        with seg._lock:
+            if seg.closed:
+                raise engine._SegmentClosed
+            n_inputs0 = len(seg.inputs)
+            for a in args:
+                if isinstance(a, NDArray):
+                    if type(a) is not NDArray and res_cls is NDArray:
+                        res_cls = type(a)
+                    lz = a._lazy_
+                    if lz is not None and lz.segment is seg:
+                        run_args.append(("r", lz.node, lz.out))
+                        sig_args.append(("r", lz.node, lz.out))
+                        node_on_tape |= (recording
+                                         and a._tape_node is not None)
+                        continue
+                    if lz is not None:
+                        # a foreign lazy raced in after the pre-pass:
+                        # bail, the per-op path forces it lock-free
+                        raise engine._SegmentClosed
+                    d = a._data_
+                    if isinstance(d, _Tracer):
+                        raise engine._Unfreezable("tracer input")
+                    on_tape = recording and autograd._on_tape(a)
+                    idx = seg.add_input(d, a, on_tape)
+                    run_args.append(("x", idx))
+                    sig_args.append(("x", idx))
+                    if on_tape:
+                        tape_inputs.append(idx)
+                    node_on_tape |= on_tape
+                elif isinstance(a, _Tracer):
+                    raise engine._Unfreezable("tracer input")
+                elif isinstance(a, jax.Array):
+                    idx = seg.add_input(a, None, False)
+                    run_args.append(("x", idx))
+                    sig_args.append(("x", idx))
+                else:
+                    run_args.append(("c", a))
+                    sig_args.append(("c", engine._freeze_static(a)))
+
+            kw_run, kw_sig, statics, statics_sig = [], [], {}, []
+            for k, v in kwargs.items():
+                if isinstance(v, NDArray):
+                    lz = v._lazy_
+                    if lz is not None and lz.segment is seg:
+                        kw_run.append((k, ("r", lz.node, lz.out)))
+                        kw_sig.append((k, ("r", lz.node, lz.out)))
+                        continue
+                    if lz is not None:
+                        raise engine._SegmentClosed
+                    d = v._data_
+                    if isinstance(d, _Tracer):
+                        raise engine._Unfreezable("tracer input")
+                    idx = seg.add_input(d, None, False)
+                    kw_run.append((k, ("x", idx)))
+                    kw_sig.append((k, ("x", idx)))
+                elif isinstance(v, _Tracer):
+                    raise engine._Unfreezable("tracer input")
+                elif isinstance(v, jax.Array):
+                    idx = seg.add_input(v, None, False)
+                    kw_run.append((k, ("x", idx)))
+                    kw_sig.append((k, ("x", idx)))
+                else:
+                    statics[k] = v
+                    statics_sig.append((k, engine._freeze_static(v)))
+
+            if need_key:
+                # all checks passed — the op IS bulked — so consuming
+                # the key here cannot double-draw with a fallthrough
+                from .. import random as _rnd
+                idx = seg.add_input(_rnd.next_key(), None, False)
+                kw_run.append(("_key", ("x", idx)))
+                kw_sig.append(("_key", ("x", idx)))
+
+            eligible = recording and spec.differentiable and node_on_tape
+            if eligible:
+                seg.mark_diff_inputs(tape_inputs)
+            node_sig = (name, tuple(sig_args), tuple(sorted(kw_sig)),
+                        tuple(sorted(statics_sig)), n_outs, eligible)
+            prog = engine._NodeProg(spec.fn, name, run_args, kw_run,
+                                    statics, n_outs, eligible, node_sig)
+            node_idx = seg.add_node(prog)
+
+            handles = []
+            for j in range(n_outs):
+                h = _new_lazy_handle(
+                    res_cls, engine._LazyRef(seg, node_idx, j))
+                if eligible:
+                    h._tape_node = engine.PENDING_TAPE
+                seg.add_ref(node_idx, j, h)
+                handles.append(h)
+    except (engine._Unfreezable, engine._SegmentClosed):
+        if n_inputs0 is not None:
+            # drop inputs this aborted record appended — orphans would
+            # pollute the segment's cache signature and vjp primal set
+            seg.rollback_inputs(n_inputs0)
+        engine._STATS["fallthroughs"] += 1
+        return _NOT_BULKED
+
+    if seg.full:
+        engine.flush_bulk()
+    return handles[0] if n_outs == 1 else tuple(handles)
+
+
 def invoke_op(name: str, args: tuple, kwargs: dict):
     """The imperative dispatch path (parity: MXImperativeInvokeEx →
     Imperative::Invoke → PushFCompute → Engine::PushAsync; see SURVEY.md
     §3.1).  Here: unwrap → jax op (PJRT async dispatch) → wrap; when the
     autograd tape is recording, compute through jax.vjp and record a
     TapeNode (parity: Imperative::RecordOp).
+
+    Under ``engine.bulk`` the op is not dispatched: it records into the
+    thread's BulkSegment and returns lazy handles (see engine.py) —
+    genuine op bulking, compiled once per segment signature.
     """
     spec = get_op(name)
+
+    seg = engine.current_segment()
+    if seg is not None and spec.bulkable and not _OUTPUT_MONITORS:
+        res = _bulk_record(seg, name, spec, args, kwargs)
+        if res is not _NOT_BULKED:
+            return res
+
     out = kwargs.pop("out", None)
     ctx = kwargs.pop("ctx", None)
 

@@ -154,7 +154,8 @@ def roi_align(data, rois, pooled_size=(7, 7), spatial_scale=1.0,
     return jax.vmap(one_roi)(rois)
 
 
-@register_op("quantize", aliases=("_contrib_quantize",), differentiable=False)
+@register_op("quantize", aliases=("_contrib_quantize",), differentiable=False,
+             num_outputs=3)
 def quantize(data, min_range, max_range, out_type="uint8"):
     scale = 255.0 / (max_range - min_range)
     q = jnp.clip(jnp.round((data - min_range) * scale), 0, 255)
@@ -427,7 +428,7 @@ def _mb_center(b):
 
 
 @register_op("multibox_target", aliases=("_contrib_MultiBoxTarget",),
-             differentiable=False)
+             differentiable=False, num_outputs=3)
 def multibox_target(anchor, label, cls_pred, overlap_threshold=0.5,
                     ignore_label=-1.0, negative_mining_ratio=-1.0,
                     negative_mining_thresh=0.5, minimum_negative_samples=0,

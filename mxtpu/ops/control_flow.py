@@ -34,7 +34,8 @@ def _unwrap_struct(out):
                  for o in out), False
 
 
-@register_op("foreach", aliases=("_foreach", "_contrib_foreach"))
+@register_op("foreach", aliases=("_foreach", "_contrib_foreach"),
+             bulkable=False)
 def foreach(*arrays, body=None, num_data=1):
     """Scan `body` over the leading axis of the data arrays.
 
@@ -59,7 +60,8 @@ def foreach(*arrays, body=None, num_data=1):
     return tuple(stacked) + tuple(final_states)
 
 
-@register_op("while_loop", aliases=("_while_loop", "_contrib_while_loop"))
+@register_op("while_loop", aliases=("_while_loop", "_contrib_while_loop"),
+             bulkable=False)
 def while_loop(*loop_vars, cond=None, func=None, max_iterations=None):
     """MXNet while_loop: run `func` while `cond` holds, at most
     max_iterations times.  func(loop_vars) -> (step_outputs, new_loop_vars).
@@ -96,7 +98,7 @@ def while_loop(*loop_vars, cond=None, func=None, max_iterations=None):
     return tuple(stacked) + tuple(final_vars) + (n_steps,)
 
 
-@register_op("cond", aliases=("_cond", "_contrib_cond"))
+@register_op("cond", aliases=("_cond", "_contrib_cond"), bulkable=False)
 def cond_op(pred, *inputs, then_func=None, else_func=None):
     """MXNet cond: run then_func(*inputs) or else_func(*inputs) depending
     on scalar pred.  Both branches must return the same structure.

@@ -7,7 +7,7 @@ lives in mxtpu/operator.py; this registry entry is what surfaces it as
 from ..base import MXTPUError, register_op
 
 
-@register_op("Custom")
+@register_op("Custom", bulkable=False)
 def Custom(*arrays, op_type=None, **params):
     """Invoke a user-registered custom operator (parity: nd.Custom)."""
     if op_type is None:

@@ -294,3 +294,24 @@ def _sample_multinomial(data, shape=None, get_prob=False, dtype="int32",
 def shuffle(data, _key=None):
     """Random permutation along the first axis (shuffle_op.cc)."""
     return jax.random.permutation(_key_of(_key), data, axis=0)
+
+
+# Every op in this module draws from the global RNG stream inside its
+# impl (_key_of(None) -> next_key()).  Under a fused bulk trace that
+# draw would happen at TRACE time and the key would be baked into the
+# cached program — a segment-cache hit would replay identical
+# "randomness".  The dropout/RNN family solves this with record-time
+# key injection (_NEEDS_KEY in ndarray.py); this family opts out of
+# bulking instead (callers pass _key explicitly for traced use).
+def _mark_rng_ops_unbulkable():
+    from ..base import _OP_REGISTRY
+    flipped = {}
+    for name, spec in list(_OP_REGISTRY.items()):
+        if getattr(spec.fn, "__module__", None) == __name__ \
+                and spec.bulkable:
+            if id(spec) not in flipped:  # keep ONE spec object per op
+                flipped[id(spec)] = spec._replace(bulkable=False)
+            _OP_REGISTRY[name] = flipped[id(spec)]
+
+
+_mark_rng_ops_unbulkable()

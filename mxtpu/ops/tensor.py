@@ -242,7 +242,12 @@ def sort(x, axis=-1, is_ascend=True):
     return out
 
 
-@register_op("topk", differentiable=False)
+def _topk_outputs(kw):
+    # ret_typ="both" returns (values, indices); every other mode one array
+    return 2 if kw.get("ret_typ") == "both" else 1
+
+
+@register_op("topk", differentiable=False, num_outputs=_topk_outputs)
 def topk(x, axis=-1, k=1, ret_typ="indices", is_ascend=False, dtype="float32"):
     # lax.top_k works on the last axis; move target axis there.
     xm = jnp.moveaxis(x, axis, -1)
@@ -393,7 +398,14 @@ def stack(*xs, axis=0):
     return jnp.stack(xs, axis=axis)
 
 
-@register_op("split", aliases=("SliceChannel",))
+def _split_outputs(kw):
+    """Kwarg-dependent arity (the _outputs_per_weight pattern): the
+    engine bulker and symbolic unpacking need the count pre-execution.
+    A count of 1 means a BARE array return (not a 1-tuple)."""
+    return int(kw.get("num_outputs", 1))
+
+
+@register_op("split", aliases=("SliceChannel",), num_outputs=_split_outputs)
 def split(x, num_outputs=1, axis=1, squeeze_axis=False):
     parts = jnp.split(x, num_outputs, axis=axis)
     if squeeze_axis:
@@ -401,7 +413,14 @@ def split(x, num_outputs=1, axis=1, squeeze_axis=False):
     return tuple(parts) if len(parts) > 1 else parts[0]
 
 
-@register_op("split_v2")
+def _split_v2_outputs(kw):
+    ios = kw.get("indices_or_sections", 1)
+    if isinstance(ios, (list, tuple)):
+        return len(ios) + 1
+    return int(ios)
+
+
+@register_op("split_v2", num_outputs=_split_v2_outputs)
 def split_v2(x, indices_or_sections=1, axis=0, squeeze_axis=False):
     """Split into equal sections (int) or at indices (tuple) (parity:
     mx.nd.split_v2 — src/operator/tensor/matrix_op.cc _split_v2)."""
@@ -902,13 +921,13 @@ def linalg_det(a):
     return jnp.linalg.det(a)
 
 
-@register_op("linalg_slogdet", aliases=("slogdet",))
+@register_op("linalg_slogdet", aliases=("slogdet",), num_outputs=2)
 def linalg_slogdet(a):
     sign, logdet = jnp.linalg.slogdet(a)
     return sign, logdet
 
 
-@register_op("linalg_gelqf")
+@register_op("linalg_gelqf", num_outputs=2)
 def linalg_gelqf(a):
     """LQ factorization of a full-rank wide matrix: A = L·Q with Q's rows
     orthonormal (reference gelqf contract), via QR of A^T."""
@@ -916,7 +935,7 @@ def linalg_gelqf(a):
     return jnp.swapaxes(r, -1, -2), jnp.swapaxes(q, -1, -2)
 
 
-@register_op("linalg_syevd")
+@register_op("linalg_syevd", num_outputs=2)
 def linalg_syevd(a):
     """Symmetric eigendecomposition: A = U^T·diag(w)·U with eigenvectors
     in U's ROWS (reference syevd layout; jax.eigh returns columns)."""

@@ -368,3 +368,62 @@ def test_cli_lint_path(tmp_path, capsys):
     out = capsys.readouterr().out
     assert rc == 1
     assert "L001" in out
+
+
+# -- op bulking rules (PR 3) -------------------------------------------
+
+def test_audit_flags_undeclared_multi_output():
+    """R002 also fires when a multi-output op declares NO num_outputs:
+    engine.bulk assumes undeclared ops are single-output."""
+
+    @register_op("_test_silent_multi_op", differentiable=False)
+    def _silent(x):
+        return x, x + 1
+
+    try:
+        rep = audit_registry(ops=["_test_silent_multi_op"])
+        bad = rep.filter(code="R002")
+        assert [d.subject for d in bad] == ["_test_silent_multi_op"]
+        assert bad.diagnostics[0].details == {"declared": None,
+                                              "observed": 2}
+    finally:
+        _OP_REGISTRY.pop("_test_silent_multi_op")
+
+
+_BULK_SYNC_SRC = '''
+from mxtpu import engine
+
+def fusion_broken(x):
+    with engine.bulk(32):
+        y = x * 2.0
+        v = y.asnumpy()
+        z = x + 1.0
+        f = float(z)
+        print(z)
+        engine.wait_all()
+    return v, f
+'''
+
+
+def test_trace_lint_flags_sync_in_bulk_region():
+    rep = lint_source(_BULK_SYNC_SRC, filename="bulk.py")
+    l5 = rep.filter(code="L005")
+    subjects = sorted(d.subject for d in l5.diagnostics)
+    assert subjects == ["asnumpy", "float", "print", "wait_all"], subjects
+    # WARNING severity: the default --fail-on error gate ignores it
+    assert all(d.severity == Severity.WARNING for d in l5.diagnostics)
+
+
+def test_trace_lint_bulk_rule_scoped_and_suppressible():
+    ok_src = '''
+from mxtpu import engine
+
+def fine(x):
+    with engine.bulk(32):
+        y = x * 2.0
+        z = y.asnumpy()  # trace-ok: deliberate mid-region readback
+    x.asnumpy()          # outside the region: not L005
+    return z
+'''
+    rep = lint_source(ok_src, filename="ok.py")
+    assert len(rep.filter(code="L005")) == 0, rep

@@ -59,9 +59,35 @@ def check_mxtpu():
         feats = Features()
         enabled = [f for f in feats.keys() if feats.is_enabled(f)]
         print("features     :", ", ".join(sorted(enabled)) or "none")
+        check_engine_bulk()
     except Exception as e:
         print("mxtpu        : IMPORT FAILED (%s: %s)"
               % (type(e).__name__, e))
+
+
+def check_engine_bulk():
+    """Exercise the op-bulking path once and report the segment-cache
+    counters (docs/engine.md): a healthy install shows one cache miss on
+    the first flush and a hit on the second, zero eager replays."""
+    print("----------Engine Bulking----------")
+    try:
+        import mxtpu as mx
+        from mxtpu import engine
+        print("sync mode    :", engine.is_sync())
+        print("ambient size :", engine.bulk_size(),
+              "(MXTPU_ENGINE_BULK_SIZE)")
+        engine.reset_bulk_stats()
+        x = mx.nd.array([1.0, 2.0, 3.0])
+        for _ in range(2):
+            with engine.bulk(8):
+                ((x * 2.0) + 1.0).asnumpy()  # trace-ok: diagnostic probe
+        st = engine.bulk_stats()
+        print("bulk cache   : %d hit / %d miss / %d flushes, "
+              "%d ops bulked, %d eager replays, %d cached programs"
+              % (st["cache_hits"], st["cache_misses"], st["flushes"],
+                 st["bulked_ops"], st["eager_replays"], st["cache_size"]))
+    except Exception as e:
+        print("bulking      : FAILED (%s: %s)" % (type(e).__name__, e))
 
 
 def check_devices(timeout_s=60):
