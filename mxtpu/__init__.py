@@ -58,6 +58,7 @@ _LAZY = {
     "numpy_extension": ".numpy_extension",
     "contrib": ".contrib",
     "preemption": ".preemption",
+    "resilience": ".resilience",
     "operator": ".operator",
     "horovod": ".horovod",
 }

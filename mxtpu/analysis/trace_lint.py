@@ -39,6 +39,12 @@ L005        WARNING   sync point inside an ``engine.bulk`` region: a call
                       that forces the pending segment (.asnumpy()/.item()/
                       float()/print()/wait_all()...) splits the fused
                       program — the ops after it start a new segment
+L006        WARNING   ``time.sleep`` or raw ``signal.signal`` outside
+                      ``mxtpu/resilience/`` and ``preemption.py`` — ad-hoc
+                      sleeps defeat the injectable-clock test discipline
+                      (use RetryPolicy / a fault plan's delay action) and
+                      raw signal handlers leak past exceptions (use
+                      ``preemption.install``, which restores dispositions)
 ==========  ========  =====================================================
 
 The L005 rule lints ``with ... bulk(...):`` bodies rather than traced
@@ -401,6 +407,53 @@ class _BulkRegionLinter(ast.NodeVisitor):
         self.generic_visit(node)
 
 
+def _resilience_exempt(filename: str) -> bool:
+    """L006 exemption: the resilience package owns the real sleeps (the
+    default RetryPolicy/plan sleep implementations) and preemption.py
+    owns the managed signal.signal calls."""
+    norm = filename.replace("\\", "/")
+    parts = norm.split("/")
+    return "resilience" in parts or parts[-1] == "preemption.py"
+
+
+class _HostHazardLinter(ast.NodeVisitor):
+    """L006: module-wide scan for ``time.sleep`` / raw ``signal.signal``
+    calls.  Unlike L001-L005 this is not scoped to traced regions — a
+    bare sleep anywhere in library code defeats the injectable-clock
+    test discipline, and a raw signal.signal leaks the handler when an
+    exception skips the restore path."""
+
+    def __init__(self, fname: str, lines: List[str], report: Report):
+        self.fname = fname
+        self.lines = lines
+        self.report = report
+
+    def _emit(self, node, subject, message):
+        if _trace_ok_suppressed(self.lines, node):
+            return
+        self.report.add(Diagnostic(
+            _PASS, "L006", Severity.WARNING, subject, message,
+            location="%s:%d" % (self.fname, node.lineno)))
+
+    def visit_Call(self, node):
+        name = _dotted_name(node.func)
+        if name == "time.sleep":
+            self._emit(
+                node, "time.sleep",
+                "time.sleep outside mxtpu/resilience: blocking sleeps "
+                "belong behind an injectable sleep (RetryPolicy(sleep=...) "
+                "/ a fault plan's delay action) so tests stay fast and "
+                "deterministic")
+        elif name == "signal.signal":
+            self._emit(
+                node, "signal.signal",
+                "raw signal.signal outside preemption.py: an exception "
+                "between install and restore leaks the handler — use "
+                "mxtpu.preemption.install/uninstall, which always "
+                "restores the previous disposition")
+        self.generic_visit(node)
+
+
 def lint_source(source: str, filename: str = "<string>") -> Report:
     """Lint one Python source string; returns a Report."""
     report = Report()
@@ -436,6 +489,8 @@ def lint_source(source: str, filename: str = "<string>") -> Report:
             linter.visit(stmt)
 
     _BulkRegionLinter(filename, lines, report).visit(tree)
+    if not _resilience_exempt(filename):
+        _HostHazardLinter(filename, lines, report).visit(tree)
     return report
 
 

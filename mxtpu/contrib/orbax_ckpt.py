@@ -43,9 +43,17 @@ def _trainer_tree(trainer):
     }
 
 
-def save_trainer(path: str, trainer) -> None:
+def save_trainer(path: str, trainer, retry=None) -> None:
     """Write params + optimizer state + step count.  Must run after the
-    trainer staged its parameters (one step, or step() bootstrap)."""
+    trainer staged its parameters (one step, or step() bootstrap).
+
+    ``retry``: optional :class:`mxtpu.resilience.RetryPolicy` for
+    transient storage failures.  The ``checkpoint.save`` fault-injection
+    site fires before orbax touches the path, so injected faults never
+    leave a partial checkpoint behind; a real mid-write failure may
+    leave one, which orbax refuses to overwrite — retries of that case
+    need a fresh path (documented limitation, docs/resilience.md)."""
+    from ..resilience.faults import inject as _inject
     import orbax.checkpoint as ocp
 
     if not trainer._params_sharded:
@@ -53,8 +61,16 @@ def save_trainer(path: str, trainer) -> None:
             "save_trainer: run one trainer.step first so parameters and "
             "optimizer state exist on the mesh")
     path = os.path.abspath(path)
-    with ocp.StandardCheckpointer() as ckptr:
-        ckptr.save(path, _trainer_tree(trainer))
+
+    def attempt():
+        _inject("checkpoint.save")
+        with ocp.StandardCheckpointer() as ckptr:
+            ckptr.save(path, _trainer_tree(trainer))
+
+    if retry is None:
+        attempt()
+    else:
+        retry.call(attempt)
 
 
 def restore_trainer(path: str, trainer) -> None:

@@ -389,6 +389,13 @@ class BulkSegment:
                 return
             _STATS["flushes"] += 1
             try:
+                # resilience injection site: a raise here exercises the
+                # flush-site error contract (the segment closes with the
+                # error, lazy handles are poisoned, the exception
+                # surfaces at this sync point) without needing a
+                # genuinely jit-hostile segment
+                from .resilience.faults import inject as _inject_fault
+                _inject_fault("engine.flush")
                 self._execute()
             except Exception as e:
                 self.error = e

@@ -29,8 +29,16 @@ import jax.numpy as jnp
 
 from .base import MXTPUError
 from .ndarray import NDArray
+from .resilience.faults import inject as _inject
+from .resilience.retry import RetryPolicy
 
-__all__ = ["KVStore", "create"]
+__all__ = ["KVStore", "UninitializedKeyError", "create"]
+
+
+class UninitializedKeyError(ValueError, MXTPUError):
+    """push/pull on a key that was never ``init()``-ed.  Subclasses BOTH
+    ValueError (the natural type for a bad argument) and MXTPUError (so
+    existing ``except MXTPUError`` callers keep working)."""
 
 
 def _key2str(key):
@@ -51,6 +59,29 @@ class KVStore:
         self._updater = None
         self._optimizer = None
         self._compression_params = None
+        self._retry_policy: Optional[RetryPolicy] = None
+
+    def set_retry_policy(self, policy: Optional[RetryPolicy]):
+        """Retry transient cross-worker reduce failures under ``policy``
+        (None disables; default off).  Multi-process caveat: the
+        cross-worker reduce is synchronized — only enable this when
+        every worker applies the same policy, so retries re-enter the
+        collective in lockstep (docs/resilience.md)."""
+        self._retry_policy = policy
+
+    def _require_init(self, k):
+        """Clear error for push/pull on an un-init-ed key (mirrors
+        get_op's close-match suggestion)."""
+        if k in self._store:
+            return
+        import difflib
+        close = difflib.get_close_matches(k, list(self._store), n=3,
+                                          cutoff=0.6)
+        hint = ("; did you mean %s?" % " or ".join(repr(c) for c in close)
+                if close else "")
+        raise UninitializedKeyError(
+            "key %r has not been initialized — call init(%r, value) "
+            "before push/pull%s" % (k, k, hint))
 
     # -- identity --------------------------------------------------------
     @property
@@ -84,7 +115,18 @@ class KVStore:
             if target is not None:
                 d = jax.device_put(d, target)
             acc = acc + d
-        return self._cross_worker_reduce(acc)
+        # the cross-worker leg is the transient-failure surface (DCN/ICI
+        # hiccups, a peer mid-restart): run it through the injection
+        # site + retry policy.  The reduce is idempotent — the local sum
+        # above is already materialized, so a retry re-sends, never
+        # re-adds.
+        def attempt():
+            _inject("kvstore.reduce")
+            return self._cross_worker_reduce(acc)
+
+        if self._retry_policy is None:
+            return attempt()
+        return self._retry_policy.call(attempt)
 
     def _cross_worker_reduce(self, arr):
         """Hook for dist types; identity for single-worker stores."""
@@ -95,8 +137,7 @@ class KVStore:
         keys, values = _pairs(key, value, allow_list_of_lists=True)
         for k, vlist in zip(keys, values):
             k = _key2str(k)
-            if k not in self._store:
-                raise MXTPUError(f"key {k} has not been initialized")
+            self._require_init(k)
             if not isinstance(vlist, (list, tuple)):
                 vlist = [vlist]
             if (self._updater is not None and len(vlist) == 1
@@ -134,8 +175,7 @@ class KVStore:
         keys, outs = _pairs(key, out, allow_list_of_lists=True)
         for k, olist in zip(keys, outs):
             k = _key2str(k)
-            if k not in self._store:
-                raise MXTPUError(f"key {k} has not been initialized")
+            self._require_init(k)
             if not isinstance(olist, (list, tuple)):
                 olist = [olist]
             for o in olist:
@@ -172,9 +212,8 @@ class KVStore:
                              % (len(keys), len(outs), len(rids)))
         results = []
         for k, o, rid in zip(keys, outs, rids):
-            dense = self._store.get(_key2str(k))  # raw jax array
-            if dense is None:
-                raise MXTPUError(f"key {k!r} not initialized")
+            self._require_init(_key2str(k))
+            dense = self._store[_key2str(k)]  # raw jax array
             ids = (rid.data if hasattr(rid, "data")
                    else jnp.asarray(rid)).astype(jnp.int32).ravel()
             ids = jnp.unique(ids)
@@ -263,6 +302,15 @@ class DistTPUSyncKVStore(KVStore):
         super().__init__(kv_type)
         from .parallel import collectives
         self._coll = collectives
+        # NO default retry policy: the cross-process reduce is a
+        # SYNCHRONIZED operation — one worker unilaterally re-entering
+        # it while its peers completed (or are still blocked in) the
+        # same round would pair the retry with the peers' NEXT
+        # collective, silently corrupting the reduction or hanging.
+        # Retrying here is only sound when every worker retries in
+        # lockstep (e.g. the whole push wrapped at a coordination
+        # barrier), so it stays an explicit set_retry_policy opt-in
+        # (docs/resilience.md spells out the caveat).
 
     @property
     def rank(self) -> int:

@@ -33,10 +33,29 @@ key-ring's derivation), its own seen-token penalty row, and attends
 only its own [0, pos] prefix, so every request's token stream is
 IDENTICAL to an isolated ``ShardedDecoder.generate`` call with the same
 seed (asserted in tests/test_serving.py).
+
+Failure paths (docs/resilience.md): a host-side exception in a
+per-slot path — admission prefill, the ``serving.step`` /
+``serving.admit`` fault-injection sites, the per-slot eos check —
+quarantines ONLY the offending slot: the request finishes with status
+``"failed"`` (or re-queues while it has ``retries`` left), the row is
+scrubbed and returned to the pool, and every OTHER in-flight request's
+token stream stays bit-identical to a fault-free run (per-slot RNG
+streams and penalty rows make the proof local — removing one lane
+cannot shift another lane's draws; asserted under injected faults in
+tests/test_serving_faults.py).  Per-request wall-clock deadlines evict
+expired requests at iteration boundaries with status ``"expired"``;
+bounded admission (``max_pending``) sheds load with a typed
+:class:`~mxtpu.resilience.LoadShedError` instead of unbounded queue
+growth.  A failure of the POOLED compiled step itself is pool-level by
+construction and propagates to the caller — on-device dispatch cannot
+attribute a fault to one lane, and the host-side per-slot paths above
+are where per-request failures actually arise.
 """
 
 from __future__ import annotations
 
+import time
 from typing import Any, Dict, List, Optional
 
 import jax
@@ -46,6 +65,9 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from .. import random as _random
 from ..ndarray import NDArray, array as nd_array
+from ..resilience import LoadShedError
+from ..resilience.counters import bump as _bump
+from ..resilience.faults import inject as _inject
 from .decode import ShardedDecoder, _bucket
 from .mesh import DeviceMesh
 from .sharding import ShardingRules
@@ -58,11 +80,11 @@ class Request:
 
     __slots__ = ("rid", "prompt", "max_new_tokens", "temperature",
                  "top_k", "top_p", "repetition_penalty", "seed",
-                 "eos_id")
+                 "eos_id", "deadline_at", "retries_left")
 
     def __init__(self, rid, prompt, max_new_tokens, temperature=0.0,
                  top_k=0, top_p=0.0, repetition_penalty=1.0, seed=None,
-                 eos_id=None):
+                 eos_id=None, deadline_at=None, retries=0):
         self.rid = rid
         self.prompt = prompt            # (1, Tp) int32 numpy
         self.max_new_tokens = int(max_new_tokens)
@@ -72,6 +94,8 @@ class Request:
         self.repetition_penalty = float(repetition_penalty or 1.0)
         self.seed = seed
         self.eos_id = eos_id
+        self.deadline_at = deadline_at  # absolute clock() value or None
+        self.retries_left = int(retries)
 
     @property
     def sampled(self):
@@ -136,7 +160,9 @@ class ContinuousBatchingEngine:
                  num_slots: int = 4, max_length: int = 256,
                  cache_dtype: str = "float32",
                  cache_spec: P = P(None, "tp", None, None),
-                 bucket_prefill: bool = True):
+                 bucket_prefill: bool = True,
+                 max_pending: Optional[int] = None, clock=None,
+                 history: int = 1024):
         self._dec = ShardedDecoder(block, mesh, rules, cache_spec,
                                    bucket_prefill)
         self._block = block
@@ -154,6 +180,21 @@ class ContinuousBatchingEngine:
         self._prompt_dtype = None
         self._steps = 0
         self._tokens_generated = 0
+        # -- resilience state (docs/resilience.md) -----------------------
+        self._max_pending = (None if max_pending is None
+                             else int(max_pending))
+        self._clock = clock if clock is not None else time.monotonic
+        self._status: Dict[int, str] = {}       # rid -> lifecycle status
+        self._errors: Dict[int, dict] = {}      # rid -> last error record
+        # status/error records of TERMINAL requests are kept for the
+        # last `history` completions only — a long-lived engine must not
+        # grow per-request bookkeeping without bound
+        self._history = max(int(history), 2 * self._num_slots)
+        self._done: List[int] = []              # terminal rids, oldest first
+        self._quarantined = 0
+        self._retries = 0
+        self._deadline_evictions = 0
+        self._shed = 0
 
     # -- introspection ---------------------------------------------------
     @property
@@ -176,15 +217,39 @@ class ContinuousBatchingEngine:
     def stats(self):
         return {"steps": self._steps,
                 "tokens_generated": self._tokens_generated,
+                "quarantined": self._quarantined,
+                "retries": self._retries,
+                "deadline_evictions": self._deadline_evictions,
+                "shed": self._shed,
                 "compiled_programs": sorted(
                     k[0] for k in self._dec._jit_cache)}
+
+    def status(self, rid) -> str:
+        """Lifecycle status of one request: ``queued`` / ``active`` /
+        ``ok`` / ``failed`` / ``expired`` (``unknown`` for a rid this
+        engine never issued)."""
+        return self._status.get(rid, "unknown")
+
+    def error(self, rid) -> Optional[dict]:
+        """The last error record of a quarantined/failed request
+        (``{"type", "error", "site", "step", "emitted"}``) or None.
+        Kept even after a successful retry, for observability."""
+        return self._errors.get(rid)
 
     # -- request intake --------------------------------------------------
     def submit(self, prompt_ids, max_new_tokens, temperature=0.0,
                top_k=0, top_p=0.0, repetition_penalty=1.0, seed=None,
-               eos_id=None) -> int:
+               eos_id=None, deadline_s=None, retries=0) -> int:
         """Queue one request; returns its id.  Sampling knobs follow the
-        ``generate`` contract (temperature=0 greedy; seed reproduces)."""
+        ``generate`` contract (temperature=0 greedy; seed reproduces).
+
+        ``deadline_s``: wall-clock budget in seconds (engine clock);
+        past it the request is evicted at the next iteration boundary
+        with status ``"expired"`` and its partial output.  ``retries``:
+        how many times a quarantined (step/admission-failed) request is
+        re-queued and restarted from scratch before it is marked
+        ``"failed"`` — a restart is bit-identical to a fresh submit
+        (per-slot RNG streams re-derive from the seed)."""
         prompt_ids = prompt_ids if isinstance(prompt_ids, NDArray) \
             else nd_array(prompt_ids)
         if prompt_ids.ndim != 2 or prompt_ids.shape[0] != 1:
@@ -196,14 +261,26 @@ class ContinuousBatchingEngine:
             raise ValueError(
                 "request needs %d cache positions > slot max_length %d"
                 % (Tp + int(max_new_tokens), self._max_length))
+        if self._max_pending is not None and \
+                len(self._queue) >= self._max_pending:
+            self._shed += 1
+            _bump("shed_requests")
+            raise LoadShedError(
+                "admission queue full (%d pending >= max_pending=%d): "
+                "request shed — back off and resubmit"
+                % (len(self._queue), self._max_pending))
         if self._prompt_dtype is None:
             self._prompt_dtype = prompt_ids.dtype
         rid = self._next_rid
         self._next_rid += 1
         prompt = onp.asarray(prompt_ids.asnumpy(), dtype=onp.int32)
+        deadline_at = (None if deadline_s is None
+                       else self._clock() + float(deadline_s))
         self._queue.append(Request(
             rid, prompt, max_new_tokens, temperature, top_k, top_p,
-            repetition_penalty, seed, eos_id))
+            repetition_penalty, seed, eos_id, deadline_at=deadline_at,
+            retries=retries))
+        self._status[rid] = "queued"
         return rid
 
     # -- pool plumbing ---------------------------------------------------
@@ -224,7 +301,7 @@ class ContinuousBatchingEngine:
             self._seen = jnp.zeros((self._num_slots, vocab), bool)
 
     # -- admission -------------------------------------------------------
-    def _finish(self, slot_idx_or_none, req, emitted, row):
+    def _finish(self, slot_idx_or_none, req, emitted, row, status="ok"):
         prompt = jnp.asarray(req.prompt, jnp.int32)
         if emitted:
             toks = jnp.stack(emitted)[:, row].reshape(1, -1)
@@ -233,8 +310,98 @@ class ContinuousBatchingEngine:
             out = prompt
         dt = self._prompt_dtype or onp.int32
         self._results[req.rid] = NDArray(out.astype(jnp.dtype(dt)))
+        self._status[req.rid] = status
+        self._done.append(req.rid)
+        if len(self._done) > self._history:
+            evicted = self._done[:-self._history]
+            del self._done[:-self._history]
+            for rid in evicted:
+                self._status.pop(rid, None)
+                self._errors.pop(rid, None)
         if slot_idx_or_none is not None:
             self._slots[slot_idx_or_none] = None
+
+    # -- failure paths ---------------------------------------------------
+    def _scrub_row(self, row):
+        """Return a cache row to the pool: zero its penalty bookkeeping.
+        The KV contents need no scrub — the next admission's slot
+        prefill overwrites [0, Tb) and per-row validity masks already
+        keep a dead lane's positions out of every other lane's
+        attention (the normal slot-reuse discipline)."""
+        if self._seen is not None:
+            self._seen = self._seen.at[row].set(False)
+
+    def _record_error(self, req, exc, site, emitted_n):
+        self._errors[req.rid] = {
+            "type": type(exc).__name__,
+            "error": str(exc),
+            "site": site,
+            "step": self._steps,
+            "emitted": emitted_n,
+        }
+
+    def _requeue_or_fail(self, req, exc, site, emitted=None, row=0):
+        """Shared tail of every per-request failure: re-queue while the
+        request has retries left (a from-scratch restart — bit-identical
+        to a fresh submit), else finish it with status ``failed`` and
+        its partial output."""
+        self._record_error(req, exc, site, len(emitted or []))
+        if req.retries_left > 0:
+            req.retries_left -= 1
+            self._retries += 1
+            _bump("retries")
+            self._status[req.rid] = "queued"
+            self._queue.append(req)
+        else:
+            self._finish(None, req, emitted or [], row, status="failed")
+
+    def _quarantine_request(self, req, exc, site, row, emitted=None):
+        """Shared quarantine tail (occupied slot and failed admission
+        alike): scrub the row's bookkeeping and fail/re-queue the
+        request."""
+        self._scrub_row(row)
+        self._quarantined += 1
+        _bump("quarantined_slots")
+        self._requeue_or_fail(req, exc, site, emitted=emitted, row=row)
+
+    def _quarantine(self, slot_idx, exc, site):
+        """Evict ONLY the offending slot: scrub the row, return it to
+        the pool, and fail/re-queue the request.  Every other slot's
+        state (its own RNG stream, penalty row, cache row) is untouched,
+        which is what keeps the other streams bit-identical to a
+        fault-free run."""
+        slot = self._slots[slot_idx]
+        self._slots[slot_idx] = None
+        self._quarantine_request(slot.req, exc, site, slot.row,
+                                 emitted=slot.emitted)
+
+    def _evict_expired(self):
+        """Iteration-boundary deadline sweep over active slots AND the
+        queue; expired requests finish with status ``expired`` and their
+        partial output."""
+        now = self._clock()
+
+        def expired(req):
+            return req.deadline_at is not None and now >= req.deadline_at
+
+        for i, slot in enumerate(self._slots):
+            if slot is not None and expired(slot.req):
+                self._slots[i] = None
+                self._scrub_row(slot.row)
+                self._deadline_evictions += 1
+                _bump("deadline_evictions")
+                self._finish(None, slot.req, slot.emitted, slot.row,
+                             status="expired")
+        if self._queue and any(expired(r) for r in self._queue):
+            keep = []
+            for req in self._queue:
+                if expired(req):
+                    self._deadline_evictions += 1
+                    _bump("deadline_evictions")
+                    self._finish(None, req, [], 0, status="expired")
+                else:
+                    keep.append(req)
+            self._queue = keep
 
     def _admit(self, req, slot_idx):
         """Compiled slot-prefill + first-token sample; mirrors the
@@ -243,6 +410,7 @@ class ContinuousBatchingEngine:
         prompt's last real logit row)."""
         from ..models.sampler import sample_next_token
 
+        _inject("serving.admit", key=req.rid)
         Tp = req.prompt.shape[1]
         bucketing = (self._dec._bucket_prefill
                      and not self._dec._block_has_moe())
@@ -282,6 +450,7 @@ class ContinuousBatchingEngine:
             self._finish(None, req, slot.emitted, slot_idx)
             return
         self._slots[slot_idx] = slot
+        self._status[req.rid] = "active"
 
     def _slot_done(self, slot):
         if len(slot.emitted) >= slot.req.max_new_tokens:
@@ -295,14 +464,21 @@ class ContinuousBatchingEngine:
 
     # -- one scheduler iteration ----------------------------------------
     def step(self):
-        """One iteration: admit queued requests into free slots, then
-        run ONE pooled decode step for every active slot.  Returns the
-        list of request ids finished this iteration."""
+        """One iteration: evict deadline-expired requests, admit queued
+        requests into free slots, then run ONE pooled decode step for
+        every active slot.  Returns the list of request ids finished
+        this iteration (any terminal status).
+
+        Per-slot failure handling: an exception in a per-slot host path
+        (admission prefill, the per-slot fault sites, the eos check)
+        quarantines that slot only — the iteration proceeds for every
+        other slot with bit-identical results."""
         from ..models.sampler import sample_next_token
 
+        finished_before = set(self._results)
+        self._evict_expired()
         if self._queue:
             self._ensure_pool(nd_array(self._queue[0].prompt))
-        finished_before = set(self._results)
         # admission at the iteration boundary (Orca-style): joiners
         # prefill now and take part in the very next pooled step
         for i in range(self._num_slots):
@@ -313,9 +489,26 @@ class ContinuousBatchingEngine:
                 if req.max_new_tokens <= 0:
                     self._finish(None, req, [], 0)
                     continue
-                self._admit(req, i)
+                try:
+                    self._admit(req, i)
+                except Exception as exc:
+                    # failed admission never occupied the slot (it is
+                    # assigned last in _admit); the shared tail scrubs
+                    # the penalty bookkeeping a partial admission may
+                    # have touched
+                    self._quarantine_request(req, exc, "serving.admit",
+                                             row=i)
 
         active = [i for i, s in enumerate(self._slots) if s is not None]
+        # per-slot fault site, consulted at the iteration boundary in
+        # slot order (deterministic hit counting): a raise here models a
+        # per-request step failure and quarantines exactly that slot
+        for i in list(active):
+            try:
+                _inject("serving.step", key=self._slots[i].req.rid)
+            except Exception as exc:
+                self._quarantine(i, exc, "serving.step")
+                active.remove(i)
         if active:
             pos = onp.zeros((self._num_slots,), onp.int32)
             for i in active:
@@ -331,7 +524,12 @@ class ContinuousBatchingEngine:
                 s = self._slots[i]
                 s.pos += 1
                 s.emitted.append(self._last_tokens)
-                if self._slot_done(s):
+                try:
+                    done = self._slot_done(s)
+                except Exception as exc:  # per-slot eos host read
+                    self._quarantine(i, exc, "serving.step")
+                    continue
+                if done:
                     self._finish(i, s.req, s.emitted, s.row)
         return [r for r in self._results if r not in finished_before]
 
@@ -382,9 +580,15 @@ class ContinuousBatchingEngine:
         (1, T_prompt + generated) NDArray}."""
         # non-convergence watchdog, sized ONCE from the total
         # outstanding work (every iteration with any active slot emits
-        # at least one token, so a healthy run can never exceed this)
-        outstanding = sum(r.max_new_tokens for r in self._queue) + sum(
-            s.req.max_new_tokens - len(s.emitted)
+        # at least one token, so a healthy run can never exceed this).
+        # A request with retries may restart from scratch up to
+        # retries_left more times, so its worst case is (1 + retries)
+        # full decodes.
+        outstanding = sum(
+            (1 + r.retries_left) * r.max_new_tokens
+            for r in self._queue) + sum(
+            (1 + s.req.retries_left) * s.req.max_new_tokens
+            - len(s.emitted)
             for s in self._slots if s is not None)
         limit = 4 * (outstanding + len(self._queue)
                      + self._num_slots + 1)

@@ -17,6 +17,8 @@ import logging
 import signal
 import threading
 
+from .resilience.faults import inject as _inject
+
 __all__ = ["install", "uninstall", "preempted", "reset",
            "PreemptionCheckpointHandler"]
 
@@ -44,12 +46,30 @@ def _handler(signum, frame):
             logging.exception("preemption checkpoint failed")
 
 
-def install(save_fn, signals=(signal.SIGTERM,)):
+def _wrap_save(save_fn, retry):
+    """Route the save through the ``checkpoint.save`` fault-injection
+    site and (optionally) a RetryPolicy — a flaky checkpoint target
+    inside the SIGTERM grace window is exactly when a bounded retry
+    earns its keep."""
+    def attempt():
+        _inject("checkpoint.save")
+        return save_fn()
+
+    if retry is None:
+        return attempt
+    return lambda: retry.call(attempt)
+
+
+def install(save_fn, signals=(signal.SIGTERM,), retry=None):
     """Install the preemption hook.  save_fn() is called once on the
-    first signal; training loops may also poll preempted()."""
+    first signal; training loops may also poll preempted().  ``retry``:
+    optional :class:`mxtpu.resilience.RetryPolicy` applied to the save
+    (transient checkpoint-write failures re-attempt inside the grace
+    window; exhaustion is logged, never propagated out of the signal
+    handler)."""
     with _lock:
         uninstall_locked()
-        _state["save_fn"] = save_fn
+        _state["save_fn"] = _wrap_save(save_fn, retry)
         _state["signals"] = tuple(signals)
         _state["flag"] = False
         for sig in signals:
@@ -83,15 +103,24 @@ def reset():
 class PreemptionCheckpointHandler:
     """Estimator event handler: saves parameters + trainer states on
     preemption and stops the fit loop at the next batch boundary
-    (plugs into gluon.contrib.estimator alongside CheckpointHandler)."""
+    (plugs into gluon.contrib.estimator alongside CheckpointHandler).
+
+    Also a context manager: ``__exit__`` always uninstalls the SIGTERM
+    hook, so an exception inside the fit loop cannot leak the handler
+    into unrelated later code (the event-handler API — ``batch_end`` /
+    ``train_end`` — keeps working unchanged)::
+
+        with PreemptionCheckpointHandler(prefix, net, trainer) as h:
+            est.fit(...)   # or a manual loop polling h.stop_training
+    """
 
     def __init__(self, model_prefix, net, trainer=None,
-                 signals=(signal.SIGTERM,)):
+                 signals=(signal.SIGTERM,), retry=None):
         self._prefix = model_prefix
         self._net = net
         self._trainer = trainer
         self.stop_training = False  # polled by estimator.fit
-        install(self._save, signals)
+        install(self._save, signals, retry=retry)
 
     def _save(self):
         self._net.save_parameters("%s-preempt.params" % self._prefix)
@@ -104,3 +133,10 @@ class PreemptionCheckpointHandler:
 
     def train_end(self, estimator, *args, **kwargs):
         uninstall()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        uninstall()
+        return False

@@ -1,0 +1,45 @@
+"""mxtpu.resilience — deterministic fault injection + the failure-path
+hardening it verifies (SURVEY §5: the reference's whole recovery story
+is checkpoint-restart; a production serving/training system also needs
+the first exception NOT to take down everything in flight).
+
+Three pieces (docs/resilience.md has the full story):
+
+- :mod:`~mxtpu.resilience.faults` — named injection sites woven into
+  hot paths (serving step/admission, KVStore cross-worker reduce,
+  checkpoint save, bulk-segment flush).  A *fault plan* (context
+  manager or the ``MXTPU_FAULT_PLAN`` env var) deterministically raises
+  a chosen exception or injects latency on the Nth hit of a site, so
+  chaos tests replay bit-for-bit.
+- :mod:`~mxtpu.resilience.retry` — :class:`RetryPolicy` (exponential
+  backoff, deadline budget, injectable clock/sleep), wired into KVStore
+  reductions and checkpoint writes.
+- the hardened failure paths themselves live where the hot code lives:
+  slot quarantine / deadlines / load shedding in
+  ``parallel/serving.py``, the always-uninstalling preemption handler
+  in ``preemption.py``.
+
+Typed serving rejections (:class:`LoadShedError`) and process-wide
+counters (:func:`counters`) are exported here.
+"""
+
+from ..base import MXTPUError
+from .counters import bump, counters, reset_counters
+from .faults import (SITES, FaultPlan, FaultRule, InjectedFault,
+                     active_plan, fault_plan, inject, reload_env_plan,
+                     site_stats)
+from .retry import RetryPolicy
+
+__all__ = [
+    "FaultPlan", "FaultRule", "InjectedFault", "fault_plan", "inject",
+    "active_plan", "site_stats", "reload_env_plan", "SITES",
+    "RetryPolicy", "LoadShedError",
+    "bump", "counters", "reset_counters",
+]
+
+
+class LoadShedError(MXTPUError):
+    """Typed rejection raised by bounded admission: the serving queue is
+    at ``max_pending`` and the engine sheds the request instead of
+    growing the queue without bound.  Callers catch this to back off or
+    route elsewhere; it never poisons in-flight work."""

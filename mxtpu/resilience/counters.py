@@ -1,0 +1,46 @@
+"""Process-wide resilience counters.
+
+One flat dict, bumped by the fault injector (faults fired / delays
+injected), the retry machinery (retries / exhaustions), and the serving
+engine (quarantines / deadline evictions / load sheds).  Surfaced by
+``tools/diagnose.py`` and the degraded-decode bench so a bug report
+carries the failure-handling story alongside the perf story.
+
+Lives in its own module so ``faults``, ``retry`` and the subsystems that
+instrument themselves can all import it without cycles.
+"""
+
+from __future__ import annotations
+
+import threading
+
+__all__ = ["bump", "counters", "reset_counters"]
+
+_LOCK = threading.Lock()
+
+_COUNTERS = {
+    "faults_injected": 0,      # raise-action rules fired
+    "faults_delayed": 0,       # delay-action rules fired
+    "retries": 0,              # backoff sleeps taken by RetryPolicy.call
+    "retry_exhaustions": 0,    # calls that re-raised after the budget
+    "quarantined_slots": 0,    # serving slots scrubbed after a fault
+    "deadline_evictions": 0,   # requests evicted past their deadline
+    "shed_requests": 0,        # submissions rejected by max_pending
+}
+
+
+def bump(name: str, n: int = 1) -> None:
+    with _LOCK:
+        _COUNTERS[name] = _COUNTERS.get(name, 0) + n
+
+
+def counters() -> dict:
+    """Snapshot of the process-wide resilience counters."""
+    with _LOCK:
+        return dict(_COUNTERS)
+
+
+def reset_counters() -> None:
+    with _LOCK:
+        for k in _COUNTERS:
+            _COUNTERS[k] = 0

@@ -1,0 +1,483 @@
+"""mxtpu.resilience: deterministic fault injection, retry policy, and
+the hardened failure paths it verifies (kvstore reduce retry, checkpoint
+save retry, preemption handler hygiene, bit-exact checkpoint-resume).
+
+Test discipline (ISSUE 4 acceptance): NO real sleeps — every delay goes
+through an injected recorder/clock — and every fault scenario is
+counter-driven, so reruns are bit-for-bit identical."""
+
+import os
+import signal
+
+import numpy as np
+import pytest
+
+import mxtpu as mx
+from mxtpu import autograd, engine, nd, preemption
+from mxtpu.base import MXTPUError
+from mxtpu.gluon import Trainer, nn
+from mxtpu.kvstore import UninitializedKeyError
+from mxtpu.resilience import (FaultPlan, InjectedFault, RetryPolicy,
+                              counters, fault_plan, reset_counters)
+from mxtpu.resilience.faults import SITES, FaultRule, inject, \
+    reload_env_plan
+
+
+# ------------------------------------------------------------ fault plans
+
+class TestPlanGrammar:
+    def test_full_rule(self):
+        r = FaultRule.parse("serving.step#7@2x3:raise=OSError(net down)")
+        assert (r.site, r.key, r.at, r.count) == ("serving.step", "7", 2, 3)
+        assert r.exc is OSError and r.message == "net down"
+
+    def test_defaults(self):
+        r = FaultRule.parse("engine.flush:raise")
+        assert (r.at, r.count, r.always, r.period) == (1, 1, False, None)
+        assert r.exc is InjectedFault
+
+    def test_period_defaults_start(self):
+        r = FaultRule.parse("kvstore.reduce%100:raise")
+        assert r.period == 100 and r.at == 100
+
+    def test_delay(self):
+        r = FaultRule.parse("checkpoint.save:delay=0.5")
+        assert r.action == "delay" and r.seconds == 0.5
+
+    def test_exception_resolution(self):
+        assert FaultRule.parse("s:raise=TimeoutError").exc is TimeoutError
+        assert FaultRule.parse("s:raise=MXTPUError").exc is MXTPUError
+        assert FaultRule.parse(
+            "s:raise=mxtpu.base.MXTPUError").exc is MXTPUError
+
+    @pytest.mark.parametrize("bad", [
+        "no-action-separator", "site:explode", "site:raise=NotAClass",
+        "site@@2:raise", "site:delay=fast",
+    ])
+    def test_bad_rules_rejected(self, bad):
+        with pytest.raises(ValueError):
+            FaultRule.parse(bad)
+
+    def test_multi_rule_plan(self):
+        p = FaultPlan("a.site@1:raise=OSError; b.site:delay=0.1")
+        assert len(p.rules) == 2
+
+
+@pytest.mark.parametrize("site", SITES)
+class TestFaultMatrix:
+    """Each documented site × fail-once / fail-always / latency, at the
+    injector level (the subsystem wirings are exercised below and in
+    test_serving_faults.py)."""
+
+    def test_fail_once(self, site):
+        with fault_plan("%s@2:raise=ValueError(boom)" % site) as p:
+            inject(site)                       # hit 1: clean
+            with pytest.raises(ValueError, match="boom"):
+                inject(site)                   # hit 2: fires
+            inject(site)                       # hit 3: clean again
+        assert p.stats()[site] == {"hits": 3, "fired": 1}
+
+    def test_fail_always(self, site):
+        with fault_plan("%s@2+:raise=OSError" % site) as p:
+            inject(site)
+            for _ in range(3):
+                with pytest.raises(OSError):
+                    inject(site)
+        assert p.stats()[site] == {"hits": 4, "fired": 3}
+
+    def test_latency(self, site):
+        sleeps = []
+        with fault_plan("%s@1+:delay=0.25" % site, sleep=sleeps.append):
+            inject(site)
+            inject(site)
+        assert sleeps == [0.25, 0.25]  # recorded, never slept
+
+
+class TestPlanSemantics:
+    def test_key_scoping(self):
+        """#KEY rules only count matching inject(site, key=...) calls."""
+        with fault_plan("s.x#5@2:raise=OSError") as p:
+            inject("s.x", key=4)
+            inject("s.x", key=5)               # hit 1 for the rule
+            inject("s.x", key=4)
+            with pytest.raises(OSError):
+                inject("s.x", key=5)           # hit 2: fires
+        assert p.stats()["s.x"] == {"hits": 2, "fired": 1}
+
+    def test_period_fires_every_nth(self):
+        fired = []
+        with fault_plan("s.y%3:raise=OSError"):
+            for i in range(1, 10):
+                try:
+                    inject("s.y")
+                    fired.append(False)
+                except OSError:
+                    fired.append(True)
+        assert [i + 1 for i, f in enumerate(fired) if f] == [3, 6, 9]
+
+    def test_replay_bit_identical(self):
+        """Re-entering one plan object resets its counters: two runs of
+        the same scenario fire on identical hits."""
+        plan = fault_plan("s.z@2x2:raise=OSError")
+
+        def run():
+            hits = []
+            with plan:
+                for _ in range(5):
+                    try:
+                        inject("s.z")
+                        hits.append("ok")
+                    except OSError:
+                        hits.append("fault")
+            return hits
+
+        assert run() == run() == ["ok", "fault", "fault", "ok", "ok"]
+
+    def test_default_message_names_site_and_hit(self):
+        with fault_plan("s.w:raise"):
+            with pytest.raises(InjectedFault, match=r"s\.w.*hit 1"):
+                inject("s.w")
+
+    def test_no_plan_is_noop(self):
+        inject("anything.at.all")  # must not raise
+
+    def test_fault_plan_rebinds_sleep_on_existing_plan(self):
+        """Passing sleep= with an already-built FaultPlan must not be
+        silently dropped (it would reintroduce real sleeps)."""
+        sleeps = []
+        plan = FaultPlan("s.q@1+:delay=5.0")
+        with fault_plan(plan, sleep=sleeps.append):
+            inject("s.q")
+        assert sleeps == [5.0]
+
+    def test_env_var_plan(self, monkeypatch):
+        monkeypatch.setenv("MXTPU_FAULT_PLAN", "env.site@1:raise=OSError")
+        reload_env_plan()
+        try:
+            with pytest.raises(OSError):
+                inject("env.site")
+            inject("env.site")  # fail-once spent
+        finally:
+            monkeypatch.delenv("MXTPU_FAULT_PLAN")
+            reload_env_plan()
+        inject("env.site")  # plan gone
+
+    def test_context_plan_wins_over_env(self, monkeypatch):
+        monkeypatch.setenv("MXTPU_FAULT_PLAN", "c.site@1+:raise=OSError")
+        reload_env_plan()
+        try:
+            with fault_plan("other.site:raise"):
+                inject("c.site")  # env plan masked by the scoped plan
+        finally:
+            monkeypatch.delenv("MXTPU_FAULT_PLAN")
+            reload_env_plan()
+
+
+# ------------------------------------------------------------ retry policy
+
+class _Clock:
+    def __init__(self):
+        self.t = 0.0
+        self.sleeps = []
+
+    def now(self):
+        return self.t
+
+    def sleep(self, d):
+        self.sleeps.append(d)
+        self.t += d
+
+    def policy(self, **kw):
+        kw.setdefault("base_delay", 0.1)
+        kw.setdefault("multiplier", 2.0)
+        kw.setdefault("max_delay", 1.0)
+        return RetryPolicy(clock=self.now, sleep=self.sleep, **kw)
+
+
+class TestRetryPolicy:
+    def test_succeeds_after_transient_failures(self):
+        clk = _Clock()
+        n = [0]
+
+        def flaky():
+            n[0] += 1
+            if n[0] < 3:
+                raise OSError("transient")
+            return 42
+
+        assert clk.policy(max_attempts=4).call(flaky) == 42
+        assert clk.sleeps == [0.1, 0.2]  # exponential, capped schedule
+
+    def test_exhaustion_raises_original_with_attempt_count(self):
+        clk = _Clock()
+        n = [0]
+
+        def dead():
+            n[0] += 1
+            raise OSError("still down")
+
+        with pytest.raises(OSError, match="still down") as ei:
+            clk.policy(max_attempts=3).call(dead)
+        assert n[0] == 3
+        assert ei.value.mxtpu_retry_attempts == 3
+        assert clk.sleeps == [0.1, 0.2]
+
+    def test_deadline_budget_stops_early(self):
+        clk = _Clock()
+        pol = clk.policy(max_attempts=10, deadline=0.25)
+
+        def dead():
+            raise OSError("down")
+
+        with pytest.raises(OSError) as ei:
+            pol.call(dead)
+        # 0.1 slept, then the 0.2 backoff would cross the 0.25s budget
+        assert clk.sleeps == [0.1]
+        assert ei.value.mxtpu_retry_attempts == 2
+
+    def test_max_delay_caps_backoff(self):
+        clk = _Clock()
+        pol = clk.policy(max_attempts=6)
+
+        def dead():
+            raise OSError("down")
+
+        with pytest.raises(OSError):
+            pol.call(dead)
+        assert clk.sleeps == [0.1, 0.2, 0.4, 0.8, 1.0]
+
+    def test_non_retryable_propagates_immediately(self):
+        clk = _Clock()
+        pol = clk.policy(max_attempts=5, retry_on=(OSError,))
+
+        def typo():
+            raise TypeError("bug, not weather")
+
+        with pytest.raises(TypeError):
+            pol.call(typo)
+        assert clk.sleeps == []
+
+    def test_counters(self):
+        reset_counters()
+        clk = _Clock()
+        n = [0]
+
+        def flaky():
+            n[0] += 1
+            if n[0] < 2:
+                raise OSError("x")
+            return 1
+
+        clk.policy(max_attempts=3).call(flaky)
+        with pytest.raises(OSError):
+            clk.policy(max_attempts=2).call(
+                lambda: (_ for _ in ()).throw(OSError("y")))
+        c = counters()
+        assert c["retries"] == 2 and c["retry_exhaustions"] == 1
+
+    def test_wrap_decorator(self):
+        clk = _Clock()
+        n = [0]
+
+        @clk.policy(max_attempts=2).wrap
+        def flaky():
+            n[0] += 1
+            if n[0] < 2:
+                raise OSError
+            return "ok"
+
+        assert flaky() == "ok"
+
+    def test_max_attempts_validated(self):
+        with pytest.raises(ValueError):
+            RetryPolicy(max_attempts=0)
+
+
+# ------------------------------------------------------------ kvstore
+
+class TestKVStoreResilience:
+    def _store(self):
+        kv = mx.kv.create("local")
+        kv.init("conv0_weight", nd.ones((2, 2)))
+        return kv
+
+    def test_push_uninitialized_key_is_clear_valueerror(self):
+        kv = self._store()
+        with pytest.raises(ValueError, match=r"conv0_weights.*init.*"
+                           r"did you mean 'conv0_weight'"):
+            kv.push("conv0_weights", nd.ones((2, 2)))
+
+    def test_pull_uninitialized_key_is_clear_valueerror(self):
+        kv = self._store()
+        out = nd.zeros((2, 2))
+        with pytest.raises(ValueError, match="has not been initialized"):
+            kv.pull("conv0_wieght", out=out)
+        with pytest.raises(ValueError, match="has not been initialized"):
+            kv.row_sparse_pull("nope", out=out,
+                               row_ids=nd.array([0], dtype="int64"))
+
+    def test_uninitialized_key_error_type_compat(self):
+        """New type satisfies both except ValueError and the historical
+        except MXTPUError."""
+        assert issubclass(UninitializedKeyError, ValueError)
+        assert issubclass(UninitializedKeyError, MXTPUError)
+        kv = self._store()
+        with pytest.raises(MXTPUError):
+            kv.push("missing", nd.ones((2, 2)))
+
+    def test_reduce_fault_without_policy_raises(self):
+        kv = self._store()
+        with fault_plan("kvstore.reduce@1:raise=OSError(dcn)"):
+            with pytest.raises(OSError, match="dcn"):
+                kv.push("conv0_weight", nd.ones((2, 2)))
+
+    def test_reduce_retry_recovers_and_value_correct(self):
+        kv = self._store()
+        clk = _Clock()
+        kv.set_retry_policy(clk.policy(max_attempts=3))
+        with fault_plan("kvstore.reduce@1:raise=OSError(dcn)") as p:
+            kv.push("conv0_weight", nd.full((2, 2), 7.0))
+        assert clk.sleeps == [0.1]         # exactly one backoff
+        assert p.stats()["kvstore.reduce"]["fired"] == 1
+        out = nd.zeros((2, 2))
+        kv.pull("conv0_weight", out=out)
+        np.testing.assert_array_equal(out.asnumpy(),
+                                      np.full((2, 2), 7.0, np.float32))
+
+    def test_reduce_retry_exhaustion_raises_original(self):
+        kv = self._store()
+        clk = _Clock()
+        kv.set_retry_policy(clk.policy(max_attempts=3))
+        with fault_plan("kvstore.reduce@1+:raise=OSError(dcn dead)"):
+            with pytest.raises(OSError, match="dcn dead") as ei:
+                kv.push("conv0_weight", nd.ones((2, 2)))
+        assert ei.value.mxtpu_retry_attempts == 3
+
+
+# ------------------------------------------------------------ engine.flush
+
+class TestEngineFlushSite:
+    def test_fault_surfaces_at_sync_point_then_recovers(self):
+        x = nd.array([1.0, 2.0, 3.0])
+        with fault_plan("engine.flush@1:raise=OSError(flush)"):
+            with pytest.raises(OSError, match="flush"):
+                with engine.bulk(8):
+                    ((x * 2.0) + 1.0).asnumpy()  # trace-ok: sync IS the test
+        # fail-once spent: the next segment compiles and runs clean
+        with engine.bulk(8):
+            y = (x * 2.0) + 1.0
+        np.testing.assert_array_equal(y.asnumpy(), [3.0, 5.0, 7.0])
+
+    def test_poisoned_handle_reraises(self):
+        x = nd.array([1.0, 2.0])
+        with fault_plan("engine.flush@1:raise=OSError(gone)"):
+            with pytest.raises(OSError):
+                with engine.bulk(8):
+                    y = x + 1.0
+            with pytest.raises(MXTPUError, match="previously failed"):
+                y.asnumpy()  # trace-ok: forcing the poisoned handle
+
+
+# ------------------------------------------------------------ preemption
+
+class TestPreemptionHardening:
+    def test_context_manager_uninstalls_on_exception(self):
+        net = nn.Dense(2, in_units=2)
+        with pytest.raises(RuntimeError, match="fit blew up"):
+            with preemption.PreemptionCheckpointHandler(
+                    "/tmp/unused", net, signals=(signal.SIGUSR1,)):
+                raise RuntimeError("fit blew up")
+        assert signal.getsignal(signal.SIGUSR1) is not preemption._handler
+        preemption.reset()
+
+    def test_event_handler_api_still_uninstalls(self):
+        net = nn.Dense(2, in_units=2)
+        h = preemption.PreemptionCheckpointHandler(
+            "/tmp/unused", net, signals=(signal.SIGUSR1,))
+        h.train_end(None)
+        assert signal.getsignal(signal.SIGUSR1) is not preemption._handler
+        preemption.reset()
+
+    def test_checkpoint_save_retry_inside_signal_handler(self):
+        calls = []
+        clk = _Clock()
+        preemption.install(lambda: calls.append(1),
+                           signals=(signal.SIGUSR1,),
+                           retry=clk.policy(max_attempts=3))
+        try:
+            with fault_plan("checkpoint.save@1:raise=OSError(nfs)") as p:
+                os.kill(os.getpid(), signal.SIGUSR1)
+            assert calls == [1]            # saved on the retry attempt
+            assert clk.sleeps == [0.1]
+            assert p.stats()["checkpoint.save"]["fired"] == 1
+        finally:
+            preemption.uninstall()
+            preemption.reset()
+
+    def test_checkpoint_save_exhaustion_never_escapes_handler(self):
+        calls = []
+        clk = _Clock()
+        preemption.install(lambda: calls.append(1),
+                           signals=(signal.SIGUSR1,),
+                           retry=clk.policy(max_attempts=2))
+        try:
+            with fault_plan("checkpoint.save@1+:raise=OSError(dead)"):
+                os.kill(os.getpid(), signal.SIGUSR1)  # must not propagate
+            assert calls == []
+            assert preemption.preempted()
+        finally:
+            preemption.uninstall()
+            preemption.reset()
+
+
+def test_preemption_checkpoint_resume_bit_exact(tmp_path):
+    """The full SURVEY-§5 recovery story, end to end: save on an
+    injected preemption signal mid-training → restore params + trainer
+    (momentum) states into a fresh net → continue → the final weights
+    are BIT-identical to an uninterrupted run."""
+
+    def fresh():
+        mx.random.seed(5)
+        net = nn.Dense(3, in_units=4)
+        net.initialize()
+        tr = Trainer(net.collect_params(), "sgd",
+                     {"learning_rate": 0.1, "momentum": 0.9})
+        return net, tr
+
+    R = np.random.RandomState(0)
+    data = [nd.array(R.randn(2, 4).astype(np.float32)) for _ in range(6)]
+    labels = [nd.array(R.randn(2, 3).astype(np.float32))
+              for _ in range(6)]
+
+    def train(net, tr, lo, hi):
+        for i in range(lo, hi):
+            with autograd.record():
+                loss = ((net(data[i]) - labels[i]) ** 2).sum()
+            loss.backward()
+            tr.step(1)
+
+    # uninterrupted reference
+    net1, tr1 = fresh()
+    train(net1, tr1, 0, 6)
+
+    # interrupted: preempted after step 3, checkpointed by the handler
+    prefix = str(tmp_path / "model")
+    net2, tr2 = fresh()
+    with preemption.PreemptionCheckpointHandler(
+            prefix, net2, tr2, signals=(signal.SIGUSR2,)) as h:
+        train(net2, tr2, 0, 3)
+        os.kill(os.getpid(), signal.SIGUSR2)
+        h.batch_end(None)
+        assert h.stop_training
+    preemption.reset()
+    assert signal.getsignal(signal.SIGUSR2) is not preemption._handler
+
+    # restore into a FRESH process-equivalent and finish the run
+    net3, tr3 = fresh()
+    net3.load_parameters(prefix + "-preempt.params")
+    tr3.load_states(prefix + "-preempt.states")
+    train(net3, tr3, 3, 6)
+    np.testing.assert_array_equal(net3.weight.data().asnumpy(),
+                                  net1.weight.data().asnumpy())
+    np.testing.assert_array_equal(net3.bias.data().asnumpy(),
+                                  net1.bias.data().asnumpy())

@@ -1,0 +1,263 @@
+"""ContinuousBatchingEngine failure paths under deterministic fault
+injection (ISSUE 4 tentpole): a step/prefill fault quarantines ONLY the
+offending slot and every other in-flight request's token stream stays
+bit-identical to the fault-free run; quarantined requests retry to
+completion; deadlines evict at iteration boundaries (injected clock —
+no real sleeps); bounded admission sheds with a typed error; the engine
+survives N consecutive poisoned admissions.
+
+Compile discipline follows tests/test_serving.py: ONE module-scoped
+engine serves every scenario (faults are host-side, so no new programs
+compile).  It is built over an injected fake clock from the start —
+requests without deadlines never consult it, and the deadline test
+advances it without compiling a second engine."""
+
+import numpy as np
+import pytest
+
+import mxtpu as mx
+from mxtpu import nd
+from mxtpu.models.transformer import (llama_tiny,
+                                      transformer_lm_sharding_rules)
+from mxtpu.parallel import ContinuousBatchingEngine, ShardedDecoder, \
+    make_mesh
+from mxtpu.resilience import LoadShedError, fault_plan
+
+MAXLEN = 32
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    mx.random.seed(77)
+    net = llama_tiny(vocab_size=50)
+    net.initialize()
+    return net
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    return make_mesh(dp=1, tp=2)
+
+
+@pytest.fixture(scope="module")
+def isolated(tiny, mesh):
+    return ShardedDecoder(tiny, mesh, transformer_lm_sharding_rules())
+
+
+CLK = {"t": 0.0}  # the module engine's injected clock
+
+
+@pytest.fixture(scope="module")
+def eng(tiny, mesh):
+    return ContinuousBatchingEngine(tiny, mesh,
+                                    transformer_lm_sharding_rules(),
+                                    num_slots=2, max_length=MAXLEN,
+                                    clock=lambda: CLK["t"])
+
+
+def _prompts(rng, lengths, vocab=50):
+    return [nd.array(rng.randint(0, vocab, (1, t)), dtype="int32")
+            for t in lengths]
+
+
+def _want(isolated, p, n, **kw):
+    return isolated.generate(p, max_new_tokens=n, max_length=MAXLEN,
+                             **kw).asnumpy()
+
+
+def test_quarantine_preserves_other_streams_and_retry_completes(
+        eng, isolated):
+    """The acceptance scenario: an injected ``serving.step`` failure in
+    the slot decoding request r2 quarantines only that slot — r1 and r3
+    (which backfills the freed row) decode streams bit-identical to the
+    fault-free isolated runs — and r2's retry restarts from scratch and
+    ALSO completes bit-identical."""
+    rng = np.random.RandomState(3)
+    p1, p2, p3 = _prompts(rng, (3, 5, 4))
+    before = eng.stats
+    r1 = eng.submit(p1, 6)
+    r2 = eng.submit(p2, 5, retries=1)
+    r3 = eng.submit(p3, 4)
+    # key the rule to r2's rid: only ITS step-site hits count
+    with fault_plan("serving.step#%d@2:raise=RuntimeError(poisoned)"
+                    % r2) as plan:
+        res = eng.run()
+    assert plan.stats()["serving.step"]["fired"] == 1
+    np.testing.assert_array_equal(res[r1].asnumpy(),
+                                  _want(isolated, p1, 6))
+    np.testing.assert_array_equal(res[r3].asnumpy(),
+                                  _want(isolated, p3, 4))
+    # the retried request completed, bit-identical to a fresh run
+    assert eng.status(r2) == "ok"
+    np.testing.assert_array_equal(res[r2].asnumpy(),
+                                  _want(isolated, p2, 5))
+    err = eng.error(r2)   # last error kept for observability
+    assert err["type"] == "RuntimeError" and err["site"] == "serving.step"
+    after = eng.stats
+    assert after["quarantined"] - before["quarantined"] == 1
+    assert after["retries"] - before["retries"] == 1
+
+
+def test_quarantine_without_retries_fails_with_partial_output(
+        eng, isolated):
+    """No retry budget: the request finishes with status ``failed``, an
+    error record, and the tokens it emitted before the fault — which are
+    themselves a PREFIX of the fault-free stream (parity holds right up
+    to the quarantine)."""
+    rng = np.random.RandomState(7)
+    p1, p2 = _prompts(rng, (4, 6))
+    r1 = eng.submit(p1, 6)
+    r2 = eng.submit(p2, 5)
+    with fault_plan("serving.step#%d@3:raise=RuntimeError(dead)" % r2):
+        res = eng.run()
+    np.testing.assert_array_equal(res[r1].asnumpy(),
+                                  _want(isolated, p1, 6))
+    assert eng.status(r2) == "failed"
+    assert eng.error(r2)["error"] == "dead"
+    part = res[r2].asnumpy()
+    full = _want(isolated, p2, 5)
+    assert p2.shape[1] < part.shape[1] < full.shape[1]
+    np.testing.assert_array_equal(part[0], full[0, :part.shape[1]])
+
+
+def test_sampled_streams_survive_neighbor_quarantine(eng, isolated):
+    """Seeded sampling next to a quarantined slot: per-slot RNG streams
+    mean the surviving request's DRAWS cannot shift when its neighbor
+    dies mid-flight."""
+    rng = np.random.RandomState(11)
+    p1, p2 = _prompts(rng, (3, 4))
+    r1 = eng.submit(p1, 6, temperature=0.8, top_k=20, seed=101)
+    r2 = eng.submit(p2, 6)
+    with fault_plan("serving.step#%d@2:raise=OSError(gone)" % r2):
+        res = eng.run()
+    assert eng.status(r2) == "failed"
+    np.testing.assert_array_equal(
+        res[r1].asnumpy(),
+        _want(isolated, p1, 6, temperature=0.8, top_k=20, seed=101))
+
+
+def test_admission_fault_quarantines_request_not_engine(eng, isolated):
+    """A prefill (``serving.admit``) failure fails that request only;
+    the slot stays free and the engine keeps serving."""
+    rng = np.random.RandomState(13)
+    p1, p2 = _prompts(rng, (3, 5))
+    r1 = eng.submit(p1, 4)
+    r2 = eng.submit(p2, 4)
+    with fault_plan("serving.admit#%d@1:raise=OSError(oom)" % r1):
+        res = eng.run()
+    assert eng.status(r1) == "failed"
+    assert eng.error(r1)["site"] == "serving.admit"
+    np.testing.assert_array_equal(res[r2].asnumpy(),
+                                  _want(isolated, p2, 4))
+
+
+def test_engine_survives_consecutive_poisoned_admissions(eng, isolated):
+    """N requests in a row fail at admission (fail-always plan): every
+    one is recorded failed, no slot leaks, and the next clean request
+    decodes with full parity."""
+    rng = np.random.RandomState(17)
+    prompts = _prompts(rng, (3, 4, 5, 3, 4))
+    with fault_plan("serving.admit@1+:raise=OSError(disk full)"):
+        rids = [eng.submit(p, 3) for p in prompts]
+        eng.run()
+    assert [eng.status(r) for r in rids] == ["failed"] * len(rids)
+    assert eng.free_slots == eng.num_slots and eng.pending == 0
+    r = eng.submit(prompts[0], 4)
+    res = eng.run()
+    assert eng.status(r) == "ok"
+    np.testing.assert_array_equal(res[r].asnumpy(),
+                                  _want(isolated, prompts[0], 4))
+
+
+def test_fault_scenarios_deterministic_across_reruns(eng):
+    """Bit-for-bit replayability: the same plan over the same workload
+    produces identical outputs, statuses and fire counts every time."""
+    rng = np.random.RandomState(19)
+    p1, p2 = _prompts(rng, (4, 5))
+
+    def scenario():
+        r1 = eng.submit(p1, 5)
+        r2 = eng.submit(p2, 4, retries=1)
+        with fault_plan("serving.step#%d@2:raise=RuntimeError(x)"
+                        % r2) as plan:
+            res = eng.run()
+        return (res[r1].asnumpy(), res[r2].asnumpy(),
+                eng.status(r1), eng.status(r2),
+                plan.stats()["serving.step"]["fired"])
+
+    a, b = scenario(), scenario()
+    np.testing.assert_array_equal(a[0], b[0])
+    np.testing.assert_array_equal(a[1], b[1])
+    assert a[2:] == b[2:]
+
+
+def test_deadline_eviction_at_iteration_boundary(eng, isolated):
+    """Injected clock (NO real sleeps): a request past its wall-clock
+    deadline is evicted at the next step() boundary with status
+    ``expired`` and its partial output; its neighbor is untouched."""
+    t0 = CLK["t"]
+    rng = np.random.RandomState(23)
+    p1, p2, p3 = _prompts(rng, (3, 4, 3))
+    before = eng.stats["deadline_evictions"]
+    ra = eng.submit(p1, 8, deadline_s=5.0)
+    rb = eng.submit(p2, 8)
+    eng.step()
+    eng.step()
+    assert eng.status(ra) == "active"
+    CLK["t"] = t0 + 10.0                 # past ra's deadline only
+    eng.step()
+    assert eng.status(ra) == "expired" and eng.status(rb) == "active"
+    assert eng.stats["deadline_evictions"] - before == 1
+    # queued requests expire too, without ever taking a slot
+    rq = eng.submit(p3, 4, deadline_s=-1.0)
+    eng.step()
+    assert eng.status(rq) == "expired"
+    res = eng.run()
+    np.testing.assert_array_equal(res[rb].asnumpy(),
+                                  _want(isolated, p2, 8))
+    part = res[ra].asnumpy()
+    full = _want(isolated, p1, 8)
+    np.testing.assert_array_equal(part[0], full[0, :part.shape[1]])
+
+
+def test_bounded_admission_sheds_with_typed_error(tiny, mesh):
+    """max_pending bounds the queue: the overflow submit raises
+    LoadShedError (catchable as MXTPUError too), nothing is enqueued,
+    and the counter records the shed.  No decode runs — shedding is
+    pure host bookkeeping."""
+    from mxtpu.base import MXTPUError
+
+    e = ContinuousBatchingEngine(tiny, mesh,
+                                 transformer_lm_sharding_rules(),
+                                 num_slots=2, max_length=MAXLEN,
+                                 max_pending=2)
+    rng = np.random.RandomState(29)
+    p = _prompts(rng, (3,))[0]
+    e.submit(p, 3)
+    e.submit(p, 3)
+    with pytest.raises(LoadShedError, match="max_pending"):
+        e.submit(p, 3)
+    assert issubclass(LoadShedError, MXTPUError)
+    assert e.pending == 2 and e.stats["shed"] == 1
+
+
+def test_stats_exposes_resilience_counters(eng):
+    for key in ("quarantined", "retries", "deadline_evictions", "shed"):
+        assert key in eng.stats
+
+
+def test_terminal_status_history_is_bounded(tiny, mesh):
+    """Per-request status/error bookkeeping must not grow without bound
+    on a long-lived engine: only the last `history` completions keep
+    records.  Zero-token requests finish at the iteration boundary
+    without compiling any program, so this stays cheap."""
+    e = ContinuousBatchingEngine(tiny, mesh,
+                                 transformer_lm_sharding_rules(),
+                                 num_slots=2, max_length=MAXLEN,
+                                 history=4)
+    rng = np.random.RandomState(31)
+    p = _prompts(rng, (3,))[0]
+    rids = [e.submit(p, 0) for _ in range(8)]
+    e.run()
+    assert [e.status(r) for r in rids[:4]] == ["unknown"] * 4  # evicted
+    assert [e.status(r) for r in rids[4:]] == ["ok"] * 4       # retained

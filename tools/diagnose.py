@@ -90,6 +90,40 @@ def check_engine_bulk():
         print("bulking      : FAILED (%s: %s)" % (type(e).__name__, e))
 
 
+def check_resilience():
+    """Exercise the fault-injection + retry machinery once (injected
+    clock/sleep — no real waiting) and print the process-wide resilience
+    counters (docs/resilience.md): a healthy install shows one injected
+    fault absorbed by exactly one retry."""
+    print("----------Resilience----------")
+    try:
+        from mxtpu import resilience
+        from mxtpu.resilience import RetryPolicy, fault_plan, faults
+
+        print("fault sites  :", ", ".join(faults.SITES))
+        print("env plan     :",
+              os.environ.get("MXTPU_FAULT_PLAN") or "none")
+        # session counters FIRST — the probe below must not pollute (and
+        # must never reset) what this process actually experienced
+        c = resilience.counters()
+        print("counters     : %d retries / %d exhaustions / "
+              "%d quarantines / %d deadline evictions / %d sheds"
+              % (c["retries"], c["retry_exhaustions"],
+                 c["quarantined_slots"], c["deadline_evictions"],
+                 c["shed_requests"]))
+        sleeps = []
+        pol = RetryPolicy(max_attempts=3, base_delay=0.01,
+                          sleep=sleeps.append)
+        with fault_plan("diagnose.probe@1:raise=OSError(probe)"):
+            pol.call(faults.inject, "diagnose.probe")
+        d = resilience.counters()
+        print("probe        : ok (%d injected fault, %d retry, no real "
+              "sleep)" % (d["faults_injected"] - c["faults_injected"],
+                          d["retries"] - c["retries"]))
+    except Exception as e:
+        print("resilience   : FAILED (%s: %s)" % (type(e).__name__, e))
+
+
 def check_devices(timeout_s=60):
     print("----------Device Info----------")
     try:
@@ -150,6 +184,7 @@ def main():
     check_libraries()
     check_environment()
     check_mxtpu()
+    check_resilience()
     check_analysis(full=full)
     check_devices()
 
