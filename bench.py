@@ -562,8 +562,145 @@ def _bench_eager_dispatch():
     print(json.dumps(rec), flush=True)
 
 
+def _bench_guardian():
+    """Guardian cost + recovery (round-10 tentpole: training guardian).
+
+    Metric 1, train_step_guarded_overhead: blocked per-step p50 of the
+    SAME model/optimizer with and without in-step containment (fused
+    finiteness reduction + where-gated update + one ok-scalar host sync
+    per step) — the acceptance bar is < 5% overhead.  Honest on any
+    platform since both columns run identically; labeled regardless.
+
+    Metric 2, train_steps_to_recover: the same guarded trainer driven by
+    Guardian.run over a DETERMINISTIC 1%-NaN plan (every 100th batch is
+    index-poisoned with a NaN — data-driven, replayable bit-for-bit)
+    plus one forced rollback via the counter-driven guardian.check site.
+    The value is the extra step executions (skips consume their batch;
+    the rollback replays from the last verified checkpoint)."""
+    import tempfile
+
+    import numpy as np
+    import jax
+    import mxtpu as mx
+    from mxtpu import gluon, nd
+    from mxtpu.gluon import nn
+    from mxtpu.parallel import make_mesh, SPMDTrainer
+    from mxtpu.resilience import Guardian, fault_plan
+
+    platform = jax.devices()[0].platform
+    cpu = platform == "cpu"
+    hidden, in_units, batch = (512, 256, 512) if cpu else (2048, 1024, 256)
+    timed = 30 if cpu else 40
+
+    def build(guard):
+        mx.random.seed(17)
+        net = nn.HybridSequential(prefix="g_")
+        net.add(nn.Dense(hidden, activation="relu", in_units=in_units,
+                         prefix="a_"),
+                nn.Dense(hidden, activation="relu", in_units=hidden,
+                         prefix="b_"),
+                nn.Dense(10, in_units=hidden, prefix="c_"))
+        net.initialize()
+        return net, SPMDTrainer(net, gluon.loss.SoftmaxCrossEntropyLoss(),
+                                "sgd", make_mesh(dp=1),
+                                optimizer_params={"learning_rate": 0.05,
+                                                  "momentum": 0.9},
+                                guard=guard)
+
+    R = np.random.RandomState(0)
+    X = nd.array(R.rand(batch, in_units).astype(np.float32))
+    y = nd.array(R.randint(0, 10, (batch,)).astype(np.float32))
+
+    # INTERLEAVED A/B: alternate unguarded/guarded steps so thermal/
+    # scheduler drift hits both columns equally (back-to-back blocks
+    # showed ±6% swings on the CPU host — larger than the effect)
+    _, tr_plain = build(False)
+    _, tr_guard = build(True)
+    for _ in range(3):
+        tr_plain.step(X, y).asnumpy()  # compile + warm
+        tr_guard.step(X, y).asnumpy()
+    lat_p, lat_g = [], []
+    for _ in range(timed):
+        t0 = time.perf_counter()
+        tr_plain.step(X, y).asnumpy()  # blocked: both columns sync fully
+        lat_p.append(time.perf_counter() - t0)
+        t0 = time.perf_counter()
+        tr_guard.step(X, y).asnumpy()
+        lat_g.append(time.perf_counter() - t0)
+    lat_p.sort()
+    lat_g.sort()
+    plain = lat_p[len(lat_p) // 2]
+    guarded = lat_g[len(lat_g) // 2]
+    overhead = guarded / plain - 1.0
+    rec = {
+        "metric": "train_step_guarded_overhead",
+        "value": round(overhead * 100, 2),
+        "unit": "percent",
+        "vs_baseline": None,
+        "platform": platform,
+        "guarded_step_ms": round(guarded * 1e3, 3),
+        "unguarded_step_ms": round(plain * 1e3, 3),
+        "config": {"hidden": hidden, "in_units": in_units, "batch": batch,
+                   "timed_steps": timed, "optimizer": "sgd+momentum",
+                   "method": "interleaved A/B, blocked p50"},
+        "baseline_note": "no upstream analogue (reference has no in-step "
+                         "containment); the comparison column is this "
+                         "repo's own unguarded compiled step",
+    }
+    if cpu:
+        rec["platform_note"] = ("CPU builder host — both columns equally "
+                                "CPU-bound, ratio indicative but NOT a "
+                                "TPU number")
+    print(json.dumps(rec), flush=True)
+
+    # -- recovery under the deterministic 1%-NaN plan --------------------
+    num_steps = 200 if cpu else 300
+
+    def data_fn(step):
+        # pure function of the step index (the guardian's re-seeding
+        # contract): batch synthesized from a per-step seed
+        Rs = np.random.RandomState(1000 + step)
+        Xb = Rs.rand(batch, in_units).astype(np.float32)
+        yb = Rs.randint(0, 10, (batch,)).astype(np.float32)
+        if (step + 1) % 100 == 0:  # deterministic 1% NaN poisoning
+            Xb[0, 0] = np.nan
+        return nd.array(Xb), nd.array(yb)
+
+    net, tr = build(True)
+    g = Guardian(tempfile.mkdtemp(prefix="mxtpu-guardian-bench-"),
+                 max_skips=2, checkpoint_every=25)
+    plan = "guardian.check@%d:raise" % (num_steps // 2)
+    t0 = time.perf_counter()
+    with fault_plan(plan):
+        stats = g.run(tr, data_fn, num_steps)
+    dt = time.perf_counter() - t0
+    extra = stats["steps"] - num_steps
+    rec = {
+        "metric": "train_steps_to_recover",
+        "value": extra,
+        "unit": "extra step executions",
+        "vs_baseline": None,
+        "platform": platform,
+        "effective_steps": num_steps,
+        "skips": stats["skips"],
+        "rollbacks": stats["rollbacks"],
+        "checkpoints": stats["checkpoints"],
+        "wall_s": round(dt, 2),
+        "fault_plan": "NaN batch every 100th step (index-driven) + %s"
+                      % plan,
+        "baseline_note": "no upstream analogue; deterministic counter/"
+                         "index-driven faults, replayable bit-for-bit",
+    }
+    if cpu:
+        rec["platform_note"] = ("CPU builder host — recovery STEP counts "
+                                "are platform-independent; wall time is "
+                                "not a TPU number")
+    print(json.dumps(rec), flush=True)
+
+
 def _child_main():
     _bench_eager_dispatch()
+    _bench_guardian()
     _bench_resnet()
     _bench_bert()
     _bench_attention()

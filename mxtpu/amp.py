@@ -44,13 +44,27 @@ class LossScaler:
         self._unskipped = 0
 
     def has_overflow(self, params):
-        import numpy as onp
+        """True iff any gradient holds a non-finite value.  ONE fused
+        on-device ``multi_all_finite`` reduction and ONE host sync (the
+        scalar verdict) — the reference (and the previous version here)
+        pulled every parameter to host with ``asnumpy()`` per step.  The
+        decision is bit-identical: AND of per-tensor finiteness equals
+        NOT(OR of per-tensor overflow)."""
+        from .ndarray.ndarray import invoke_op
+        from .ndarray.sparse import BaseSparseNDArray
+
+        grads = []
         for p in params:
             g = p.grad() if callable(getattr(p, "grad", None)) else p
-            a = g.asnumpy()
-            if not onp.isfinite(a).all():
-                return True
-        return False
+            if isinstance(g, BaseSparseNDArray):
+                # a sparse grad is non-finite iff its stored values are
+                g = g.data
+            grads.append(g)
+        if not grads:
+            return False
+        ok = invoke_op("multi_all_finite", tuple(grads),
+                       {"num_arrays": len(grads)})
+        return not bool(ok.asnumpy())
 
     def update_scale(self, overflow):
         if overflow:

@@ -67,17 +67,42 @@ def save_trainer(path: str, trainer, retry=None) -> None:
         with ocp.StandardCheckpointer() as ckptr:
             ckptr.save(path, _trainer_tree(trainer))
 
+    def manifest():
+        # verified-checkpoint weave (docs/guardian.md): record every
+        # file's size + CRC32 in a <path>.mxmf sidecar so restore can
+        # prove the tree intact before orbax parses it.  Retried
+        # separately from the orbax save: re-entering attempt() after
+        # the payload landed would fail on the already-existing path.
+        # Process 0 ONLY: the orbax save above is collective (every host
+        # writes its own shards), but the manifest is one whole-tree CRC
+        # pass — running it on every host would re-read the entire
+        # multi-host tree num_processes times over shared storage,
+        # defeating the no-host-gather point of this path.
+        if jax.process_index() != 0:
+            return
+        from ..resilience import checkpoint as _ckpt
+        _ckpt.write_dir_manifest(path)
+
     if retry is None:
         attempt()
+        manifest()
     else:
         retry.call(attempt)
+        retry.call(manifest)
 
 
 def restore_trainer(path: str, trainer) -> None:
     """Restore onto the CURRENT mesh: every leaf is re-placed with the
-    trainer's present shardings (topology may differ from save time)."""
+    trainer's present shardings (topology may differ from save time).
+    When a ``.mxmf`` directory manifest exists (written by
+    :func:`save_trainer`), the tree is CRC-verified first — damage
+    raises a typed :class:`~mxtpu.resilience.CorruptCheckpointError`
+    naming the bad member instead of an orbax deserialization error."""
     import orbax.checkpoint as ocp
 
+    from ..resilience import checkpoint as _ckpt
+
+    _ckpt.verify_dir(os.path.abspath(path))
     if not trainer._params_sharded:
         raise ValueError(
             "restore_trainer: run one trainer.step first (or stage "

@@ -22,9 +22,33 @@ __all__ = ["Trainer"]
 
 
 class Trainer:
+    """``guard=True`` (default: the ``MXTPU_GUARDIAN`` env var) adds
+    in-step divergence containment (docs/guardian.md): ONE fused
+    ``multi_all_finite`` reduction checks every gradient on device
+    (single host sync) before the allreduce; a non-finite verdict skips
+    the allreduce and the optimizer update entirely, so params and
+    optimizer state are bit-identical to not having stepped (and NaNs
+    never reach a kvstore that updates on push).  The verdict is
+    exposed as ``trainer.last_step_ok``.  On a distributed kvstore the
+    per-worker verdicts are AND-reduced through one extra scalar
+    collective so every worker takes the same skip/apply branch (a
+    unilateral skip would desync the synchronized allreduce).  With an
+    AMP fp16 loss scaler attached (``amp.init_trainer``), the same
+    check drives the scaler's grow/backoff automaton inside ``step`` —
+    no separate per-param overflow loop.
+
+    Scope: the pre-reduce check sees per-device/per-worker addends, and
+    a reduction can overflow a narrow dtype even when every addend is
+    finite.  On the ``update_on_kvstore=False`` path the reduced grads
+    land back in local buffers and a second post-reduce check closes
+    that gap; with ``update_on_kvstore=True`` the optimizer applies
+    INSIDE the push, so reduce-time overflow there is outside the
+    containment guarantee (keep fp32 grads, or update_on_kvstore=False,
+    for AMP runs near the fp16 ceiling)."""
+
     def __init__(self, params, optimizer, optimizer_params=None,
                  kvstore="device", compression_params=None,
-                 update_on_kvstore=None):
+                 update_on_kvstore=None, guard=None):
         param_list = []
         if isinstance(params, (dict, ParameterDict)):
             for key in sorted(list(params.keys())):
@@ -55,6 +79,12 @@ class Trainer:
         self._update_on_kvstore = None
         self._distributed = None
         self._params_to_init = []
+        if guard is None:
+            from ..resilience.guardian import guard_enabled_default
+            guard = guard_enabled_default()
+        self._guard = bool(guard)
+        self.last_step_ok = True
+        self._narrow_grads = None  # lazy: any fp16/bf16 grad buffers?
         self._reset_kvstore()
 
     def _check_contexts(self):
@@ -164,15 +194,141 @@ class Trainer:
 
     def step(self, batch_size, ignore_stale_grad=False):
         """allreduce grads, then apply optimizer updates scaled by
-        1/batch_size (parity: Trainer.step)."""
+        1/batch_size (parity: Trainer.step).  Guarded/AMP trainers run
+        the fused finiteness check first and skip the update on a
+        non-finite verdict — containment, not propagation."""
         rescale_grad = self._scale / batch_size
         self._check_and_rescale_grad(rescale_grad)
         if not self._kv_initialized:
             self._init_kvstore()
         if self._params_to_init:
             self._init_params()
+        # the containment gate runs BEFORE the allreduce: with
+        # update_on_kvstore the optimizer applies inside the push, so a
+        # post-reduce check could not stop a NaN from poisoning the
+        # store's weights (distributed workers AND their local verdicts
+        # into one global verdict first — see _maybe_skip_update)
+        post = self._post_reduce_applicable()
+        # when a post-reduce re-check will run, IT owns the step's final
+        # verdict — the scaler must be driven exactly once per step, so
+        # the pre-reduce check defers the clean-step drive to it (a
+        # window-boundary grow here would otherwise cancel the
+        # post-reduce backoff, leaving the scale un-backed-off on an
+        # overflowing step)
+        if self._maybe_skip_update(drive_scaler_on_ok=not post):
+            return
         self._allreduce_grads()
+        if post and self._post_reduce_overflow():
+            return
         self._update(ignore_stale_grad)
+
+    # -- in-step containment (docs/guardian.md) --------------------------
+    def _grads_all_finite(self):
+        """ONE fused on-device multi_all_finite reduction over every
+        gradient on every device, one host sync — the guarded step's
+        verdict."""
+        grads = []
+        for param in self._params:
+            if param.grad_req != "null":
+                # dense buffers, not list_grad(): a row_sparse view can't
+                # feed multi_all_finite, and the dense buffer's verdict is
+                # identical (untouched rows accumulated finite zeros)
+                grads.extend(param._list_dense_grad())
+        if not grads:
+            return True
+        ok = invoke_op("multi_all_finite", tuple(grads),
+                       {"num_arrays": len(grads)})
+        return bool(ok.asnumpy())
+
+    def _maybe_skip_update(self, drive_scaler_on_ok=True):
+        """Containment gate between allreduce and update: with guarding
+        (or an AMP loss scaler) active, a non-finite gradient anywhere
+        skips the whole update — params and optimizer state stay
+        bit-identical to not stepping.  Returns True when the update
+        must be skipped.  An overflow verdict always drives the scaler's
+        backoff (it is final — the step is skipped); the clean-step
+        drive is deferred to the post-reduce check when one will run
+        (``drive_scaler_on_ok=False``), so the scaler sees exactly one
+        verdict per step."""
+        scaler = getattr(self, "_amp_loss_scaler", None)
+        if not self._guard and scaler is None:
+            return False
+        ok = self._grads_all_finite()
+        if self._distributed:
+            # the verdict must be GLOBAL: workers see different local
+            # grads, and a unilateral skip would desync the synchronized
+            # allreduce/push below (everyone else blocks in the
+            # collective).  AND the per-worker verdicts — every worker
+            # runs this tiny reduce every guarded step, so the branch
+            # taken is identical on all of them (and the AMP scalers
+            # stay in lockstep too).
+            import jax
+            import numpy as onp
+
+            from ..parallel import collectives as _coll
+            total = _coll.all_reduce_across_processes(
+                onp.float32(1.0 if ok else 0.0))
+            ok = bool(float(total) >= jax.process_count() - 0.5)
+        self.last_step_ok = ok
+        if scaler is not None and (drive_scaler_on_ok or not ok):
+            scaler.update_scale(overflow=not ok)
+        if ok:
+            return False
+        from ..resilience.counters import bump
+        bump("guardian_skips")
+        for param in self._params:
+            if param.grad_req != "null":
+                param._consume_sparse_row_ids()  # grads consumed anyway
+        return True
+
+    def _post_reduce_applicable(self):
+        """True when a second, post-reduce finiteness check must run:
+        pushpull path (update_on_kvstore applies inside the push — no
+        hook point) AND a gradient dtype narrow enough for a reduce-sum
+        of finite addends to overflow (fp16/bf16, or any run with an AMP
+        scaler attached).  Plain fp32 training skips the second
+        reduction and host sync entirely."""
+        scaler = getattr(self, "_amp_loss_scaler", None)
+        if ((not self._guard and scaler is None) or not self._kvstore
+                or self._update_on_kvstore):
+            return False
+        if scaler is not None:
+            return True
+        if self._narrow_grads is None:
+            # grad dtypes are fixed once params are initialized (a
+            # cast() mid-training is not a supported flow), so scan the
+            # buffers once instead of per hot-path step
+            self._narrow_grads = any(
+                str(g.dtype) in ("float16", "bfloat16")
+                for param in self._params if param.grad_req != "null"
+                for g in param._list_dense_grad())
+        return self._narrow_grads
+
+    def _post_reduce_overflow(self):
+        """Second half of the containment gate (see
+        :meth:`_post_reduce_applicable`): the pre-reduce check sees
+        per-device addends, but their SUM can overflow a narrow grad
+        dtype (fp16 near the 65504 ceiling under a large loss scale)
+        even when every addend is finite.  The reduced grads sit back in
+        the dense buffers, so re-checking after the reduce catches that
+        and skips the update.  Owns the step's final verdict: drives the
+        scaler exactly once (the pre-reduce check deferred its
+        clean-step drive here)."""
+        scaler = getattr(self, "_amp_loss_scaler", None)
+        ok = self._grads_all_finite()
+        # the verdict is already global — every worker holds the SAME
+        # reduced buffers, so no cross-process AND is needed here
+        if scaler is not None:
+            scaler.update_scale(overflow=not ok)
+        if ok:
+            return False
+        self.last_step_ok = False
+        from ..resilience.counters import bump
+        bump("guardian_skips")
+        for param in self._params:
+            if param.grad_req != "null":
+                param._consume_sparse_row_ids()
+        return True
 
     def _check_and_rescale_grad(self, scale):
         if self._update_on_kvstore and self._distributed and \
@@ -224,6 +380,8 @@ class Trainer:
             "supported. Try setting `update_on_kvstore` to False when " \
             "creating trainer."
         self._check_and_rescale_grad(self._scale / batch_size)
+        if self._maybe_skip_update():
+            return
         self._update(ignore_stale_grad)
 
     def _update(self, ignore_stale_grad=False):
@@ -358,21 +516,30 @@ class Trainer:
                 "yet initialized in kvstore."
             self._kvstore.save_optimizer_states(fname, dump_optimizer=True)
         else:
-            with open(fname, "wb") as fout:
-                fout.write(self._updaters[0].get_states(dump_optimizer=True))
+            # atomic write + CRC32 manifest sidecar (docs/guardian.md):
+            # a crash mid-save leaves the previous states file intact
+            from ..resilience import checkpoint as _ckpt
+            _ckpt.write_verified(
+                fname, self._updaters[0].get_states(dump_optimizer=True))
 
     def load_states(self, fname):
-        """Load optimizer/updater states (parity: load_states)."""
+        """Load optimizer/updater states (parity: load_states).  A CRC
+        manifest, when present, is verified first — damaged files raise
+        a typed :class:`~mxtpu.resilience.CorruptCheckpointError`
+        instead of misparsing."""
         if not self._kv_initialized:
             self._init_kvstore()
         if self._params_to_init:
             self._init_params()
         if self._update_on_kvstore:
+            # the kvstore's load verifies — one read, one verify
             self._kvstore.load_optimizer_states(fname)
             self._optimizer = self._kvstore._updater.optimizer
         else:
+            from ..resilience import checkpoint as _ckpt
             with open(fname, "rb") as f:
                 states = f.read()
+            _ckpt.verify(fname, data=states)
             for updater in self._updaters:
                 updater.set_states(states)
                 updater.optimizer = self._updaters[0].optimizer

@@ -282,14 +282,19 @@ class KVStore:
     def save_optimizer_states(self, fname, dump_optimizer=False):
         if self._updater is None:
             raise MXTPUError("there is no optimizer in the kvstore")
-        with open(fname, "wb") as f:
-            f.write(self._updater.get_states(dump_optimizer))
+        # atomic + CRC-manifested (docs/guardian.md): a crash mid-save
+        # leaves the previous states file intact
+        from .resilience import checkpoint as _ckpt
+        _ckpt.write_verified(fname, self._updater.get_states(dump_optimizer))
 
     def load_optimizer_states(self, fname):
         if self._updater is None:
             raise MXTPUError("there is no optimizer in the kvstore")
+        from .resilience import checkpoint as _ckpt
         with open(fname, "rb") as f:
-            self._updater.set_states(f.read())
+            states = f.read()
+        _ckpt.verify(fname, data=states)
+        self._updater.set_states(states)
 
 
 class DistTPUSyncKVStore(KVStore):

@@ -10,12 +10,22 @@ Two formats:
    The exact reference layout could not be verified against the mount
    (SURVEY.md §0); the reader fails with a clear error rather than
    misparsing.
+
+Robustness (docs/guardian.md): ``save`` writes atomically (tmp + fsync +
+rename) with a per-tensor CRC32 manifest sidecar via
+:mod:`mxtpu.resilience.checkpoint`, so a crash mid-save can never leave
+a truncated file at the final path.  ``load`` verifies the manifest when
+present, and every parse failure — truncation, bad magic, short payload
+— raises a typed :class:`~mxtpu.resilience.CorruptCheckpointError`
+naming the file and byte offset instead of a raw ``struct.error`` or a
+silent misparse.
 """
 
 from __future__ import annotations
 
 import json
 import struct
+import zlib
 from typing import Dict, List, Union
 
 import numpy as onp
@@ -30,8 +40,14 @@ _DTYPE_FLAG = {0: "float32", 1: "float64", 2: "float16", 3: "uint8",
                4: "int32", 5: "int8", 6: "int64"}
 
 
+def _ckpt():
+    from ..resilience import checkpoint
+    return checkpoint
+
+
 def save(fname: str, data):
-    """Save NDArrays: list -> unnamed, dict -> named (parity mx.nd.save)."""
+    """Save NDArrays: list -> unnamed, dict -> named (parity mx.nd.save).
+    Atomic, with a CRC32 manifest sidecar (``<fname>.mxmf``)."""
     if isinstance(data, NDArray):
         data = [data]
     if isinstance(data, dict):
@@ -48,36 +64,113 @@ def save(fname: str, data):
                    for a in np_arrays],
     }
     blob = json.dumps(index).encode()
-    with open(fname, "wb") as f:
-        f.write(_MAGIC)
-        f.write(struct.pack("<Q", len(blob)))
-        f.write(blob)
-        for a in np_arrays:
-            f.write(onp.ascontiguousarray(a).tobytes())
+    header = _MAGIC + struct.pack("<Q", len(blob)) + blob
+    tensors = []
+
+    def chunks():
+        # streamed into write_verified one tensor at a time — the whole
+        # payload is never resident (matters exactly when checkpointing
+        # under memory pressure, e.g. a preemption save)
+        yield header
+        off = len(header)
+        for i, a in enumerate(np_arrays):
+            b = onp.ascontiguousarray(a).tobytes()
+            tensors.append({"name": names[i] if names else str(i),
+                            "offset": off, "size": len(b),
+                            "crc32": zlib.crc32(b) & 0xFFFFFFFF})
+            off += len(b)
+            yield b
+
+    _ckpt().write_verified(fname, chunks(), tensors=tensors)
 
 
 def load(fname: str) -> Union[List[NDArray], Dict[str, NDArray]]:
-    with open(fname, "rb") as f:
-        head = f.read(8)
-        if head == _MAGIC:
-            (n,) = struct.unpack("<Q", f.read(8))
-            index = json.loads(f.read(n))
-            out = []
-            for meta in index["arrays"]:
+    import mmap
+
+    ckpt = _ckpt()
+    try:
+        with open(fname, "rb") as f:
+            # mmap, not read(): restore peak memory stays bounded (the
+            # page cache backs the map) — a multi-GB checkpoint is never
+            # resident as one buffer, which matters exactly when
+            # restoring under memory pressure after a preemption.  An
+            # empty file cannot be mapped; b"" takes the same typed
+            # truncation path below.
+            try:
+                buf = mmap.mmap(f.fileno(), 0, access=mmap.ACCESS_READ)
+            except ValueError:
+                buf = b""
+    except FileNotFoundError:
+        ckpt.verify(fname)  # typed "file missing" when a manifest exists
+        raise
+    # CRC check when a manifest sidecar exists — zlib.crc32 streams
+    # through the map without materializing it
+    ckpt.verify(fname, data=buf)
+
+    def corrupt(msg, offset):
+        raise ckpt.CorruptCheckpointError(msg, path=fname, offset=offset)
+
+    if len(buf) < 8:
+        corrupt("truncated NDArray file: %d bytes, header needs 8"
+                % len(buf), len(buf))
+    if buf[:8] == _MAGIC:
+        if len(buf) < 16:
+            corrupt("truncated MXTP header: %d bytes, need 16" % len(buf),
+                    len(buf))
+        (n,) = struct.unpack_from("<Q", buf, 8)
+        if 16 + n > len(buf):
+            corrupt("truncated MXTP index: need %d bytes, file has %d"
+                    % (16 + n, len(buf)), len(buf))
+        try:
+            index = json.loads(buf[16:16 + n])
+            metas = index["arrays"]
+            names = index["names"]
+        except (ValueError, KeyError, TypeError):
+            corrupt("MXTP index is not parseable JSON", 16)
+        off = 16 + n
+        out = []
+        for i, meta in enumerate(metas):
+            nm = (names[i] if isinstance(names, list) and i < len(names)
+                  else i)
+            try:
+                # a bit flip INSIDE still-parseable JSON (e.g. a mangled
+                # dtype string or a non-int shape entry) must surface as
+                # the typed error too, not a bare TypeError/KeyError
                 dt = onp.dtype(meta["dtype"])
-                count = int(onp.prod(meta["shape"])) if meta["shape"] else 1
-                buf = f.read(count * dt.itemsize)
-                out.append(array(onp.frombuffer(buf, dtype=dt).reshape(
-                    meta["shape"])))
-            if index["names"]:
-                return dict(zip(index["names"], out))
-            return out
-        # legacy path
-        f.seek(0)
-        return _load_legacy(f.read())
+                shape = tuple(int(d) for d in meta["shape"])
+                count = int(onp.prod(shape)) if shape else 1
+            except (KeyError, TypeError, ValueError):
+                corrupt("MXTP index entry %d (%r) is malformed" % (i, nm),
+                        16)
+            nbytes = count * dt.itemsize
+            if off + nbytes > len(buf):
+                corrupt("short payload for tensor %d (%r): needs bytes "
+                        "[%d, %d) but file ends at %d"
+                        % (i, nm, off, off + nbytes, len(buf)), len(buf))
+            out.append(array(onp.frombuffer(
+                buf, dtype=dt, count=count, offset=off).reshape(shape)))
+            off += nbytes
+        if names:
+            return dict(zip(names, out))
+        return out
+    try:
+        return _load_legacy(buf, fname)
+    except struct.error as e:
+        # every struct.unpack_from failure is an out-of-bounds read —
+        # a truncated or damaged legacy file, never a caller bug
+        raise ckpt.CorruptCheckpointError(
+            "truncated legacy NDArray file (%s)" % e, path=fname,
+            offset=len(buf)) from None
+    except UnicodeDecodeError as e:
+        # a flipped byte inside a stored name: damage, typed like the rest
+        raise ckpt.CorruptCheckpointError(
+            "undecodable name in legacy NDArray file (%s)" % e,
+            path=fname, offset=len(buf)) from None
 
 
-def _load_legacy(buf: bytes):
+def _load_legacy(buf: bytes, fname: str = "<bytes>"):
+    from ..resilience.checkpoint import CorruptCheckpointError
+
     off = 0
 
     def u64():
@@ -100,18 +193,20 @@ def _load_legacy(buf: bytes):
 
     magic = u64()
     if magic != _LEGACY_LIST_MAGIC:
-        raise ValueError(
+        raise CorruptCheckpointError(
             f"unrecognised NDArray file (magic {magic:#x}); neither MXTP "
-            "nor legacy MXNet format")
+            "nor legacy MXNet format", path=fname, offset=0)
     u64()  # reserved
     n = u64()
     arrays = []
     for _ in range(n):
+        block_off = off
         m = u32()
         if m != _LEGACY_ND_MAGIC:
-            raise ValueError(
+            raise CorruptCheckpointError(
                 "legacy NDArray block magic mismatch — reference layout "
-                "differs from the documented V2 format; cannot load")
+                "differs from the documented V2 format; cannot load",
+                path=fname, offset=block_off)
         stype = i32()
         if stype not in (-1, 0):  # kDefaultStorage / dense marker
             raise ValueError("sparse legacy arrays unsupported (descoped)")
@@ -120,8 +215,20 @@ def _load_legacy(buf: bytes):
         i32()  # dev_type
         i32()  # dev_id
         dtype_flag = i32()
-        dt = onp.dtype(_DTYPE_FLAG.get(dtype_flag, "float32"))
+        if dtype_flag not in _DTYPE_FLAG:
+            # a damaged flag must not silently reinterpret the payload
+            # as float32 — wrong dtype + wrong itemsize = garbage weights
+            raise CorruptCheckpointError(
+                "unknown dtype flag %d in legacy NDArray block"
+                % dtype_flag, path=fname, offset=off - 4)
+        dt = onp.dtype(_DTYPE_FLAG[dtype_flag])
         count = int(onp.prod(shape)) if shape else 1
+        if off + count * dt.itemsize > len(buf):
+            raise CorruptCheckpointError(
+                "short payload in legacy NDArray block: needs %d bytes "
+                "at offset %d but file ends at %d"
+                % (count * dt.itemsize, off, len(buf)), path=fname,
+                offset=len(buf))
         a = onp.frombuffer(buf, dtype=dt, count=count, offset=off).reshape(shape)
         off += count * dt.itemsize
         arrays.append(array(a))
@@ -129,6 +236,10 @@ def _load_legacy(buf: bytes):
     names = []
     for _ in range(nk):
         ln = u64()
+        if off + ln > len(buf):
+            raise CorruptCheckpointError(
+                "short name table in legacy NDArray file", path=fname,
+                offset=len(buf))
         names.append(buf[off:off + ln].decode())
         off += ln
     if names:

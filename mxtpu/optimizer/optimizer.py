@@ -636,7 +636,18 @@ class Updater:
         states = {k: jax.tree_util.tree_map(onp.asarray, v)
                   for k, v in self.states.items()}
         if dump_optimizer:
-            return pickle.dumps((states, self.optimizer))
+            # reference parity: param_dict is runtime wiring (live
+            # Parameters holding device buffers), not optimizer state —
+            # strip it for the pickle (depending on backend state the
+            # buffers can drag unpicklable Device refs into the dump)
+            # and restore after; the loading Trainer rebuilds it from
+            # its own params
+            pd = self.optimizer.param_dict
+            self.optimizer.param_dict = {}
+            try:
+                return pickle.dumps((states, self.optimizer))
+            finally:
+                self.optimizer.param_dict = pd
         return pickle.dumps(states)
 
     def set_states(self, states):
@@ -644,7 +655,16 @@ class Updater:
         if isinstance(states, tuple) and len(states) == 2 and not isinstance(
                 states[0], onp.ndarray):
             try:
+                prev = self.optimizer
                 states, self.optimizer = states
+                # the dump strips param_dict (see get_states); inherit
+                # the live wiring so per-param lr_mult/wd_mult keep
+                # applying for direct kvstore save/load round-trips
+                # (gluon Trainer.load_states rebuilds it afterwards
+                # regardless)
+                if not getattr(self.optimizer, "param_dict", None) \
+                        and prev is not None:
+                    self.optimizer.param_dict = prev.param_dict
             except Exception:
                 pass
         self.states = {

@@ -52,6 +52,22 @@ class SPMDTrainer:
     clip_gradient_norm : optional global-norm gradient clip fused into
         the compiled step (parity: gluon.utils.clip_global_norm); the
         norm reduces over ALL parameter shards on-device.
+    guard : in-step divergence containment (docs/guardian.md): the
+        compiled step additionally reduces an on-device finiteness check
+        over loss + every gradient shard and applies the update under a
+        ``lax.cond`` gate — a non-finite step leaves params and optimizer
+        state bit-identical to not having run it, in the SAME compiled
+        program (no recompile on the skip path).  Costs one small host
+        sync per step (the ``ok`` scalar, read into
+        ``self.last_step_ok``).  Default: the ``MXTPU_GUARDIAN`` env
+        var.
+    dynamic_loss_scale : fp16-style dynamic loss scaling fused into the
+        guarded step (implies ``guard``): the loss is scaled by a traced
+        device scalar, grads unscaled before clip/update, and the
+        grow/backoff automaton (x ``loss_scale_factor`` after
+        ``loss_scale_window`` clean steps, / on overflow, floor 1.0)
+        runs on device inside the same program — replacing the
+        reference's per-param host ``asnumpy()`` overflow loop.
     """
 
     def __init__(self, block, loss_fn, optimizer, mesh: DeviceMesh,
@@ -59,7 +75,12 @@ class SPMDTrainer:
                  optimizer_params: Optional[dict] = None,
                  batch_spec: P = P("dp"), label_spec: P = P("dp"),
                  remat: bool = False, donate: bool = True,
-                 clip_gradient_norm: Optional[float] = None):
+                 clip_gradient_norm: Optional[float] = None,
+                 guard: Optional[bool] = None,
+                 dynamic_loss_scale: bool = False,
+                 loss_scale_init: float = 2.0 ** 16,
+                 loss_scale_factor: float = 2.0,
+                 loss_scale_window: int = 2000):
         self._block = block
         self._loss_fn = loss_fn
         self._mesh = mesh
@@ -70,6 +91,15 @@ class SPMDTrainer:
         self._donate = donate
         self._clip_norm = (float(clip_gradient_norm)
                            if clip_gradient_norm is not None else None)
+        if guard is None:
+            from ..resilience.guardian import guard_enabled_default
+            guard = dynamic_loss_scale or guard_enabled_default()
+        self._guard = bool(guard) or bool(dynamic_loss_scale)
+        self._dyn_scale = bool(dynamic_loss_scale)
+        self._scale_cfg = (float(loss_scale_init), float(loss_scale_factor),
+                           int(loss_scale_window))
+        self._scale_state = None  # (scale f32, clean-step count i32) device
+        self.last_step_ok = True  # verdict of the most recent guarded step
         if isinstance(optimizer, str):
             optimizer = opt_mod.create(optimizer, **(optimizer_params or {}))
         cls = type(optimizer)
@@ -153,22 +183,23 @@ class SPMDTrainer:
         if self._remat:
             forward = jax.checkpoint(forward, static_argnums=())
 
-        def step(diff_leaves, aux_leaves, opt_states, lr, t, batch, label,
-                 key):
-            def loss_of(dl):
-                return forward(dl, aux_leaves, key, batch, label)
+        guard = self._guard
+        dyn_scale = self._dyn_scale
+        _, scale_factor, scale_window = self._scale_cfg
 
-            (loss, new_aux), grads = jax.value_and_grad(
-                loss_of, has_aux=True)(diff_leaves)
-            if clip_norm is not None:
-                # global-norm clipping fused into the step (parity:
-                # gluon.utils.clip_global_norm, but on-device over the
-                # sharded grads — XLA reduces across the mesh for free)
-                gnorm = jnp.sqrt(sum(
-                    jnp.sum(jnp.square(g.astype(jnp.float32)))
-                    for g in grads))
-                scale = jnp.minimum(1.0, clip_norm / (gnorm + 1e-6))
-                grads = [g * scale.astype(g.dtype) for g in grads]
+        def clip(grads):
+            if clip_norm is None:
+                return grads
+            # global-norm clipping fused into the step (parity:
+            # gluon.utils.clip_global_norm, but on-device over the
+            # sharded grads — XLA reduces across the mesh for free)
+            gnorm = jnp.sqrt(sum(
+                jnp.sum(jnp.square(g.astype(jnp.float32)))
+                for g in grads))
+            scale = jnp.minimum(1.0, clip_norm / (gnorm + 1e-6))
+            return [g * scale.astype(g.dtype) for g in grads]
+
+        def update(diff_leaves, grads, opt_states, lr, t):
             new_leaves = []
             new_states = []
             for leaf, g, st, wd in zip(diff_leaves, grads, opt_states, wds):
@@ -177,7 +208,76 @@ class SPMDTrainer:
                 w, s = optimizer._step_t(leaf, g, st, lr, wd, t)
                 new_leaves.append(w.astype(leaf.dtype))
                 new_states.append(s)
+            return new_leaves, new_states
+
+        def step(diff_leaves, aux_leaves, opt_states, lr, t, batch, label,
+                 key):
+            def loss_of(dl):
+                return forward(dl, aux_leaves, key, batch, label)
+
+            (loss, new_aux), grads = jax.value_and_grad(
+                loss_of, has_aux=True)(diff_leaves)
+            grads = clip(grads)
+            new_leaves, new_states = update(diff_leaves, grads, opt_states,
+                                            lr, t)
             return tuple(new_leaves), new_aux, tuple(new_states), loss
+
+        def guarded_step(diff_leaves, aux_leaves, opt_states, lr, t, batch,
+                         label, key, scale_state):
+            scale, clean = scale_state
+
+            def loss_of(dl):
+                loss, aux = forward(dl, aux_leaves, key, batch, label)
+                scaled = loss * scale.astype(loss.dtype) if dyn_scale \
+                    else loss
+                return scaled, (loss, aux)
+
+            (_, (loss, aux_out)), grads = jax.value_and_grad(
+                loss_of, has_aux=True)(diff_leaves)
+            # fused finiteness reduction over loss + EVERY gradient shard
+            # (the multi_all_finite rule, on the scaled grads so fp16
+            # overflow is caught before unscaling) — ONE device scalar,
+            # one host sync, instead of a per-param asnumpy() loop
+            ok = jnp.isfinite(loss.astype(jnp.float32))
+            for g in grads:
+                ok = ok & jnp.all(jnp.isfinite(g.astype(jnp.float32)))
+            if dyn_scale:
+                inv = jnp.float32(1.0) / scale
+                grads = [(g.astype(jnp.float32) * inv).astype(g.dtype)
+                         for g in grads]
+
+            # the containment gate: lax.cond, not where — XLA executes
+            # only the taken branch, so a healthy step pays no extra
+            # parameter traffic and a non-finite step passes the OLD
+            # buffers through everywhere — params, optimizer state, aux
+            # (running stats) — bit-identical to not having stepped, in
+            # this same program (no recompile on the skip path)
+            def take(_):
+                cg = clip(grads)
+                nl, ns = update(diff_leaves, cg, opt_states, lr, t)
+                return tuple(nl), tuple(aux_out), tuple(ns)
+
+            def keep(_):
+                return (tuple(diff_leaves), tuple(aux_leaves),
+                        tuple(opt_states))
+
+            new_leaves, new_aux, new_states = jax.lax.cond(
+                ok, take, keep, None)
+            if dyn_scale:
+                # grow/backoff automaton, on device: clean steps count up
+                # to the window then double the scale; overflow halves it
+                # (floor 1.0) and resets the count
+                grown = clean + 1
+                do_grow = grown >= scale_window
+                new_scale = jnp.where(
+                    ok, jnp.where(do_grow, scale * scale_factor, scale),
+                    jnp.maximum(jnp.float32(1.0), scale / scale_factor))
+                new_clean = jnp.where(
+                    ok, jnp.where(do_grow, 0, grown), 0)
+            else:
+                new_scale, new_clean = scale, clean
+            return (tuple(new_leaves), new_aux, tuple(new_states), loss,
+                    ok, (new_scale, new_clean))
 
         jm = self._mesh.jax_mesh
         rep = NamedSharding(jm, P())
@@ -194,20 +294,29 @@ class SPMDTrainer:
                  NamedSharding(jm, self._batch_spec),
                  NamedSharding(jm, self._label_spec), rep)
         out_sh = (diff_sh, aux_sh, state_sh, rep)
+        if guard:
+            in_sh = in_sh + ((rep, rep),)
+            out_sh = out_sh + (rep, (rep, rep))
         donate = (0, 1, 2) if self._donate else ()
-        return jax.jit(step, in_shardings=in_sh, out_shardings=out_sh,
+        return jax.jit(guarded_step if guard else step,
+                       in_shardings=in_sh, out_shardings=out_sh,
                        donate_argnums=donate)
 
     # -- public API ------------------------------------------------------
-    def step(self, data, label):
-        """One optimization step on a global batch. Returns the (device)
-        scalar loss NDArray; no host sync — call .asnumpy() to block."""
+    def _ensure_staged(self, data):
+        """Resolve deferred shapes with one imperative forward and stage
+        params/optimizer state onto the mesh (idempotent)."""
         if not self._params_sharded:
-            # resolve deferred shapes with one imperative forward
             with autograd.pause(train_mode=False):
                 self._block(data if isinstance(data, NDArray)
                             else nd.array(data))
             self._stage_params()
+
+    def step(self, data, label):
+        """One optimization step on a global batch. Returns the (device)
+        scalar loss NDArray; no host sync — call .asnumpy() to block.
+        (Guarded trainers additionally sync the one ``ok`` scalar.)"""
+        self._ensure_staged(data)
 
         data = data if isinstance(data, NDArray) else nd.array(data)
         label = label if isinstance(label, NDArray) else nd.array(label)
@@ -242,9 +351,31 @@ class SPMDTrainer:
 
         diff_leaves = tuple(p.data()._data for p in self._diff_params)
         aux_leaves = tuple(p.data()._data for p in self._aux_params)
-        new_leaves, new_aux, new_states, loss = jitted(
-            diff_leaves, aux_leaves, tuple(self._opt_states), lr, t, batch,
-            lab, _random.next_key())
+        if self._guard:
+            if self._scale_state is None:
+                self._scale_state = (jnp.float32(self._scale_cfg[0]
+                                                 if self._dyn_scale
+                                                 else 1.0), jnp.int32(0))
+            new_leaves, new_aux, new_states, loss, ok, scale_state = \
+                jitted(diff_leaves, aux_leaves, tuple(self._opt_states),
+                       lr, t, batch, lab, _random.next_key(),
+                       self._scale_state)
+            self._scale_state = scale_state
+            okb = bool(ok)  # the ONE host sync of the guarded step
+            self.last_step_ok = okb
+            if not okb:
+                # the gate selected the old values — undo the step-count
+                # advance so state is indistinguishable from not stepping
+                from ..resilience.counters import bump
+                bump("guardian_skips")
+                self._num_update -= 1
+                for i in range(len(self._diff_params)):
+                    iuc[i] = self._num_update
+                self._optimizer.num_update = self._num_update
+        else:
+            new_leaves, new_aux, new_states, loss = jitted(
+                diff_leaves, aux_leaves, tuple(self._opt_states), lr, t,
+                batch, lab, _random.next_key())
         for p, leaf in zip(self._diff_params, new_leaves):
             p.data()._rebind(leaf)
         for p, leaf in zip(self._aux_params, new_aux):
@@ -266,44 +397,95 @@ class SPMDTrainer:
     def set_learning_rate(self, lr):
         self._optimizer.lr = lr
 
+    @property
+    def loss_scale(self):
+        """Current dynamic loss scale (host float; syncs the device
+        scalar).  1.0 when guarding without dynamic scaling; None when
+        unguarded."""
+        if self._scale_state is None:
+            if not self._guard:
+                return None
+            return self._scale_cfg[0] if self._dyn_scale else 1.0
+        return float(jax.device_get(self._scale_state[0]))
+
     # -- checkpoint/resume (parity: gluon.Trainer.save_states /
     # load_states; required by the preemption-restart story, SURVEY §5) --
     def save_states(self, fname):
-        """Serialize optimizer state + step count to fname.  State leaves
-        are gathered to host numpy — the file is mesh-layout independent,
-        so a restart may use a different device topology."""
+        """Serialize optimizer state + step count (+ dynamic loss-scale
+        state) to fname.  State leaves are gathered to host numpy — the
+        file is mesh-layout independent, so a restart may use a
+        different device topology.  The write is atomic with a CRC32
+        manifest sidecar (docs/guardian.md): a crash mid-save leaves the
+        previous file intact, and ``load_states`` verifies before
+        parsing."""
         import pickle
 
         import numpy as onp
 
         states = jax.tree_util.tree_map(lambda a: onp.asarray(a),
                                         tuple(self._opt_states))
-        with open(fname, "wb") as f:
-            pickle.dump({"num_update": self._num_update,
-                         "opt_states": states}, f)
+        scale_state = self._scale_state
+        if scale_state is not None:
+            scale_state = tuple(onp.asarray(s) for s in scale_state)
+        blob = pickle.dumps({"num_update": self._num_update,
+                             "opt_states": states,
+                             "scale_state": scale_state})
+        from ..resilience import checkpoint as _ckpt
+        _ckpt.write_verified(fname, blob)
 
-    def load_states(self, fname):
-        """Restore optimizer state saved by save_states.  Must be called
-        after the first step (or after parameters are staged) so the
-        sharding layout to re-place the state onto is known."""
-        import pickle
-
-        with open(fname, "rb") as f:
-            blob = pickle.load(f)
+    def _restore_host_state(self, num_update, opt_states, scale_state):
+        """Re-place host-side (numpy) optimizer state + step count +
+        loss-scale state onto the CURRENT shardings.  The single restore
+        path shared by :meth:`load_states` and the guardian's rollback
+        (step() re-derives per-index update counts from ``_num_update``,
+        so nothing else needs touching).  A None ``scale_state`` resets
+        the scale to its lazy initial value — a drifted scale surviving
+        a restore would break bit-exact replay."""
         if not self._params_sharded:
             raise ValueError(
-                "load_states: run one step first (or stage parameters) so "
-                "optimizer state shardings exist to place the load onto")
-        if len(blob["opt_states"]) != len(self._opt_states):
+                "state restore: run one step first (or stage parameters) "
+                "so optimizer state shardings exist to place the load "
+                "onto")
+        if len(opt_states) != len(self._opt_states):
             raise ValueError(
-                "load_states: checkpoint has %d optimizer-state entries "
-                "but this trainer has %d parameters — architecture "
-                "mismatch or truncated file"
-                % (len(blob["opt_states"]), len(self._opt_states)))
-        self._num_update = int(blob["num_update"])
+                "state restore: checkpoint has %d optimizer-state "
+                "entries but this trainer has %d parameters — "
+                "architecture mismatch or truncated file"
+                % (len(opt_states), len(self._opt_states)))
+        self._num_update = int(num_update)
+        self._optimizer.num_update = self._num_update
         restored = []
-        for cur, saved in zip(self._opt_states, blob["opt_states"]):
+        for cur, saved in zip(self._opt_states, opt_states):
             restored.append(jax.tree_util.tree_map(
                 lambda c, s: jax.device_put(jnp.asarray(s), c.sharding),
                 cur, saved))
         self._opt_states = restored
+        if scale_state is None:
+            self._scale_state = None
+        else:
+            s, clean = scale_state
+            self._scale_state = (jnp.float32(s), jnp.int32(clean))
+
+    def load_states(self, fname):
+        """Restore optimizer state saved by save_states.  Must be called
+        after the first step (or after parameters are staged) so the
+        sharding layout to re-place the state onto is known.  Verifies
+        the CRC manifest when present and raises a typed
+        :class:`~mxtpu.resilience.CorruptCheckpointError` on damaged or
+        unparseable files."""
+        import pickle
+
+        from ..resilience import checkpoint as _ckpt
+
+        with open(fname, "rb") as f:
+            raw = f.read()
+        _ckpt.verify(fname, data=raw)
+        try:
+            blob = pickle.loads(raw)
+        except (pickle.UnpicklingError, EOFError, AttributeError,
+                ValueError) as e:
+            raise _ckpt.CorruptCheckpointError(
+                "trainer state unparseable (%s: %s)"
+                % (type(e).__name__, e), path=fname) from None
+        self._restore_host_state(blob["num_update"], blob["opt_states"],
+                                 blob.get("scale_state"))

@@ -20,7 +20,7 @@ import threading
 from .resilience.faults import inject as _inject
 
 __all__ = ["install", "uninstall", "preempted", "reset",
-           "PreemptionCheckpointHandler"]
+           "PreemptionCheckpointHandler", "restore_latest"]
 
 _lock = threading.Lock()
 _state = {"flag": False, "save_fn": None, "prev": {}, "signals": ()}
@@ -100,6 +100,107 @@ def reset():
         _state["flag"] = False
 
 
+def restore_latest(model_prefix, net, trainer=None):
+    """Restore the newest VERIFIED preemption checkpoint written by
+    :class:`PreemptionCheckpointHandler` under ``model_prefix``.
+
+    Walks the rotated generations newest → oldest
+    (``-preempt.params[.N]``), verifies each params (+ states, when a
+    trainer is given) pair against its CRC manifest, and loads the first
+    intact pair — a truncated, bit-flipped, or missing file falls back
+    to the previous good generation (counted in
+    ``resilience.counters()['ckpt_fallbacks']``).  The states file is
+    matched to its params by the save-event token both manifests carry,
+    not by suffix: a crash between the pair's two commit renames leaves
+    suffix-aligned files from different save events (each CRC-clean),
+    and token matching makes that torn pair fall back to the newest
+    consistent one instead of silently loading new weights with stale
+    optimizer state.  Returns the
+    generation index loaded (0 = the most recent save); raises
+    :class:`~mxtpu.resilience.CorruptCheckpointError` when no generation
+    survives."""
+    from .resilience import checkpoint as _ckpt
+    from .resilience.counters import bump
+
+    import os
+
+    pfile = "%s-preempt.params" % model_prefix
+    sfile = "%s-preempt.states" % model_prefix
+    # scan generations independently: a deleted NEWEST file must not
+    # hide the intact older ones behind it (the missing-file case of the
+    # corruption matrix falls back like any other damage).  A generation
+    # is a candidate only if some trace of it exists on disk — a payload
+    # or a manifest — so a prefix with no checkpoints at all reports
+    # "none present" rather than a phantom corrupt generation 0.
+    candidates = []
+    for g in range(max(64, _ckpt.default_keep())):
+        suffix = "" if g == 0 else ".%d" % g
+        paths = (pfile + suffix, pfile + suffix + _ckpt.MANIFEST_SUFFIX)
+        if any(os.path.exists(p) for p in paths):
+            candidates.append(g)
+    if not candidates:
+        raise _ckpt.CorruptCheckpointError(
+            "no preemption checkpoint under prefix %r (no generation "
+            "present — never saved, or the prefix is wrong)"
+            % model_prefix)
+    def _states_for(psuffix):
+        """The states file belonging to the params generation at
+        ``psuffix``.  The pair is matched by the shared save-event token
+        the handler stamps into both manifests — the two files commit
+        with separate renames, so a crash between them leaves suffix
+        "aligned" files from DIFFERENT saves, each individually
+        CRC-clean; token matching finds the states file that was really
+        written alongside these params, whatever suffix rotation left it
+        at.  Tokenless checkpoints (written before stamping) fall back
+        to suffix-aligned pairing."""
+        token = _ckpt.save_event(pfile + psuffix)
+        if token is None:
+            return sfile + psuffix
+        for g2 in range(max(64, _ckpt.default_keep())):
+            cand = sfile + ("" if g2 == 0 else ".%d" % g2)
+            if os.path.exists(cand) and _ckpt.save_event(cand) == token:
+                return cand
+        raise _ckpt.CorruptCheckpointError(
+            "no states file carries save event %s — torn pair from a "
+            "crash between the params and states commits" % token,
+            path=pfile + psuffix)
+
+    last_err = None
+    for g in candidates:
+        suffix = "" if g == 0 else ".%d" % g
+        try:
+            fns = (pfile + suffix,)
+            if trainer is not None:
+                fns = (pfile + suffix, _states_for(suffix))
+            for fn in fns:
+                # cheap pre-checks only (existence + manifest presence,
+                # the required=True contract) — the load paths below do
+                # the ONE verified read each; a full CRC pass here would
+                # double restore I/O on a multi-GB checkpoint
+                if not os.path.exists(fn):
+                    raise _ckpt.CorruptCheckpointError(
+                        "checkpoint file missing", path=fn)
+                if not _ckpt.has_manifest(fn):
+                    raise _ckpt.CorruptCheckpointError(
+                        "checkpoint has no manifest (%s sidecar missing) "
+                        "but verification was required"
+                        % _ckpt.MANIFEST_SUFFIX, path=fn)
+            net.load_parameters(fns[0])
+            if trainer is not None:
+                trainer.load_states(fns[1])
+            return g
+        except _ckpt.CorruptCheckpointError as e:
+            logging.warning("preemption restore: generation %d unusable "
+                            "(%s) — falling back", g, e)
+            bump("ckpt_fallbacks")
+            last_err = e
+    raise _ckpt.CorruptCheckpointError(
+        "no verified preemption checkpoint under prefix %r (%d generation"
+        "(s) present, all damaged or incomplete%s)"
+        % (model_prefix, len(candidates),
+           "; last error: %s" % last_err if last_err else ""))
+
+
 class PreemptionCheckpointHandler:
     """Estimator event handler: saves parameters + trainer states on
     preemption and stops the fit loop at the next batch boundary
@@ -112,20 +213,58 @@ class PreemptionCheckpointHandler:
 
         with PreemptionCheckpointHandler(prefix, net, trainer) as h:
             est.fit(...)   # or a manual loop polling h.stop_training
+
+    ``keep``: checkpoint generations retained (default
+    ``MXTPU_CKPT_KEEP``).  Each save STAGES the new
+    ``-preempt.params``/``.states`` pair to ``.staging`` names first
+    (atomic writes + CRC32 manifests), then commits: rotate the previous
+    pair to ``.1``, ``.2``, … (logrotate-style, manifests travel along)
+    and rename the staged files into place.  The fallible write phase —
+    including every ``retry`` re-attempt — therefore never touches the
+    previous good generations; a save that dies inside the grace window
+    can never destroy them or re-rotate the history.  Restore through
+    :func:`restore_latest`, which verifies and falls back past damaged
+    generations (docs/guardian.md).
     """
 
     def __init__(self, model_prefix, net, trainer=None,
-                 signals=(signal.SIGTERM,), retry=None):
+                 signals=(signal.SIGTERM,), retry=None, keep=None):
         self._prefix = model_prefix
         self._net = net
         self._trainer = trainer
+        self._keep = keep
         self.stop_training = False  # polled by estimator.fit
         install(self._save, signals, retry=retry)
 
     def _save(self):
-        self._net.save_parameters("%s-preempt.params" % self._prefix)
+        from .resilience import checkpoint as _ckpt
+        pfile = "%s-preempt.params" % self._prefix
+        sfile = "%s-preempt.states" % self._prefix
+        # STAGE first, commit after: the writes (the part that can fail,
+        # and the part a RetryPolicy re-runs) target staging names, so a
+        # failed or retried attempt never touches the previous good
+        # generations — rotating up front would let each retry re-rotate,
+        # eating the history off the keep-K end and pairing params with
+        # states from different save events.  The commit phase is pure
+        # renames, entered only once both files exist.
+        # Both files carry one shared save-event token in their
+        # manifests: the two commits below are separate renames, so a
+        # crash between them pairs params and states from DIFFERENT
+        # saves — each individually CRC-clean.  restore_latest matches
+        # by token, so a torn pair is detected and the previous
+        # consistent pair loads instead.
+        import os
+        token = os.urandom(8).hex()
+        self._net.save_parameters(pfile + ".staging")
+        _ckpt.stamp_save_event(pfile + ".staging", token)
         if self._trainer is not None:
-            self._trainer.save_states("%s-preempt.states" % self._prefix)
+            self._trainer.save_states(sfile + ".staging")
+            _ckpt.stamp_save_event(sfile + ".staging", token)
+        _ckpt.rotate_history(pfile, keep=self._keep)
+        _ckpt.move_with_manifest(pfile + ".staging", pfile)
+        if self._trainer is not None:
+            _ckpt.rotate_history(sfile, keep=self._keep)
+            _ckpt.move_with_manifest(sfile + ".staging", sfile)
 
     def batch_end(self, estimator, *args, **kwargs):
         if preempted():

@@ -23,7 +23,7 @@ import numpy as onp
 
 __all__ = ["seed", "uniform", "normal", "randint", "randn", "shuffle",
            "multinomial", "gamma", "exponential", "poisson",
-           "generator", "next_key"]
+           "generator", "next_key", "get_state", "set_state"]
 
 
 class _KeyRing:
@@ -87,6 +87,22 @@ def next_key():
     if _TRACE_STACK:
         return _TRACE_STACK[-1].next_key()
     return _GLOBAL.next_key()
+
+
+def get_state():
+    """(seed, draw_counter) of the global key-ring — everything needed to
+    reproduce the stream from here.  Checkpoint/rollback support
+    (resilience.guardian): saving this at a step boundary and restoring
+    it makes the replayed key stream bit-identical."""
+    return (_GLOBAL._seed, _GLOBAL._counter)
+
+
+def set_state(state):
+    """Restore a (seed, draw_counter) snapshot from :func:`get_state`."""
+    s, counter = state
+    _GLOBAL._seed = int(s)
+    _GLOBAL._root = None  # re-derived lazily from the restored seed
+    _GLOBAL._counter = int(counter)
 
 
 def seed(seed_state: int, ctx: str = "all"):

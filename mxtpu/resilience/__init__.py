@@ -24,10 +24,14 @@ counters (:func:`counters`) are exported here.
 """
 
 from ..base import MXTPUError
+from .checkpoint import (CheckpointSet, CorruptCheckpointError,
+                         rotate_history, verify, verify_dir,
+                         write_verified)
 from .counters import bump, counters, reset_counters
 from .faults import (SITES, FaultPlan, FaultRule, InjectedFault,
                      active_plan, fault_plan, inject, reload_env_plan,
                      site_stats)
+from .guardian import DivergenceError, Guardian, guard_enabled_default
 from .retry import RetryPolicy
 
 __all__ = [
@@ -35,6 +39,9 @@ __all__ = [
     "active_plan", "site_stats", "reload_env_plan", "SITES",
     "RetryPolicy", "LoadShedError",
     "bump", "counters", "reset_counters",
+    "CheckpointSet", "CorruptCheckpointError", "write_verified",
+    "verify", "verify_dir", "rotate_history",
+    "Guardian", "DivergenceError", "guard_enabled_default",
 ]
 
 

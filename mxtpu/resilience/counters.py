@@ -26,6 +26,11 @@ _COUNTERS = {
     "quarantined_slots": 0,    # serving slots scrubbed after a fault
     "deadline_evictions": 0,   # requests evicted past their deadline
     "shed_requests": 0,        # submissions rejected by max_pending
+    "guardian_skips": 0,       # non-finite steps contained (update gated off)
+    "guardian_rollbacks": 0,   # rollback-to-verified-checkpoint recoveries
+    "ckpt_writes": 0,          # verified checkpoint payloads written
+    "ckpt_corruptions": 0,     # checkpoints that failed verification
+    "ckpt_fallbacks": 0,       # restores that fell back past a bad checkpoint
 }
 
 

@@ -24,6 +24,14 @@ site                fires at
                     ``contrib.orbax_ckpt.save_trainer``
 ``engine.flush``    start of a bulk-segment flush
                     (``engine.BulkSegment.flush``)
+``guardian.check``  once per guardian-supervised step, before the batch
+                    is fetched (``resilience.guardian.Guardian.run``);
+                    a raise forces the divergence verdict → rollback
+``ckpt.write``      before any byte of a verified checkpoint write
+                    lands (``resilience.checkpoint.write_verified``) —
+                    a raise models a failed write, previous file intact
+``ckpt.verify``     at each checkpoint verification
+                    (``resilience.checkpoint.verify`` / ``verify_dir``)
 ==================  =====================================================
 
 ``inject(site, key=...)`` may be called with any site name — the table
@@ -77,7 +85,8 @@ __all__ = ["InjectedFault", "FaultRule", "FaultPlan", "fault_plan",
 
 #: the documented injection sites (see module docstring for locations)
 SITES = ("serving.step", "serving.admit", "kvstore.reduce",
-         "checkpoint.save", "engine.flush")
+         "checkpoint.save", "engine.flush", "guardian.check",
+         "ckpt.write", "ckpt.verify")
 
 
 class InjectedFault(MXTPUError):

@@ -1,0 +1,343 @@
+"""Training guardian: divergence containment policy + verified-checkpoint
+rollback/replay recovery (docs/guardian.md).
+
+Layered on the two mechanisms the trainers provide:
+
+- **In-step containment** (``SPMDTrainer(guard=True)`` / gluon
+  ``Trainer(guard=True)``): the step itself detects non-finite
+  grads/loss on device and gates the update off, leaving params and
+  optimizer state bit-identical to not having stepped.  The trainer
+  exposes the verdict as ``trainer.last_step_ok``.
+- **Verified checkpoints** (:mod:`~mxtpu.resilience.checkpoint`):
+  atomic, CRC-manifested, rotated — restore falls back past corrupted
+  files automatically.
+
+The :class:`Guardian` adds the policy: count consecutive contained
+skips, watch for loss spikes, and when divergence persists, roll the
+trainer back to the last *verified* checkpoint and replay.  Replay is
+bit-exact because a checkpoint captures everything the step stream
+depends on: parameters, optimizer state, ``num_update``, the dynamic
+loss-scale state, and the RNG key-ring counter
+(:func:`mxtpu.random.get_state`) — and because :meth:`Guardian.run`
+requires the data stream to be a pure function of the step index
+(``data_fn(step)``), re-seeding it to a step is just calling it with
+that step again.
+
+Fault site ``guardian.check`` fires once per supervised step before the
+batch is fetched; a planned raise there forces the divergence verdict →
+immediate rollback, which makes the whole recovery path deterministically
+testable with zero real NaNs (counter-driven plans advance across the
+replay, so an ``@N``/``xC`` rule does not re-fire forever).
+
+``MXTPU_GUARDIAN`` (truthy) flips the trainers' default ``guard=`` on
+process-wide; ``MXTPU_CKPT_KEEP`` sets the rotation depth.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import pickle
+from typing import Callable, Optional
+
+from ..base import MXTPUError
+from .checkpoint import CheckpointSet
+from .counters import bump
+from .faults import inject
+
+__all__ = ["Guardian", "DivergenceError", "guard_enabled_default"]
+
+
+class DivergenceError(MXTPUError):
+    """Training diverged beyond what the guardian can recover: rollback
+    budget exhausted without progress, or no verified checkpoint left to
+    roll back to."""
+
+
+def guard_enabled_default() -> bool:
+    """Ambient default for the trainers' ``guard=`` option: truthy
+    ``MXTPU_GUARDIAN`` turns in-step containment on process-wide."""
+    v = os.environ.get("MXTPU_GUARDIAN", "").strip().lower()
+    return v not in ("", "0", "false", "off", "no")
+
+
+class Guardian:
+    """Divergence policy + rollback/replay driver over a guarded
+    :class:`~mxtpu.parallel.trainer.SPMDTrainer`.
+
+    Parameters
+    ----------
+    ckpt_dir : directory for the rotated verified checkpoints.
+    keep : checkpoints retained (default ``MXTPU_CKPT_KEEP``, 3).
+    max_skips : consecutive contained (non-finite, update-gated-off)
+        steps tolerated before rolling back.  Isolated skips just move
+        on — the bad batch is consumed, state untouched.  When the
+        streak hits the limit, its step indices are QUARANTINED before
+        the rollback (replay is bit-exact, so re-running them would
+        reproduce the identical skips forever); the replayed run is
+        bit-identical to one that never saw those batches.
+    max_rollbacks : rollbacks tolerated without reaching a NEW
+        checkpoint; exceeding it raises :class:`DivergenceError` (the
+        run is looping, not recovering).
+    spike_factor : optional late-divergence detector: a *finite* loss
+        greater than ``spike_factor`` x the median of the last
+        ``spike_window`` healthy losses triggers an immediate rollback
+        (the poisoned update already applied, so containment can't help
+        — only rollback can).  The spiking step is then QUARANTINED:
+        replay skips that batch entirely, because a bit-exact replay
+        would reproduce the same spike and loop forever.  Costs one
+        extra host sync per step; None (default) disables it.
+    checkpoint_every : steps between verified checkpoints.
+    """
+
+    def __init__(self, ckpt_dir: str, keep: Optional[int] = None,
+                 max_skips: int = 2, max_rollbacks: int = 3,
+                 spike_factor: Optional[float] = None,
+                 spike_window: int = 16, checkpoint_every: int = 25,
+                 name: str = "guardian"):
+        self.ckpts = CheckpointSet(ckpt_dir, name=name, keep=keep)
+        self.max_skips = int(max_skips)
+        self.max_rollbacks = int(max_rollbacks)
+        self.spike_factor = (float(spike_factor)
+                             if spike_factor is not None else None)
+        self.spike_window = int(spike_window)
+        self.checkpoint_every = int(checkpoint_every)
+        self.stats = {"steps": 0, "skips": 0, "rollbacks": 0,
+                      "checkpoints": 0, "ckpt_write_failures": 0,
+                      "spikes": 0}
+        self._loss_window: list = []
+        self._rollbacks_since_ckpt = 0
+        self._quarantined_steps: set = set()
+
+    # -- trainer snapshot/restore ----------------------------------------
+    @staticmethod
+    def _snapshot(trainer, step: int) -> bytes:
+        """Full host-side state blob: params + optimizer state +
+        num_update + loss-scale state + RNG key-ring counter + step."""
+        import numpy as onp
+
+        import jax
+
+        from .. import random as _random
+
+        if not getattr(trainer, "_params_sharded", False):
+            raise ValueError(
+                "guardian checkpoint: run one trainer.step first so "
+                "parameters and optimizer state exist on the mesh")
+        params = {p.name: onp.asarray(p.data()._data)
+                  for p in trainer._diff_params + trainer._aux_params}
+        states = jax.tree_util.tree_map(lambda a: onp.asarray(a),
+                                        tuple(trainer._opt_states))
+        scale_state = getattr(trainer, "_scale_state", None)
+        if scale_state is not None:
+            scale_state = tuple(onp.asarray(s) for s in scale_state)
+        return pickle.dumps({
+            "step": int(step),
+            "num_update": int(trainer._num_update),
+            "params": params,
+            "opt_states": states,
+            "scale_state": scale_state,
+            "rng": _random.get_state(),
+        })
+
+    @staticmethod
+    def _restore(trainer, blob: bytes) -> int:
+        """Re-place a snapshot onto the trainer's CURRENT shardings and
+        restore the RNG stream; returns the snapshot's step index."""
+        import jax
+        import jax.numpy as jnp
+
+        from .. import random as _random
+
+        saved = pickle.loads(blob)
+        if not getattr(trainer, "_params_sharded", False):
+            raise ValueError(
+                "guardian restore: run one trainer.step first so target "
+                "shardings exist to place the restore onto")
+        for p in trainer._diff_params + trainer._aux_params:
+            if p.name not in saved["params"]:
+                raise ValueError(
+                    "guardian restore: checkpoint is missing parameter "
+                    "%r — architecture mismatch" % p.name)
+            holder = p.data()
+            holder._rebind(jax.device_put(
+                jnp.asarray(saved["params"][p.name]),
+                holder._data.sharding))
+        # optimizer state + step count + scale state: the same restore
+        # path load_states uses (trainer-owned, so a state-layout change
+        # there cannot silently strand the rollback)
+        trainer._restore_host_state(saved["num_update"],
+                                    saved["opt_states"],
+                                    saved.get("scale_state"))
+        _random.set_state(saved["rng"])
+        return int(saved["step"])
+
+    # -- checkpoint/rollback ----------------------------------------------
+    def checkpoint(self, trainer, step: int, required: bool = False) -> bool:
+        """Write a verified checkpoint at the current step boundary.
+        A failed write (injected or real) is contained: logged and
+        counted, training continues on the previous checkpoints.
+        ``required=True`` (the baseline) re-raises instead — containment
+        there would leave the guardian with no rollback target at all."""
+        try:
+            self.ckpts.save(int(step), self._snapshot(trainer, step))
+        except Exception:
+            if required:
+                raise
+            logging.exception("guardian: checkpoint write at step %d "
+                              "failed — continuing on previous", step)
+            self.stats["ckpt_write_failures"] += 1
+            return False
+        self.stats["checkpoints"] += 1
+        self._rollbacks_since_ckpt = 0
+        return True
+
+    def rollback(self, trainer) -> int:
+        """Restore the newest checkpoint that verifies (falling back
+        past corrupted ones) and return its step index.  The counters
+        (``stats['rollbacks']``, ``guardian_rollbacks``) record COMPLETED
+        restores only — a budget-exhausted or no-checkpoint-left attempt
+        raises without bumping them, so a DivergenceError post-mortem
+        never reads one more successful recovery than happened."""
+        if self._rollbacks_since_ckpt >= self.max_rollbacks:
+            raise DivergenceError(
+                "guardian: %d rollbacks without reaching a new "
+                "checkpoint — training is diverging faster than it "
+                "recovers" % self._rollbacks_since_ckpt)
+        got = self.ckpts.latest_verified()
+        if got is None:
+            raise DivergenceError(
+                "guardian: rollback requested but no verified checkpoint "
+                "survives in %r" % self.ckpts.directory)
+        step, blob = got
+        restored = self._restore(trainer, blob)
+        self.stats["rollbacks"] += 1
+        bump("guardian_rollbacks")
+        self._rollbacks_since_ckpt += 1
+        self._loss_window.clear()
+        logging.warning("guardian: rolled back to verified checkpoint at "
+                        "step %d", restored)
+        return restored
+
+    # -- spike policy ------------------------------------------------------
+    def _is_spike(self, loss_value: float) -> bool:
+        if self.spike_factor is None:
+            return False
+        w = self._loss_window
+        spike = False
+        if len(w) >= max(4, self.spike_window // 4):
+            med = sorted(w)[len(w) // 2]
+            spike = loss_value > self.spike_factor * max(med, 1e-30)
+        if not spike:
+            w.append(loss_value)
+            if len(w) > self.spike_window:
+                w.pop(0)
+        return spike
+
+    # -- the supervised loop ----------------------------------------------
+    def run(self, trainer, data_fn: Callable[[int], tuple],
+            num_steps: int, start_step: int = 0) -> dict:
+        """Drive ``trainer`` for ``num_steps`` steps with containment,
+        periodic verified checkpoints, and rollback/replay.
+
+        ``data_fn(step) -> (data, label)`` MUST be a pure function of
+        the step index — that is the re-seeding contract that makes
+        replay after a rollback bit-exact (a stateful iterator cannot be
+        rewound).  The trainer must have been built with ``guard=True``
+        (or ``MXTPU_GUARDIAN``) so skipped steps are contained in-step.
+
+        Returns a copy of ``self.stats``.
+        """
+        if not getattr(trainer, "_guard", False):
+            raise ValueError(
+                "Guardian.run requires a guarded trainer — construct it "
+                "with guard=True (or set MXTPU_GUARDIAN=1) so non-finite "
+                "steps are contained inside the compiled step")
+        step = int(start_step)
+        skip_window: list = []  # step indices of the current skip streak
+        if not getattr(trainer, "_params_sharded", True):
+            # stage params before the baseline checkpoint (same bootstrap
+            # the first trainer.step would run)
+            data, _ = data_fn(step)
+            trainer._ensure_staged(data)
+        if self.ckpts.latest_verified() is None:
+            # baseline checkpoint: rollback must always have a target, so
+            # a failure HERE (unwritable dir, wrong trainer type) raises
+            # instead of being contained — training on with zero
+            # checkpoints would turn the first rollback into an
+            # unrecoverable DivergenceError
+            self.checkpoint(trainer, step, required=True)
+        last_ckpt = step  # boundary covered at entry (baseline or resume)
+        while step < num_steps:
+            # periodic save at the TOP of the loop so every path that
+            # advances step — healthy, contained skip, quarantined —
+            # crosses it; a bottom-of-loop save would silently drop any
+            # generation whose boundary is reached via a skip.  last_ckpt
+            # stops a re-save of the very state a rollback just restored.
+            # DEFERRED while a skip streak is in progress: a contained
+            # skip still advances the RNG key-ring (the key is an input
+            # to the compiled step), so a mid-streak snapshot would bake
+            # in draws of steps that may be quarantined — replay from it
+            # would shift every later key vs the advertised
+            # never-saw-those-batches run.  The schedule is RELATIVE
+            # (every checkpoint_every steps since the last save) so a
+            # deferred boundary is caught up at the first streak-free
+            # step instead of being dropped until the next multiple.
+            if (step - last_ckpt >= self.checkpoint_every
+                    and not skip_window):
+                self.checkpoint(trainer, step)
+                last_ckpt = step
+            forced = False
+            try:
+                inject("guardian.check", key=step)
+            except Exception:
+                # a planned raise at guardian.check = forced divergence
+                # verdict: the deterministic trigger for the rollback path
+                forced = True
+            if forced:
+                step = self.rollback(trainer)
+                last_ckpt = step  # that checkpoint IS the current state
+                skip_window.clear()
+                continue
+            if step in self._quarantined_steps:
+                step += 1  # quarantined batch: never re-applied
+                continue
+            data, label = data_fn(step)
+            loss = trainer.step(data, label)
+            self.stats["steps"] += 1
+            if not trainer.last_step_ok:
+                # contained in-step: state bit-identical to not stepping;
+                # the batch is consumed, so move on — rollback only when
+                # skips persist (a stuck loss-scale/NaN regime)
+                self.stats["skips"] += 1
+                skip_window.append(step)
+                if len(skip_window) >= self.max_skips:
+                    # quarantine the whole streak before rolling back:
+                    # replay is bit-exact, so WITHOUT quarantine it would
+                    # reproduce the identical skips and loop straight
+                    # into DivergenceError — the streak's batches are
+                    # consumed poison, and skipped steps never touched
+                    # state, so the post-replay result is bit-identical
+                    # to a run that never saw them (same as the spike
+                    # path)
+                    self._quarantined_steps.update(skip_window)
+                    step = self.rollback(trainer)
+                    last_ckpt = step
+                    skip_window.clear()
+                    continue
+                step += 1
+                continue
+            skip_window.clear()
+            if self.spike_factor is not None:
+                lv = float(loss.asnumpy())
+                if self._is_spike(lv):
+                    # the poisoned update applied — roll back, and
+                    # quarantine this batch so the (bit-exact) replay
+                    # does not walk into the same spike forever
+                    self.stats["spikes"] += 1
+                    self._quarantined_steps.add(step)
+                    step = self.rollback(trainer)
+                    last_ckpt = step
+                    continue
+            step += 1
+        return dict(self.stats)

@@ -1,0 +1,1023 @@
+"""Training guardian tests (docs/guardian.md): in-step divergence
+containment (the bit-exactness pair), dynamic loss scaling inside the
+compiled step, verified-checkpoint rollback/replay, and the corruption
+matrix (truncation / bit-flip / missing file → previous-good fallback),
+all driven by the deterministic fault harness — no real crashes, no
+real NaN-producing hardware needed."""
+
+import os
+import signal
+import struct
+
+import numpy as np
+import pytest
+
+import mxtpu as mx
+from mxtpu import amp, autograd, gluon, nd, preemption
+from mxtpu.gluon import nn
+from mxtpu.parallel import make_mesh, SPMDTrainer
+from mxtpu.resilience import (CheckpointSet, CorruptCheckpointError,
+                              DivergenceError, Guardian, counters,
+                              fault_plan)
+from mxtpu.resilience import checkpoint as ckpt_mod
+
+
+def _build_spmd(seed=7, opt="adam", in_units=8, **kw):
+    mx.random.seed(seed)
+    net = nn.Dense(4, in_units=in_units, prefix="d_")
+    net.initialize()
+    tr = SPMDTrainer(net, gluon.loss.L2Loss(), opt, make_mesh(dp=2),
+                     optimizer_params={"learning_rate": 1e-2}, **kw)
+    return net, tr
+
+
+def _batches(n=30, seed=1, nan_steps=()):
+    R = np.random.RandomState(seed)
+    out = []
+    for i in range(n):
+        X = R.randn(8, 8).astype(np.float32)
+        if i in nan_steps:
+            X[0, 0] = np.nan
+        out.append((nd.array(X), nd.array(R.randn(8, 4).astype("f"))))
+    return out
+
+
+def _state_leaves(tr):
+    import jax
+    return [np.asarray(leaf) for leaf in
+            jax.tree_util.tree_leaves(tuple(tr._opt_states))]
+
+
+# ------------------------------------------------------- in-step containment
+
+class TestInStepContainment:
+    def test_skip_is_bit_identical_to_not_stepping_one_program(self):
+        """Acceptance pair (a): a non-finite step leaves params AND
+        optimizer state bit-identical to not having run it, inside the
+        ONE compiled program — no recompile on the skip path."""
+        net, tr = _build_spmd(guard=True)
+        (X, y), = _batches(1)
+        tr.step(X, y)
+        assert tr.last_step_ok
+        w0 = net.weight.data().asnumpy().copy()
+        b0 = net.bias.data().asnumpy().copy()
+        s0 = _state_leaves(tr)
+        n0 = tr._num_update
+        c0 = counters()
+
+        Xn = X.asnumpy().copy()
+        Xn[0, 0] = np.nan
+        loss = tr.step(nd.array(Xn), y)
+        assert not tr.last_step_ok
+        assert not np.isfinite(float(loss.asnumpy()))
+        np.testing.assert_array_equal(net.weight.data().asnumpy(), w0)
+        np.testing.assert_array_equal(net.bias.data().asnumpy(), b0)
+        for a, b in zip(_state_leaves(tr), s0):
+            np.testing.assert_array_equal(a, b)
+        assert tr._num_update == n0  # step count did not advance
+        assert len(tr._jit_cache) == 1  # SAME program served both verdicts
+        assert counters()["guardian_skips"] == c0["guardian_skips"] + 1
+
+        tr.step(X, y)  # and the trainer keeps going
+        assert tr.last_step_ok
+        assert len(tr._jit_cache) == 1
+
+    def test_guarded_ok_path_matches_unguarded_bitwise(self):
+        """The guard must be numerically invisible on healthy steps."""
+        def run(**kw):
+            net, tr = _build_spmd(seed=11, opt="sgd", **kw)
+            for X, y in _batches(5, seed=2):
+                tr.step(X, y)
+            return net.weight.data().asnumpy()
+
+        np.testing.assert_array_equal(run(), run(guard=True))
+
+    def test_aux_running_stats_gated_too(self):
+        """BatchNorm running stats are updated in the forward — a skipped
+        step must roll those back as well."""
+        mx.random.seed(5)
+        net = nn.HybridSequential(prefix="n_")
+        net.add(nn.Dense(8, in_units=8, prefix="fc_"),
+                nn.BatchNorm(in_channels=8, prefix="bn_"),
+                nn.Dense(4, in_units=8, prefix="out_"))
+        net.initialize()
+        tr = SPMDTrainer(net, gluon.loss.L2Loss(), "sgd", make_mesh(dp=2),
+                         optimizer_params={"learning_rate": 1e-2},
+                         guard=True)
+        (X, y), = _batches(1)
+        tr.step(X, y)
+        aux = {p.name: p.data().asnumpy().copy() for p in tr._aux_params}
+        assert aux, "BatchNorm should contribute aux (running-stat) params"
+        Xn = X.asnumpy().copy()
+        Xn[0, 0] = np.inf
+        tr.step(nd.array(Xn), y)
+        assert not tr.last_step_ok
+        for p in tr._aux_params:
+            np.testing.assert_array_equal(p.data().asnumpy(), aux[p.name])
+
+    def test_gluon_trainer_guard_skips_update(self):
+        mx.random.seed(1)
+        net = nn.Dense(3, in_units=4)
+        net.initialize()
+        trainer = gluon.Trainer(net.collect_params(), "sgd",
+                                {"learning_rate": 0.1, "momentum": 0.9},
+                                guard=True)
+        X = nd.array(np.ones((2, 4), "f"))
+        y = nd.array(np.zeros((2, 3), "f"))
+        loss_fn = gluon.loss.L2Loss()
+        with autograd.record():
+            loss_fn(net(X), y).backward()
+        trainer.step(2)
+        assert trainer.last_step_ok
+        w0 = net.weight.data().asnumpy().copy()
+        mom0 = np.asarray(trainer._updaters[0].states[
+            trainer._param2idx[net.weight.name]])
+        Xb = np.ones((2, 4), "f")
+        Xb[0, 0] = np.inf
+        with autograd.record():
+            loss_fn(net(nd.array(Xb)), y).backward()
+        trainer.step(2)
+        assert not trainer.last_step_ok
+        np.testing.assert_array_equal(net.weight.data().asnumpy(), w0)
+        np.testing.assert_array_equal(
+            np.asarray(trainer._updaters[0].states[
+                trainer._param2idx[net.weight.name]]), mom0)
+
+    def test_gluon_guard_row_sparse_grads(self):
+        """The guarded gate must consume the DENSE grad buffers:
+        Embedding(sparse_grad=True) grads surface as RowSparseNDArray
+        views, which multi_all_finite can't eat — and the dense buffer's
+        verdict is identical (untouched rows accumulated zeros).  Same
+        for a LossScaler fed the sparse views directly."""
+        mx.random.seed(9)
+        emb = nn.Embedding(10, 3, sparse_grad=True)
+        emb.initialize()
+        trainer = gluon.Trainer(emb.collect_params(), "sgd",
+                                {"learning_rate": 0.5}, guard=True)
+        x = nd.array(np.array([1, 4, 4, 7]), dtype="int32")
+        with autograd.record():
+            (emb(x) ** 2).mean().backward()
+        trainer.step(1)
+        assert trainer.last_step_ok
+        w0 = emb.weight.data().asnumpy().copy()
+        # poison the dense grad buffer in a TOUCHED row, then re-record
+        with autograd.record():
+            (emb(x) ** 2).mean().backward()
+        g = emb.weight._grad[0]
+        poisoned = np.array(g.asnumpy())
+        poisoned[4, 0] = np.nan
+        g._rebind(nd.array(poisoned)._data)
+        trainer.step(1)
+        assert not trainer.last_step_ok
+        np.testing.assert_array_equal(emb.weight.data().asnumpy(), w0)
+        # LossScaler.has_overflow accepts the sparse view itself
+        scaler = amp.LossScaler()
+        with autograd.record():
+            (emb(x) ** 2).mean().backward()
+        assert scaler.has_overflow([emb.weight.grad()]) is False
+        assert emb.weight.grad().stype == "row_sparse"
+
+    def test_gluon_guard_dist_kvstore_global_verdict(self):
+        """Over a distributed kvstore the verdict is AND-reduced across
+        workers so every worker takes the same skip/apply branch (a
+        unilateral skip would desync the synchronized push).  Single
+        process: the reduce degenerates to the local verdict, and the
+        skip must still leave the store's weights untouched."""
+        mx.random.seed(4)
+        net = nn.Dense(3, in_units=4)
+        net.initialize()
+        trainer = gluon.Trainer(net.collect_params(), "sgd",
+                                {"learning_rate": 0.1},
+                                kvstore="dist_tpu_sync", guard=True)
+        X = nd.array(np.ones((2, 4), "f"))
+        y = nd.array(np.zeros((2, 3), "f"))
+        loss_fn = gluon.loss.L2Loss()
+        with autograd.record():
+            loss_fn(net(X), y).backward()
+        trainer.step(2)
+        assert trainer.last_step_ok and trainer._distributed
+        w0 = net.weight.data().asnumpy().copy()
+        Xb = np.ones((2, 4), "f")
+        Xb[0, 0] = np.nan
+        with autograd.record():
+            loss_fn(net(nd.array(Xb)), y).backward()
+        trainer.step(2)
+        assert not trainer.last_step_ok
+        np.testing.assert_array_equal(net.weight.data().asnumpy(), w0)
+
+    def test_gluon_post_reduce_overflow_contained(self):
+        """The pre-reduce check sees finite per-worker addends, but the
+        reduction itself can overflow a narrow dtype.  On the pushpull
+        (update_on_kvstore=False) path a second post-reduce check must
+        contain that (it only arms for narrow grad dtypes — fp32 pays no
+        second sync): simulate the reduce-time overflow by poisoning the
+        dense grad buffer right after the real allreduce."""
+        mx.random.seed(8)
+        net = nn.Dense(3, in_units=4)
+        net.initialize()
+        net.cast("float16")  # narrow dtype arms the post-reduce check
+        trainer = gluon.Trainer(net.collect_params(), "sgd",
+                                {"learning_rate": 0.1},
+                                kvstore="dist_tpu_sync",
+                                update_on_kvstore=False, guard=True)
+        assert trainer._post_reduce_applicable() or not trainer._kv_initialized
+        X = nd.array(np.ones((2, 4), np.float16))
+        y = nd.array(np.zeros((2, 3), np.float16))
+        loss_fn = gluon.loss.L2Loss()
+        with autograd.record():
+            loss_fn(net(X), y).backward()
+        trainer.step(2)
+        assert trainer.last_step_ok
+        w0 = net.weight.data().asnumpy().copy()
+
+        real = trainer._allreduce_grads
+
+        def poisoned_reduce():
+            real()
+            g = net.weight._list_dense_grad()[0]
+            assert trainer._post_reduce_applicable()
+            bad = g.asnumpy().copy()
+            bad[0, 0] = np.inf  # finite addends, overflowed sum
+            g[:] = nd.array(bad)
+
+        trainer._allreduce_grads = poisoned_reduce
+        try:
+            with autograd.record():
+                loss_fn(net(X), y).backward()
+            trainer.step(2)
+        finally:
+            trainer._allreduce_grads = real
+        assert trainer.last_step_ok is False
+        np.testing.assert_array_equal(net.weight.data().asnumpy(), w0)
+        # and the trainer recovers on the next healthy step
+        with autograd.record():
+            loss_fn(net(X), y).backward()
+        trainer.step(2)
+        assert trainer.last_step_ok
+
+    def test_gluon_amp_scaler_driven_by_fused_check(self):
+        """With an fp16 loss scaler attached, trainer.step runs the fused
+        overflow check and the grow/backoff automaton — no per-param
+        asnumpy loop, and an overflow step changes nothing but the
+        scale."""
+        amp._amp_state.update({"initialized": False, "target_dtype": None,
+                               "loss_scaler": None})
+        try:
+            amp.init(target_dtype="float16")
+            mx.random.seed(2)
+            net = nn.Dense(3, in_units=4)
+            net.initialize()
+            trainer = gluon.Trainer(net.collect_params(), "sgd",
+                                    {"learning_rate": 0.1})
+            amp.init_trainer(trainer)
+            scaler = trainer._amp_loss_scaler
+            scaler.loss_scale = 64.0
+            X = nd.array(np.ones((2, 4), "f"))
+            y = nd.array(np.zeros((2, 3), "f"))
+            loss_fn = gluon.loss.L2Loss()
+            with autograd.record():
+                loss_fn(net(X), y).backward()
+            trainer.step(2)
+            assert trainer.last_step_ok and scaler.loss_scale == 64.0
+            w0 = net.weight.data().asnumpy().copy()
+            Xb = np.ones((2, 4), "f")
+            Xb[0, 0] = np.inf
+            with autograd.record():
+                loss_fn(net(nd.array(Xb)), y).backward()
+            trainer.step(2)
+            assert not trainer.last_step_ok
+            assert scaler.loss_scale == 32.0  # backoff happened in step
+            np.testing.assert_array_equal(net.weight.data().asnumpy(), w0)
+        finally:
+            amp._amp_state.update({"initialized": False,
+                                   "target_dtype": None,
+                                   "loss_scaler": None})
+
+    def test_fused_has_overflow_decision_parity(self):
+        """Satellite: the fused multi_all_finite verdict must equal the
+        reference per-param asnumpy loop on every mix."""
+        R = np.random.RandomState(0)
+        cases = []
+        for bad in (None, "nan", "inf", "-inf"):
+            arrs = [R.randn(5).astype(dt)
+                    for dt in ("float32", "float16")]
+            arrs.append(R.randn(3, 3).astype("float32"))
+            if bad is not None:
+                v = {"nan": np.nan, "inf": np.inf, "-inf": -np.inf}[bad]
+                arrs[1][0] = v
+            cases.append([nd.array(a) for a in arrs])
+        scaler = amp.LossScaler()
+        for arrs in cases:
+            reference = any(
+                not np.isfinite(a.asnumpy()).all() for a in arrs)
+            assert scaler.has_overflow(arrs) == reference
+        assert scaler.has_overflow([]) is False
+
+
+# ------------------------------------------------------ dynamic loss scaling
+
+class TestDynamicLossScale:
+    def test_grow_backoff_inside_one_compiled_step(self):
+        net, tr = _build_spmd(opt="sgd", dynamic_loss_scale=True,
+                              loss_scale_init=1024.0, loss_scale_window=3)
+        assert tr.loss_scale == 1024.0
+        (X, y), = _batches(1)
+        for _ in range(3):
+            tr.step(X, y)
+        assert tr.loss_scale == 2048.0  # grew after the window
+        Xn = X.asnumpy().copy()
+        Xn[0, 0] = np.nan
+        w0 = net.weight.data().asnumpy().copy()
+        tr.step(nd.array(Xn), y)
+        assert not tr.last_step_ok
+        assert tr.loss_scale == 1024.0  # backed off
+        np.testing.assert_array_equal(net.weight.data().asnumpy(), w0)
+        assert len(tr._jit_cache) == 1  # scale state is traced, not baked
+
+    def test_power_of_two_scaling_is_bit_exact_vs_unscaled(self):
+        """Scale/unscale by powers of two is exact in fp32, so the
+        dynamically-scaled trajectory must be bit-identical."""
+        def run(**kw):
+            net, tr = _build_spmd(seed=13, opt="sgd", **kw)
+            for X, y in _batches(4, seed=3):
+                tr.step(X, y)
+            return net.weight.data().asnumpy()
+
+        np.testing.assert_array_equal(
+            run(guard=True),
+            run(dynamic_loss_scale=True, loss_scale_init=1024.0))
+
+    def test_restore_of_prestep_baseline_resets_scale(self):
+        """The guardian's baseline checkpoint is taken before the first
+        step, when the scale state is still lazily uninitialized —
+        restoring it must RESET the (drifted) scale to loss_scale_init,
+        or replay from that baseline would not be bit-exact."""
+        net, tr = _build_spmd(opt="sgd", dynamic_loss_scale=True,
+                              loss_scale_init=1024.0)
+        (X, y), = _batches(1)
+        tr._ensure_staged(X)
+        blob = Guardian._snapshot(tr, 0)
+        Xn = X.asnumpy().copy()
+        Xn[0, 0] = np.nan
+        tr.step(nd.array(Xn), y)  # overflow: scale backs off
+        assert tr.loss_scale == 512.0
+        Guardian._restore(tr, blob)
+        assert tr.loss_scale == 1024.0  # drifted scale did not survive
+
+    def test_scale_state_survives_save_load_states(self, tmp_path):
+        net, tr = _build_spmd(opt="sgd", dynamic_loss_scale=True,
+                              loss_scale_init=512.0, loss_scale_window=2)
+        (X, y), = _batches(1)
+        for _ in range(2):
+            tr.step(X, y)
+        assert tr.loss_scale == 1024.0
+        f = str(tmp_path / "st")
+        tr.save_states(f)
+        net2, tr2 = _build_spmd(opt="sgd", dynamic_loss_scale=True,
+                                loss_scale_init=512.0, loss_scale_window=2)
+        tr2.step(X, y)
+        tr2.load_states(f)
+        assert tr2.loss_scale == 1024.0
+
+
+# --------------------------------------------------- rollback/replay (tent)
+
+class TestGuardianRollbackReplay:
+    def test_forced_divergence_rollback_replay_bit_exact(self, tmp_path):
+        """Acceptance pair (b): rollback-and-replay after an injected
+        divergence lands bit-identical to the uninterrupted run."""
+        batches = _batches(20, seed=4)
+
+        def data_fn(step):
+            return batches[step]
+
+        net1, tr1 = _build_spmd(guard=True)
+        g1 = Guardian(str(tmp_path / "clean"), checkpoint_every=5)
+        g1.run(tr1, data_fn, 20)
+        ref_w = net1.weight.data().asnumpy()
+        ref_s = _state_leaves(tr1)
+
+        net2, tr2 = _build_spmd(guard=True)
+        g2 = Guardian(str(tmp_path / "faulted"), checkpoint_every=5)
+        # guardian.check hit 12 = step index 11 (one check per executed
+        # loop iteration) — forces the divergence verdict exactly once
+        with fault_plan("guardian.check@12:raise"):
+            st = g2.run(tr2, data_fn, 20)
+        assert st["rollbacks"] == 1
+        np.testing.assert_array_equal(net2.weight.data().asnumpy(), ref_w)
+        for a, b in zip(_state_leaves(tr2), ref_s):
+            np.testing.assert_array_equal(a, b)
+
+    def test_replay_bit_exact_with_traced_dropout_rng(self, tmp_path):
+        """The checkpoint captures the RNG key-ring counter, so replayed
+        dropout masks are the SAME masks — asserted via a net whose
+        forward draws traced keys every step."""
+        def build():
+            mx.random.seed(21)
+            net = nn.HybridSequential(prefix="n_")
+            net.add(nn.Dense(16, in_units=8, prefix="a_"),
+                    nn.Dropout(0.5),
+                    nn.Dense(4, in_units=16, prefix="b_"))
+            net.initialize()
+            tr = SPMDTrainer(net, gluon.loss.L2Loss(), "sgd",
+                             make_mesh(dp=2),
+                             optimizer_params={"learning_rate": 1e-2},
+                             guard=True)
+            return net, tr
+
+        batches = _batches(12, seed=5)
+
+        def data_fn(step):
+            return batches[step]
+
+        net1, tr1 = build()
+        Guardian(str(tmp_path / "c"), checkpoint_every=4).run(
+            tr1, data_fn, 12)
+        net2, tr2 = build()
+        g = Guardian(str(tmp_path / "f"), checkpoint_every=4)
+        with fault_plan("guardian.check@7:raise"):
+            st = g.run(tr2, data_fn, 12)
+        assert st["rollbacks"] == 1
+        np.testing.assert_array_equal(
+            net1[0].weight.data().asnumpy(),
+            net2[0].weight.data().asnumpy())
+
+    def test_isolated_nan_steps_skip_through_without_rollback(self,
+                                                              tmp_path):
+        batches = _batches(10, seed=6, nan_steps={3, 7})
+        net, tr = _build_spmd(guard=True)
+        g = Guardian(str(tmp_path / "g"), max_skips=2, checkpoint_every=4)
+        st = g.run(tr, lambda s: batches[s], 10)
+        assert st["skips"] == 2 and st["rollbacks"] == 0
+        assert np.isfinite(net.weight.data().asnumpy()).all()
+
+    def test_skip_streak_quarantined_on_rollback(self, tmp_path):
+        """max_skips consecutive NaN batches trigger a rollback, and the
+        streak is quarantined — replay is bit-exact, so WITHOUT the
+        quarantine it would reproduce the identical skips forever.  The
+        run recovers and lands bit-identical to a run that never saw
+        those batches."""
+        batches = _batches(10, seed=7, nan_steps={4, 5})
+        net, tr = _build_spmd(guard=True)
+        g = Guardian(str(tmp_path / "g"), max_skips=2, max_rollbacks=2,
+                     checkpoint_every=3)
+        st = g.run(tr, lambda s: batches[s], 10)
+        assert st["skips"] == 2 and st["rollbacks"] == 1
+        net2, tr2 = _build_spmd(guard=True)
+        for i in range(10):
+            if i not in (4, 5):
+                Xb, yb = batches[i]
+                tr2.step(Xb, yb)
+        np.testing.assert_array_equal(net.weight.data().asnumpy(),
+                                      net2.weight.data().asnumpy())
+
+    def test_checkpoint_boundary_crossed_by_skip_still_saves(self,
+                                                             tmp_path):
+        """A contained skip that advances step ACROSS a checkpoint
+        boundary must not drop that generation — the periodic save sits
+        at the top of the loop on a RELATIVE schedule, so a boundary
+        deferred past an active streak is caught up at the first
+        streak-free step."""
+        batches = _batches(8, seed=9, nan_steps={4})
+        net, tr = _build_spmd(guard=True)
+        g = Guardian(str(tmp_path / "g"), max_skips=2, checkpoint_every=5)
+        st = g.run(tr, lambda s: batches[s], 8)
+        assert st["skips"] == 1 and st["rollbacks"] == 0
+        # baseline at 0; boundary 5 lands mid-streak ({4} still open),
+        # deferred one step and caught up at 6
+        assert 0 in g.ckpts.steps() and 6 in g.ckpts.steps()
+
+    def test_persistent_divergence_raises_divergence_error(self, tmp_path):
+        # a divergence verdict on EVERY supervised step (forced via the
+        # guardian.check site): rollback can never make progress and the
+        # guardian must raise instead of spinning forever
+        batches = _batches(10, seed=7)
+        net, tr = _build_spmd(guard=True)
+        g = Guardian(str(tmp_path / "g"), max_skips=2, max_rollbacks=2,
+                     checkpoint_every=3)
+        with pytest.raises(DivergenceError, match="rollbacks"):
+            with fault_plan("guardian.check%1:raise"):
+                g.run(tr, lambda s: batches[s], 10)
+
+    def test_spike_rolls_back_and_quarantines_the_batch(self, tmp_path):
+        """A finite loss explosion (containment can't see it — the update
+        applied) triggers rollback, and the offending batch is
+        quarantined on replay: the final state is bit-identical to a run
+        that never saw that batch at all."""
+        batches = _batches(12, seed=8)
+        # poison ONE batch with huge (finite) values → loss spike
+        X, y = batches[6]
+        batches[6] = (nd.array(X.asnumpy() * 1e6), y)
+        net, tr = _build_spmd(guard=True)
+        g = Guardian(str(tmp_path / "g"), spike_factor=100.0,
+                     checkpoint_every=3, max_rollbacks=10)
+        st = g.run(tr, lambda s: batches[s], 12)
+        assert st["spikes"] == 1 and st["rollbacks"] == 1
+        # reference: the same trainer stepping every batch EXCEPT the
+        # quarantined one (same RNG key order — the quarantined step
+        # draws no key in either run)
+        net2, tr2 = _build_spmd(guard=True)
+        for i in range(12):
+            if i != 6:
+                Xb, yb = batches[i]
+                tr2.step(Xb, yb)
+        np.testing.assert_array_equal(net.weight.data().asnumpy(),
+                                      net2.weight.data().asnumpy())
+
+    def test_rollback_falls_back_past_corrupt_checkpoint(self, tmp_path):
+        batches = _batches(20, seed=9)
+        net, tr = _build_spmd(guard=True)
+        g = Guardian(str(tmp_path / "g"), checkpoint_every=5, keep=4)
+        g.run(tr, lambda s: batches[s], 12)  # checkpoints at 0, 5, 10
+        newest = g.ckpts.path(max(g.ckpts.steps()))
+        buf = bytearray(open(newest, "rb").read())
+        buf[len(buf) // 2] ^= 0x10  # single-bit flip
+        open(newest, "wb").write(bytes(buf))
+        c0 = counters()
+        with fault_plan("guardian.check@1:raise"):
+            g.run(tr, lambda s: batches[s], 14, start_step=12)
+        c1 = counters()
+        assert g.stats["rollbacks"] == 1
+        assert c1["ckpt_corruptions"] > c0["ckpt_corruptions"]
+        assert c1["ckpt_fallbacks"] > c0["ckpt_fallbacks"]
+
+    def test_no_verified_checkpoint_left_raises(self, tmp_path):
+        batches = _batches(8, seed=10)
+        net, tr = _build_spmd(guard=True)
+        g = Guardian(str(tmp_path / "g"), checkpoint_every=4)
+        g.run(tr, lambda s: batches[s], 6)
+        for s in g.ckpts.steps():
+            p = g.ckpts.path(s)
+            open(p, "wb").write(b"garbage")
+        with pytest.raises(DivergenceError, match="no verified"):
+            g.rollback(tr)
+
+    def test_streak_spanning_boundary_replay_bit_exact_rng(self, tmp_path):
+        """A skip streak that spans a checkpoint boundary must NOT
+        snapshot mid-streak: contained skips still draw RNG keys (the
+        key is an input to the compiled step), so a mid-streak snapshot
+        would shift every post-rollback dropout mask vs the advertised
+        never-saw-those-batches run."""
+        def build():
+            mx.random.seed(23)
+            net = nn.HybridSequential(prefix="q_")
+            net.add(nn.Dense(16, in_units=8, prefix="a_"),
+                    nn.Dropout(0.5),
+                    nn.Dense(4, in_units=16, prefix="b_"))
+            net.initialize()
+            tr = SPMDTrainer(net, gluon.loss.L2Loss(), "sgd",
+                             make_mesh(dp=2),
+                             optimizer_params={"learning_rate": 1e-2},
+                             guard=True)
+            return net, tr
+
+        # boundary (checkpoint_every=5) falls INSIDE the {4, 5} streak
+        batches = _batches(10, seed=8, nan_steps={4, 5})
+        net1, tr1 = build()
+        g = Guardian(str(tmp_path / "g"), max_skips=2, checkpoint_every=5)
+        st = g.run(tr1, lambda s: batches[s], 10)
+        assert st["rollbacks"] == 1
+        net2, tr2 = build()
+        for i in range(10):
+            if i not in (4, 5):
+                Xb, yb = batches[i]
+                tr2.step(Xb, yb)
+        np.testing.assert_array_equal(net1[0].weight.data().asnumpy(),
+                                      net2[0].weight.data().asnumpy())
+
+    def test_baseline_checkpoint_failure_raises(self, tmp_path):
+        """A failed BASELINE write must raise, not be contained —
+        training on with zero checkpoints would turn the first rollback
+        into an unrecoverable DivergenceError."""
+        batches = _batches(4, seed=13)
+        net, tr = _build_spmd(guard=True)
+        g = Guardian(str(tmp_path / "g"), checkpoint_every=2)
+        with fault_plan("ckpt.write@1:raise=OSError"):
+            with pytest.raises(OSError):
+                g.run(tr, lambda s: batches[s], 4)
+
+    def test_run_requires_guarded_trainer(self, tmp_path):
+        net, tr = _build_spmd(guard=False)
+        g = Guardian(str(tmp_path / "g"))
+        with pytest.raises(ValueError, match="guard=True"):
+            g.run(tr, lambda s: _batches(1)[0], 1)
+
+
+# --------------------------------------------------------- corruption matrix
+
+def _truncate(path):
+    data = open(path, "rb").read()
+    open(path, "wb").write(data[:max(1, len(data) - 9)])
+
+
+def _bitflip(path):
+    buf = bytearray(open(path, "rb").read())
+    buf[len(buf) // 2] ^= 0x01
+    open(path, "wb").write(bytes(buf))
+
+
+def _remove(path):
+    os.remove(path)
+
+
+class TestCorruptionMatrix:
+    @pytest.mark.parametrize("corrupt", [_truncate, _bitflip, _remove],
+                             ids=["truncation", "bitflip", "missing"])
+    def test_preemption_restore_falls_back_to_previous_good(
+            self, tmp_path, corrupt):
+        """Every corruption-matrix case on the NEWEST preemption
+        checkpoint restores from the previous good generation."""
+        mx.random.seed(3)
+        net = nn.Dense(3, in_units=4)
+        net.initialize()
+        net(nd.array(np.ones((1, 4), "f")))
+        prefix = str(tmp_path / "m")
+        h = preemption.PreemptionCheckpointHandler(
+            prefix, net, signals=(signal.SIGUSR1,), keep=3)
+        try:
+            os.kill(os.getpid(), signal.SIGUSR1)
+            preemption.reset()
+            w_good = net.weight.data().asnumpy().copy()
+            net.weight.data()._rebind((net.weight.data() * 2.0)._data)
+            os.kill(os.getpid(), signal.SIGUSR1)  # newest generation
+        finally:
+            preemption.uninstall()
+            preemption.reset()
+        corrupt(prefix + "-preempt.params")
+        c0 = counters()
+        net2 = nn.Dense(3, in_units=4)
+        net2.initialize()
+        net2(nd.array(np.ones((1, 4), "f")))
+        gen = preemption.restore_latest(prefix, net2)
+        assert gen == 1
+        np.testing.assert_array_equal(net2.weight.data().asnumpy(), w_good)
+        assert counters()["ckpt_fallbacks"] > c0["ckpt_fallbacks"]
+
+    @pytest.mark.parametrize("crash_fn", ["rotate_history",
+                                          "move_with_manifest"],
+                             ids=["before-states-rotate",
+                                  "before-states-move"])
+    def test_torn_pair_restores_matching_save_event(
+            self, tmp_path, monkeypatch, crash_fn):
+        """A crash between the params commit and the states commit
+        leaves generation 0 holding params from save N next to states
+        from save N-1 — BOTH CRC-clean, so per-file verification alone
+        would silently load new weights with stale optimizer state.
+        The shared save-event token must detect the torn pair and
+        restore the newest CONSISTENT (params, states) pair instead."""
+        def fresh(seed):
+            mx.random.seed(seed)
+            net = nn.Dense(3, in_units=4)
+            net.initialize()
+            tr = gluon.Trainer(net.collect_params(), "sgd",
+                               {"learning_rate": 0.1, "momentum": 0.9})
+            with autograd.record():
+                loss = gluon.loss.L2Loss()(
+                    net(nd.array(np.ones((2, 4), "f"))),
+                    nd.array(np.zeros((2, 3), "f")))
+            loss.backward()
+            tr.step(2)
+            return net, tr
+
+        prefix = str(tmp_path / "m")
+        net, tr = fresh(3)
+        h = preemption.PreemptionCheckpointHandler(
+            prefix, net, tr, signals=(signal.SIGUSR1,), keep=3)
+        try:
+            os.kill(os.getpid(), signal.SIGUSR1)  # save N-1: consistent
+            preemption.reset()
+            w_good = net.weight.data().asnumpy().copy()
+            net.weight.data()._rebind((net.weight.data() * 2.0)._data)
+            # save N crashes in the commit window: after the params
+            # commit, before the states rotate (or move) — simulated by
+            # failing the SECOND call of the chosen commit primitive
+            calls = {"n": 0}
+            real = getattr(ckpt_mod, crash_fn)
+
+            def dying(*a, **kw):
+                calls["n"] += 1
+                if calls["n"] == 2:
+                    raise RuntimeError("simulated crash mid-commit")
+                return real(*a, **kw)
+
+            monkeypatch.setattr(ckpt_mod, crash_fn, dying)
+            os.kill(os.getpid(), signal.SIGUSR1)  # save N: torn
+            preemption.reset()
+            monkeypatch.setattr(ckpt_mod, crash_fn, real)
+        finally:
+            preemption.uninstall()
+            preemption.reset()
+        # generation 0 is now params-N (either next to states N-1, or
+        # next to no states at all) — each surviving file CRC-verifies
+        c0 = counters()
+        net2, tr2 = fresh(9)
+        preemption.restore_latest(prefix, net2, tr2)
+        np.testing.assert_array_equal(net2.weight.data().asnumpy(),
+                                      w_good)
+        assert counters()["ckpt_fallbacks"] > c0["ckpt_fallbacks"]
+
+    def test_restore_latest_reports_none_present(self, tmp_path):
+        """No checkpoints under the prefix at all (never saved / typo):
+        the error says so, and no phantom generation-0 fallback is
+        logged or counted."""
+        net = nn.Dense(3, in_units=4)
+        net.initialize()
+        c0 = counters()
+        with pytest.raises(CorruptCheckpointError, match="no generation"):
+            preemption.restore_latest(str(tmp_path / "nope"), net)
+        assert counters()["ckpt_fallbacks"] == c0["ckpt_fallbacks"]
+
+    @pytest.mark.parametrize("corrupt", [_truncate, _bitflip],
+                             ids=["truncation", "bitflip"])
+    def test_spmd_load_states_raises_typed_error(self, tmp_path, corrupt):
+        net, tr = _build_spmd()
+        (X, y), = _batches(1)
+        tr.step(X, y)
+        f = str(tmp_path / "st")
+        tr.save_states(f)
+        corrupt(f)
+        with pytest.raises(CorruptCheckpointError) as ei:
+            tr.load_states(f)
+        assert f in str(ei.value)
+
+    def test_ckpt_write_fault_leaves_previous_file_intact(self, tmp_path):
+        net, tr = _build_spmd()
+        (X, y), = _batches(1)
+        tr.step(X, y)
+        f = str(tmp_path / "st")
+        tr.save_states(f)
+        good = open(f, "rb").read()
+        tr.step(X, y)
+        with fault_plan("ckpt.write@1:raise=OSError(disk gone)"):
+            with pytest.raises(OSError, match="disk gone"):
+                tr.save_states(f)
+        assert open(f, "rb").read() == good  # old checkpoint untouched
+        tr.load_states(f)  # and it still verifies + loads
+
+    def test_ckpt_verify_site_fires_at_restore(self, tmp_path):
+        net, tr = _build_spmd()
+        (X, y), = _batches(1)
+        tr.step(X, y)
+        f = str(tmp_path / "st")
+        tr.save_states(f)
+        with fault_plan("ckpt.verify@1:raise=OSError(flaky read)") as p:
+            with pytest.raises(OSError, match="flaky read"):
+                tr.load_states(f)
+        assert p.stats()["ckpt.verify"]["fired"] == 1
+
+    def test_gluon_save_states_verified_roundtrip(self, tmp_path):
+        mx.random.seed(4)
+        net = nn.Dense(3, in_units=4)
+        net.initialize()
+        trainer = gluon.Trainer(net.collect_params(), "sgd",
+                                {"learning_rate": 0.1, "momentum": 0.9})
+        X = nd.array(np.ones((2, 4), "f"))
+        y = nd.array(np.zeros((2, 3), "f"))
+        with autograd.record():
+            gluon.loss.L2Loss()(net(X), y).backward()
+        trainer.step(2)
+        f = str(tmp_path / "gs")
+        trainer.save_states(f)
+        assert os.path.exists(f + ckpt_mod.MANIFEST_SUFFIX)
+        _bitflip(f)
+        with pytest.raises(CorruptCheckpointError):
+            trainer.load_states(f)
+
+
+# ------------------------------------------------- serialization typed errors
+
+class TestSerializationTypedErrors:
+    def test_truncated_header(self, tmp_path):
+        f = str(tmp_path / "t.params")
+        open(f, "wb").write(b"MXT")
+        with pytest.raises(CorruptCheckpointError) as ei:
+            nd.load(f)
+        assert ei.value.path == f and ei.value.offset == 3
+
+    def test_bad_magic(self, tmp_path):
+        f = str(tmp_path / "b.params")
+        open(f, "wb").write(b"NOTMAGIC" + b"\0" * 32)
+        with pytest.raises(CorruptCheckpointError, match="unrecognised"):
+            nd.load(f)
+
+    def test_short_payload_without_manifest(self, tmp_path):
+        f = str(tmp_path / "s.params")
+        nd.save(f, [nd.ones((4,))])
+        os.remove(f + ckpt_mod.MANIFEST_SUFFIX)  # parse-level detection
+        _truncate(f)
+        with pytest.raises(CorruptCheckpointError, match="short payload"):
+            nd.load(f)
+
+    def test_bitflip_with_manifest_names_tensor_and_offset(self, tmp_path):
+        f = str(tmp_path / "c.params")
+        nd.save(f, {"w": nd.ones((4,)), "b": nd.ones((2,))})
+        data = bytearray(open(f, "rb").read())
+        data[-1] ^= 0x80  # damage the LAST tensor's payload
+        open(f, "wb").write(bytes(data))
+        with pytest.raises(CorruptCheckpointError) as ei:
+            nd.load(f)
+        assert "'b'" in str(ei.value) and ei.value.offset is not None
+
+    def test_malformed_index_entry_raises_typed(self, tmp_path):
+        """A bit flip INSIDE still-parseable index JSON (mangled dtype
+        string, non-int shape) must raise the typed error, not a bare
+        TypeError/KeyError escaping the fallback chain."""
+        import json
+        f = str(tmp_path / "m.params")
+        nd.save(f, {"w": nd.ones((4,))})
+        os.remove(f + ckpt_mod.MANIFEST_SUFFIX)  # parse-level detection
+        buf = bytearray(open(f, "rb").read())
+        (n,) = struct.unpack_from("<Q", buf, 8)
+        index = json.loads(bytes(buf[16:16 + n]))
+        index["arrays"][0]["dtype"] = "float3 "  # flipped byte, same len
+        blob = json.dumps(index).encode()
+        blob += b" " * (n - len(blob))  # keep the declared length honest
+        open(f, "wb").write(bytes(buf[:16]) + blob + bytes(buf[16 + n:]))
+        with pytest.raises(CorruptCheckpointError, match="malformed"):
+            nd.load(f)
+
+    def test_truncated_legacy_raises_typed(self, tmp_path):
+        f = str(tmp_path / "l.params")
+        # legacy list header claiming one array, then nothing
+        open(f, "wb").write(struct.pack("<QQQ", 0x112, 0, 1))
+        with pytest.raises(CorruptCheckpointError):
+            nd.load(f)
+
+    @staticmethod
+    def _legacy_one_float(dtype_flag=0, name=b"w"):
+        """A minimal legacy-format file: one scalar float32 block plus a
+        one-entry name table (layout from serialization._load_legacy)."""
+        return (struct.pack("<QQQ", 0x112, 0, 1)
+                + struct.pack("<IiiiiI", 0xF993FAC9, 0, 1, 1, 0, 0)
+                + struct.pack("<i", dtype_flag)
+                + struct.pack("<f", 1.5)
+                + struct.pack("<QQ", 1, len(name)) + name)
+
+    def test_legacy_unknown_dtype_flag_raises_typed(self, tmp_path):
+        """A flipped dtype flag must raise, not silently reinterpret the
+        payload as float32 (wrong dtype = garbage weights, undetected)."""
+        f = str(tmp_path / "l.params")
+        open(f, "wb").write(self._legacy_one_float(dtype_flag=22))
+        with pytest.raises(CorruptCheckpointError, match="dtype flag 22"):
+            nd.load(f)
+
+    def test_legacy_undecodable_name_raises_typed(self, tmp_path):
+        """A flipped byte inside a stored name (invalid UTF-8) is file
+        damage: typed error, not a raw UnicodeDecodeError that escapes
+        the restore-fallback machinery."""
+        f = str(tmp_path / "l.params")
+        open(f, "wb").write(self._legacy_one_float(name=b"\xe1"))
+        with pytest.raises(CorruptCheckpointError, match="name"):
+            nd.load(f)
+
+    def test_load_parameters_roundtrip_still_works(self, tmp_path):
+        mx.random.seed(6)
+        net = nn.Dense(3, in_units=4)
+        net.initialize()
+        net(nd.array(np.ones((1, 4), "f")))
+        f = str(tmp_path / "p.params")
+        net.save_parameters(f)
+        net2 = nn.Dense(3, in_units=4)
+        net2.load_parameters(f)
+        np.testing.assert_array_equal(net2.weight.data().asnumpy(),
+                                      net.weight.data().asnumpy())
+
+
+# ----------------------------------------------------------- checkpoint sets
+
+class TestCheckpointSet:
+    def test_keep_last_k_rotation(self, tmp_path):
+        cs = CheckpointSet(str(tmp_path), keep=3)
+        for s in range(6):
+            cs.save(s, b"blob-%d" % s)
+        assert cs.steps() == [3, 4, 5]
+
+    def test_latest_verified_falls_back(self, tmp_path):
+        cs = CheckpointSet(str(tmp_path), keep=4)
+        for s in range(3):
+            cs.save(s, b"blob-%d" % s)
+        _bitflip(cs.path(2))
+        c0 = counters()
+        step, blob = cs.latest_verified()
+        assert step == 1 and blob == b"blob-1"
+        assert counters()["ckpt_corruptions"] == c0["ckpt_corruptions"] + 1
+
+    def test_atomic_write_keeps_old_on_injected_failure(self, tmp_path):
+        p = str(tmp_path / "f")
+        ckpt_mod.write_verified(p, b"old")
+        with fault_plan("ckpt.write@1:raise=OSError"):
+            with pytest.raises(OSError):
+                ckpt_mod.write_verified(p, b"new")
+        assert open(p, "rb").read() == b"old"
+        ckpt_mod.verify(p, required=True)
+
+    def test_staged_manifest_rescues_crash_between_renames(self, tmp_path):
+        """Payload and manifest are two renames; a crash between them
+        leaves the NEW payload with the OLD manifest.  The staged
+        ``.mxmf.next`` written before the payload rename must rescue it:
+        verify() promotes the staged manifest instead of condemning a
+        perfectly valid checkpoint."""
+        import json
+        import zlib
+        p = str(tmp_path / "f")
+        ckpt_mod.write_verified(p, b"old-bytes")
+        # reproduce the mid-commit crash state by hand: new payload on
+        # disk, old .mxmf still in place, new manifest only staged
+        open(p, "wb").write(b"new-bytes!")
+        staged = {"format": 1, "size": 10,
+                  "crc32": zlib.crc32(b"new-bytes!") & 0xFFFFFFFF,
+                  "tensors": []}
+        open(p + ckpt_mod.MANIFEST_SUFFIX + ".next", "w").write(
+            json.dumps(staged))
+        m = ckpt_mod.verify(p, required=True)
+        assert m["crc32"] == staged["crc32"]
+        # promoted: the staged file became the real manifest
+        assert not os.path.exists(p + ckpt_mod.MANIFEST_SUFFIX + ".next")
+        ckpt_mod.verify(p, required=True)
+
+    def test_staged_manifest_rescues_first_write_crash(self, tmp_path):
+        """First-ever write crashing between the renames leaves a payload
+        with NO .mxmf at all — required verification must still accept
+        via the staged manifest."""
+        import json
+        import zlib
+        p = str(tmp_path / "g")
+        open(p, "wb").write(b"payload")
+        staged = {"format": 1, "size": 7,
+                  "crc32": zlib.crc32(b"payload") & 0xFFFFFFFF,
+                  "tensors": []}
+        open(p + ckpt_mod.MANIFEST_SUFFIX + ".next", "w").write(
+            json.dumps(staged))
+        assert ckpt_mod.verify(p, required=True) is not None
+
+    def test_stale_staged_manifest_is_never_promoted(self, tmp_path):
+        """A staged manifest describing OTHER bytes (stale leftover) must
+        not rescue a genuinely corrupt checkpoint — the CRC gate."""
+        import json
+        p = str(tmp_path / "h")
+        ckpt_mod.write_verified(p, b"good-bytes")
+        _bitflip(p)
+        open(p + ckpt_mod.MANIFEST_SUFFIX + ".next", "w").write(
+            json.dumps({"format": 1, "size": 999, "crc32": 1,
+                        "tensors": []}))
+        with pytest.raises(CorruptCheckpointError):
+            ckpt_mod.verify(p, required=True)
+
+
+# ------------------------------------------------------------- env defaults
+
+class TestEnvDefaults:
+    def test_mxtpu_guardian_flips_trainer_defaults(self, monkeypatch):
+        from mxtpu.resilience.guardian import guard_enabled_default
+        monkeypatch.setenv("MXTPU_GUARDIAN", "1")
+        assert guard_enabled_default()
+        net = nn.Dense(2, in_units=2)
+        net.initialize()
+        trainer = gluon.Trainer(net.collect_params(), "sgd",
+                                {"learning_rate": 0.1})
+        assert trainer._guard
+        monkeypatch.setenv("MXTPU_GUARDIAN", "0")
+        assert not guard_enabled_default()
+
+    def test_mxtpu_ckpt_keep_default(self, monkeypatch):
+        monkeypatch.setenv("MXTPU_CKPT_KEEP", "7")
+        assert ckpt_mod.default_keep() == 7
+        monkeypatch.delenv("MXTPU_CKPT_KEEP")
+        assert ckpt_mod.default_keep() == 3
+
+
+# -------------------------------------------------------------- orbax weave
+
+def test_orbax_manifest_detects_damaged_member(tmp_path):
+    pytest.importorskip("orbax.checkpoint")
+    from mxtpu.contrib import orbax_ckpt
+    from mxtpu.parallel import PartitionSpec as P
+    from mxtpu.parallel.sharding import ShardingRules
+
+    mx.random.seed(5)
+    net = nn.Dense(4, in_units=8, prefix="d_")
+    net.initialize()
+    tr = SPMDTrainer(net, gluon.loss.L2Loss(), "sgd", make_mesh(dp=2),
+                     ShardingRules([(r"weight$", P("dp", None))]),
+                     optimizer_params={"learning_rate": 1e-2},
+                     batch_spec=P(), label_spec=P())
+    X = nd.array(np.random.RandomState(0).randn(8, 8).astype("f"))
+    y = nd.array(np.random.RandomState(1).randn(8, 4).astype("f"))
+    tr.step(X, y)
+    path = str(tmp_path / "ck")
+    orbax_ckpt.save_trainer(path, tr)
+    assert os.path.exists(path + ckpt_mod.MANIFEST_SUFFIX)
+    # damage one member file of the orbax tree
+    victim = None
+    for dirpath, _, files in os.walk(path):
+        for fn in files:
+            full = os.path.join(dirpath, fn)
+            if os.path.getsize(full) > 64:
+                victim = full
+                break
+        if victim:
+            break
+    assert victim is not None
+    _bitflip(victim)
+    with pytest.raises(CorruptCheckpointError):
+        orbax_ckpt.restore_trainer(path, tr)

@@ -124,6 +124,49 @@ def check_resilience():
         print("resilience   : FAILED (%s: %s)" % (type(e).__name__, e))
 
 
+def check_guardian():
+    """Exercise the verified-checkpoint machinery once (tempdir, tiny
+    blobs, one deliberate corruption) and print the guardian counters
+    (docs/guardian.md): a healthy install detects the damaged newest
+    checkpoint and falls back to the previous good one."""
+    print("----------Guardian----------")
+    try:
+        import tempfile
+
+        from mxtpu import resilience
+        from mxtpu.resilience import checkpoint as ckpt
+
+        print("guard default:",
+              "on" if resilience.guard_enabled_default() else "off",
+              "(MXTPU_GUARDIAN=%s)"
+              % (os.environ.get("MXTPU_GUARDIAN") or "unset"))
+        print("ckpt keep    : %d (MXTPU_CKPT_KEEP=%s)"
+              % (ckpt.default_keep(),
+                 os.environ.get("MXTPU_CKPT_KEEP") or "unset"))
+        # session counters FIRST — the probe must not pollute the report
+        c = resilience.counters()
+        print("counters     : %d skips / %d rollbacks / %d ckpt writes / "
+              "%d corruptions / %d fallbacks"
+              % (c["guardian_skips"], c["guardian_rollbacks"],
+                 c["ckpt_writes"], c["ckpt_corruptions"],
+                 c["ckpt_fallbacks"]))
+        with tempfile.TemporaryDirectory() as d:
+            cs = ckpt.CheckpointSet(d, keep=3)
+            cs.save(0, b"probe-0")
+            cs.save(1, b"probe-1")
+            buf = bytearray(open(cs.path(1), "rb").read())
+            buf[0] ^= 0xFF
+            open(cs.path(1), "wb").write(bytes(buf))
+            got = cs.latest_verified()
+        if got == (0, b"probe-0"):
+            print("probe        : ok (corrupt newest detected, fell back "
+                  "to previous good)")
+        else:
+            print("probe        : UNEXPECTED result %r" % (got,))
+    except Exception as e:
+        print("guardian     : FAILED (%s: %s)" % (type(e).__name__, e))
+
+
 def check_devices(timeout_s=60):
     print("----------Device Info----------")
     try:
@@ -185,6 +228,7 @@ def main():
     check_environment()
     check_mxtpu()
     check_resilience()
+    check_guardian()
     check_analysis(full=full)
     check_devices()
 
