@@ -1,10 +1,10 @@
 """mxtpu.analysis — static analyses over the Symbol/CachedOp graph IR,
-the op registry, and sharding rules (parity: the nnvm graph-pass layer —
-InferShape/InferType/PlanMemory ran as static analyses before execution;
-see PAPER.md §1 layer 6 and src/executor/graph_executor.cc in the
-reference).
+the op registry, sharding rules, and the compiled-program discipline
+(parity: the nnvm graph-pass layer — InferShape/InferType/PlanMemory ran
+as static analyses before execution; see PAPER.md §1 layer 6 and
+src/executor/graph_executor.cc in the reference).
 
-Four shipped passes, each returning a :class:`Report` of located
+Seven shipped passes, each returning a :class:`Report` of located
 :class:`Diagnostic` records instead of silent Nones or deep-in-XLA
 failures:
 
@@ -16,15 +16,32 @@ failures:
 - ``audit_registry()`` — num_outputs vs abstract eval, differentiable
   ops admit jax.vjp, alias-table integrity.
 - ``trace_lint(paths)`` — AST lint for host-sync/retrace hazards in
-  jit-traced code paths.
+  jit-traced code paths (plus dead ``# trace-ok`` suppressions).
+- ``check_compiles()`` — turns the process-wide compile ledger (every
+  jit entry point reports into it) into C0xx discipline diagnostics;
+  ``compile_budget(n)`` asserts compile counts in tests.
+- ``check_memory(target, budget)`` — sharding-aware per-device HBM
+  estimate (params + activation-liveness peak + KV-cache residency)
+  over Symbol graphs or jittable callables, M0xx against a budget.
+- ``check_donation(fn, *args, donate_argnums=...)`` — verifies donated
+  buffers actually alias in the compiled executable and flags missed
+  donation opportunities (D0xx); ``check_trainer_donation`` applies it
+  to an SPMDTrainer's compiled step.
 
 CLI: ``python -m mxtpu.analysis`` (see docs/analysis.md).  Custom passes
 register via :func:`register_pass` and run via :func:`run_pass`.
 """
 
+from .compile_check import (CompileBudgetExceeded, check_compiles,
+                            compile_budget)
+from .compile_ledger import CompileLedger, Signature, get_ledger
 from .diagnostics import (Diagnostic, Report, Severity, get_pass,
                           list_passes, register_pass, run_pass)
+from .donation_check import check_donation, check_trainer_donation
 from .graph_verify import verify_graph
+from .memory_estimate import (MemoryEstimate, check_memory,
+                              estimate_graph_memory, estimate_jit_memory,
+                              kv_cache_residency, xla_memory_stats)
 from .registry_audit import audit_registry
 from .sharding_check import check_sharding
 from .trace_lint import lint_source, trace_lint
@@ -34,4 +51,9 @@ __all__ = [
     "register_pass", "get_pass", "list_passes", "run_pass",
     "verify_graph", "check_sharding", "audit_registry", "trace_lint",
     "lint_source",
+    "CompileLedger", "Signature", "get_ledger", "check_compiles",
+    "compile_budget", "CompileBudgetExceeded",
+    "MemoryEstimate", "check_memory", "estimate_graph_memory",
+    "estimate_jit_memory", "kv_cache_residency", "xla_memory_stats",
+    "check_donation", "check_trainer_donation",
 ]

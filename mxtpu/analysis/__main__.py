@@ -6,7 +6,15 @@ Subcommands:
 - ``lint [PATH ...]``    trace-safety lint (default: the mxtpu package)
 - ``graph FILE.json``    verify a saved symbol.json (``--shape name=2,3``
   repeatable for input shapes)
-- ``all``                registry + lint (the repo self-lint; default)
+- ``memory FILE.json``   per-device HBM estimate of a saved symbol.json
+  (``--shape`` as above, ``--budget 16GiB`` to fail over budget)
+- ``compile [LEDGER.json]`` compile-discipline check: analyze a ledger
+  dump written via ``MXTPU_COMPILE_LEDGER_DUMP``, or (no argument) run
+  the in-process probe workload and check the live ledger
+- ``donate``             donation/aliasing self-check: builds a tiny
+  SPMDTrainer step and verifies its donated buffers alias
+- ``all``                registry + lint + the compile/memory/donation
+  self-applications (the repo self-lint; default)
 
 Exit status is 1 when diagnostics at or above ``--fail-on`` (default
 ``error``) were produced, so the command slots into CI directly.
@@ -17,7 +25,8 @@ from __future__ import annotations
 import argparse
 import sys
 
-from . import (Report, Severity, audit_registry, trace_lint, verify_graph)
+from . import (Report, Severity, audit_registry, check_compiles,
+               check_memory, trace_lint, verify_graph)
 
 
 def _parse_shape_args(pairs):
@@ -30,17 +39,77 @@ def _parse_shape_args(pairs):
     return shapes
 
 
+def _self_apply_compile() -> Report:
+    """Populate the live ledger with a small, correctly-disciplined
+    workload (bulked eager segments re-flushed for cache hits) and run
+    the discipline checker over everything this process recorded."""
+    import numpy as np
+
+    import mxtpu as mx
+    from mxtpu import engine
+
+    x = mx.nd.array(np.arange(8.0, dtype=np.float32))
+    for _ in range(2):
+        with engine.bulk(8):
+            ((x * 2.0) + 1.0).asnumpy()  # trace-ok: analysis probe
+    return check_compiles()
+
+
+def _self_apply_memory() -> Report:
+    """Estimate the reference MLP graph (the same one the graph verifier
+    self-checks with) against a generous per-device budget."""
+    from .. import symbol as sym
+
+    data = sym.Variable("data")
+    fc1 = sym.FullyConnected(data, num_hidden=128, name="selfcheck_fc1")
+    act = sym.Activation(fc1, act_type="relu", name="selfcheck_act")
+    net = sym.FullyConnected(act, num_hidden=10, name="selfcheck_fc2")
+    return check_memory(net, budget_bytes="1GiB", data=(32, 64))
+
+
+def _self_apply_donation() -> Report:
+    """Build a tiny SPMDTrainer (donate=True, the default) and verify
+    its compiled step's donated buffers actually alias."""
+    import numpy as np
+
+    import mxtpu as mx
+    from ..gluon import loss as gloss, nn
+    from ..parallel.mesh import DeviceMesh
+    from ..parallel.trainer import SPMDTrainer
+    from .donation_check import check_trainer_donation
+
+    mx.random.seed(0)
+    net = nn.Dense(8, in_units=4)
+    net.initialize()
+    trainer = SPMDTrainer(net, gloss.SoftmaxCrossEntropyLoss(), "sgd",
+                          DeviceMesh(dp=1),
+                          optimizer_params={"learning_rate": 0.1,
+                                            "momentum": 0.9})
+    X = mx.nd.array(np.zeros((4, 4), np.float32))
+    y = mx.nd.array(np.zeros((4,), np.float32))
+    # lowering-level verification (the aliasing attributes): the
+    # executable-level confirmation is exercised by the test suite
+    return check_trainer_donation(trainer, X, y, compile=False)
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="python -m mxtpu.analysis",
         description="static graph verifier, sharding checker, registry "
-                    "audit, and trace-safety lint")
+                    "audit, trace-safety lint, compile-discipline "
+                    "checker, HBM estimator, and donation checker")
     ap.add_argument("command", nargs="?", default="all",
-                    choices=["all", "registry", "lint", "graph"])
+                    choices=["all", "registry", "lint", "graph",
+                             "memory", "compile", "donate"])
     ap.add_argument("paths", nargs="*",
-                    help="lint: files/dirs; graph: one symbol.json")
+                    help="lint: files/dirs; graph/memory: one "
+                         "symbol.json; compile: one ledger dump")
     ap.add_argument("--shape", action="append", metavar="NAME=D0,D1",
-                    help="input shape hint for `graph` (repeatable)")
+                    help="input shape hint for `graph`/`memory` "
+                         "(repeatable)")
+    ap.add_argument("--budget", default=None, metavar="BYTES",
+                    help="memory: per-device budget (e.g. 16GiB); "
+                         "over-budget estimates are errors")
     ap.add_argument("--json", action="store_true",
                     help="emit diagnostics as JSON")
     ap.add_argument("--fail-on", default="error",
@@ -57,6 +126,12 @@ def main(argv=None) -> int:
             include_unverified=args.include_unverified))
     if args.command in ("all", "lint"):
         report.extend(trace_lint(args.paths or None))
+    if args.command == "all":
+        # self-apply the compile/memory/donation passes on built-in
+        # probe workloads: the CI gate exercises every pass end to end
+        report.extend(_self_apply_compile())
+        report.extend(_self_apply_memory())
+        report.extend(_self_apply_donation())
     if args.command == "graph":
         if len(args.paths) != 1:
             raise SystemExit("graph: exactly one symbol.json path")
@@ -64,6 +139,24 @@ def main(argv=None) -> int:
         sym = load(args.paths[0])
         report.extend(verify_graph(
             sym, known_shapes=_parse_shape_args(args.shape)))
+    if args.command == "memory":
+        if len(args.paths) != 1:
+            raise SystemExit("memory: exactly one symbol.json path")
+        from ..symbol import load
+        sym = load(args.paths[0])
+        report.extend(check_memory(
+            sym, budget_bytes=args.budget,
+            known_shapes=_parse_shape_args(args.shape)))
+    if args.command == "compile":
+        if args.paths:
+            from .compile_ledger import CompileLedger
+            with open(args.paths[0]) as f:
+                ledger = CompileLedger.from_json(f.read())
+            report.extend(check_compiles(ledger, include_summary=True))
+        else:
+            report.extend(_self_apply_compile())
+    if args.command == "donate":
+        report.extend(_self_apply_donation())
 
     if args.json:
         print(report.to_json())

@@ -1,0 +1,264 @@
+"""compile_check: turn the compile ledger into located C0xx diagnostics.
+
+The discipline being checked is PERF.md round 5's: the number of
+compiled programs per workload must be bounded by design (prefill
+buckets + one pooled step for serving; one step program per batch
+signature for training), never by traffic.  The ledger records every
+jit-cache lookup with its signature pre-split into shapes / dtypes /
+weak-type flags / static parts, so each growth mode gets its own code:
+
+==========  ========  =====================================================
+code        severity  meaning
+==========  ========  =====================================================
+C001        ERROR     unbounded signature cardinality: ≥ threshold programs
+                      at one site differing ONLY in shapes, with varying
+                      dims that are not power-of-two bucketed — per-length
+                      compiles that should bucket
+C002        WARNING   weak-type / dtype drift: two compiles identical
+                      except dtype or weak_type flags (the classic python-
+                      scalar-vs-array retrace)
+C003        WARNING   static-kwarg churn: ≥ threshold compiles with
+                      identical shapes+dtypes differing only in the static
+                      signature part
+C004        INFO      bounded bucketed family: many shape-only signatures
+                      whose varying dims are ALL powers of two (the
+                      O(log T) growth the discipline allows) — advisory
+C005        INFO      per-site summary (programs, hits/misses, top
+                      cardinality); emitted with include_summary=True
+==========  ========  =====================================================
+
+``compile_budget(n)`` is the enforcement half: a context manager that
+snapshots the ledger and raises :class:`CompileBudgetExceeded` when more
+than ``n`` new programs were compiled inside the block, listing each
+compile's site, signature, and call site.  Tier-1 tests use it to pin
+the serving engine to (buckets + 1) programs so a bucketing regression
+cannot land silently.
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Any, Dict, List, Optional, Tuple
+
+from ..base import MXTPUError
+from .compile_ledger import (CompileLedger, Miss, Signature, get_ledger)
+from .diagnostics import Diagnostic, Report, Severity, register_pass
+
+__all__ = ["check_compiles", "compile_budget", "CompileBudgetExceeded"]
+
+_PASS = "compile_check"
+
+
+def _is_pow2(n: int) -> bool:
+    return n > 0 and (n & (n - 1)) == 0
+
+
+def _shape_deltas(shapes_set) -> Tuple[Optional[List[int]], List[set]]:
+    """Flattened positions where the shape tuples differ, and the value
+    sets observed at each varying position.
+
+    Returns ``(None, [])`` for structurally heterogeneous groups — a
+    per-parameter optimizer site legitimately holds one signature per
+    distinct param shape, which is bounded by the model, not by
+    traffic.  The C001 defect is specifically the PER-LENGTH pattern:
+    congruent shapes varying along one effective axis, so groups whose
+    variation is not reducible to a single axis (different ranks, or
+    multiple uncorrelated varying dims) are not candidates."""
+    shapes = sorted(shapes_set)
+    flat = []
+    for s in shapes:
+        row = []
+        for dims in s:
+            row.extend(dims if isinstance(dims, (tuple, list)) else (dims,))
+        flat.append(tuple(row))
+    if len({len(r) for r in flat}) != 1:
+        return None, []
+    varying, values = [], []
+    for pos in range(len(flat[0])):
+        vals = {r[pos] for r in flat}
+        if len(vals) > 1:
+            varying.append(pos)
+            values.append(vals)
+    if len(varying) > 1:
+        # multiple varying dims only count as ONE axis when they are
+        # perfectly correlated (e.g. several same-length inputs growing
+        # together); otherwise the workload is heterogeneous, not
+        # unbucketed
+        for row in flat:
+            if len({row[p] for p in varying}) > 1:
+                return None, []
+    return varying, values
+
+
+def check_compiles(ledger: Optional[CompileLedger] = None,
+                   shape_churn_threshold: int = 4,
+                   static_churn_threshold: int = 3,
+                   include_summary: bool = False) -> Report:
+    """Analyze a compile ledger (default: the process-wide one); returns
+    a Report of C0xx diagnostics located at the call sites that compiled."""
+    led = ledger if ledger is not None else get_ledger()
+    report = Report()
+    stats = led.stats() if include_summary else {}
+
+    for site in led.sites():
+        rec = led.site(site)
+        misses: List[Miss] = list(rec.misses)
+        sigs = [m.signature for m in misses]
+        first_site = next((m.callsite for m in misses if m.callsite), None)
+
+        # -- C001 / C004: shape-only cardinality -------------------------
+        groups: Dict[Any, List[Miss]] = {}
+        for m in misses:
+            s = m.signature
+            groups.setdefault((s.dtypes, s.weak, s.static),
+                              []).append(m)
+        for key, members in groups.items():
+            shapes_set = {m.signature.shapes for m in members}
+            if len(shapes_set) < shape_churn_threshold:
+                continue
+            varying, values = _shape_deltas(shapes_set)
+            if varying is None:
+                continue  # heterogeneous group: bounded by the model
+            all_vals = [v for vs in values for v in vs]
+            bucketed = bool(all_vals) and all(
+                isinstance(v, int) and _is_pow2(v) for v in all_vals)
+            where = next((m.callsite for m in members if m.callsite),
+                         first_site)
+            detail = {"site": site, "programs": len(shapes_set),
+                      "varying_dims": varying,
+                      "observed_values": [sorted(vs, key=repr)[:16]
+                                          for vs in values]}
+            if bucketed:
+                report.add(Diagnostic(
+                    _PASS, "C004", Severity.INFO, site,
+                    "%d compiled programs at %s differ only in shapes "
+                    "whose varying dims are all powers of two — bounded "
+                    "bucketed growth (the O(log T) family the discipline "
+                    "allows)" % (len(shapes_set), site),
+                    location=where, details=detail))
+            else:
+                report.add(Diagnostic(
+                    _PASS, "C001", Severity.ERROR, site,
+                    "%d compiled programs at %s differ ONLY in shapes "
+                    "(varying dims %s, values %s): per-length compiles "
+                    "that should bucket — pad to power-of-two buckets "
+                    "(see ShardedDecoder's _bucket) or fix the varying "
+                    "dimension" % (
+                        len(shapes_set), site, varying,
+                        [sorted(vs, key=repr)[:8] for vs in values]),
+                    location=where, details=detail))
+
+        # -- C002: dtype / weak-type drift -------------------------------
+        seen_pairs = set()
+        by_shape_static: Dict[Any, List[Signature]] = {}
+        for s in set(sigs):
+            by_shape_static.setdefault((s.shapes, s.static),
+                                       []).append(s)
+        for (shapes, _), members in sorted(by_shape_static.items(),
+                                           key=lambda kv: repr(kv[0])):
+            if len(members) < 2:
+                continue
+            dts = {(s.dtypes, s.weak) for s in members}
+            if len(dts) < 2:
+                continue
+            key = (site, shapes)
+            if key in seen_pairs:
+                continue
+            seen_pairs.add(key)
+            kinds = []
+            if len({s.dtypes for s in members}) > 1:
+                kinds.append("dtype")
+            if len({s.weak for s in members}) > 1:
+                kinds.append("weak_type")
+            report.add(Diagnostic(
+                _PASS, "C002", Severity.WARNING, site,
+                "%d compiled programs at %s share shapes %r but differ "
+                "in %s (%s): a python scalar / weak-typed constant is "
+                "flipping the signature between calls — pin the dtype "
+                "(jnp.float32(x), astype) at the call site" % (
+                    len(members), site, shapes, " and ".join(kinds),
+                    sorted({s.dtypes for s in members})[:4]),
+                location=first_site,
+                details={"site": site,
+                         "variants": sorted(repr((s.dtypes, s.weak))
+                                            for s in members)[:8]}))
+
+        # -- C003: static-kwarg churn ------------------------------------
+        by_arrays: Dict[Any, set] = {}
+        for s in set(sigs):
+            by_arrays.setdefault((s.shapes, s.dtypes, s.weak),
+                                 set()).add(s.static)
+        for key, statics in sorted(by_arrays.items(),
+                                   key=lambda kv: repr(kv[0])):
+            if len(statics) < static_churn_threshold:
+                continue
+            report.add(Diagnostic(
+                _PASS, "C003", Severity.WARNING, site,
+                "%d compiled programs at %s share identical array "
+                "signatures but differ in static parts: a static kwarg "
+                "is churning per call — make it a traced array, or "
+                "bound its value set" % (len(statics), site),
+                location=first_site,
+                details={"site": site, "static_variants": len(statics),
+                         "sample": sorted(repr(s) for s in statics)[:6]}))
+
+        if include_summary:
+            report.add(Diagnostic(
+                _PASS, "C005", Severity.INFO, site,
+                "%s: %d program(s) compiled, %d hit(s) / %d miss(es), "
+                "top shape cardinality %d" % (
+                    site, rec.miss_count, rec.hits, rec.miss_count,
+                    stats[site]["shape_cardinality"]),
+                location=first_site))
+
+    return report
+
+
+class CompileBudgetExceeded(MXTPUError):
+    """Raised by :func:`compile_budget` when a block compiled more
+    programs than its budget.  ``compiles`` holds the Miss records."""
+
+    def __init__(self, msg, compiles=None):
+        super().__init__(msg)
+        self.compiles = list(compiles or [])
+
+
+@contextlib.contextmanager
+def compile_budget(n: int, sites: Optional[tuple] = None,
+                   ledger: Optional[CompileLedger] = None):
+    """Assert that at most ``n`` new programs are compiled inside the
+    block (optionally restricted to ledger ``sites``; a name ending in
+    ``*`` matches as a prefix, e.g. ``("serving.*",)``).
+
+    Raises :class:`CompileBudgetExceeded` on exit listing every compile
+    with its site, signature, and call site — the O(log T) invariant as
+    an executable assertion.  Requires the ledger to be enabled
+    (``MXTPU_COMPILE_LEDGER=0`` makes the budget unverifiable, which
+    raises immediately rather than silently passing)."""
+    led = ledger if ledger is not None else get_ledger()
+    if not led.enabled:
+        raise MXTPUError(
+            "compile_budget needs the compile ledger, but it is "
+            "disabled (MXTPU_COMPILE_LEDGER=0) — the budget cannot be "
+            "verified")
+    before = led.miss_counts(sites)
+    seq0 = led.sequence()
+    yield led
+    new = led.misses_after(seq0, sites)
+    total = sum(led.miss_counts(sites).values()) - sum(before.values())
+    if total > n:
+        lines = ["compile budget exceeded: %d new program(s) compiled, "
+                 "budget %d%s" % (total, n,
+                                  " (sites %s)" % (sites,) if sites
+                                  else "")]
+        for m in new[:16]:
+            lines.append("  - shapes=%r dtypes=%r at %s" % (
+                m.signature.shapes, m.signature.dtypes,
+                m.callsite or "<unknown>"))
+        if total > len(new):
+            lines.append("  (… %d signature(s) dropped by the per-site "
+                         "record limit)" % (total - len(new)))
+        raise CompileBudgetExceeded("\n".join(lines), compiles=new)
+
+
+register_pass(_PASS)(check_compiles)

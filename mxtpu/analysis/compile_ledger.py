@@ -1,0 +1,331 @@
+"""Process-wide compile ledger: every jit entry point reports into it.
+
+PAPER.md's blueprint makes the compiled graph — not Python dispatch —
+the unit of performance, and PERF.md round 5 established the O(log T)
+compiled-programs-per-generation discipline every serving and training
+win since relies on.  Nothing could *prove* that discipline held: each
+subsystem kept a private ``_jit_cache`` dict and regressions (a shape
+that should bucket, a static kwarg that churns, a weak-type flip) only
+showed up as mysteriously slow runs.
+
+The ledger is the shared observation point.  Every cache-fronted jit
+site — the engine's bulk-segment cache, ``CachedOp``, the sharded
+decoder's four program kinds (serving bucketed prefill + pooled decode
+step), ``SPMDTrainer.step``, and the per-parameter optimizer updates the
+gluon ``Trainer`` drives — records each lookup as a :class:`Signature`
+(shapes / dtypes / weak-type flags / static parts, pre-split so the
+checker can attribute growth to the right component) plus hit/miss and,
+for misses, the first non-mxtpu call site.  ``mxtpu.analysis
+.compile_check`` turns the record into located C0xx diagnostics and
+``compile_budget`` lets tests assert compile counts directly.
+
+Env vars (docs/analysis.md):
+
+- ``MXTPU_COMPILE_LEDGER=0``      disable recording entirely (default on;
+  a hit costs two dict operations under a lock).
+- ``MXTPU_COMPILE_LEDGER_LIMIT``  max miss records kept per site
+  (default 512; further misses are counted but drop their signatures).
+- ``MXTPU_COMPILE_LEDGER_DUMP``   path to write the ledger as JSON at
+  process exit (``python -m mxtpu.analysis compile DUMP.json`` analyzes
+  it offline).
+
+This module must stay import-light (no jax): the engine imports it on
+the eager dispatch path.
+"""
+
+from __future__ import annotations
+
+import atexit
+import json
+import os
+import threading
+from typing import Any, Dict, Iterable, List, NamedTuple, Optional, Tuple
+
+from ..base import env_bool, env_int
+
+__all__ = ["Signature", "Miss", "SiteRecord", "CompileLedger",
+           "get_ledger", "record", "observe", "ledger_enabled"]
+
+
+class Signature(NamedTuple):
+    """One jit-cache key, pre-split into the components the discipline
+    checker reasons about.  All fields must be hashable; shapes is a
+    tuple of int-tuples, dtypes a tuple of dtype-name strings, weak a
+    tuple of bools (weak_type flags, aligned with dtypes where the site
+    tracks them), static everything else (op sequences, flags, traced
+    python values)."""
+
+    shapes: Tuple[tuple, ...] = ()
+    dtypes: Tuple[str, ...] = ()
+    weak: Tuple[bool, ...] = ()
+    static: Any = ()
+
+
+class Miss(NamedTuple):
+    """One recorded compile (cache miss) at a site."""
+
+    signature: Signature
+    callsite: Optional[str]
+    seq: int                      # process-wide miss ordinal (event order)
+
+
+class SiteRecord:
+    """Hit/miss history of one jit entry point."""
+
+    __slots__ = ("site", "hits", "miss_count", "misses", "dropped")
+
+    def __init__(self, site: str):
+        self.site = site
+        self.hits = 0
+        self.miss_count = 0
+        self.misses: List[Miss] = []
+        self.dropped = 0          # misses beyond the per-site limit
+
+    @property
+    def lookups(self) -> int:
+        return self.hits + self.miss_count
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "site": self.site,
+            "hits": self.hits,
+            "misses": self.miss_count,
+            "dropped": self.dropped,
+            "signatures": [
+                {"shapes": [list(s) for s in m.signature.shapes],
+                 "dtypes": list(m.signature.dtypes),
+                 "weak": list(m.signature.weak),
+                 "static": repr(m.signature.static),
+                 "callsite": m.callsite,
+                 "seq": m.seq}
+                for m in self.misses],
+        }
+
+
+def _first_external_callsite() -> Optional[str]:
+    """file:line of the innermost frame OUTSIDE the mxtpu package — the
+    user code that triggered this compile.  Only runs on a miss, where a
+    real compile (orders of magnitude more expensive) follows anyway."""
+    import traceback
+
+    pkg_dir = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    for frame in reversed(traceback.extract_stack()[:-2]):
+        fname = os.path.abspath(frame.filename)
+        if not fname.startswith(pkg_dir + os.sep):
+            return "%s:%d" % (frame.filename, frame.lineno)
+    return None
+
+
+class CompileLedger:
+    """Thread-safe registry of per-site compile histories."""
+
+    def __init__(self, enabled: Optional[bool] = None,
+                 miss_limit: Optional[int] = None):
+        self._enabled = (env_bool("MXTPU_COMPILE_LEDGER", default=True)
+                         if enabled is None else bool(enabled))
+        self._miss_limit = (env_int("MXTPU_COMPILE_LEDGER_LIMIT", 512)
+                            if miss_limit is None else int(miss_limit))
+        self._lock = threading.Lock()
+        self._sites: Dict[str, SiteRecord] = {}
+        self._seen: Dict[str, set] = {}   # observe()'s per-site key sets
+        self._seq = 0
+
+    # -- recording -------------------------------------------------------
+    @property
+    def enabled(self) -> bool:
+        return self._enabled
+
+    def record(self, site: str, signature: Signature, hit: bool,
+               callsite: Optional[str] = None) -> None:
+        """Report one jit-cache lookup.  ``hit=False`` means a new
+        program was (or is about to be) compiled for this signature."""
+        if not self._enabled:
+            return
+        if hit:
+            with self._lock:
+                rec = self._sites.get(site)
+                if rec is None:
+                    rec = self._sites[site] = SiteRecord(site)
+                rec.hits += 1
+            return
+        # miss: callsite capture outside the lock (stack walk)
+        if callsite is None:
+            callsite = _first_external_callsite()
+        with self._lock:
+            rec = self._sites.get(site)
+            if rec is None:
+                rec = self._sites[site] = SiteRecord(site)
+            rec.miss_count += 1
+            self._seq += 1
+            if len(rec.misses) < self._miss_limit:
+                rec.misses.append(Miss(signature, callsite, self._seq))
+            else:
+                rec.dropped += 1
+
+    def observe(self, site: str, signature: Signature,
+                callsite: Optional[str] = None) -> bool:
+        """Record a lookup at a site with no inspectable cache of its own
+        (e.g. the optimizer's per-parameter jitted updates, where jax.jit
+        keeps the executable cache internally): the ledger tracks the
+        seen-signature set itself.  Returns True on hit."""
+        if not self._enabled:
+            return True
+        with self._lock:
+            seen = self._seen.setdefault(site, set())
+            hit = signature in seen
+            if not hit:
+                seen.add(signature)
+        self.record(site, signature, hit, callsite=callsite)
+        return hit
+
+    # -- querying --------------------------------------------------------
+    def sites(self) -> List[str]:
+        with self._lock:
+            return sorted(self._sites)
+
+    def site(self, name: str) -> Optional[SiteRecord]:
+        with self._lock:
+            return self._sites.get(name)
+
+    def miss_counts(self, sites: Optional[Iterable[str]] = None) \
+            -> Dict[str, int]:
+        """site -> miss count (compiled programs), optionally filtered to
+        site names or prefixes (a name ending in '*' matches as prefix)."""
+        with self._lock:
+            out = {}
+            for name, rec in self._sites.items():
+                if sites is not None and not _site_match(name, sites):
+                    continue
+                out[name] = rec.miss_count
+            return out
+
+    def sequence(self) -> int:
+        """Current process-wide miss ordinal — snapshot it before a
+        block and pass to :meth:`misses_after` to select exactly the
+        compiles that happened inside (count-based slicing would hand
+        back stale pre-snapshot records once the per-site record limit
+        drops new signatures)."""
+        with self._lock:
+            return self._seq
+
+    def misses_after(self, seq: int,
+                     sites: Optional[Iterable[str]] = None) -> List[Miss]:
+        """Miss records strictly newer than a :meth:`sequence`
+        watermark (records dropped by the per-site limit are absent —
+        compare counts via :meth:`miss_counts` for the true total)."""
+        with self._lock:
+            out = []
+            for name, rec in self._sites.items():
+                if sites is not None and not _site_match(name, sites):
+                    continue
+                out.extend(m for m in rec.misses if m.seq > seq)
+            return sorted(out, key=lambda m: m.seq)
+
+    def stats(self) -> Dict[str, Dict[str, Any]]:
+        """Per-site counters for diagnose/bench: lookups, hits, misses,
+        distinct signature count, and top signature-cardinality group."""
+        with self._lock:
+            out = {}
+            for name, rec in sorted(self._sites.items()):
+                sigs = [m.signature for m in rec.misses]
+                out[name] = {
+                    "lookups": rec.lookups,
+                    "hits": rec.hits,
+                    "misses": rec.miss_count,
+                    "distinct_signatures": len(set(sigs)),
+                    "shape_cardinality": _top_shape_cardinality(sigs),
+                }
+            return out
+
+    def total_compiles(self) -> int:
+        with self._lock:
+            return sum(r.miss_count for r in self._sites.values())
+
+    def reset(self) -> None:
+        with self._lock:
+            self._sites.clear()
+            self._seen.clear()
+            self._seq = 0
+
+    # -- persistence -----------------------------------------------------
+    def to_json(self) -> str:
+        with self._lock:
+            return json.dumps(
+                {"version": 1,
+                 "sites": [r.to_dict()
+                           for _, r in sorted(self._sites.items())]},
+                indent=2)
+
+    @classmethod
+    def from_json(cls, text: str) -> "CompileLedger":
+        data = json.loads(text)
+        led = cls(enabled=True)
+        for site in data.get("sites", ()):
+            rec = SiteRecord(site["site"])
+            rec.hits = int(site.get("hits", 0))
+            rec.miss_count = int(site.get("misses", 0))
+            rec.dropped = int(site.get("dropped", 0))
+            for s in site.get("signatures", ()):
+                sig = Signature(
+                    shapes=tuple(tuple(x) for x in s.get("shapes", ())),
+                    dtypes=tuple(s.get("dtypes", ())),
+                    weak=tuple(bool(w) for w in s.get("weak", ())),
+                    static=s.get("static", ""))
+                rec.misses.append(Miss(sig, s.get("callsite"),
+                                       int(s.get("seq", 0))))
+            led._sites[rec.site] = rec
+        return led
+
+
+def _site_match(name: str, sites: Iterable[str]) -> bool:
+    for s in sites:
+        if s.endswith("*"):
+            if name.startswith(s[:-1]):
+                return True
+        elif name == s:
+            return True
+    return False
+
+
+def _top_shape_cardinality(sigs: List[Signature]) -> int:
+    """Largest count of distinct shape tuples among signatures agreeing
+    on everything else — the number the bucketing discipline bounds."""
+    groups: Dict[Any, set] = {}
+    for s in sigs:
+        groups.setdefault((s.dtypes, s.weak, s.static),
+                          set()).add(s.shapes)
+    return max((len(v) for v in groups.values()), default=0)
+
+
+_LEDGER = CompileLedger()
+
+
+def get_ledger() -> CompileLedger:
+    """The process-wide ledger instance."""
+    return _LEDGER
+
+
+def ledger_enabled() -> bool:
+    return _LEDGER.enabled
+
+
+def record(site: str, signature: Signature, hit: bool,
+           callsite: Optional[str] = None) -> None:
+    """Module-level convenience for instrumented jit sites."""
+    _LEDGER.record(site, signature, hit, callsite=callsite)
+
+
+def observe(site: str, signature: Signature,
+            callsite: Optional[str] = None) -> bool:
+    return _LEDGER.observe(site, signature, callsite=callsite)
+
+
+_dump_path = os.environ.get("MXTPU_COMPILE_LEDGER_DUMP")
+if _dump_path:
+    def _dump_at_exit(path=_dump_path):
+        try:
+            with open(path, "w") as f:
+                f.write(_LEDGER.to_json())
+        except OSError:
+            pass
+    atexit.register(_dump_at_exit)

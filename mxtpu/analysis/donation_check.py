@@ -1,0 +1,338 @@
+"""donation_check: verify buffer donation actually aliases, and flag
+missed donation opportunities.
+
+``donate_argnums`` is a *request*: XLA only aliases a donated input to
+an output with the same shape+dtype, and a donation that cannot alias is
+silently dropped (jax prints one easily-missed UserWarning and the
+program quietly doubles its parameter residency).  The inverse failure
+is quieter still: a trainer step that passes params/optimizer state
+undonated holds two full copies of the model across every update —
+ROADMAP item 5 (whole-loop scan capture with donation) is built on
+catching exactly that.
+
+The pass checks three layers:
+
+1. **Aval matching** — the same shape+dtype greedy matching XLA's
+   aliasing pass performs, over the flattened donated leaves vs the
+   outputs.  Platform-independent.
+2. **Lowered aliasing attributes** — ``tf.aliasing_output`` per entry
+   parameter in the lowered StableHLO: what lowering actually recorded.
+3. **Compiled executable** — ``input_output_alias`` in the optimized
+   HLO plus ``memory_analysis().alias_size_in_bytes``: what the
+   executable will really do (skipped with ``compile=False``).
+
+==========  ========  =====================================================
+code        severity  meaning
+==========  ========  =====================================================
+D001        ERROR     a donated argument does not alias any output in the
+                      compiled program (donation silently dropped)
+D002        WARNING   missed donation: an undonated argument's leaves all
+                      match leftover outputs exactly (params/opt-state
+                      passed undonated)
+D003        INFO      donation verified: n leaves aliased, bytes saved
+D004        INFO      executable-level verification unavailable on this
+                      backend (aval-level result stands)
+==========  ========  =====================================================
+
+``check_trainer_donation(trainer, data, label)`` applies the pass to an
+``SPMDTrainer``'s compiled step (donate_argnums ``(0, 1, 2)`` — params,
+aux, optimizer state); tests seed a ``donate=False`` trainer and assert
+the D002s name the undonated state.
+"""
+
+from __future__ import annotations
+
+import re
+import warnings
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from .diagnostics import Diagnostic, Report, Severity, register_pass
+from .memory_estimate import format_bytes
+
+__all__ = ["check_donation", "check_trainer_donation"]
+
+_PASS = "donation_check"
+
+_ARG_SPLIT = re.compile(r"%arg(\d+)")
+_ALIAS_NUM = re.compile(r"tf\.aliasing_output\s*=\s*(\d+)")
+
+
+def _aval_of(x) -> Tuple[tuple, str]:
+    return (tuple(getattr(x, "shape", ())),
+            str(getattr(x, "dtype", "float32")))
+
+
+def _nbytes(aval: Tuple[tuple, str]) -> int:
+    import jax.numpy as jnp
+    n = 1
+    for d in aval[0]:
+        n *= int(d)
+    try:
+        return n * jnp.dtype(aval[1]).itemsize
+    except TypeError:
+        return n * 4
+
+
+def _lowered_alias_map(lowered_text: str) -> Dict[int, int]:
+    """flat entry-parameter index -> aliased output index, parsed from
+    the lowered StableHLO's ``tf.aliasing_output`` arg attributes.
+
+    Attribute dicts can nest braces inside quoted strings
+    (``mhlo.sharding = "{replicated}"``), so instead of matching the
+    ``{...}`` dict, split the module text on ``%argN`` references: the
+    aliasing attribute of arg N, when present, sits between its
+    signature occurrence and the next ``%arg`` (body uses of ``%argN``
+    carry no attributes, and first-win keeps the signature's)."""
+    out = {}
+    parts = _ARG_SPLIT.split(lowered_text)
+    # parts = [prefix, argidx, chunk, argidx, chunk, ...]
+    for i in range(1, len(parts) - 1, 2):
+        idx = int(parts[i])
+        if idx in out:
+            continue
+        am = _ALIAS_NUM.search(parts[i + 1])
+        if am:
+            out[idx] = int(am.group(1))
+    return out
+
+
+def check_donation(fn, *sample_args, donate_argnums: Sequence[int] = (),
+                   donatable_argnums: Optional[Sequence[int]] = None,
+                   static_argnums: Sequence[int] = (),
+                   in_shardings=None, out_shardings=None,
+                   compile: bool = True,
+                   arg_names: Optional[Sequence[str]] = None) -> Report:
+    """Check donation/aliasing of one jittable callable on sample
+    arguments (abstract or concrete; never executes).
+
+    donate_argnums: what the caller donates (the claim under test).
+    donatable_argnums: arguments that COULD be donated — dead after the
+    call from the caller's point of view (default: every non-static,
+    non-donated argument); only these produce D002.
+    arg_names: display names per argnum (defaults to ``arg<i>``).
+    """
+    import jax
+
+    report = Report()
+    statics = set(static_argnums)
+    names = list(arg_names) if arg_names is not None else [
+        "arg%d" % i for i in range(len(sample_args))]
+
+    # flat leaf index ranges per top-level argnum (jit's flattening order)
+    flat: List[Tuple[int, Tuple[tuple, str]]] = []
+    arg_leaf_idx: Dict[int, List[int]] = {}
+    for i, a in enumerate(sample_args):
+        if i in statics:
+            continue
+        for leaf in jax.tree_util.tree_leaves(a):
+            arg_leaf_idx.setdefault(i, []).append(len(flat))
+            flat.append((i, _aval_of(leaf)))
+
+    kw = {}
+    if in_shardings is not None:
+        kw["in_shardings"] = in_shardings
+    if out_shardings is not None:
+        kw["out_shardings"] = out_shardings
+    if hasattr(fn, "lower") and not kw and not static_argnums:
+        # already a jit-staged callable (e.g. a trainer's compiled step):
+        # lower IT directly — wrapping it in another jax.jit would lower
+        # the outer call without the inner stage's aliasing attributes,
+        # and donate_argnums here describes the claim being verified
+        jitted = fn
+    else:
+        jitted = jax.jit(fn, donate_argnums=tuple(donate_argnums),
+                         static_argnums=tuple(static_argnums), **kw)
+    with warnings.catch_warnings(record=True) as wrec:
+        warnings.simplefilter("always")
+        lowered = jitted.lower(*sample_args)
+    drop_warnings = [str(w.message) for w in wrec
+                     if "donated buffers were not usable" in
+                     str(w.message)
+                     or "onation is not implemented" in str(w.message)]
+
+    out_avals = [_aval_of(o) for o in
+                 jax.tree_util.tree_leaves(lowered.out_info)]
+
+    alias_map = _lowered_alias_map(lowered.as_text())
+    backend_unverifiable = any("onation is not implemented" in w
+                               for w in drop_warnings)
+
+    # -- aval-level greedy matching (XLA's aliasing rule) ----------------
+    remaining = list(range(len(out_avals)))
+
+    def take_match(aval):
+        for k in remaining:
+            if out_avals[k] == aval:
+                remaining.remove(k)
+                return k
+        return None
+
+    donated = sorted(set(donate_argnums) - statics)
+    aliased_leaves = 0
+    aliased_bytes = 0
+    for argnum in donated:
+        leaf_idxs = arg_leaf_idx.get(argnum, [])
+        dead = []
+        for li in leaf_idxs:
+            aval = flat[li][1]
+            matched = take_match(aval)
+            in_exec = li in alias_map
+            if in_exec:
+                aliased_leaves += 1
+                aliased_bytes += _nbytes(aval)
+            elif matched is None:
+                dead.append((li, aval))
+            elif not backend_unverifiable:
+                # an output matched but lowering did not alias it —
+                # donation dropped (consumed elsewhere / ordering)
+                dead.append((li, aval))
+            else:
+                aliased_leaves += 1  # aval-level only (D004 notes it)
+                aliased_bytes += _nbytes(aval)
+        if dead:
+            report.add(Diagnostic(
+                _PASS, "D001", Severity.ERROR, names[argnum],
+                "donated argument %s: %d of %d leaves do not alias any "
+                "output (e.g. %s %s) — the donation is silently dropped "
+                "and the buffer stays resident; donate only buffers "
+                "whose shape+dtype match an output%s" % (
+                    names[argnum], len(dead), len(leaf_idxs),
+                    dead[0][1][1], dead[0][1][0],
+                    "; jax: %s" % drop_warnings[0].split("\n")[0][:160]
+                    if drop_warnings else ""),
+                details={"argnum": argnum,
+                         "dead_leaves": [list(map(str, d[1]))
+                                         for d in dead[:8]]}))
+
+    # -- missed opportunities --------------------------------------------
+    if donatable_argnums is None:
+        donatable = [i for i in range(len(sample_args))
+                     if i not in statics and i not in set(donated)]
+    else:
+        donatable = [i for i in donatable_argnums
+                     if i not in statics and i not in set(donated)]
+    for argnum in donatable:
+        leaf_idxs = arg_leaf_idx.get(argnum, [])
+        if not leaf_idxs:
+            continue
+        trial = list(remaining)
+        matches = 0
+        saved = 0
+        for li in leaf_idxs:
+            aval = flat[li][1]
+            for k in trial:
+                if out_avals[k] == aval:
+                    trial.remove(k)
+                    matches += 1
+                    saved += _nbytes(aval)
+                    break
+        if matches == len(leaf_idxs) and matches > 0:
+            # every leaf of the argument matches a leftover output:
+            # donating it would alias in full
+            for li in leaf_idxs:
+                remaining.remove(next(
+                    k for k in remaining
+                    if out_avals[k] == flat[li][1]))
+            report.add(Diagnostic(
+                _PASS, "D002", Severity.WARNING, names[argnum],
+                "argument %s (%d leaves, %s) is passed undonated but "
+                "every leaf matches an output exactly — donating it "
+                "would update in place and halve its residency "
+                "(donate_argnums)" % (names[argnum], matches,
+                                      format_bytes(saved)),
+                details={"argnum": argnum, "leaves": matches,
+                         "bytes": saved}))
+
+    # -- executable-level confirmation -----------------------------------
+    if backend_unverifiable:
+        report.add(Diagnostic(
+            _PASS, "D004", Severity.INFO, "backend",
+            "this backend does not implement buffer donation — "
+            "executable-level aliasing cannot be verified here; the "
+            "aval-level verdicts above stand"))
+    elif donated:
+        exec_aliases = None
+        if compile:
+            compiled = lowered.compile()
+            txt = compiled.as_text() or ""
+            exec_aliases = "input_output_alias" in txt
+            try:
+                alias_bytes = int(
+                    compiled.memory_analysis().alias_size_in_bytes)
+            except Exception:
+                alias_bytes = None
+        else:
+            alias_bytes = None
+        if aliased_leaves:
+            report.add(Diagnostic(
+                _PASS, "D003", Severity.INFO, "donation",
+                "%d donated leaf/leaves alias outputs (%s saved)%s" % (
+                    aliased_leaves, format_bytes(aliased_bytes),
+                    {True: "; executable confirms input_output_alias",
+                     False: "; executable shows NO input_output_alias",
+                     None: ""}[exec_aliases]),
+                details={"leaves": aliased_leaves,
+                         "bytes": aliased_bytes,
+                         "alias_bytes": alias_bytes}))
+            if exec_aliases is False:
+                report.add(Diagnostic(
+                    _PASS, "D001", Severity.ERROR, "donation",
+                    "lowering recorded aliasing but the compiled "
+                    "executable has no input_output_alias — donation "
+                    "was dropped during compilation"))
+    return report
+
+
+def check_trainer_donation(trainer, data, label,
+                           compile: bool = True) -> Report:
+    """Apply :func:`check_donation` to an ``SPMDTrainer``'s compiled
+    step.  Stages the trainer if needed (one imperative forward) and
+    lowers the step abstractly — no training step executes.
+    ``compile=False`` stops at the lowered aliasing attributes (cheaper;
+    skips the executable-level confirmation).
+
+    donate=True trainers must verify clean (D003); donate=False
+    trainers get one D002 per undonated state argument — params, aux
+    and optimizer state each held twice per step."""
+    import jax
+    import jax.numpy as jnp
+
+    from .. import ndarray as nd
+    from .. import random as _random
+
+    data = data if isinstance(data, nd.NDArray) else nd.array(data)
+    label = label if isinstance(label, nd.NDArray) else nd.array(label)
+    trainer._ensure_staged(data)
+    if trainer._guard and trainer._scale_state is None:
+        trainer._scale_state = (jnp.float32(
+            trainer._scale_cfg[0] if trainer._dyn_scale else 1.0),
+            jnp.int32(0))
+
+    batch = data._data
+    lab = label._data
+    sig = (tuple(batch.shape), str(batch.dtype), tuple(lab.shape),
+           str(lab.dtype))
+    step_fn = trainer._build_step(*sig)
+
+    diff_leaves = tuple(p.data()._data for p in trainer._diff_params)
+    aux_leaves = tuple(p.data()._data for p in trainer._aux_params)
+    args = [diff_leaves, aux_leaves, tuple(trainer._opt_states),
+            jnp.float32(trainer._effective_lr()), jnp.float32(1.0),
+            batch, lab, _random.next_key()]
+    names = ["params", "aux_params", "opt_states", "lr", "t", "batch",
+             "label", "rng_key"]
+    if trainer._guard:
+        args.append(trainer._scale_state)
+        names.append("scale_state")
+
+    donated = (0, 1, 2) if trainer._donate else ()
+    # step_fn is already a jax.jit stage with its donate/shardings baked
+    # in; re-wrap the underlying behavior by checking THROUGH it: lower
+    # directly and reuse check_donation's parsing on the lowered text.
+    report = check_donation(
+        step_fn, *args, donate_argnums=donated,
+        donatable_argnums=(0, 1, 2), arg_names=names, compile=compile)
+    return report
+
+
+register_pass(_PASS)(check_donation)

@@ -45,6 +45,10 @@ L006        WARNING   ``time.sleep`` or raw ``signal.signal`` outside
                       (use RetryPolicy / a fault plan's delay action) and
                       raw signal handlers leak past exceptions (use
                       ``preemption.install``, which restores dispositions)
+L007        INFO      dead ``# trace-ok`` suppression: the comment is
+                      present but no diagnostic was suppressed on that
+                      line — the hazard it excused is gone; delete the
+                      comment so stale suppressions don't accumulate
 ==========  ========  =====================================================
 
 The L005 rule lints ``with ... bulk(...):`` bodies rather than traced
@@ -94,17 +98,40 @@ _SAFE_ATTRS = {"shape", "ndim", "dtype", "size", "aval", "sharding",
 
 
 def _trace_ok_suppressed(lines: List[str], node: ast.AST,
-                         span_node: Optional[ast.AST] = None) -> bool:
+                         span_node: Optional[ast.AST] = None,
+                         used: Optional[Set[int]] = None) -> bool:
     """Honor "# trace-ok" anywhere on the lines the flagged expression
     spans (multi-line calls / conditions included) — shared by every
-    rule so the suppression convention stays consistent."""
+    rule so the suppression convention stays consistent.  Lines whose
+    comment actually suppressed a diagnostic are recorded into ``used``
+    so L007 can report the DEAD ones afterwards."""
     span = span_node if span_node is not None else node
     start = span.lineno
     end = getattr(span, "end_lineno", None) or start
+    hit = False
     for ln in range(start, min(end, len(lines)) + 1):
         if 0 < ln <= len(lines) and "# trace-ok" in lines[ln - 1]:
-            return True
-    return False
+            if used is not None:
+                used.add(ln)
+            hit = True
+    return hit
+
+
+def _trace_ok_comment_lines(source: str) -> Set[int]:
+    """Line numbers carrying a real ``# trace-ok`` COMMENT token —
+    tokenized, so the phrase inside a string literal or docstring (this
+    very module documents the convention) never counts."""
+    import io
+    import tokenize
+
+    out: Set[int] = set()
+    try:
+        for tok in tokenize.generate_tokens(io.StringIO(source).readline):
+            if tok.type == tokenize.COMMENT and "trace-ok" in tok.string:
+                out.add(tok.start[0])
+    except (tokenize.TokenError, IndentationError, SyntaxError):
+        pass  # partial token stream: keep what was collected
+    return out
 
 
 def _dotted_name(node: ast.AST) -> Optional[str]:
@@ -221,15 +248,17 @@ class _ScopeLinter(ast.NodeVisitor):
     """Lints one traced function body with simple forward taint flow."""
 
     def __init__(self, fname: str, lines: List[str], report: Report,
-                 tainted: Set[str]):
+                 tainted: Set[str], used: Optional[Set[int]] = None):
         self.fname = fname
         self.lines = lines
         self.report = report
         self.tainted = set(tainted)
+        self.used = used
 
     # -- helpers ---------------------------------------------------------
     def _suppressed(self, node, span_node=None) -> bool:
-        return _trace_ok_suppressed(self.lines, node, span_node)
+        return _trace_ok_suppressed(self.lines, node, span_node,
+                                    used=self.used)
 
     def _emit(self, node, code, severity, subject, message,
               span_node=None):
@@ -334,7 +363,8 @@ class _ScopeLinter(ast.NodeVisitor):
     # fn are traced too and linted with inherited taint); skip re-walk
     def visit_FunctionDef(self, node):
         sub = _ScopeLinter(self.fname, self.lines, self.report,
-                           self.tainted | _tainted_params(node))
+                           self.tainted | _tainted_params(node),
+                           used=self.used)
         for stmt in node.body:
             sub.visit(stmt)
 
@@ -342,7 +372,8 @@ class _ScopeLinter(ast.NodeVisitor):
 
     def visit_Lambda(self, node):
         sub = _ScopeLinter(self.fname, self.lines, self.report,
-                           self.tainted | _tainted_params(node))
+                           self.tainted | _tainted_params(node),
+                           used=self.used)
         sub.visit(node.body)
 
 
@@ -359,14 +390,16 @@ class _BulkRegionLinter(ast.NodeVisitor):
     the region asked for.  Heuristic trigger: any with-item whose context
     expression is a call to a function named ``bulk``."""
 
-    def __init__(self, fname: str, lines: List[str], report: Report):
+    def __init__(self, fname: str, lines: List[str], report: Report,
+                 used: Optional[Set[int]] = None):
         self.fname = fname
         self.lines = lines
         self.report = report
+        self.used = used
         self._depth = 0  # > 0 while inside a bulk region
 
     def _emit(self, node, subject, what):
-        if _trace_ok_suppressed(self.lines, node):
+        if _trace_ok_suppressed(self.lines, node, used=self.used):
             return
         self.report.add(Diagnostic(
             _PASS, "L005", Severity.WARNING, subject,
@@ -423,13 +456,15 @@ class _HostHazardLinter(ast.NodeVisitor):
     test discipline, and a raw signal.signal leaks the handler when an
     exception skips the restore path."""
 
-    def __init__(self, fname: str, lines: List[str], report: Report):
+    def __init__(self, fname: str, lines: List[str], report: Report,
+                 used: Optional[Set[int]] = None):
         self.fname = fname
         self.lines = lines
         self.report = report
+        self.used = used
 
     def _emit(self, node, subject, message):
-        if _trace_ok_suppressed(self.lines, node):
+        if _trace_ok_suppressed(self.lines, node, used=self.used):
             return
         self.report.add(Diagnostic(
             _PASS, "L006", Severity.WARNING, subject, message,
@@ -481,22 +516,41 @@ def lint_source(source: str, filename: str = "<string>") -> Report:
                 nested.add(sub)
     traced -= nested
 
+    used: Set[int] = set()
     for fn in traced:
         tainted = _tainted_params(fn)
-        linter = _ScopeLinter(filename, lines, report, tainted)
+        linter = _ScopeLinter(filename, lines, report, tainted, used=used)
         body = fn.body if isinstance(fn.body, list) else [fn.body]
         for stmt in body:
             linter.visit(stmt)
 
-    _BulkRegionLinter(filename, lines, report).visit(tree)
+    _BulkRegionLinter(filename, lines, report, used=used).visit(tree)
     if not _resilience_exempt(filename):
-        _HostHazardLinter(filename, lines, report).visit(tree)
+        _HostHazardLinter(filename, lines, report, used=used).visit(tree)
+
+    # L007: suppressions present but never consulted by a firing rule —
+    # the hazard they excused is gone, so the comment is stale
+    for ln in sorted(_trace_ok_comment_lines(source) - used):
+        report.add(Diagnostic(
+            _PASS, "L007", Severity.INFO, "trace-ok",
+            "dead `# trace-ok` suppression: no diagnostic is suppressed "
+            "on this line — the hazard it excused is gone; remove the "
+            "stale comment",
+            location="%s:%d" % (filename, ln)))
     return report
+
+
+# per-file result cache keyed on (abspath, mtime_ns, size): the repo
+# self-lints several times per process (tier-1 self-lint, the CLI `all`
+# self-application, diagnose) and an unchanged file's findings are
+# deterministic — the second full-package lint becomes ~free
+_FILE_CACHE: dict = {}
 
 
 def trace_lint(paths: Union[str, Iterable[str], None] = None) -> Report:
     """Lint .py files under the given paths (default: the mxtpu package
-    directory — the repo self-lint)."""
+    directory — the repo self-lint).  Unchanged files (same mtime+size)
+    are served from a per-process cache."""
     if paths is None:
         paths = [os.path.dirname(os.path.dirname(
             os.path.abspath(__file__)))]
@@ -517,6 +571,12 @@ def trace_lint(paths: Union[str, Iterable[str], None] = None) -> Report:
     report = Report()
     for f in sorted(files):
         try:
+            st = os.stat(f)
+            key = (os.path.abspath(f), st.st_mtime_ns, st.st_size)
+            cached = _FILE_CACHE.get(key)
+            if cached is not None:
+                report.diagnostics.extend(cached)
+                continue
             with open(f, encoding="utf-8") as fh:
                 src = fh.read()
         except OSError as exc:
@@ -524,7 +584,9 @@ def trace_lint(paths: Union[str, Iterable[str], None] = None) -> Report:
                 _PASS, "L000", Severity.WARNING, f,
                 "unreadable: %s" % exc))
             continue
-        report.extend(lint_source(src, filename=f))
+        file_report = lint_source(src, filename=f)
+        _FILE_CACHE[key] = list(file_report.diagnostics)
+        report.extend(file_report)
     return report
 
 

@@ -128,6 +128,18 @@ class CachedOp:
         sig = (tuple((tuple(d.shape), str(d.dtype)) for d in input_datas),
                nd_positions, static_args, training)
         jitted = self._jit_cache.get(sig)
+        # compile-ledger report (docs/analysis.md): one site per block, so
+        # compile_check attributes shape churn to the cache that grows
+        from .analysis.compile_ledger import (Signature, ledger_enabled,
+                                              record)
+        if ledger_enabled():
+            record("cached_op.%s" % self._block.name, Signature(
+                shapes=tuple(tuple(d.shape) for d in input_datas),
+                dtypes=tuple(str(d.dtype) for d in input_datas),
+                weak=tuple(bool(getattr(d, "weak_type", False))
+                           for d in input_datas),
+                static=(nd_positions, static_args, training)),
+                hit=jitted is not None)
         if jitted is None:
             fn = self._make_fn(training, static_args, nd_positions)
             jitted = jax.jit(fn)

@@ -447,8 +447,23 @@ class BulkSegment:
                   for e in self.inputs),
             live_mask, diff_idx, recorded,
         )
-
         compiled = _SEG_CACHE.get(sig)
+        # compile-ledger report (docs/analysis.md): the segment cache is
+        # a jit entry point — the ledger is how compile_check proves the
+        # discipline holds.  Signature pre-split so shape churn, dtype
+        # drift and op-sequence churn attribute to the right C0xx code.
+        # Gated so MXTPU_COMPILE_LEDGER=0 skips even the signature build.
+        from .analysis.compile_ledger import (Signature as _LedgerSig,
+                                              ledger_enabled,
+                                              record as _ledger_record)
+        if ledger_enabled():
+            _ledger_record("engine.bulk", _LedgerSig(
+                shapes=tuple(tuple(e.value.shape) for e in self.inputs),
+                dtypes=tuple(str(e.value.dtype) for e in self.inputs),
+                weak=tuple(bool(getattr(e.value, "weak_type", False))
+                           for e in self.inputs),
+                static=(sig[0], live_mask, diff_idx, recorded)),
+                hit=compiled is not None)
         if compiled is _EAGER:
             _STATS["cache_hits"] += 1
             _STATS["eager_replays"] += 1

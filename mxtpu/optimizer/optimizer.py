@@ -117,11 +117,29 @@ class Optimizer:
         # matching the reference's in-place fused optimizer ops.
         return jax.jit(self._step, donate_argnums=(0, 2))
 
+    def _ledger_observe(self, weight, grad):
+        """Report this per-parameter compiled update into the process
+        compile ledger (docs/analysis.md).  jax.jit keeps the executable
+        cache internally (one retrace per distinct shape/dtype), so the
+        ledger tracks the seen-signature set itself — this is how the
+        gluon Trainer's compiled steps become visible to compile_check.
+        Gated before the signature build: this runs per parameter per
+        step."""
+        from ..analysis.compile_ledger import (Signature, ledger_enabled,
+                                               observe)
+        if not ledger_enabled():
+            return
+        observe("optimizer.%s" % type(self).__name__.lower(), Signature(
+            shapes=(tuple(weight.shape), tuple(grad.shape)),
+            dtypes=(str(weight.dtype), str(grad.dtype)),
+            weak=(), static=()))
+
     def update(self, index, weight, grad, state):
         """Imperative entry (parity: Optimizer.update).  Mutates weight/state."""
         self._update_count(index)
         lr = self._get_lr(index)
         wd = self._get_wd(index)
+        self._ledger_observe(weight, grad)
         new_w, new_state = self._jit_step()(
             weight.data, grad.data, state,
             jnp.float32(lr), jnp.float32(wd))
@@ -373,6 +391,7 @@ class Adam(Optimizer):
         coef2 = 1. - self.beta2 ** t
         lr = self._get_lr(index) * math.sqrt(coef2) / coef1
         wd = self._get_wd(index)
+        self._ledger_observe(weight, grad)
         new_w, new_state = self._jit_step()(
             weight.data, grad.data, state, jnp.float32(lr), jnp.float32(wd))
         weight._rebind(new_w)
@@ -538,6 +557,7 @@ class LAMB(Optimizer):
         t = self._index_update_count[index]
         lr = self._get_lr(index)
         wd = self._get_wd(index)
+        self._ledger_observe(weight, grad)
         new_w, new_state = self._jit_t_step()(
             weight.data, grad.data, state, jnp.float32(lr), jnp.float32(wd),
             jnp.float32(t))

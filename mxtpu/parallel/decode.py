@@ -209,10 +209,30 @@ class ShardedDecoder:
         return logits, block.write_cache_slot(caches, scratch,
                                               NDArray(slot))
 
+    def _ledger_report(self, kind, cache_leaves, extras, hit):
+        """Report one program-cache lookup into the process compile
+        ledger (docs/analysis.md): the bucketed prefill and pooled decode
+        step are THE sites the O(log T) discipline bounds, and
+        compile_budget / compile_check read this record.  Gated before
+        the signature build — this runs once per decode token."""
+        from ..analysis.compile_ledger import (Signature, ledger_enabled,
+                                               record)
+        if not ledger_enabled():
+            return
+        record("serving.%s" % kind, Signature(
+            shapes=tuple(tuple(ck.shape) for ck, _ in cache_leaves)
+            + tuple(tuple(e.shape) for e in extras),
+            dtypes=(str(cache_leaves[0][0].dtype),)
+            + tuple(str(e.dtype) for e in extras),
+            weak=(),
+            static=(kind,)), hit=hit)
+
     def _step_jitted(self, cache_leaves, token, pos):
         key = ("step", tuple(ck.shape for ck, _ in cache_leaves),
                cache_leaves[0][0].dtype, token.shape, token.dtype)
-        if key not in self._jit_cache:
+        hit = key in self._jit_cache
+        self._ledger_report("step", cache_leaves, (token,), hit)
+        if not hit:
             self._jit_cache[key] = self._build_program(
                 self._step_body, len(cache_leaves), n_extra_inputs=2)
         param_leaves = tuple(p.data()._data for p in self._params)
@@ -221,7 +241,9 @@ class ShardedDecoder:
     def _prefill_jitted(self, cache_leaves, tokens):
         key = ("prefill", tuple(ck.shape for ck, _ in cache_leaves),
                cache_leaves[0][0].dtype, tokens.shape, tokens.dtype)
-        if key not in self._jit_cache:
+        hit = key in self._jit_cache
+        self._ledger_report("prefill", cache_leaves, (tokens,), hit)
+        if not hit:
             self._jit_cache[key] = self._build_program(
                 self._prefill_body, len(cache_leaves), n_extra_inputs=1)
         param_leaves = tuple(p.data()._data for p in self._params)
@@ -230,7 +252,9 @@ class ShardedDecoder:
     def _step_slots_jitted(self, cache_leaves, token, pos):
         key = ("step_slots", tuple(ck.shape for ck, _ in cache_leaves),
                cache_leaves[0][0].dtype, token.shape, token.dtype)
-        if key not in self._jit_cache:
+        hit = key in self._jit_cache
+        self._ledger_report("step_slots", cache_leaves, (token,), hit)
+        if not hit:
             self._jit_cache[key] = self._build_program(
                 self._step_slots_body, len(cache_leaves),
                 n_extra_inputs=2)
@@ -241,7 +265,9 @@ class ShardedDecoder:
         key = ("slot_prefill",
                tuple(ck.shape for ck, _ in cache_leaves),
                cache_leaves[0][0].dtype, tokens.shape, tokens.dtype)
-        if key not in self._jit_cache:
+        hit = key in self._jit_cache
+        self._ledger_report("slot_prefill", cache_leaves, (tokens,), hit)
+        if not hit:
             self._jit_cache[key] = self._build_program(
                 self._slot_prefill_body, len(cache_leaves),
                 n_extra_inputs=2)

@@ -334,6 +334,16 @@ class SPMDTrainer:
         sig = (tuple(batch.shape), str(batch.dtype), tuple(lab.shape),
                str(lab.dtype))
         jitted = self._jit_cache.get(sig)
+        # compile-ledger report (docs/analysis.md): the compiled train
+        # step is a jit site the discipline checker audits — a growing
+        # batch-signature set here means data-pipeline shape churn
+        from ..analysis.compile_ledger import (Signature, ledger_enabled,
+                                               record)
+        if ledger_enabled():
+            record("spmd_trainer.step", Signature(
+                shapes=(sig[0], sig[2]), dtypes=(sig[1], sig[3]),
+                weak=(), static=(self._guard, self._dyn_scale)),
+                hit=jitted is not None)
         if jitted is None:
             jitted = self._build_step(*sig)
             self._jit_cache[sig] = jitted

@@ -456,6 +456,97 @@ def test_trace_lint_flags_sleep_and_raw_signal():
     assert "RetryPolicy" in msgs and "preemption.install" in msgs
 
 
+def test_trace_lint_dead_suppression_is_info():
+    """L007 satellite: a `# trace-ok` that suppresses nothing is
+    reported (INFO) with its line; live suppressions and the phrase
+    inside string literals are not."""
+    src = (
+        "import jax\n"
+        "@jax.jit\n"
+        "def f(x):\n"
+        "    return float(x)  # trace-ok: live — suppresses L003\n"
+        "def g(x):\n"
+        "    return x + 1  # trace-ok: stale, nothing fires here\n"
+        "DOC = 'mention of # trace-ok in a string'\n"
+    )
+    rep = lint_source(src, "supp.py")
+    l7 = rep.filter(code="L007")
+    assert [d.location for d in l7] == ["supp.py:6"]
+    assert all(d.severity == Severity.INFO for d in l7)
+    # nothing else fired (the live suppression ate L003)
+    assert len(rep) == 1
+
+
+def test_audit_cache_invalidates_on_reregistration():
+    """The eval cache (speed satellite) is keyed on fn identity: popping
+    an op and re-registering the same name with a FIXED fn must not
+    serve the stale verdict."""
+
+    @register_op("_test_cache_inval_op", num_outputs=2)
+    def _bad(x):
+        return x  # one output, declares two -> R002
+
+    try:
+        rep = audit_registry(ops=["_test_cache_inval_op"])
+        assert [d.code for d in rep] == ["R002"]
+    finally:
+        _OP_REGISTRY.pop("_test_cache_inval_op")
+
+    @register_op("_test_cache_inval_op", num_outputs=2)
+    def _good(x):
+        return x, x + 1
+
+    try:
+        rep = audit_registry(ops=["_test_cache_inval_op"])
+        assert rep.ok, str(rep)
+    finally:
+        _OP_REGISTRY.pop("_test_cache_inval_op")
+
+
+def test_audit_cache_invalidates_on_differentiable_flip():
+    """Re-registering the SAME fn with differentiable flipped must not
+    serve the stale R003 verdict — the flag is part of cache validity
+    (flipping it is R003's own recommended fix)."""
+    import jax
+
+    def _impl(x):
+        return jax.pure_callback(
+            lambda a: a, jax.ShapeDtypeStruct((2, 4), np.float32), x)
+
+    register_op("_test_diff_flip_op", differentiable=True)(_impl)
+    try:
+        rep = audit_registry(ops=["_test_diff_flip_op"])
+        assert [d.code for d in rep] == ["R003"]
+    finally:
+        _OP_REGISTRY.pop("_test_diff_flip_op")
+    register_op("_test_diff_flip_op", differentiable=False)(_impl)
+    try:
+        rep = audit_registry(ops=["_test_diff_flip_op"])
+        assert rep.ok, str(rep)
+    finally:
+        _OP_REGISTRY.pop("_test_diff_flip_op")
+
+
+def test_audit_repeat_served_from_cache():
+    """Repeat audits of the same spec reuse the cached abstract eval
+    (the tier-1 speed satellite): the cache holds the spec's fn."""
+    from mxtpu.analysis import registry_audit as ra
+
+    @register_op("_test_cached_probe_op")
+    def _op(x):
+        return x * 2
+
+    try:
+        audit_registry(ops=["_test_cached_probe_op"])
+        ent = ra._EVAL_CACHE.get("_test_cached_probe_op")
+        assert ent is not None and ent[0] is _OP_REGISTRY[
+            "_test_cached_probe_op"].fn
+        audit_registry(ops=["_test_cached_probe_op"])  # cache hit path
+    finally:
+        _OP_REGISTRY.pop("_test_cached_probe_op")
+        ra._EVAL_CACHE.pop("_test_cached_probe_op", None)
+
+
 def test_trace_lint_host_hazard_exemptions_and_suppression():
     # the resilience package and preemption.py OWN the real sleeps /
     # managed signal.signal calls — exempt by path

@@ -28,3 +28,23 @@ def test_trace_lint_mxtpu_clean():
     # sudden jump is worth a look in review
     assert len(rep.warnings) <= 8, \
         "trace-lint warnings grew past the budget:\n%s" % rep
+    # dead `# trace-ok` suppressions (L007) must not accumulate either
+    assert len(rep.filter(code="L007")) == 0, \
+        "stale trace-ok suppressions:\n%s" % rep.filter(code="L007")
+
+
+def test_cli_all_self_applies_every_pass(capsys):
+    """ISSUE 6 acceptance: `python -m mxtpu.analysis all --fail-on=error`
+    passes self-applied, INCLUDING the compile-discipline, memory, and
+    donation passes (their self-check probes run inside `all`)."""
+    from mxtpu.analysis import get_ledger
+    from mxtpu.analysis.__main__ import main
+
+    # other tests seed deliberate defects into the process-wide ledger;
+    # the self-application verdict is about THIS run's probes
+    get_ledger().reset()
+    rc = main(["all", "--fail-on=error"])
+    out = capsys.readouterr().out
+    assert rc == 0, out
+    assert "M003" in out     # memory self-estimate ran
+    assert "D003" in out     # donation self-check verified aliasing

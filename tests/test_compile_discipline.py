@@ -1,0 +1,294 @@
+"""Compile-discipline checks (ISSUE 6): the process-wide compile
+ledger, the C0xx checker's seeded-defect matrix, compile_budget, and
+the tier-1 acceptance test pinning the continuous-batching engine to
+(#prefill buckets + 1) compiled programs over a mixed-length workload —
+with a seeded bucketing regression asserted to FAIL the same budget.
+
+Runs on the virtual 8-device CPU mesh from conftest."""
+
+import numpy as np
+import pytest
+
+import mxtpu as mx
+from mxtpu import engine, nd
+from mxtpu.analysis import (CompileBudgetExceeded, CompileLedger,
+                            Severity, Signature, check_compiles,
+                            compile_budget, get_ledger)
+from mxtpu.base import MXTPUError
+from mxtpu.models.transformer import transformer_lm_sharding_rules
+from mxtpu.parallel import ContinuousBatchingEngine
+
+
+def _sig(shapes, dtypes=None, weak=None, static=()):
+    shapes = tuple(tuple(s) for s in shapes)
+    return Signature(
+        shapes=shapes,
+        dtypes=tuple(dtypes or ("float32",) * len(shapes)),
+        weak=tuple(weak or (False,) * len(shapes)),
+        static=static)
+
+
+# -- ledger unit behavior ----------------------------------------------
+
+def test_ledger_records_hits_misses_and_callsites():
+    led = CompileLedger(enabled=True)
+    s = _sig([(1, 8)])
+    led.record("site.a", s, hit=False)
+    led.record("site.a", s, hit=True)
+    led.record("site.a", s, hit=True)
+    st = led.stats()["site.a"]
+    assert st["lookups"] == 3 and st["hits"] == 2 and st["misses"] == 1
+    rec = led.site("site.a")
+    # the miss captured THIS test file as the first non-mxtpu frame
+    assert rec.misses[0].callsite and "test_compile_discipline" in \
+        rec.misses[0].callsite
+
+
+def test_ledger_observe_dedups_per_site():
+    led = CompileLedger(enabled=True)
+    s1, s2 = _sig([(4,)]), _sig([(8,)])
+    assert led.observe("opt.sgd", s1) is False   # first sight = miss
+    assert led.observe("opt.sgd", s1) is True
+    assert led.observe("opt.sgd", s2) is False
+    assert led.miss_counts()["opt.sgd"] == 2
+
+
+def test_ledger_miss_limit_counts_but_drops_records():
+    led = CompileLedger(enabled=True, miss_limit=2)
+    for i in range(5):
+        led.record("s", _sig([(1, i + 3)]), hit=False)
+    rec = led.site("s")
+    assert rec.miss_count == 5
+    assert len(rec.misses) == 2 and rec.dropped == 3
+
+
+def test_budget_never_lists_stale_records_past_miss_limit():
+    """When the per-site record limit drops the in-budget compiles'
+    signatures, the budget error must report the drop — never attribute
+    stale pre-snapshot records as the offending compiles."""
+    led = CompileLedger(enabled=True, miss_limit=2)
+    led.record("s", _sig([(1, 1)]), hit=False, callsite="old.py:1")
+    led.record("s", _sig([(1, 2)]), hit=False, callsite="old.py:2")
+    with pytest.raises(CompileBudgetExceeded) as ei:
+        with compile_budget(0, ledger=led):
+            for t in (3, 4, 5):  # all three dropped by the limit
+                led.record("s", _sig([(1, t)]), hit=False,
+                           callsite="new.py:%d" % t)
+    msg = str(ei.value)
+    assert "3 new program(s) compiled" in msg
+    assert "old.py" not in msg
+    assert "3 signature(s) dropped by the per-site record limit" in msg
+
+
+def test_ledger_json_roundtrip_preserves_findings():
+    led = CompileLedger(enabled=True)
+    for t in (5, 6, 7, 9):
+        led.record("serve.prefill", _sig([(1, t)]), hit=False,
+                   callsite="caller.py:1")
+    loaded = CompileLedger.from_json(led.to_json())
+    rep = check_compiles(loaded)
+    assert [d.code for d in rep] == ["C001"]
+    assert rep.diagnostics[0].subject == "serve.prefill"
+
+
+def test_disabled_ledger_is_inert_and_budget_refuses():
+    led = CompileLedger(enabled=False)
+    led.record("x", _sig([(2,)]), hit=False)
+    assert led.stats() == {}
+    with pytest.raises(MXTPUError, match="MXTPU_COMPILE_LEDGER"):
+        with compile_budget(1, ledger=led):
+            pass
+
+
+# -- C0xx seeded-defect matrix -----------------------------------------
+
+def test_c001_unbucketed_shape_loop_named_and_located():
+    """The deliberately unbucketed shape loop: per-length signatures at
+    one site, not powers of two — C001 ERROR naming the site."""
+    led = CompileLedger(enabled=True)
+    for t in (5, 6, 7, 9, 11):
+        led.record("decode.prefill", _sig([(1, t), (4, 16)]), hit=False,
+                   callsite="serve_loop.py:42")
+    rep = check_compiles(led)
+    bad = rep.filter(code="C001")
+    assert [d.subject for d in bad] == ["decode.prefill"]
+    d = bad.diagnostics[0]
+    assert d.severity == Severity.ERROR
+    assert d.location == "serve_loop.py:42"
+    assert d.details["programs"] == 5
+    assert not rep.ok
+
+
+def test_c001_not_fired_for_heterogeneous_param_shapes():
+    """A per-parameter optimizer site legitimately compiles once per
+    distinct param shape — bounded by the model, not traffic.  Mixed
+    ranks / uncorrelated dims must NOT read as unbucketed churn."""
+    led = CompileLedger(enabled=True)
+    for shape in ((128, 64), (128,), (64, 10), (10,), (64, 64)):
+        led.record("optimizer.sgd", _sig([shape, shape]), hit=False)
+    # congruent but uncorrelated 2-D shapes: also not a length sweep
+    for shape in ((128, 64), (64, 32), (32, 16), (16, 8)):
+        led.record("optimizer.adam", _sig([shape]), hit=False)
+    rep = check_compiles(led)
+    assert len(rep.filter(code="C001")) == 0, str(rep)
+
+
+def test_c001_correlated_multi_input_lengths_still_fire():
+    """Several same-length inputs growing together are ONE effective
+    axis — the per-length defect is still caught."""
+    led = CompileLedger(enabled=True)
+    for t in (5, 6, 7, 9):
+        led.record("seg", _sig([(t,), (t,)]), hit=False)
+    rep = check_compiles(led)
+    assert [d.code for d in rep] == ["C001"]
+
+
+def test_c004_bucketed_family_is_info_not_error():
+    """Power-of-two length families are the O(log T) growth the
+    discipline allows: INFO, never ERROR."""
+    led = CompileLedger(enabled=True)
+    for t in (8, 16, 32, 64, 128):
+        led.record("decode.prefill", _sig([(1, t)]), hit=False)
+    rep = check_compiles(led)
+    assert rep.ok
+    assert [d.code for d in rep] == ["C004"]
+
+
+def test_c002_dtype_and_weak_type_drift():
+    led = CompileLedger(enabled=True)
+    led.record("step", _sig([(4, 4)], dtypes=("float32",)), hit=False)
+    led.record("step", _sig([(4, 4)], dtypes=("float64",)), hit=False)
+    led.record("wk", _sig([(2,)], weak=(False,)), hit=False)
+    led.record("wk", _sig([(2,)], weak=(True,)), hit=False)
+    rep = check_compiles(led)
+    c2 = rep.filter(code="C002")
+    assert sorted(d.subject for d in c2) == ["step", "wk"]
+    assert "dtype" in c2.filter(subject="step").diagnostics[0].message
+    assert "weak_type" in c2.filter(subject="wk").diagnostics[0].message
+    assert all(d.severity == Severity.WARNING for d in c2)
+
+
+def test_c003_static_kwarg_churn():
+    led = CompileLedger(enabled=True)
+    for flag in ("a", "b", "c"):
+        led.record("op", _sig([(4, 4)], static=(flag,)), hit=False)
+    rep = check_compiles(led)
+    assert [d.code for d in rep] == ["C003"]
+    assert rep.diagnostics[0].details["static_variants"] == 3
+    # two variants (e.g. train/eval) are normal, not churn
+    led2 = CompileLedger(enabled=True)
+    led2.record("op", _sig([(4, 4)], static=(True,)), hit=False)
+    led2.record("op", _sig([(4, 4)], static=(False,)), hit=False)
+    assert len(check_compiles(led2)) == 0
+
+
+def test_summary_c005_opt_in():
+    led = CompileLedger(enabled=True)
+    led.record("s", _sig([(2,)]), hit=False)
+    led.record("s", _sig([(2,)]), hit=True)
+    assert len(check_compiles(led)) == 0
+    rep = check_compiles(led, include_summary=True)
+    assert [d.code for d in rep] == ["C005"]
+
+
+# -- real jit sites report into the process ledger ---------------------
+
+def test_engine_bulk_reports_and_budget_enforces():
+    led = get_ledger()
+    x = mx.nd.array(np.arange(6.0, dtype=np.float32))
+    with compile_budget(1, sites=("engine.bulk",)):
+        for _ in range(3):
+            with engine.bulk(8):
+                ((x * 1.5) + 0.5).asnumpy()  # trace-ok: same segment, 1 compile
+    before = led.miss_counts(("engine.bulk",))
+    with pytest.raises(CompileBudgetExceeded) as ei:
+        with compile_budget(0, sites=("engine.bulk",)):
+            with engine.bulk(8):
+                ((x / 3.0) - 2.0).asnumpy()  # trace-ok: new segment
+    # the error lists the offending compile's signature
+    assert "1 new program(s) compiled" in str(ei.value)
+    assert "shapes=" in str(ei.value)
+    assert sum(led.miss_counts(("engine.bulk",)).values()) == \
+        sum(before.values()) + 1
+
+
+def test_cached_op_per_length_loop_is_flagged():
+    """The real-path seeded defect: a CachedOp driven with per-length
+    inputs compiles one program per length; the ledger + checker name
+    the block's site."""
+    from mxtpu.cached_op import CachedOp
+    from mxtpu.gluon import nn
+
+    led = get_ledger()
+    net = nn.Activation("relu")
+    net.initialize()
+    op = CachedOp(net)
+    op(mx.nd.array(np.ones((1, 5), np.float32)))  # warm call: imperative
+    before = led.miss_counts(("cached_op.*",))
+    for t in (5, 6, 7, 9, 11):
+        op(mx.nd.array(np.ones((1, t), np.float32)))
+    site = "cached_op.%s" % net.name
+    assert led.miss_counts((site,))[site] - before.get(site, 0) == 5
+    rep = check_compiles()
+    assert site in [d.subject for d in rep.filter(code="C001")]
+
+
+def test_optimizer_updates_report_via_observe():
+    led = get_ledger()
+    before = led.miss_counts(("optimizer.sgd",))
+    opt = mx.optimizer.SGD(learning_rate=0.1)
+    w = nd.array(np.ones((8,), np.float32))
+    g = nd.array(np.ones((8,), np.float32))
+    state = opt.create_state(0, w)
+    for _ in range(3):
+        state = opt.update(0, w, g, state)
+    delta_miss = sum(led.miss_counts(("optimizer.sgd",)).values()) - \
+        sum(before.values())
+    assert delta_miss <= 1  # one shape = at most one compile recorded
+
+
+# -- tier-1 acceptance: the serving engine's compile budget ------------
+# The CLEAN half — a fresh mixed-length engine run stays within
+# compile_budget(buckets + 1) — lives on the existing fresh-engine test
+# in tests/test_serving.py (test_compile_count_bounded_by_buckets),
+# which wraps its run in the budget at zero extra compile cost.  Here:
+# the seeded REGRESSION, which needs its own (unbucketed) engine.
+
+def test_seeded_bucketing_regression_fails_budget():
+    """Turn bucketing OFF (the seeded regression): one prefill program
+    per distinct prompt length — the (buckets + 1) budget that holds in
+    tests/test_serving.py MUST fail here, and the checker must name the
+    site as unbucketed shape churn.  Smallest possible engine (1-layer
+    LM, single-device mesh): the defect is in the PROGRAM COUNT, which
+    is architecture-independent."""
+    from mxtpu.models.transformer import TransformerLM
+    from mxtpu.parallel.mesh import DeviceMesh
+
+    mx.random.seed(77)
+    tiny = TransformerLM(50, units=32, hidden_size=64, num_layers=1,
+                         num_heads=2, num_kv_heads=2)
+    tiny.initialize()
+    mesh = DeviceMesh(dp=1)
+    led = get_ledger()
+    led.reset()  # isolate: earlier tests left other signatures
+    eng = ContinuousBatchingEngine(tiny, mesh,
+                                   transformer_lm_sharding_rules(),
+                                   num_slots=2, max_length=32,
+                                   bucket_prefill=False)
+    rng = np.random.RandomState(31)
+    # lengths 3,5,12 would be TWO buckets (8, 16) = 3 programs under
+    # bucketing; unbucketed they are 3 prefills + 1 step = 4 > 3
+    with pytest.raises(CompileBudgetExceeded) as ei:
+        with compile_budget(3, sites=("serving.slot_prefill",
+                                      "serving.step_slots")):
+            for t in (3, 5, 12):
+                eng.submit(nd.array(rng.randint(0, 50, (1, t)),
+                                    dtype="int32"), 3)
+            eng.run()
+    assert "budget 3" in str(ei.value)
+    rep = check_compiles(shape_churn_threshold=3)
+    assert "serving.slot_prefill" in [
+        d.subject for d in rep.filter(code="C001")]
+    # scrub the seeded defect from the process-wide ledger so later
+    # self-applications (CLI `all`, diagnose) see a clean record
+    led.reset()

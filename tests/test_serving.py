@@ -159,18 +159,28 @@ def test_compile_count_bounded_by_buckets(tiny, mesh):
     """A full mixed-arrival run compiles at most (#prefill buckets + 1)
     programs: admission/eviction is host bookkeeping, the device only
     ever sees one slot-prefill per bucket and ONE pooled step,
-    regardless of traffic.  Verified against the engine's program table
-    AND each jax.jit's own executable cache.  Needs a FRESH engine so
-    the program table starts empty."""
+    regardless of traffic.  Verified against the engine's program table,
+    each jax.jit's own executable cache, AND the compile ledger via
+    ``compile_budget`` (ISSUE 6 acceptance: the O(log T) invariant as an
+    executable assertion — tests/test_compile_discipline.py asserts the
+    seeded bucketing regression fails this same budget).  Needs a FRESH
+    engine so the program table starts empty."""
+    from mxtpu.analysis import check_compiles, compile_budget
+
     rng = np.random.RandomState(31)
     # lengths 3,5,7 -> bucket 8; 12 -> bucket 16: exactly 2 buckets
     prompts = _prompts(rng, (3, 5, 7, 12))
     fresh = ContinuousBatchingEngine(tiny, mesh,
                                      transformer_lm_sharding_rules(),
                                      num_slots=2, max_length=MAXLEN)
-    for p in prompts:
-        fresh.submit(p, 3)
-    fresh.run()
+    with compile_budget(3, sites=("serving.slot_prefill",
+                                  "serving.step_slots")):
+        for p in prompts:
+            fresh.submit(p, 3)
+        fresh.run()
+    # the discipline checker sees only bounded bucketed growth here
+    assert "serving.slot_prefill" not in [
+        d.subject for d in check_compiles().filter(code="C001")]
     cache = fresh._dec._jit_cache
     prefills = [k for k in cache if k[0] == "slot_prefill"]
     steps = [k for k in cache if k[0] == "step_slots"]

@@ -106,6 +106,10 @@ def test_diagnose_runs():
         text=True)
     assert out.returncode == 0, out.stderr[-1500:]
     for section in ("Python Info", "Library Info", "MXTPU Info",
-                    "Device Info"):
+                    "Compile Ledger", "Device Info"):
         assert section in out.stdout
     assert "jax" in out.stdout
+    # the engine-bulk probe reported into the ledger: the section shows
+    # the site and a clean discipline verdict
+    assert "engine.bulk" in out.stdout
+    assert "discipline   : 0 error(s)" in out.stdout

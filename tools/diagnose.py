@@ -60,6 +60,7 @@ def check_mxtpu():
         enabled = [f for f in feats.keys() if feats.is_enabled(f)]
         print("features     :", ", ".join(sorted(enabled)) or "none")
         check_engine_bulk()
+        check_compile_ledger()
     except Exception as e:
         print("mxtpu        : IMPORT FAILED (%s: %s)"
               % (type(e).__name__, e))
@@ -88,6 +89,35 @@ def check_engine_bulk():
                  st["bulked_ops"], st["eager_replays"], st["cache_size"]))
     except Exception as e:
         print("bulking      : FAILED (%s: %s)" % (type(e).__name__, e))
+
+
+def check_compile_ledger():
+    """Print the process compile ledger (docs/analysis.md): programs
+    compiled, hit/miss per jit site, top-cardinality signatures, and the
+    discipline checker's verdict.  The engine-bulk probe above already
+    populated the ledger, so a healthy install shows the engine.bulk
+    site with one miss and one hit."""
+    print("----------Compile Ledger----------")
+    try:
+        from mxtpu.analysis import check_compiles, get_ledger
+        led = get_ledger()
+        print("enabled      :", led.enabled, "(MXTPU_COMPILE_LEDGER)")
+        print("dump path    :",
+              os.environ.get("MXTPU_COMPILE_LEDGER_DUMP") or "none")
+        stats = led.stats()
+        if not stats:
+            print("sites        : none recorded")
+        for site, s in stats.items():
+            print("%-13s: %d program(s), %d hit / %d miss, "
+                  "top shape cardinality %d"
+                  % (site[:13], s["misses"], s["hits"], s["misses"],
+                     s["shape_cardinality"]))
+        rep = check_compiles()
+        print("discipline   :", rep.summary())
+        for d in rep.errors:
+            print("  ", d)
+    except Exception as e:
+        print("ledger       : FAILED (%s: %s)" % (type(e).__name__, e))
 
 
 def check_resilience():
