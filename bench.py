@@ -471,6 +471,157 @@ def _bench_continuous_decode():
     print(json.dumps(rec), flush=True)
 
 
+def _bench_paged_decode():
+    """Paged-KV-cache serving (round-12 tentpole): the block-paged
+    engine with cross-request prefix sharing + chunked prefill vs the
+    slot engine AT THE SAME CACHE HBM, under Poisson mixed-length
+    arrivals where every prompt opens with one shared system prompt.
+    Two metrics:
+
+    - ``slots_resident_at_fixed_hbm``: peak concurrently-resident
+      requests.  The slot engine's ceiling is its slot count (each slot
+      reserves max_length positions); the paged engine spends the same
+      pool bytes page-by-page — right-sized allocation + refcounted
+      shared prefix pages — so more requests fit.
+    - ``decode_tokens_per_sec_paged``: useful tokens/sec on the same
+      workload, slot-engine column alongside.
+
+    CPU fallback runs a LABELED tiny config (plumbing evidence only)."""
+    import numpy as np
+    import jax
+    import mxtpu as mx
+    from mxtpu import nd
+    from mxtpu.models import transformer
+    from mxtpu.parallel import (ContinuousBatchingEngine,
+                                PagedContinuousBatchingEngine, make_mesh)
+
+    platform = jax.devices()[0].platform
+    cpu = platform == "cpu"
+    mx.random.seed(7)
+    if cpu:
+        lm = transformer.llama_tiny(vocab_size=256)
+        slots, n_req, max_len = 4, 12, 64
+        sys_len, plo, phi, glo, ghi, vocab = 12, 4, 12, 8, 16, 256
+        block_size, chunk, lane_mult = 8, 16, 3
+    else:
+        lm = transformer.llama_3_8b(vocab_size=32000, width_factor=0.25,
+                                    depth_factor=0.25)
+        slots, n_req, max_len = 8, 24, 256
+        sys_len, plo, phi, glo, ghi, vocab = 48, 16, 48, 24, 64, 32000
+        block_size, chunk, lane_mult = 16, 64, 3
+    lm.initialize()
+    mesh = make_mesh(dp=1)
+    rules = transformer.transformer_lm_sharding_rules()
+
+    R = np.random.RandomState(0)
+    system = R.randint(0, vocab, (1, sys_len))
+    plens = R.randint(plo, phi + 1, n_req)
+    news = R.randint(glo, ghi + 1, n_req).tolist()
+    prompts = [nd.array(np.concatenate(
+        [system, R.randint(0, vocab, (1, int(t)))], axis=1),
+        dtype="int32") for t in plens]
+    # dense Poisson arrivals: demand outpaces completions, so peak
+    # residency measures the ENGINE's ceiling, not the workload's
+    arrivals = np.cumsum(R.poisson(1, size=n_req))
+    useful = float(sum(news))
+
+    # EQUAL cache HBM: the paged pool holds exactly the bytes the slot
+    # engine's (slots x max_len) rows hold; only the paged engine gets
+    # extra scheduler LANES (host bookkeeping, not cache bytes) so the
+    # freed bytes can actually become concurrency
+    paged = PagedContinuousBatchingEngine(
+        lm, mesh, rules, num_slots=slots * lane_mult,
+        max_length=max_len, block_size=block_size,
+        num_blocks=slots * max_len // block_size, prefill_chunk=chunk)
+    slot_eng = ContinuousBatchingEngine(lm, mesh, rules,
+                                        num_slots=slots,
+                                        max_length=max_len)
+    from mxtpu.analysis import get_ledger
+    _led = get_ledger()
+    _paged_before = sum(_led.miss_counts(
+        ("serving.page_prefill", "serving.step_pages")).values())
+
+    def drive(eng):
+        it, nxt, peak = 0, 0, 0
+        t0 = time.perf_counter()
+        while nxt < n_req or eng.pending or eng.active:
+            while nxt < n_req and arrivals[nxt] <= it:
+                eng.submit(prompts[nxt], news[nxt])
+                nxt += 1
+            if eng.pending or eng.active:
+                eng.step()
+            peak = max(peak, eng.active)
+            it += 1
+        eng.run()  # collect/clear results
+        return time.perf_counter() - t0, peak
+
+    drive(paged)                   # compile warmup
+    s0 = paged.stats               # counters below are timed-drive deltas
+    paged_dt, paged_peak = drive(paged)
+    drive(slot_eng)                # compile warmup
+    slot_dt, slot_peak = drive(slot_eng)
+    st = paged.stats
+    cfg = {"slot_engine_slots": slots, "paged_lanes": slots * lane_mult,
+           "requests": n_req, "system_prompt_len": sys_len,
+           "prompt_len": [sys_len + plo, sys_len + phi],
+           "new_tokens": [glo, ghi], "max_length": max_len,
+           "block_size": block_size, "prefill_chunk": chunk,
+           "num_blocks": slots * max_len // block_size,
+           "arrivals": "poisson(1)/iteration"}
+    rec = {
+        "metric": "slots_resident_at_fixed_hbm",
+        "value": paged_peak,
+        "unit": "concurrent requests",
+        "vs_baseline": None,
+        "platform": platform,
+        "slot_engine_peak": slot_peak,
+        "residency_gain_vs_slot_engine": round(
+            paged_peak / max(slot_peak, 1), 3),
+        "prefix_hits": st["prefix_hits"] - s0["prefix_hits"],
+        "cow_copies": st["cow_copies"] - s0["cow_copies"],
+        "config": cfg,
+        "baseline_note": "both engines hold IDENTICAL cache bytes "
+                         "(paged pool == slot rows); the slot column is "
+                         "hard-capped at its slot count by construction "
+                         "— the gain is right-sized page allocation + "
+                         "refcounted shared system-prompt pages",
+    }
+    if cpu:
+        rec["config_note"] = ("CPU fallback runs a LABELED llama_tiny "
+                              "config — plumbing evidence only, NOT a "
+                              "TPU serving number")
+    print(json.dumps(rec), flush=True)
+
+    rec = {
+        "metric": "decode_tokens_per_sec_paged",
+        "value": round(useful / paged_dt, 2),
+        "unit": "tokens/sec",
+        "vs_baseline": None,
+        "platform": platform,
+        "slot_engine_tokens_per_sec": round(useful / slot_dt, 2),
+        "speedup_vs_slot_engine": round(slot_dt / paged_dt, 3),
+        "compiled_program_count": sum(_led.miss_counts(
+            ("serving.page_prefill", "serving.step_pages")).values())
+        - _paged_before,
+        "config": cfg,
+        "baseline_note": "no upstream analogue; comparison column is "
+                         "this repo's own slot engine on the identical "
+                         "shared-system-prompt workload at identical "
+                         "cache HBM",
+    }
+    if cpu:
+        rec["config_note"] = ("CPU fallback runs a LABELED llama_tiny "
+                              "config — plumbing evidence only, NOT a "
+                              "TPU serving number; on the oversubscribed "
+                              "CPU host this wall-clock comparison is "
+                              "NOISE-DOMINATED (0.6x-1.9x observed across "
+                              "identical runs) — the deterministic "
+                              "slots_resident_at_fixed_hbm record above "
+                              "is the HBM-side evidence; TPU tokens/s "
+                              "when the tunnel heals")
+    print(json.dumps(rec), flush=True)
+
+
 def _bench_analysis():
     """Static-analysis wall time (round-11 tentpole: compile-discipline
     and device-memory static analysis).  Times every pass the repo
@@ -767,6 +918,7 @@ def _child_main():
     _bench_bert()
     _bench_attention()
     _bench_continuous_decode()
+    _bench_paged_decode()
 
 
 def _probe_main():

@@ -41,7 +41,8 @@ from .donation_check import check_donation, check_trainer_donation
 from .graph_verify import verify_graph
 from .memory_estimate import (MemoryEstimate, check_memory,
                               estimate_graph_memory, estimate_jit_memory,
-                              kv_cache_residency, xla_memory_stats)
+                              kv_cache_residency,
+                              paged_kv_cache_residency, xla_memory_stats)
 from .registry_audit import audit_registry
 from .sharding_check import check_sharding
 from .trace_lint import lint_source, trace_lint
@@ -54,6 +55,7 @@ __all__ = [
     "CompileLedger", "Signature", "get_ledger", "check_compiles",
     "compile_budget", "CompileBudgetExceeded",
     "MemoryEstimate", "check_memory", "estimate_graph_memory",
-    "estimate_jit_memory", "kv_cache_residency", "xla_memory_stats",
+    "estimate_jit_memory", "kv_cache_residency",
+    "paged_kv_cache_residency", "xla_memory_stats",
     "check_donation", "check_trainer_donation",
 ]

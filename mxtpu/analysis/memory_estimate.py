@@ -18,7 +18,13 @@ first compile.  This pass answers it statically:
   decode steps with KV caches, and trainer steps.
 - **KV caches** (:func:`kv_cache_residency`): persistent cache bytes for
   a block's ``init_cache`` under a cache PartitionSpec, abstractly
-  evaluated (no allocation).
+  evaluated (no allocation).  :func:`paged_kv_cache_residency` prices
+  the BLOCK-PAGED layout (PagedContinuousBatchingEngine): bytes per
+  page, pages resident vs free, and the bytes cross-request prefix
+  sharing is saving — refcounted pages are priced ONCE, not
+  per-request, which is what a ``check_memory`` budget over the paged
+  pool inherits for free (the pool is one allocation whatever the
+  sharing degree).
 
 Per-device accounting: a tensor matched to a PartitionSpec divides by
 the product of the mesh-axis sizes it is sharded over (ceil per dim —
@@ -52,8 +58,8 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 from .diagnostics import Diagnostic, Report, Severity, register_pass
 
 __all__ = ["MemoryEstimate", "estimate_graph_memory", "estimate_jit_memory",
-           "kv_cache_residency", "check_memory", "xla_memory_stats",
-           "parse_bytes", "format_bytes"]
+           "kv_cache_residency", "paged_kv_cache_residency", "check_memory",
+           "xla_memory_stats", "parse_bytes", "format_bytes"]
 
 _PASS = "memory_estimate"
 
@@ -481,6 +487,80 @@ def kv_cache_residency(block, batch: int, max_length: int,
             total += _sharded_nbytes(tuple(leaf.shape), leaf.dtype,
                                      cache_spec, axis_sizes)
     return total, shapes
+
+
+def paged_kv_cache_residency(block, num_blocks: int, block_size: int,
+                             dtype: str = "float32", cache_spec=None,
+                             mesh=None, blocks_in_use: Optional[int] = None,
+                             shared_extra_refs: int = 0,
+                             engine=None) -> Dict[str, Any]:
+    """Per-device byte accounting of a BLOCK-PAGED KV cache
+    (:class:`~mxtpu.parallel.PagedContinuousBatchingEngine`):
+    abstractly evaluated like :func:`kv_cache_residency`, plus the
+    paged split the slot layout cannot express —
+
+    - ``bytes_per_block``: per-device bytes one page costs across every
+      layer's (k, v) pools (the granularity admission allocates at);
+    - ``resident_bytes`` / ``free_bytes``: the pool split at
+      ``blocks_in_use`` allocated pages (the +1 null page is counted in
+      ``total_bytes`` — it is real HBM — but never in the free pool);
+    - ``shared_savings_bytes``: ``shared_extra_refs`` — the sum of
+      (refcount − 1) over shared pages — times ``bytes_per_block``:
+      what an unshared layout would ADDITIONALLY hold resident right
+      now.  Refcounted pages are deliberately priced ONCE in
+      ``resident_bytes`` — a page shared by N requests is one page.
+
+    Pass a live engine (``engine=``) to read ``num_blocks`` /
+    ``block_size`` / occupancy / sharing — and the pool's actual
+    cache dtype, sharding spec and mesh — from it instead of spelling
+    them out."""
+    import jax
+
+    if engine is not None:
+        st = engine.stats
+        num_blocks = st["num_blocks"]
+        block_size = st["block_size"]
+        blocks_in_use = st["blocks_in_use"]
+        shared_extra_refs = st["shared_extra_refs"]
+        dtype = engine._cache_dtype
+        cache_spec = engine._dec._cache_spec
+        mesh = engine._mesh
+
+    def _mk():
+        return tuple((pk._data, pv._data)
+                     for pk, pv in block.init_block_pool(
+                         num_blocks + 1, block_size, dtype))
+
+    try:
+        leaves = jax.eval_shape(_mk)
+    except Exception:
+        leaves = _mk()  # tiny blocks: concrete fallback
+    axis_sizes = _axis_sizes(mesh)
+    shapes: List[Tuple[tuple, str]] = []
+    total = 0
+    per_block = 0
+    for pk, pv in leaves:
+        for leaf in (pk, pv):
+            shapes.append((tuple(leaf.shape), str(leaf.dtype)))
+            nbytes = _sharded_nbytes(tuple(leaf.shape), leaf.dtype,
+                                     cache_spec, axis_sizes)
+            total += nbytes
+            per_block += nbytes // leaf.shape[0]
+    out = {
+        "total_bytes": total,
+        "bytes_per_block": per_block,
+        "num_blocks": int(num_blocks),
+        "block_size": int(block_size),
+        "shapes": shapes,
+    }
+    if blocks_in_use is not None:
+        out["blocks_in_use"] = int(blocks_in_use)
+        out["resident_bytes"] = int(blocks_in_use) * per_block
+        out["free_bytes"] = (int(num_blocks)
+                             - int(blocks_in_use)) * per_block
+    out["shared_extra_refs"] = int(shared_extra_refs)
+    out["shared_savings_bytes"] = int(shared_extra_refs) * per_block
+    return out
 
 
 # -- the XLA cross-check --------------------------------------------------

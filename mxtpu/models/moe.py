@@ -201,6 +201,34 @@ class MoEDecoderLayer(HybridBlock):
                                             total_len=total), \
             cache_k, cache_v
 
+    def step_pages(self, x, pool_k, pool_v, tables, pos):
+        """Block-paged per-slot decode step (see step_slots: the routed
+        FFN runs capacity-unbounded so dead pool lanes cannot evict a
+        live slot's token from an expert)."""
+        h, pool_k, pool_v = self.attn.step_pages(self.attn_norm(x),
+                                                 pool_k, pool_v,
+                                                 tables, pos)
+        x = x + h
+        return x + self.moe.decode_forward(self.ffn_norm(x)), \
+            pool_k, pool_v
+
+    def prefill_pages(self, x, pool_k, pool_v, table, start_pos=0,
+                      total_len=None):
+        """Block-paged prompt-chunk ingestion with the TRAINING
+        capacity budgeted from the FULL prompt length — the same
+        ``total_len`` contract (and multi-chunk routing caveat,
+        docs/inference.md) as prefill().  ``total_len`` must be a
+        static int here: expert capacity is a SHAPE."""
+        h, pool_k, pool_v = self.attn.prefill_pages(self.attn_norm(x),
+                                                    pool_k, pool_v,
+                                                    table, start_pos)
+        x = x + h
+        total = total_len if total_len is not None \
+            else x.shape[1]  # start_pos may be traced; single-chunk only
+        return x + self.moe.prefill_forward(self.ffn_norm(x),
+                                            total_len=total), \
+            pool_k, pool_v
+
 
 def moe_sharding_rules(base=None):
     """Expert weights over "ep"; router replicated.  Compose with the

@@ -205,6 +205,99 @@ class MultiHeadAttention(HybridBlock):
         out = out.reshape(B, 1, H * D)
         return self.out_proj(out), cache_k, cache_v
 
+    def init_block_pool(self, num_blocks, block_size, dtype="float32"):
+        """Block-paged KV cache: (num_blocks, KV_heads, block_size, D)
+        per tensor — the pool the continuous-batching engine's block
+        tables index into.  Like init_cache, the fixed shape is the
+        point: one compiled program serves every table content."""
+        KV, D = self._kv_heads, self._head_dim
+        shape = (num_blocks, KV, block_size, D)
+        return (nd.zeros(shape, dtype=dtype), nd.zeros(shape, dtype=dtype))
+
+    def step_pages(self, x, pool_k, pool_v, tables, pos):
+        """One-token decode over the BLOCK-PAGED pool: x (B, 1, C),
+        ``tables`` (B, M) int32 block tables, ``pos`` (B,) per-row
+        positions.  Row b writes its K/V at logical position pos[b]
+        through its table and attends its own gathered [0, pos[b]]
+        prefix — the paged form of step_slots(): the gather reproduces
+        the contiguous cache bit-for-bit, so everything downstream is
+        the same math on the same shapes."""
+        B = x.shape[0]
+        H, KV, D = self._heads, self._kv_heads, self._head_dim
+        Tmax = tables.shape[1] * pool_k.shape[2]
+        qkv = self.qkv(x)  # (B, 1, (H+2KV)*D)
+        q = qkv[:, :, :H * D].reshape(B, 1, H, D).transpose((0, 2, 1, 3))
+        k = qkv[:, :, H * D:(H + KV) * D].reshape(
+            B, 1, KV, D).transpose((0, 2, 1, 3))
+        v = qkv[:, :, (H + KV) * D:].reshape(
+            B, 1, KV, D).transpose((0, 2, 1, 3))
+        if self._rotary:
+            q = nd.rope(q, offset=pos)  # (B,) offset: per-row rotation
+            k = nd.rope(k, offset=pos)
+        pool_k = nd._paged_cache_write_rows(pool_k, k, tables, pos=pos)
+        pool_v = nd._paged_cache_write_rows(pool_v, v, tables, pos=pos)
+        # gather the pages into sequence order, then the step_slots math
+        keys = nd._paged_cache_gather(pool_k, tables).reshape(
+            B * KV, Tmax, D)
+        values = nd._paged_cache_gather(pool_v, tables).reshape(
+            B * KV, Tmax, D)
+        rep = H // KV
+        q_r = q.reshape(B * KV, rep, D)            # (B*KV, rep, D)
+        scores = nd.batch_dot(q_r, keys,
+                              transpose_b=True) / math.sqrt(D)
+        valid = (nd.arange(0, Tmax).reshape((1, Tmax))
+                 <= pos.reshape((B, 1)))           # (B, Tmax)
+        attn = nd.masked_softmax(
+            scores.reshape(B, KV, rep, Tmax),
+            mask=valid.reshape((B, 1, 1, Tmax)).astype("bool"))
+        out = nd.batch_dot(attn.reshape(B * KV, rep, Tmax), values)
+        out = out.reshape(B, 1, H * D)
+        return self.out_proj(out), pool_k, pool_v
+
+    def prefill_pages(self, x, pool_k, pool_v, table, start_pos=0):
+        """Chunked prompt ingestion through the paged pool: x (1, T, C)
+        is ONE chunk at logical positions [start_pos, start_pos+T); its
+        K/V scatter through ``table`` (M,) and the chunk's queries
+        attend the gathered table extent (shared prefix pages, earlier
+        chunks, and the chunk itself) under the same causal mask as
+        prefill() — bit-identical to a contiguous single-pass prefill,
+        which is what lets prefix sharing SKIP the shared tokens
+        entirely."""
+        B, T, _ = x.shape
+        H, KV, D = self._heads, self._kv_heads, self._head_dim
+        Tmax = table.shape[-1] * pool_k.shape[2]
+        qkv = self.qkv(x)
+        q = qkv[:, :, :H * D].reshape(B, T, H, D).transpose((0, 2, 1, 3))
+        k = qkv[:, :, H * D:(H + KV) * D].reshape(
+            B, T, KV, D).transpose((0, 2, 1, 3))
+        v = qkv[:, :, (H + KV) * D:].reshape(
+            B, T, KV, D).transpose((0, 2, 1, 3))
+        if self._rotary:
+            q = nd.rope(q, offset=start_pos)
+            k = nd.rope(k, offset=start_pos)
+        pool_k = nd._paged_cache_write(pool_k, k, table,
+                                       start_pos=start_pos)
+        pool_v = nd._paged_cache_write(pool_v, v, table,
+                                       start_pos=start_pos)
+        keys = nd._paged_cache_gather(pool_k, table).reshape(
+            B * KV, Tmax, D)
+        values = nd._paged_cache_gather(pool_v, table).reshape(
+            B * KV, Tmax, D)
+        rep = H // KV
+        q_r = q.reshape(B * KV, rep * T, D)
+        scores = nd.batch_dot(q_r, keys,
+                              transpose_b=True) / math.sqrt(D)
+        # query at sequence position start_pos+t sees keys <= its own
+        valid = (nd.arange(0, Tmax).reshape((1, Tmax))
+                 <= (nd.arange(0, T) + start_pos).reshape((T, 1)))
+        mask = valid.reshape((1, 1, T, Tmax)).astype("bool")
+        attn = nd.masked_softmax(
+            scores.reshape(B * KV, rep, T, Tmax), mask=mask)
+        out = nd.batch_dot(attn.reshape(B * KV, rep * T, Tmax), values)
+        out = out.reshape(B, KV, rep, T, D).transpose(
+            (0, 3, 1, 2, 4)).reshape(B, T, H * D)
+        return self.out_proj(out), pool_k, pool_v
+
     def prefill(self, x, cache_k, cache_v, start_pos=0):
         """Process T tokens in ONE batched pass (vs T serial step()
         calls): computes their K/V, writes the cache block at
@@ -416,6 +509,30 @@ class LlamaDecoderLayer(HybridBlock):
         h = self.down_proj(nd.swish(self.gate_proj(h)) * self.up_proj(h))
         return x + h, cache_k, cache_v
 
+    def step_pages(self, x, pool_k, pool_v, tables, pos):
+        """One-token decode through the block-paged pool (continuous
+        batching); see Attention.step_pages."""
+        h, pool_k, pool_v = self.attn.step_pages(self.attn_norm(x),
+                                                 pool_k, pool_v,
+                                                 tables, pos)
+        x = x + h
+        h = self.ffn_norm(x)
+        h = self.down_proj(nd.swish(self.gate_proj(h)) * self.up_proj(h))
+        return x + h, pool_k, pool_v
+
+    def prefill_pages(self, x, pool_k, pool_v, table, start_pos=0,
+                      total_len=None):
+        """One prompt chunk through the block-paged pool; ``total_len``
+        accepted and ignored by dense layers (routed-FFN capacity only)
+        so TransformerLM.prefill_pages can thread it uniformly."""
+        h, pool_k, pool_v = self.attn.prefill_pages(self.attn_norm(x),
+                                                    pool_k, pool_v,
+                                                    table, start_pos)
+        x = x + h
+        h = self.ffn_norm(x)
+        h = self.down_proj(nd.swish(self.gate_proj(h)) * self.up_proj(h))
+        return x + h, pool_k, pool_v
+
 
 class TransformerLM(HybridBlock):
     """Causal decoder LM (Llama architecture; stretch config 5).
@@ -540,6 +657,52 @@ class TransformerLM(HybridBlock):
             (nd._internal_cache_write_slot(ck, sk, slot=slot, pos=pos),
              nd._internal_cache_write_slot(cv, sv, slot=slot, pos=pos))
             for (ck, cv), (sk, sv) in zip(caches, slot_caches)]
+
+    # -- block-paged decode (PagedContinuousBatchingEngine) ------------
+    def init_block_pool(self, num_blocks, block_size, dtype="float32"):
+        """Per-layer (k, v) page pools — see Attention.init_block_pool."""
+        return [layer.attn.init_block_pool(num_blocks, block_size, dtype)
+                for layer in self.layers]
+
+    def step_pages(self, token_ids, pools, tables, pos):
+        """Decode ONE token per slot through the block-paged pool:
+        token_ids (B, 1), ``tables`` (B, M) int32 block tables, ``pos``
+        (B,) → (logits (B, 1, V), new_pools).  Row b writes at logical
+        position pos[b] through its table and attends only its own
+        gathered [0, pos[b]] prefix.  Same functional-cache contract as
+        step_slots()."""
+        x = self.embed(token_ids)
+        new_pools = []
+        for layer, (pk, pv) in zip(self.layers, pools):
+            x, pk, pv = layer.step_pages(x, pk, pv, tables, pos)
+            new_pools.append((pk, pv))
+        return self._logits(x), new_pools
+
+    def prefill_pages(self, token_ids, pools, table, start_pos=0,
+                      total_len=None):
+        """Ingest ONE prompt chunk (1, T) at logical positions
+        [start_pos, start_pos+T) through the block-paged pool: the
+        chunk's K/V scatter through ``table`` (M,) and its queries
+        attend the gathered extent — shared prefix pages, earlier
+        chunks, itself.  ``total_len`` declares the FULL prompt length
+        for routed (MoE) expert-capacity budgeting, exactly as
+        prefill() does."""
+        x = self.embed(token_ids)
+        new_pools = []
+        for layer, (pk, pv) in zip(self.layers, pools):
+            x, pk, pv = layer.prefill_pages(x, pk, pv, table, start_pos,
+                                            total_len=total_len)
+            new_pools.append((pk, pv))
+        return self._logits(x), new_pools
+
+    def copy_block(self, pools, src, dst):
+        """Copy page ``src`` onto page ``dst`` in every layer's pool —
+        the admission-time copy-on-write of prefix sharing.  ``src`` /
+        ``dst`` may be traced scalars; ``src == dst`` is a bit-exact
+        no-op (how the fused prefill program skips COW)."""
+        return [(nd._paged_block_copy(pk, src=src, dst=dst),
+                 nd._paged_block_copy(pv, src=src, dst=dst))
+                for pk, pv in pools]
 
     def generate(self, prompt_ids, max_new_tokens, max_length=None,
                  temperature=0.0, top_k=0, top_p=0.0,

@@ -1095,6 +1095,79 @@ def _internal_cache_write_slot(cache, new, slot=0, pos=0):
 
 
 # ---------------------------------------------------------------------------
+# block-paged KV cache (PagedContinuousBatchingEngine): the persistent
+# cache is a pool of fixed-size pages (num_blocks, KV, block_size, D) —
+# the vLLM/PagedAttention layout with kv-heads kept on axis 1 so the
+# engine's cache_spec tp-sharding convention applies unchanged.  Every
+# op below keeps shapes STATIC: tables are padded int32 index arrays,
+# so one compiled program serves every block-table content.
+# ---------------------------------------------------------------------------
+
+@register_op("_paged_cache_gather", differentiable=False)
+def _paged_cache_gather(pool, table):
+    """Gather a request's pages into sequence order: pool
+    (N, KV, bs, D) indexed by ``table`` (..., M) int32 → contiguous
+    (..., KV, M*bs, D) view of the logical cache.  Table entries beyond
+    a request's allocation pad with the null block; the positions they
+    contribute sit past every validity mask, so their (finite) garbage
+    never reaches a softmax."""
+    t = table.astype(jnp.int32)
+    g = pool[t]                      # (..., M, KV, bs, D)
+    m, kv, bs, d = g.shape[-4:]
+    lead = g.shape[:-4]
+    perm = tuple(range(len(lead))) + tuple(
+        len(lead) + a for a in (1, 0, 2, 3))
+    return g.transpose(perm).reshape(lead + (kv, m * bs, d))
+
+
+@register_op("_paged_cache_write", differentiable=False)
+def _paged_cache_write(pool, new, table, start_pos=0):
+    """Scatter one sequence's prefill chunk ``new`` (1, KV, T, D) into
+    the paged pool through its block table: logical position
+    ``start_pos + t`` lands in page ``table[p // bs]`` at offset
+    ``p % bs``.  ``start_pos`` may be traced — one program per chunk
+    bucket serves every chunk of every request."""
+    t = table.astype(jnp.int32).reshape(-1)
+    bs = pool.shape[2]
+    start = start_pos.astype(jnp.int32) if hasattr(start_pos, "astype") \
+        else jnp.int32(start_pos)
+    p = start + jnp.arange(new.shape[2], dtype=jnp.int32)
+    blk, off = t[p // bs], p % bs
+    vals = new[0].astype(pool.dtype).transpose(1, 0, 2)  # (T, KV, D)
+    return pool.at[blk, :, off, :].set(vals)
+
+
+@register_op("_paged_cache_write_rows", differentiable=False)
+def _paged_cache_write_rows(pool, new, tables, pos):
+    """Per-slot paged decode write: row b of ``new`` (B, KV, 1, D)
+    lands at logical position ``pos[b]`` of the sequence described by
+    ``tables[b]`` (B, M) — page ``tables[b, pos[b] // bs]``, offset
+    ``pos[b] % bs``.  Distinct live slots own disjoint pages (the
+    allocator's invariant), so the scatter is conflict-free; dead
+    lanes' tables are all-null and scribble only the null page."""
+    t = tables.astype(jnp.int32)
+    bs = pool.shape[2]
+    p = jnp.asarray(pos, jnp.int32).reshape(-1)
+    rows = jnp.arange(t.shape[0])
+    blk, off = t[rows, p // bs], p % bs
+    return pool.at[blk, :, off, :].set(new[:, :, 0, :].astype(pool.dtype))
+
+
+@register_op("_paged_block_copy", differentiable=False)
+def _paged_block_copy(pool, src=0, dst=0):
+    """Copy page ``src`` onto page ``dst`` — the copy-on-write of the
+    prefix-sharing admission path (a divergent request clones the
+    partially-shared page before writing its own tokens).  ``src`` /
+    ``dst`` may be traced scalars; ``src == dst`` is a bit-exact no-op
+    write, which is how the fused prefill program skips COW without a
+    second compiled variant."""
+    s = src.astype(jnp.int32) if hasattr(src, "astype") else jnp.int32(src)
+    d = dst.astype(jnp.int32) if hasattr(dst, "astype") else jnp.int32(dst)
+    page = jax.lax.dynamic_index_in_dim(pool, s, axis=0, keepdims=False)
+    return jax.lax.dynamic_update_index_in_dim(pool, page, d, axis=0)
+
+
+# ---------------------------------------------------------------------------
 # upstream mx.np internal op names (python/mxnet/numpy calls lower to
 # `_npi_*`-registered kernels in the reference — src/operator/numpy/**).
 # Aliased here ONLY where our canonical op already has exact numpy

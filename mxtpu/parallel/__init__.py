@@ -21,9 +21,14 @@ Components:
 - ring_attention: sequence-parallel blockwise attention via shard_map +
                ppermute (long-context path; absent in the reference,
                required for TPU scale)
-- decode/serving: ShardedDecoder (jitted KV-cache decode over the mesh)
-               and ContinuousBatchingEngine (iteration-level scheduling
+- decode/serving: ShardedDecoder (jitted KV-cache decode over the mesh),
+               ContinuousBatchingEngine (iteration-level scheduling
                over a slot pool — Orca/vLLM-style serving, static-shape)
+               and PagedContinuousBatchingEngine (block-paged KV cache
+               with cross-request prefix sharing + chunked prefill)
+- paging:      BlockPool (refcounted page allocator) and PrefixIndex
+               (radix prompt-prefix index) — the paged engine's
+               host-side bookkeeping
 """
 
 from .mesh import (DeviceMesh, make_mesh, init_process_group, rank,
@@ -32,7 +37,9 @@ from . import collectives
 from .sharding import ShardingRules, PartitionSpec
 from .trainer import SPMDTrainer
 from .decode import ShardedDecoder
-from .serving import ContinuousBatchingEngine, Request
+from .paging import BlockPool, BlockPoolExhausted, PrefixIndex
+from .serving import (ContinuousBatchingEngine,
+                      PagedContinuousBatchingEngine, Request)
 from . import ring_attention
 from . import pipeline as pipeline_mod
 from .pipeline import pipeline, stack_stage_params, stage_sharding

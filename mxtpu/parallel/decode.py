@@ -209,6 +209,30 @@ class ShardedDecoder:
         return logits, block.write_cache_slot(caches, scratch,
                                               NDArray(slot))
 
+    @staticmethod
+    def _step_pages_body(block, caches, token, tables, pos):
+        """Block-paged pool decode step: ``tables`` (B, M) block tables
+        and ``pos`` (B,) positions are both traced — ONE compiled
+        program serves every table content and position combination."""
+        return block.step_pages(NDArray(token), caches, NDArray(tables),
+                                NDArray(pos))
+
+    @staticmethod
+    def _page_prefill_body(total_len, block, caches, tokens, table,
+                           start_pos, cow_src, cow_dst):
+        """Compiled paged chunk-prefill: an optional copy-on-write of
+        one page (``cow_src`` → ``cow_dst``; equal scalars are a
+        bit-exact no-op, so the COW and no-COW admissions share ONE
+        program), then one (1, Tb) chunk scattered/attended through the
+        traced block ``table`` at traced ``start_pos``.  ``total_len``
+        is STATIC (None for dense blocks; the full prompt length for
+        MoE expert-capacity budgeting — capacity is a shape)."""
+        caches = block.copy_block(caches, NDArray(cow_src),
+                                  NDArray(cow_dst))
+        return block.prefill_pages(NDArray(tokens), caches,
+                                   NDArray(table), NDArray(start_pos),
+                                   total_len=total_len)
+
     def _ledger_report(self, kind, cache_leaves, extras, hit):
         """Report one program-cache lookup into the process compile
         ledger (docs/analysis.md): the bucketed prefill and pooled decode
@@ -274,6 +298,39 @@ class ShardedDecoder:
         param_leaves = tuple(p.data()._data for p in self._params)
         return self._jit_cache[key](param_leaves, cache_leaves, tokens,
                                     slot)
+
+    def _step_pages_jitted(self, cache_leaves, token, tables, pos):
+        key = ("step_pages", tuple(ck.shape for ck, _ in cache_leaves),
+               cache_leaves[0][0].dtype, token.shape, token.dtype,
+               tables.shape)
+        hit = key in self._jit_cache
+        self._ledger_report("step_pages", cache_leaves, (token,), hit)
+        if not hit:
+            self._jit_cache[key] = self._build_program(
+                self._step_pages_body, len(cache_leaves),
+                n_extra_inputs=3)
+        param_leaves = tuple(p.data()._data for p in self._params)
+        return self._jit_cache[key](param_leaves, cache_leaves, token,
+                                    tables, pos)
+
+    def _page_prefill_jitted(self, cache_leaves, tokens, table,
+                             start_pos, cow_src, cow_dst,
+                             total_len=None):
+        import functools
+
+        key = ("page_prefill",
+               tuple(ck.shape for ck, _ in cache_leaves),
+               cache_leaves[0][0].dtype, tokens.shape, tokens.dtype,
+               table.shape, total_len)
+        hit = key in self._jit_cache
+        self._ledger_report("page_prefill", cache_leaves, (tokens,), hit)
+        if not hit:
+            self._jit_cache[key] = self._build_program(
+                functools.partial(self._page_prefill_body, total_len),
+                len(cache_leaves), n_extra_inputs=5)
+        param_leaves = tuple(p.data()._data for p in self._params)
+        return self._jit_cache[key](param_leaves, cache_leaves, tokens,
+                                    table, start_pos, cow_src, cow_dst)
 
     def _ensure_staged(self, sample_ids):
         """Resolve deferred parameter shapes (one imperative forward if

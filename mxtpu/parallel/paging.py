@@ -1,0 +1,245 @@
+"""Host-side bookkeeping for the block-paged KV cache: a refcounted
+page allocator and a radix prefix index (vLLM PagedAttention / SGLang
+RadixAttention lineage — see PAPERS.md).
+
+Everything here is pure host state — the device only ever sees padded
+int32 block tables — and everything is DETERMINISTIC: the free list is
+ordered, allocation order is a function of the request sequence alone,
+and no clock or randomness is consulted, so fault-plan replays
+(docs/resilience.md) reproduce block assignments bit-for-bit.
+
+Page 0 is the NULL page: never allocated, it absorbs the writes of
+dead/prefilling pool lanes (which flow through the fixed-shape compiled
+step with garbage tokens) and pads every table's tail.  Null-page
+contents are garbage by design; every position that could gather them
+sits beyond some request's validity mask.
+
+The prefix index shares only IMMUTABLE pages: a page is registered once
+the prompt tokens covering it are fully written and the owning request
+has finished prefilling it (decode never writes a full prompt page —
+generated tokens land in later pages).  Refcounts count *tables*
+referencing a page; when the last table drops a page it returns to the
+free list and its index entry is evicted, so the index can never pin
+HBM beyond what live requests hold.  Sharing therefore happens between
+temporally overlapping requests (the serving steady state for shared
+system prompts); cross-burst caching is future work (ROADMAP).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from ..base import MXTPUError
+
+__all__ = ["BlockPool", "BlockPoolExhausted", "PrefixIndex"]
+
+
+class BlockPoolExhausted(MXTPUError):
+    """The page pool has fewer free pages than an allocation needs.
+    Transient exhaustion (live requests hold the pages) defers
+    admission; a request that could never fit sheds at submit() with
+    :class:`~mxtpu.resilience.LoadShedError`."""
+
+
+class BlockPool:
+    """Refcounted fixed-size page allocator over ids ``1..capacity``
+    (id 0 is the reserved null page).
+
+    ``on_free`` (optional callable) fires with the page id whenever a
+    refcount drops to zero — the prefix index hooks it to evict stale
+    entries, so a table can never reference a recycled page."""
+
+    def __init__(self, capacity: int, block_size: int, on_free=None):
+        if capacity < 1:
+            raise ValueError("BlockPool needs capacity >= 1, got %d"
+                             % capacity)
+        self.capacity = int(capacity)
+        self.block_size = int(block_size)
+        self._on_free = on_free
+        # ordered free list: alloc pops lowest ids first, frees re-sort
+        # lazily — deterministic assignment for bit-exact replays
+        self._free: List[int] = list(range(1, self.capacity + 1))
+        self._refs: Dict[int, int] = {}
+
+    @property
+    def free_count(self) -> int:
+        return len(self._free)
+
+    @property
+    def in_use(self) -> int:
+        return self.capacity - len(self._free)
+
+    @property
+    def shared_count(self) -> int:
+        """Pages referenced by more than one table right now."""
+        return sum(1 for c in self._refs.values() if c > 1)
+
+    @property
+    def shared_extra_refs(self) -> int:
+        """Sum of (refcount - 1) over shared pages — the number of page
+        copies sharing is SAVING right now (what an unshared layout
+        would additionally hold resident)."""
+        return sum(c - 1 for c in self._refs.values() if c > 1)
+
+    def alloc(self, n: int) -> List[int]:
+        """Allocate ``n`` fresh pages at refcount 1 (lowest ids first).
+        Raises :class:`BlockPoolExhausted` allocating nothing when
+        fewer than ``n`` pages are free."""
+        if n > len(self._free):
+            raise BlockPoolExhausted(
+                "page pool exhausted: need %d page(s), %d free of %d "
+                "(%d held by live requests)"
+                % (n, len(self._free), self.capacity, self.in_use))
+        got, self._free = self._free[:n], self._free[n:]
+        for bid in got:
+            self._refs[bid] = 1
+        return got
+
+    def retain(self, bid: int) -> None:
+        """Add one table reference to an allocated page (prefix hit)."""
+        if bid not in self._refs:
+            raise MXTPUError("retain() of unallocated page %d" % bid)
+        self._refs[bid] += 1
+
+    def release(self, bid: int) -> None:
+        """Drop one table reference; the last drop frees the page and
+        fires ``on_free`` so index entries cannot dangle."""
+        count = self._refs.get(bid)
+        if count is None:
+            raise MXTPUError("release() of unallocated page %d" % bid)
+        if count > 1:
+            self._refs[bid] = count - 1
+            return
+        del self._refs[bid]
+        # insertion keeps the list sorted (freed pages are reused
+        # lowest-first) at O(free) — pool sizes are O(thousands)
+        lo, hi = 0, len(self._free)
+        while lo < hi:
+            mid = (lo + hi) // 2
+            if self._free[mid] < bid:
+                lo = mid + 1
+            else:
+                hi = mid
+        self._free.insert(lo, bid)
+        if self._on_free is not None:
+            self._on_free(bid)
+
+    def refcount(self, bid: int) -> int:
+        return self._refs.get(bid, 0)
+
+
+class _RadixNode:
+    __slots__ = ("children", "bid")
+
+    def __init__(self):
+        # full block-size token tuple -> child node; fan-out is tiny in
+        # practice (divergent continuations of one shared prefix)
+        self.children: Dict[Tuple[int, ...], "_RadixNode"] = {}
+        self.bid: Optional[int] = None  # page holding this edge's K/V
+
+
+class PrefixIndex:
+    """Radix tree over prompts at page granularity.
+
+    A node at depth i+1 represents prompt tokens [0, (i+1)*bs) and
+    carries the page holding K/V for tokens [i*bs, (i+1)*bs).  Lookup
+    walks full-page matches, then scans the children of the divergence
+    node for the edge sharing the LONGEST strict token prefix — that
+    page is the copy-on-write donor: cloning it gives the new request
+    valid K/V for the shared tokens and an owned page for its own.
+    """
+
+    def __init__(self, block_size: int):
+        self._bs = int(block_size)
+        self._root = _RadixNode()
+        # page id -> node, so BlockPool.on_free evicts in O(1)
+        self._nodes: Dict[int, _RadixNode] = {}
+        self._parents: Dict[int, Tuple[_RadixNode, Tuple[int, ...]]] = {}
+
+    def __len__(self):
+        return len(self._nodes)
+
+    def lookup(self, tokens: Sequence[int], limit: int
+               ) -> Tuple[List[int], Optional[Tuple[int, int]]]:
+        """Match ``tokens[:limit]`` against the tree.
+
+        Returns ``(full_pages, partial)``: the page ids of every fully
+        matched page (in sequence order), and — when the next edge
+        matches only partially — ``(donor_page_id, matched_tokens)``
+        for the copy-on-write clone, or None.  ``limit`` caps the
+        shareable extent (the engine passes Tp-1 so the last prompt
+        token is always recomputed: its logits seed the first sample).
+        """
+        bs = self._bs
+        toks = [int(t) for t in tokens]
+        node, full = self._root, []
+        i = 0
+        while i + bs <= limit:
+            chunk = tuple(toks[i:i + bs])
+            child = node.children.get(chunk)
+            if child is None or child.bid is None:
+                break
+            full.append(child.bid)
+            node = child
+            i += bs
+        # partial match of the next edge: the COW donor
+        rest = toks[i:limit]
+        best, best_r = None, 0
+        for chunk, child in node.children.items():
+            if child.bid is None:
+                continue
+            r = 0
+            for a, b in zip(chunk, rest):
+                if a != b:
+                    break
+                r += 1
+            if r > best_r:
+                best, best_r = child.bid, r
+        partial = (best, best_r) if best is not None and best_r > 0 \
+            else None
+        return full, partial
+
+    def register(self, tokens: Sequence[int], page_ids: Sequence[int]
+                 ) -> None:
+        """Insert the full prompt pages of one finished prefill:
+        ``page_ids[i]`` holds K/V for tokens [i*bs, (i+1)*bs).  Nodes
+        that already exist keep their page (the earlier request's —
+        this one shared it at admission, or raced it into the same
+        iteration and computed its own identical copy, which simply
+        stays unshared)."""
+        bs = self._bs
+        toks = [int(t) for t in tokens]
+        node = self._root
+        for i, bid in enumerate(page_ids):
+            chunk = tuple(toks[i * bs:(i + 1) * bs])
+            if len(chunk) < bs:
+                break  # only full pages are immutable/shareable
+            child = node.children.get(chunk)
+            if child is None:
+                child = _RadixNode()
+                child.bid = int(bid)
+                node.children[chunk] = child
+                self._nodes[int(bid)] = child
+                self._parents[int(bid)] = (node, chunk)
+            node = child
+
+    def evict(self, bid: int) -> None:
+        """Drop the entry holding page ``bid`` (BlockPool.on_free hook).
+        Its subtree re-parents nowhere — descendants are unreachable
+        prefixes without it, so they are dropped too (their pages stay
+        owned by whatever tables still hold them; they simply stop
+        being discoverable)."""
+        node = self._nodes.pop(int(bid), None)
+        if node is None:
+            return
+        parent, chunk = self._parents.pop(int(bid))
+        if parent.children.get(chunk) is node:
+            del parent.children[chunk]
+        # un-index the (now unreachable) subtree
+        stack = list(node.children.values())
+        while stack:
+            sub = stack.pop()
+            if sub.bid is not None:
+                self._nodes.pop(sub.bid, None)
+                self._parents.pop(sub.bid, None)
+            stack.extend(sub.children.values())

@@ -70,9 +70,11 @@ from ..resilience.counters import bump as _bump
 from ..resilience.faults import inject as _inject
 from .decode import ShardedDecoder, _bucket
 from .mesh import DeviceMesh
+from .paging import BlockPool, PrefixIndex
 from .sharding import ShardingRules
 
-__all__ = ["ContinuousBatchingEngine", "Request"]
+__all__ = ["ContinuousBatchingEngine", "PagedContinuousBatchingEngine",
+           "Request"]
 
 
 class Request:
@@ -600,5 +602,469 @@ class ContinuousBatchingEngine:
                 raise RuntimeError(
                     "continuous-batching run() failed to converge — "
                     "scheduler bug (slots: %r)" % (self._slots,))
+        out, self._results = self._results, {}
+        return out
+
+
+class _AdmissionDeferred(Exception):
+    """Internal: the page pool is transiently exhausted — the request
+    stays at the queue head and retries at the next iteration boundary
+    (pages free as in-flight requests finish).  Never user-visible."""
+
+
+class _PagedSlot(_Slot):
+    """Host-side state of one PAGED slot.  ``pos`` is None while the
+    prompt is still prefilling (one chunk per engine iteration); the
+    slot joins the pooled decode step only once it is not None.  The
+    page list itself lives in the engine's per-row table (released on
+    every terminal path through one helper)."""
+
+    __slots__ = ("Tp", "chunks", "chunk_i", "cow")
+
+    def __init__(self, req, row, Tp, chunks, cow):
+        self.req = req
+        self.row = row
+        self.pos = None
+        self.emitted = []
+        self.keys = None
+        self.Tp = Tp
+        self.chunks = chunks          # [(start, T_actual, T_bucketed)]
+        self.chunk_i = 0
+        self.cow = cow                # (src_page, dst_page) or None
+
+    @property
+    def prefilling(self):
+        return self.pos is None
+
+
+class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
+    """Continuous batching over a BLOCK-PAGED KV cache with
+    cross-request prefix sharing and chunked prefill (vLLM
+    PagedAttention / SGLang radix-cache lineage, kept static-shape).
+
+    The slot engine above reserves ``max_length`` cache positions per
+    slot no matter what a request needs; at serving scale, cache bytes
+    ARE concurrency, so that stranding is the capacity ceiling.  This
+    engine replaces the per-slot rows with ONE pool of ``num_blocks``
+    fixed-size pages:
+
+    - **Paged pool** — per-layer (num_blocks+1, KV, block_size, D)
+      caches (page 0 reserved as the null page that absorbs dead-lane
+      writes).  Each slot holds a padded int32 block table threaded
+      through the compiled step; ``TransformerLM.step_pages`` /
+      ``prefill_pages`` gather/scatter through the table, reproducing
+      the contiguous cache bit-for-bit.  A request holds
+      ceil(need/block_size) pages instead of max_length positions.
+    - **Prefix sharing** — a host-side radix index maps full prompt
+      pages to their holders; a request whose prompt prefix matches
+      references the SAME immutable pages (refcounted) and skips
+      recomputing them entirely.  At the divergence point the partially
+      matching page is cloned copy-on-write (``src == dst`` folds the
+      no-COW case into the same compiled program).  Valid because the
+      prefix K/V is a pure function of the prefix tokens (asserted
+      bit-exact in tests) — which is also why MoE blocks opt OUT of
+      sharing: their expert capacity budgets from the FULL prompt
+      length, so a prefix's K/V is not donor-independent.
+    - **Chunked prefill** — long prompts ingest ``prefill_chunk``
+      tokens per engine iteration, interleaved with the pooled decode
+      step, so a long admission never stalls in-flight token streams.
+      Chunk lengths come from the same power-of-two buckets as the
+      slot engine, so compiled programs stay ≤ (#chunk buckets + 1).
+
+    Everything the slot engine guarantees carries over: per-request
+    streams bit-identical to isolated ``ShardedDecoder.generate``
+    (greedy, seeded-sampled, penalized — including under fault plans),
+    quarantine/deadline/shed semantics, O(log T) compiled programs.
+    New fault sites: ``serving.prefix_lookup`` and
+    ``serving.block_alloc`` (docs/resilience.md); pool exhaustion a
+    request can NEVER satisfy sheds at submit() with
+    :class:`~mxtpu.resilience.LoadShedError`, transient exhaustion
+    defers admission at the queue head until pages free.
+
+    Parameters (beyond ContinuousBatchingEngine's)
+    ----------------------------------------------
+    block_size : tokens per page (16 default — the vLLM sweet spot:
+        smaller pages waste less tail but cost more table/gather
+        overhead and shorter shareable units).
+    num_blocks : pool capacity in pages.  Default
+        ``num_slots * ceil(max_length / block_size)`` — byte parity
+        with the slot engine, at which point right-sized allocation +
+        sharing turn the saved bytes into extra resident requests.
+    prefill_chunk : tokens ingested per iteration during admission
+        (power of two >= 8; prompts shorter than one chunk admit in a
+        single iteration, exactly like the slot engine).
+    """
+
+    def __init__(self, block, mesh: DeviceMesh,
+                 rules: Optional[ShardingRules] = None,
+                 num_slots: int = 4, max_length: int = 256,
+                 cache_dtype: str = "float32",
+                 cache_spec: P = P(None, "tp", None, None),
+                 bucket_prefill: bool = True,
+                 max_pending: Optional[int] = None, clock=None,
+                 history: int = 1024, block_size: int = 16,
+                 num_blocks: Optional[int] = None,
+                 prefill_chunk: int = 64):
+        super().__init__(block, mesh, rules, num_slots, max_length,
+                         cache_dtype, cache_spec, bucket_prefill,
+                         max_pending, clock, history)
+        bs = int(block_size)
+        chunk = int(prefill_chunk)
+        if bs < 1:
+            raise ValueError("block_size must be >= 1, got %d" % bs)
+        if chunk < 8 or (chunk & (chunk - 1)):
+            raise ValueError(
+                "prefill_chunk must be a power of two >= 8 (it is a "
+                "compiled-program shape), got %d" % chunk)
+        self._bs = bs
+        self._chunk = chunk
+        # table width: every request's pages plus headroom for the last
+        # chunk's bucket padding (padded writes must stay inside the
+        # request's own pages; positions past the prompt are overwritten
+        # by decode or sit beyond every validity mask)
+        self._M = -(-(self._max_length + chunk) // bs)
+        if num_blocks is None:
+            num_blocks = self._num_slots * (-(-self._max_length // bs))
+        self._prefix = PrefixIndex(bs)
+        self._bp = BlockPool(int(num_blocks), bs,
+                             on_free=self._prefix.evict)
+        self._slot_pages: List[Optional[List[int]]] = \
+            [None] * self._num_slots
+        self._prefix_hits = 0
+        self._cow_copies = 0
+
+    # -- introspection ---------------------------------------------------
+    @property
+    def stats(self):
+        out = dict(super().stats)
+        out.update({
+            "blocks_in_use": self._bp.in_use,
+            "blocks_free": self._bp.free_count,
+            "blocks_shared": self._bp.shared_count,
+            "shared_extra_refs": self._bp.shared_extra_refs,
+            "prefix_hits": self._prefix_hits,
+            "cow_copies": self._cow_copies,
+            "block_size": self._bs,
+            "num_blocks": self._bp.capacity,
+        })
+        return out
+
+    # -- paged pool plumbing ---------------------------------------------
+    def _ensure_pool(self, sample_prompt):
+        self._dec._ensure_staged(sample_prompt)
+        if self._pool is not None:
+            return
+        jm = self._mesh.jax_mesh
+        cache_sh = NamedSharding(jm, self._dec._cache_spec)
+        self._pool = tuple(
+            (jax.device_put(pk._data, cache_sh),
+             jax.device_put(pv._data, cache_sh))
+            for pk, pv in self._block.init_block_pool(
+                self._bp.capacity + 1, self._bs, self._cache_dtype))
+
+    def _release_row(self, row):
+        """Drop row's page references (idempotent — every terminal path
+        funnels here); last-reference pages return to the free list and
+        evict their prefix-index entries via the pool's on_free hook."""
+        pages = self._slot_pages[row]
+        if pages is None:
+            return
+        self._slot_pages[row] = None
+        for bid in pages:
+            self._bp.release(bid)
+
+    def _scrub_row(self, row):
+        super()._scrub_row(row)
+        self._release_row(row)
+
+    def _finish(self, slot_idx_or_none, req, emitted, row, status="ok"):
+        super()._finish(slot_idx_or_none, req, emitted, row, status)
+        if slot_idx_or_none is not None:
+            self._release_row(row)
+
+    def _table_row(self, row):
+        t = onp.zeros((self._M,), onp.int32)
+        pages = self._slot_pages[row]
+        if pages:
+            t[:len(pages)] = pages
+        return t
+
+    # -- admission -------------------------------------------------------
+    def _plan_chunks(self, start, Tp, bucketing):
+        """Chunk schedule over prompt positions [start, Tp): compiled
+        chunk shapes stay on the power-of-two ladder (≤ prefill_chunk),
+        and a shape whose bucket padding would spill past the slot
+        extent (ceil(max_length / bs) pages — the slot engine's
+        reservation) descends the ladder instead, ingesting fewer
+        tokens that round: padding never inflates a request's page
+        need beyond slot parity, so anything the slot engine admits at
+        this max_length fits the pool too (only a mid-prefix shared
+        start can still spill, by at most one page — the 8-token
+        bucket floor).  Returns the schedule and the padded extent
+        (the last position any chunk's padding writes — allocation
+        must cover it)."""
+        cap = -(-self._max_length // self._bs) * self._bs
+        chunks, extent = [], 0
+        while start < Tp:
+            rem = Tp - start
+            if bucketing:
+                Tb = min(_bucket(rem), self._chunk)
+                while Tb > 8 and start + Tb > cap:
+                    Tb //= 2
+                Tact = min(rem, Tb)
+            else:
+                Tact = Tb = min(rem, self._chunk)
+            chunks.append((start, Tact, Tb))
+            extent = max(extent, start + Tb)
+            start += Tact
+        return chunks, extent
+
+    def _pages_needed(self, Tp, max_new):
+        """Worst-case (share-nothing) page count for one request —
+        the submit()-time feasibility bound."""
+        _, extent = self._plan_chunks(
+            0, Tp, self._dec._bucket_prefill
+            and not self._dec._block_has_moe())
+        return -(-max(Tp + max_new, extent) // self._bs)
+
+    def submit(self, prompt_ids, max_new_tokens, temperature=0.0,
+               top_k=0, top_p=0.0, repetition_penalty=1.0, seed=None,
+               eos_id=None, deadline_s=None, retries=0) -> int:
+        """Same contract as the slot engine's submit(); additionally a
+        request whose worst-case page need exceeds the WHOLE pool can
+        never be admitted and sheds immediately with LoadShedError
+        (transient exhaustion — pages held by live requests — defers
+        admission instead, it never sheds)."""
+        pids = prompt_ids if isinstance(prompt_ids, NDArray) \
+            else nd_array(prompt_ids)
+        if pids.ndim == 2 and pids.shape[0] == 1:
+            need = self._pages_needed(pids.shape[1],
+                                      int(max_new_tokens))
+            if need > self._bp.capacity:
+                self._shed += 1
+                _bump("shed_requests")
+                raise LoadShedError(
+                    "request needs %d page(s) > pool capacity %d "
+                    "(block_size=%d): can never be admitted — shed"
+                    % (need, self._bp.capacity, self._bs))
+        return super().submit(pids, max_new_tokens, temperature, top_k,
+                              top_p, repetition_penalty, seed, eos_id,
+                              deadline_s, retries)
+
+    def _admit(self, req, slot_idx):
+        """Paged admission: prefix lookup + page allocation + chunk
+        schedule; the FIRST chunk (with the copy-on-write fold) runs
+        immediately, so a prompt no longer than one chunk completes
+        admission in this iteration exactly like the slot engine."""
+        _inject("serving.admit", key=req.rid)
+        Tp = req.prompt.shape[1]
+        moe = self._dec._block_has_moe()
+        bucketing = self._dec._bucket_prefill and not moe
+        full, partial = [], None
+        if not moe:
+            # MoE prefixes are not donor-independent (expert capacity
+            # budgets from the FULL prompt length) — no sharing
+            _inject("serving.prefix_lookup", key=req.rid)
+            full, partial = self._prefix.lookup(req.prompt[0],
+                                                limit=Tp - 1)
+        n_shared = len(full) * self._bs + (partial[1] if partial else 0)
+        chunks, extent = self._plan_chunks(n_shared, Tp, bucketing)
+        n_pages = -(-max(Tp + req.max_new_tokens, extent) // self._bs)
+        need = n_pages - len(full)
+        _inject("serving.block_alloc", key=req.rid)
+        if need > self._bp.free_count:
+            raise _AdmissionDeferred()
+        fresh = self._bp.alloc(need)
+        pages = list(full) + fresh
+        for bid in full:
+            self._bp.retain(bid)
+        self._slot_pages[slot_idx] = pages   # release path armed NOW
+        if full or partial:
+            self._prefix_hits += 1
+        cow = None
+        if partial:
+            cow = (partial[0], pages[len(full)])
+            self._cow_copies += 1
+        slot = _PagedSlot(req, slot_idx, Tp, chunks, cow)
+        self._slots[slot_idx] = slot
+        self._status[req.rid] = "active"
+        try:
+            self._advance_prefill(slot_idx)
+        except Exception:
+            # the caller's quarantine path expects a FAILED admission
+            # never to occupy the slot (the slot-engine invariant)
+            self._slots[slot_idx] = None
+            raise
+
+    def _advance_prefill(self, slot_idx):
+        """Run ONE prefill chunk for a prefilling slot; the final chunk
+        samples the first token (mirroring the slot engine's admission
+        tail bit-for-bit: seed applied AFTER prefill, first draw from
+        the prompt's last real logit row) and registers the prompt's
+        full pages in the prefix index."""
+        from ..models.sampler import sample_next_token
+
+        slot = self._slots[slot_idx]
+        req = slot.req
+        start, Tact, Tb = slot.chunks[slot.chunk_i]
+        raw = jnp.asarray(req.prompt[:, start:start + Tact], jnp.int32)
+        if Tb > Tact:
+            raw = jnp.pad(raw, ((0, 0), (0, Tb - Tact)))
+        src, dst = slot.cow if slot.cow is not None else (0, 0)
+        slot.cow = None                      # COW runs exactly once
+        moe = self._dec._block_has_moe()
+        logits, self._pool = self._dec._page_prefill_jitted(
+            self._pool, raw, jnp.asarray(self._table_row(slot_idx)),
+            jnp.int32(start), jnp.int32(src), jnp.int32(dst),
+            total_len=(slot.Tp if moe else None))
+        slot.chunk_i += 1
+        if slot.chunk_i < len(slot.chunks):
+            return                           # more chunks next iteration
+        # -- prefill complete: the slot-engine admission tail ------------
+        Tp = slot.Tp
+        last = logits[:, Tp - 1 - start]               # (1, V)
+        keys = None
+        if req.seed is not None and req.sampled:
+            # seed AFTER prefill — the ordering generate() guarantees
+            keys = _slot_keys(req.seed)
+        elif req.sampled:
+            keys = _slot_keys(onp.random.randint(0, 2**31 - 1))
+        self._ensure_seen(last.shape[-1])
+        if req.penalized:
+            row = jnp.zeros((last.shape[-1],), bool).at[
+                jnp.asarray(req.prompt[0], jnp.int32)].set(True)
+            self._seen = self._seen.at[slot_idx].set(row)
+        tok = sample_next_token(
+            last, keys.next_key() if req.sampled else None,
+            req.temperature, req.top_k, req.top_p,
+            req.repetition_penalty,
+            seen_mask=self._seen[slot_idx:slot_idx + 1]
+            if req.penalized else None)
+        tok = tok.astype(jnp.int32)                    # (1,)
+        if req.penalized:
+            self._seen = self._seen.at[slot_idx, tok[0]].set(True)
+        if self._last_tokens is None:
+            self._last_tokens = jnp.zeros((self._num_slots,), jnp.int32)
+        self._last_tokens = self._last_tokens.at[slot_idx].set(tok[0])
+        slot.pos = Tp
+        slot.keys = keys
+        slot.emitted = [self._last_tokens]
+        if not moe:
+            # prompt pages fully below Tp are now immutable: decode
+            # writes land at >= Tp, chunk padding past Tp never touches
+            # them — future prompts may share them
+            self._prefix.register(req.prompt[0],
+                                  self._slot_pages[slot_idx][:Tp
+                                                             // self._bs])
+        if self._slot_done(slot):
+            self._finish(slot_idx, req, slot.emitted, slot_idx)
+
+    # -- one scheduler iteration ----------------------------------------
+    def step(self):
+        """One iteration: deadline sweep, admissions (deferring at the
+        queue head on transient page exhaustion), ONE prefill chunk per
+        prefilling slot, then ONE pooled paged decode step over every
+        DECODING slot.  Same per-slot failure containment as the slot
+        engine; chunk-prefill faults quarantine under the admission
+        site."""
+        from ..models.sampler import sample_next_token
+
+        finished_before = set(self._results)
+        self._evict_expired()
+        # chunked prefill FIRST: slots already prefilling advance one
+        # chunk per iteration, interleaved with (never stalling) the
+        # decode step below; slots admitted later this iteration ran
+        # their first chunk inside _admit and wait for the next one
+        for i in range(self._num_slots):
+            s = self._slots[i]
+            if s is not None and s.prefilling:
+                try:
+                    self._advance_prefill(i)
+                except Exception as exc:
+                    self._quarantine(i, exc, "serving.admit")
+        if self._queue:
+            self._ensure_pool(nd_array(self._queue[0].prompt))
+        deferred = False
+        for i in range(self._num_slots):
+            if not self._queue or deferred:
+                break
+            if self._slots[i] is None:
+                req = self._queue.pop(0)
+                if req.max_new_tokens <= 0:
+                    self._finish(None, req, [], 0)
+                    continue
+                try:
+                    self._admit(req, i)
+                except _AdmissionDeferred:
+                    # FIFO preserved: the request stays at the head and
+                    # no later request jumps it into the freed pages
+                    self._queue.insert(0, req)
+                    deferred = True
+                except Exception as exc:
+                    self._quarantine_request(req, exc, "serving.admit",
+                                             row=i)
+
+        active = [i for i, s in enumerate(self._slots)
+                  if s is not None and not s.prefilling]
+        for i in list(active):
+            try:
+                _inject("serving.step", key=self._slots[i].req.rid)
+            except Exception as exc:
+                self._quarantine(i, exc, "serving.step")
+                active.remove(i)
+        if active:
+            pos = onp.zeros((self._num_slots,), onp.int32)
+            tables = onp.zeros((self._num_slots, self._M), onp.int32)
+            for i in active:
+                pos[i] = self._slots[i].pos
+                tables[i] = self._table_row(i)
+            logits, self._pool = self._dec._step_pages_jitted(
+                self._pool, self._last_tokens.reshape(-1, 1),
+                jnp.asarray(tables), jnp.asarray(pos))
+            last = logits[:, 0]                          # (B, V)
+            self._sample_pool(last, active, sample_next_token)
+            self._steps += 1
+            self._tokens_generated += len(active)
+            for i in active:
+                s = self._slots[i]
+                s.pos += 1
+                s.emitted.append(self._last_tokens)
+                try:
+                    done = self._slot_done(s)
+                except Exception as exc:  # per-slot eos host read
+                    self._quarantine(i, exc, "serving.step")
+                    continue
+                if done:
+                    self._finish(i, s.req, s.emitted, s.row)
+        return [r for r in self._results if r not in finished_before]
+
+    # -- drain -----------------------------------------------------------
+    def run(self):
+        """Drain the queue and every active slot; returns {request id →
+        (1, T_prompt + generated) NDArray}.  The non-convergence guard
+        additionally budgets the prefill-chunk iterations and the
+        page-exhaustion admission deferrals (bounded: a deferred
+        request waits only on in-flight requests, which emit every
+        iteration)."""
+        def iters(req, emitted_n=0):
+            chunks = -(-req.prompt.shape[1] // self._chunk)
+            return (1 + req.retries_left) * (
+                req.max_new_tokens + chunks) - emitted_n
+
+        outstanding = sum(iters(r) for r in self._queue) + sum(
+            iters(s.req, len(s.emitted))
+            for s in self._slots if s is not None)
+        limit = 4 * (outstanding + len(self._queue)
+                     + self._num_slots + 1) + \
+            2 * self._bp.capacity
+        guard = 0
+        while self._queue or any(s is not None for s in self._slots):
+            self.step()
+            guard += 1
+            if guard > limit:
+                raise RuntimeError(
+                    "paged continuous-batching run() failed to "
+                    "converge — scheduler bug (slots: %r, free pages: "
+                    "%d)" % (self._slots, self._bp.free_count))
         out, self._results = self._results, {}
         return out

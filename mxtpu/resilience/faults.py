@@ -17,6 +17,15 @@ site                fires at
                     (``ContinuousBatchingEngine.step``)
 ``serving.admit``   start of the compiled slot-prefill admission path
                     (``ContinuousBatchingEngine._admit``), keyed by rid
+``serving.prefix_lookup``
+                    before the paged engine's radix prefix-index lookup
+                    (``PagedContinuousBatchingEngine._admit``), keyed
+                    by rid — a raise models a corrupt/poisoned index
+``serving.block_alloc``
+                    before the paged engine's page allocation (same
+                    admission path), keyed by rid — a raise models the
+                    pool-exhausted path; genuine transient exhaustion
+                    defers admission, it never raises
 ``kvstore.reduce``  inside the (retried) cross-worker reduce of
                     ``KVStore.push`` / ``pushpull``
 ``checkpoint.save`` inside the preemption save callback
@@ -84,7 +93,8 @@ __all__ = ["InjectedFault", "FaultRule", "FaultPlan", "fault_plan",
            "SITES"]
 
 #: the documented injection sites (see module docstring for locations)
-SITES = ("serving.step", "serving.admit", "kvstore.reduce",
+SITES = ("serving.step", "serving.admit", "serving.prefix_lookup",
+         "serving.block_alloc", "kvstore.reduce",
          "checkpoint.save", "engine.flush", "guardian.check",
          "ckpt.write", "ckpt.verify")
 

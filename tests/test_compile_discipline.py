@@ -254,6 +254,57 @@ def test_optimizer_updates_report_via_observe():
 # which wraps its run in the budget at zero extra compile cost.  Here:
 # the seeded REGRESSION, which needs its own (unbucketed) engine.
 
+def test_paged_engine_holds_compile_budget():
+    """ISSUE-7 acceptance: the PAGED engine stays within
+    compile_budget(#chunk buckets + 1) over a mixed-length workload
+    WITH chunked prefill and prefix sharing — block tables, positions,
+    chunk starts and the COW fold are all traced, so only the bucketed
+    chunk SHAPES compile.  Lengths 3, 12 bucket to 8, 16; length 20
+    chunks as 16 + a bucketed-8 tail; the shared-prefix pair's suffix
+    chunks land in the same two buckets: exactly 2 prefill programs +
+    1 paged step.  Smallest possible engine (1-layer LM, single-device
+    mesh) — the invariant is in the PROGRAM COUNT."""
+    from mxtpu.models.transformer import TransformerLM
+    from mxtpu.parallel import PagedContinuousBatchingEngine
+    from mxtpu.parallel.mesh import DeviceMesh
+
+    mx.random.seed(77)
+    tiny = TransformerLM(50, units=32, hidden_size=64, num_layers=1,
+                         num_heads=2, num_kv_heads=2)
+    tiny.initialize()
+    eng = PagedContinuousBatchingEngine(
+        tiny, DeviceMesh(dp=1), transformer_lm_sharding_rules(),
+        num_slots=2, max_length=32, block_size=8, prefill_chunk=16)
+    rng = np.random.RandomState(31)
+    shared = rng.randint(0, 50, (1, 13))
+    with compile_budget(3, sites=("serving.page_prefill",
+                                  "serving.step_pages")):
+        for t in (3, 12, 20):
+            eng.submit(nd.array(rng.randint(0, 50, (1, t)),
+                                dtype="int32"), 3)
+        eng.run()
+        # overlapping shared-prefix pair (sharing lives as long as a
+        # holder does): the second admission reuses the donor's pages
+        # and its suffix chunk reuses the compiled buckets — the COW
+        # fold is the SAME program
+        eng.submit(nd.array(np.concatenate(
+            [shared, rng.randint(0, 50, (1, 3))], axis=1),
+            dtype="int32"), 4)
+        eng.step()              # donor prefills + registers its pages
+        eng.submit(nd.array(np.concatenate(
+            [shared, rng.randint(0, 50, (1, 5))], axis=1),
+            dtype="int32"), 3)
+        eng.run()
+    assert eng.stats["prefix_hits"] >= 1
+    assert eng.stats["cow_copies"] >= 1
+    # the discipline checker sees only bounded bucketed growth here
+    assert "serving.page_prefill" not in [
+        d.subject for d in check_compiles().filter(code="C001")]
+    cache = eng._dec._jit_cache
+    assert len([k for k in cache if k[0] == "page_prefill"]) == 2
+    assert len([k for k in cache if k[0] == "step_pages"]) == 1
+
+
 def test_seeded_bucketing_regression_fails_budget():
     """Turn bucketing OFF (the seeded regression): one prefill program
     per distinct prompt length — the (buckets + 1) budget that holds in

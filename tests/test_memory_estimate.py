@@ -108,6 +108,45 @@ def test_kv_cache_residency_abstract():
     assert sharded == total // 2
 
 
+def test_paged_kv_cache_residency_accounting():
+    """ISSUE-7 satellite: the paged layout — bytes per page, resident
+    vs free split, shared-page savings, and the refcounted-once rule
+    (a page shared by N tables is ONE page; the unshared equivalent
+    would hold shared_extra_refs more copies resident)."""
+    from mxtpu.analysis import paged_kv_cache_residency
+    from mxtpu.models.transformer import llama_tiny
+
+    mx.random.seed(0)
+    net = llama_tiny(vocab_size=50)
+    out = paged_kv_cache_residency(net, num_blocks=16, block_size=8,
+                                   blocks_in_use=10,
+                                   shared_extra_refs=3)
+    # 2 layers x (k, v) x (17, 2, 8, 16) f32 — the +1 null page is
+    # real HBM and priced in the total, never in the free pool
+    per_block = F32 * 4 * (2 * 8 * 16)
+    assert out["bytes_per_block"] == per_block
+    assert out["total_bytes"] == 17 * per_block
+    assert out["resident_bytes"] == 10 * per_block
+    assert out["free_bytes"] == 6 * per_block
+    assert out["shared_savings_bytes"] == 3 * per_block
+    assert out["shapes"] == [((17, 2, 8, 16), "float32")] * 4
+    # tp-sharded pool: kv-head axis divides, per-device bytes halve
+    sharded = paged_kv_cache_residency(
+        net, num_blocks=16, block_size=8,
+        cache_spec=P(None, "tp"), mesh={"tp": 2})
+    assert sharded["total_bytes"] == out["total_bytes"] // 2
+    # check_memory budgets the POOL (one allocation, whatever the
+    # sharing degree): a budget that fits the pool passes even when
+    # the sum of per-request logical caches would blow it
+    rep = check_memory(
+        sym.Variable("tokens"), budget_bytes=out["total_bytes"] * 2,
+        known_shapes={"tokens": (4, 8)},
+        kv_caches=[(s, d) for s, d in out["shapes"]])
+    assert rep.ok
+    m3 = rep.filter(code="M003").diagnostics[0]
+    assert m3.details["kv_cache"] == out["total_bytes"]
+
+
 # -- the XLA cross-check (acceptance: within 10%) ----------------------
 
 def _rel_err(est_total, xla_total):

@@ -484,6 +484,18 @@ CASES.update({
     "_internal_cache_write_slot": C(
         lambda: (A(2, 3, 8, 4), A(1, 3, 4, 4)), {"slot": 1, "pos": 2},
         grad=False),
+    # block-paged cache family (PagedContinuousBatchingEngine): pool
+    # (pages=5, KV=3, block=4, D=2); tables are int32 page indices
+    "_paged_cache_gather": C(
+        lambda: (A(5, 3, 4, 2), IDX(2, 3, n=5)), grad=False),
+    "_paged_cache_write": C(
+        lambda: (A(5, 3, 4, 2), A(1, 3, 6, 2), IDX(3, n=5)),
+        {"start_pos": 2}, grad=False),
+    "_paged_cache_write_rows": C(
+        lambda: (A(5, 3, 4, 2), A(2, 3, 1, 2), IDX(2, 3, n=5),
+                 jnp.asarray([5, 2])), grad=False),
+    "_paged_block_copy": C(
+        lambda: (A(5, 3, 4, 2),), {"src": 1, "dst": 3}, grad=False),
     "_npi_einsum": C(lambda: (A(2, 3), A(3, 4)),
                      {"subscripts": "ij,jk->ik"}),
     "gradientmultiplier": C(lambda: (A(3, 4),), {"scalar": 1.0}),

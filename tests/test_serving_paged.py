@@ -1,0 +1,384 @@
+"""PagedContinuousBatchingEngine: the block-paged KV cache must keep
+the slot engine's whole contract — every request's token stream
+bit-identical to an isolated ``ShardedDecoder.generate`` (greedy,
+seeded-sampled, penalized; including under the PR-4 ``serving.step``
+fault plan) — while adding cross-request prefix sharing (refcounted
+immutable pages, copy-on-write exactly at the divergence page),
+chunked prefill that never stalls in-flight streams, and page-pool
+accounting that cannot leak.
+
+Compile discipline: ``prefill_chunk=8`` pins every chunk to ONE
+bucketed shape, so the whole module compiles exactly one paged prefill
+program and one paged step (the compile-budget assertion itself lives
+in tests/test_compile_discipline.py).  ONE module-scoped engine serves
+every scenario; each test drains it fully.  Runs on the virtual
+8-device CPU mesh from conftest."""
+
+import numpy as np
+import pytest
+
+import mxtpu as mx
+from mxtpu import nd
+from mxtpu.models.transformer import llama_tiny, \
+    transformer_lm_sharding_rules
+from mxtpu.parallel import (PagedContinuousBatchingEngine,
+                            ShardedDecoder, make_mesh)
+from mxtpu.parallel.paging import BlockPool, BlockPoolExhausted, \
+    PrefixIndex
+from mxtpu.resilience import LoadShedError, fault_plan
+
+MAXLEN = 32
+BS = 8
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    mx.random.seed(77)
+    net = llama_tiny(vocab_size=50)
+    net.initialize()
+    return net
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    return make_mesh(dp=1, tp=2)
+
+
+@pytest.fixture(scope="module")
+def isolated(tiny, mesh):
+    """The per-request reference path: one static-batch generate each."""
+    return ShardedDecoder(tiny, mesh, transformer_lm_sharding_rules())
+
+
+@pytest.fixture(scope="module")
+def eng(tiny, mesh):
+    return PagedContinuousBatchingEngine(
+        tiny, mesh, transformer_lm_sharding_rules(), num_slots=2,
+        max_length=MAXLEN, block_size=BS, prefill_chunk=8)
+
+
+def _prompts(rng, lengths, vocab=50):
+    return [nd.array(rng.randint(0, vocab, (1, t)), dtype="int32")
+            for t in lengths]
+
+
+def _want(isolated, p, n, **kw):
+    return isolated.generate(p, max_new_tokens=n, max_length=MAXLEN,
+                             **kw).asnumpy()
+
+
+def _row_of(eng, rid):
+    for i, s in enumerate(eng._slots):
+        if s is not None and s.req.rid == rid:
+            return i
+    raise AssertionError("rid %d holds no slot" % rid)
+
+
+# --------------------------------------------------- host-side bookkeeping
+
+def test_block_pool_alloc_release_refcounts():
+    freed = []
+    bp = BlockPool(4, 8, on_free=freed.append)
+    a = bp.alloc(3)
+    assert a == [1, 2, 3] and bp.free_count == 1 and bp.in_use == 3
+    bp.retain(2)
+    assert bp.shared_count == 1 and bp.shared_extra_refs == 1
+    bp.release(2)
+    assert bp.refcount(2) == 1 and not freed  # still one holder
+    for bid in a:
+        bp.release(bid)
+    assert freed == [1, 2, 3] and bp.free_count == 4
+    with pytest.raises(BlockPoolExhausted, match="free"):
+        bp.alloc(5)
+    assert bp.free_count == 4  # failed alloc allocates nothing
+    # freed pages are reused lowest-first (deterministic replay order)
+    assert bp.alloc(2) == [1, 2]
+
+
+def test_prefix_index_lookup_register_evict():
+    idx = PrefixIndex(4)
+    toks = list(range(12))
+    idx.register(toks, [5, 9])          # pages for [0:4) and [4:8)
+    full, partial = idx.lookup(toks, limit=11)
+    assert full == [5, 9] and partial is None
+    # diverging inside the second page -> that page is the COW donor
+    other = toks[:6] + [99, 98]
+    full, partial = idx.lookup(other, limit=8)
+    assert full == [5] and partial == (9, 2)
+    # the limit fences the last token (its logits seed the first draw):
+    # a page that would cross it degrades to a partial (COW) match
+    full, partial = idx.lookup(toks, limit=7)
+    assert full == [5] and partial == (9, 3)
+    idx.evict(5)                        # parent gone -> subtree dropped
+    assert idx.lookup(toks, limit=11) == ([], None)
+    assert len(idx) == 0
+
+
+# ----------------------------------------------------------- core parity
+
+def test_paged_join_evict_greedy_parity(eng, isolated):
+    """More requests than slots, mixed prompt/output lengths, one
+    prompt long enough to prefill in two chunks: every token stream
+    equals the isolated run-to-completion decode, and the drained pool
+    holds zero pages."""
+    rng = np.random.RandomState(3)
+    prompts = _prompts(rng, (3, 5, 12, 7))
+    news = [6, 3, 5, 2]
+    rids = [eng.submit(p, n) for p, n in zip(prompts, news)]
+    res = eng.run()
+    for rid, p, n in zip(rids, prompts, news):
+        np.testing.assert_array_equal(res[rid].asnumpy(),
+                                      _want(isolated, p, n))
+    st = eng.stats
+    assert st["blocks_in_use"] == 0
+    assert st["blocks_free"] == st["num_blocks"]
+
+
+def test_prefix_sharing_parity_and_cow_at_divergence(eng, isolated):
+    """The tentpole scenario: B shares A's 13-token prompt prefix
+    (one full page + 5 tokens into the next).  B must reference A's
+    first page (same id, refcount 2), clone EXACTLY the divergence
+    page copy-on-write, and both streams stay bit-identical to their
+    isolated generates."""
+    rng = np.random.RandomState(7)
+    shared = rng.randint(0, 50, (1, 13))
+    pa = nd.array(np.concatenate(
+        [shared, rng.randint(0, 50, (1, 3))], 1), dtype="int32")
+    pb = nd.array(np.concatenate(
+        [shared, rng.randint(0, 50, (1, 5))], 1), dtype="int32")
+    before = eng.stats
+    ra = eng.submit(pa, 6)
+    eng.step()                      # admit + chunk [0:8)
+    eng.step()                      # chunk [8:16) -> registered, decoding
+    rb = eng.submit(pb, 5)
+    eng.step()                      # B admits: lookup hits A's pages
+    rows = {rid: _row_of(eng, rid) for rid in (ra, rb)}
+    pages_a = eng._slot_pages[rows[ra]]
+    pages_b = eng._slot_pages[rows[rb]]
+    assert pages_b[0] == pages_a[0]          # full page shared
+    assert pages_b[1] != pages_a[1]          # COW clone at divergence
+    mid = eng.stats
+    assert mid["blocks_shared"] == 1
+    assert mid["prefix_hits"] - before["prefix_hits"] == 1
+    assert mid["cow_copies"] - before["cow_copies"] == 1
+    while eng.pending or eng.active:
+        eng.step()
+    np.testing.assert_array_equal(eng.take_result(ra).asnumpy(),
+                                  _want(isolated, pa, 6))
+    np.testing.assert_array_equal(eng.take_result(rb).asnumpy(),
+                                  _want(isolated, pb, 5))
+    assert eng.stats["blocks_in_use"] == 0
+
+
+def test_seeded_sampled_and_penalized_shared_prefix_parity(
+        eng, isolated):
+    """Sampled (per-slot RNG streams) and penalized requests sharing a
+    prompt prefix AND the pool in the same iterations: draws are
+    bit-identical to the isolated seeded generates."""
+    rng = np.random.RandomState(11)
+    shared = rng.randint(0, 50, (1, 10))
+    pa = nd.array(np.concatenate(
+        [shared, rng.randint(0, 50, (1, 2))], 1), dtype="int32")
+    pb = nd.array(np.concatenate(
+        [shared, rng.randint(0, 50, (1, 4))], 1), dtype="int32")
+    ra = eng.submit(pa, 5, temperature=0.8, top_k=20, top_p=0.9,
+                    seed=101)
+    eng.step()
+    eng.step()
+    rb = eng.submit(pb, 4, temperature=0.7, seed=202)
+    rc = eng.submit(pa, 5, repetition_penalty=1.3)
+    res = eng.run()
+    np.testing.assert_array_equal(
+        res[ra].asnumpy(),
+        _want(isolated, pa, 5, temperature=0.8, top_k=20, top_p=0.9,
+              seed=101))
+    np.testing.assert_array_equal(
+        res[rb].asnumpy(),
+        _want(isolated, pb, 4, temperature=0.7, seed=202))
+    np.testing.assert_array_equal(
+        res[rc].asnumpy(),
+        _want(isolated, pa, 5, repetition_penalty=1.3))
+    assert eng.stats["blocks_in_use"] == 0
+
+
+def test_evicting_donor_never_perturbs_sharer(eng, isolated):
+    """A (the donor whose pages B shares) is quarantined mid-decode by
+    an injected fault: B's SEEDED stream must stay bit-identical — the
+    shared pages survive at refcount 1 until B finishes — and every
+    page is reclaimed afterwards."""
+    rng = np.random.RandomState(13)
+    shared = rng.randint(0, 50, (1, 13))
+    pa = nd.array(np.concatenate(
+        [shared, rng.randint(0, 50, (1, 3))], 1), dtype="int32")
+    pb = nd.array(np.concatenate(
+        [shared, rng.randint(0, 50, (1, 4))], 1), dtype="int32")
+    ra = eng.submit(pa, 8)
+    eng.step()
+    eng.step()
+    rb = eng.submit(pb, 6, temperature=0.8, seed=303)
+    with fault_plan("serving.step#%d@3:raise=RuntimeError(dead)" % ra):
+        res = eng.run()
+    assert eng.status(ra) == "failed"
+    np.testing.assert_array_equal(
+        res[rb].asnumpy(),
+        _want(isolated, pb, 6, temperature=0.8, seed=303))
+    # donor's partial output is a prefix of its fault-free stream
+    part = res[ra].asnumpy()
+    full = _want(isolated, pa, 8)
+    assert pa.shape[1] <= part.shape[1] < full.shape[1]
+    np.testing.assert_array_equal(part[0], full[0, :part.shape[1]])
+    assert eng.stats["blocks_in_use"] == 0
+
+
+def test_chunked_prefill_never_stalls_decode(eng, isolated):
+    """A long prompt (3 chunks) admits while a short request decodes:
+    the decoding stream emits a token EVERY iteration of the long
+    admission — chunked prefill interleaves instead of stalling — and
+    both outputs keep parity."""
+    rng = np.random.RandomState(17)
+    p_short, p_long = _prompts(rng, (3, 20))
+    ra = eng.submit(p_short, 10)
+    eng.step()                              # A admits and starts
+    rb = eng.submit(p_long, 4)
+    emitted_during_prefill = []
+    for _ in range(3):                      # B's chunks [0:8) [8:16) [16:20)
+        row_a = _row_of(eng, ra)
+        n0 = len(eng._slots[row_a].emitted)
+        eng.step()
+        emitted_during_prefill.append(
+            len(eng._slots[row_a].emitted) - n0)
+    assert emitted_during_prefill == [1, 1, 1]  # never stalled
+    while eng.pending or eng.active:
+        eng.step()
+    np.testing.assert_array_equal(eng.take_result(ra).asnumpy(),
+                                  _want(isolated, p_short, 10))
+    np.testing.assert_array_equal(eng.take_result(rb).asnumpy(),
+                                  _want(isolated, p_long, 4))
+
+
+def test_step_fault_plan_retry_parity(eng, isolated):
+    """The PR-4 acceptance scenario on the PAGED engine: an injected
+    ``serving.step`` failure quarantines only that slot (its pages
+    reclaimed), the neighbor's stream is bit-identical to fault-free,
+    and the retry restarts bit-identically from its seed."""
+    rng = np.random.RandomState(19)
+    p1, p2 = _prompts(rng, (4, 6))
+    r1 = eng.submit(p1, 6)
+    r2 = eng.submit(p2, 5, retries=1)
+    before = eng.stats
+    with fault_plan("serving.step#%d@2:raise=RuntimeError(poisoned)"
+                    % r2) as plan:
+        res = eng.run()
+    assert plan.stats()["serving.step"]["fired"] == 1
+    np.testing.assert_array_equal(res[r1].asnumpy(),
+                                  _want(isolated, p1, 6))
+    assert eng.status(r2) == "ok"
+    np.testing.assert_array_equal(res[r2].asnumpy(),
+                                  _want(isolated, p2, 5))
+    after = eng.stats
+    assert after["quarantined"] - before["quarantined"] == 1
+    assert after["retries"] - before["retries"] == 1
+    assert after["blocks_in_use"] == 0
+
+
+def test_block_alloc_and_prefix_lookup_fault_sites(eng, isolated):
+    """The new paged fault sites: an injected raise in the page
+    allocation or the prefix lookup fails ONLY that request (admission
+    never occupied the slot), the neighbor keeps parity, and no page
+    leaks."""
+    rng = np.random.RandomState(23)
+    p1, p2 = _prompts(rng, (4, 5))
+    r1 = eng.submit(p1, 3)
+    r2 = eng.submit(p2, 4)
+    with fault_plan("serving.block_alloc#%d@1:raise=OSError(boom)" % r1):
+        res = eng.run()
+    assert eng.status(r1) == "failed"
+    assert eng.error(r1)["site"] == "serving.admit"
+    np.testing.assert_array_equal(res[r2].asnumpy(),
+                                  _want(isolated, p2, 4))
+    r3 = eng.submit(p1, 3)
+    r4 = eng.submit(p2, 4)
+    with fault_plan("serving.prefix_lookup#%d@1:raise=OSError(bad)" % r4):
+        res = eng.run()
+    assert eng.status(r4) == "failed"
+    np.testing.assert_array_equal(res[r3].asnumpy(),
+                                  _want(isolated, p1, 3))
+    assert eng.stats["blocks_in_use"] == 0
+
+
+def test_pool_exhaustion_sheds_impossible_defers_transient(tiny, mesh,
+                                                           isolated):
+    """A request that can NEVER fit (worst-case pages > whole pool)
+    sheds at submit() with the typed LoadShedError; two requests that
+    fit only one-at-a-time admit sequentially — the deferred one waits
+    at the queue head (no error, FIFO kept) and completes with full
+    parity.  Tiny single-purpose engines: 1 paged prefill + 1 step
+    program each."""
+    from mxtpu.base import MXTPUError
+
+    small = PagedContinuousBatchingEngine(
+        tiny, mesh, transformer_lm_sharding_rules(), num_slots=2,
+        max_length=MAXLEN, block_size=BS, num_blocks=3, prefill_chunk=8)
+    rng = np.random.RandomState(29)
+    p = _prompts(rng, (10,))[0]
+    with pytest.raises(LoadShedError, match="can never be admitted"):
+        small.submit(p, 15)                 # needs 4 pages > 3
+    assert issubclass(LoadShedError, MXTPUError)
+    assert small.stats["shed"] == 1 and small.pending == 0
+
+    p1, p2 = _prompts(rng, (6, 7))
+    r1 = small.submit(p1, 10)               # 2 pages
+    r2 = small.submit(p2, 9)                # 2 pages: must wait for r1
+    res = small.run()
+    assert small.status(r1) == "ok" and small.status(r2) == "ok"
+    np.testing.assert_array_equal(res[r1].asnumpy(),
+                                  _want(isolated, p1, 10))
+    np.testing.assert_array_equal(res[r2].asnumpy(),
+                                  _want(isolated, p2, 9))
+    assert small.stats["blocks_in_use"] == 0
+
+
+def test_request_edge_cases_and_stats_surface(eng):
+    rng = np.random.RandomState(31)
+    p = _prompts(rng, (4,))[0]
+    r0 = eng.submit(p, 0)                   # nothing to generate
+    r1 = eng.submit(p, 1)                   # finishes at admission
+    res = eng.run()
+    np.testing.assert_array_equal(res[r0].asnumpy(), p.asnumpy())
+    assert res[r1].shape == (1, 5)
+    with pytest.raises(ValueError):         # doesn't fit max_length
+        eng.submit(p, MAXLEN)
+    for key in ("blocks_in_use", "blocks_free", "blocks_shared",
+                "shared_extra_refs", "prefix_hits", "cow_copies",
+                "block_size", "num_blocks", "quarantined", "shed"):
+        assert key in eng.stats, key
+    assert eng.stats["blocks_in_use"] == 0
+
+
+@pytest.mark.slow
+def test_moe_paged_engine_parity(mesh):
+    """MoE blocks on the paged engine: prefix sharing auto-disabled
+    (expert capacity budgets from the FULL prompt length, so prefix
+    K/V is not donor-independent), chunked prefill threads total_len,
+    and single-chunk parity holds.  Marked slow like the slot engine's
+    MoE test — the dense tests above carry the tier-1 contract."""
+    from mxtpu.models.transformer import TransformerLM
+
+    mx.random.seed(9)
+    lm = TransformerLM(vocab_size=40, units=16, hidden_size=32,
+                       num_layers=1, num_heads=4, num_kv_heads=2,
+                       num_experts=4, capacity_factor=4.0)
+    lm.initialize()
+    dec = ShardedDecoder(lm, mesh, transformer_lm_sharding_rules())
+    peng = PagedContinuousBatchingEngine(
+        lm, mesh, transformer_lm_sharding_rules(), num_slots=2,
+        max_length=16, block_size=8, prefill_chunk=8)
+    rng = np.random.RandomState(23)
+    prompts = _prompts(rng, (3, 4), vocab=40)
+    rids = [peng.submit(p, 3) for p in prompts]
+    res = peng.run()
+    for rid, p in zip(rids, prompts):
+        want = dec.generate(p, max_new_tokens=3,
+                            max_length=16).asnumpy()
+        np.testing.assert_array_equal(res[rid].asnumpy(), want)
+    assert peng.stats["prefix_hits"] == 0   # sharing disabled for MoE

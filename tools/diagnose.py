@@ -120,6 +120,64 @@ def check_compile_ledger():
         print("ledger       : FAILED (%s: %s)" % (type(e).__name__, e))
 
 
+def check_serving():
+    """Exercise the paged continuous-batching engine once on a micro
+    model (single-device CPU mesh, two requests sharing a prompt
+    prefix) and print the paged-cache counters (docs/inference.md): a
+    healthy install shows a prefix hit, a copy-on-write clone, and an
+    empty pool after the drain."""
+    print("----------Serving (paged KV cache)----------")
+    try:
+        import numpy as np
+
+        import mxtpu as mx
+        from mxtpu import nd
+        from mxtpu.models.transformer import (
+            TransformerLM, transformer_lm_sharding_rules)
+        from mxtpu.parallel import PagedContinuousBatchingEngine
+        from mxtpu.parallel.mesh import DeviceMesh
+
+        mx.random.seed(7)
+        lm = TransformerLM(32, units=16, hidden_size=32, num_layers=1,
+                           num_heads=2, num_kv_heads=2)
+        lm.initialize()
+        eng = PagedContinuousBatchingEngine(
+            lm, DeviceMesh(dp=1), transformer_lm_sharding_rules(),
+            num_slots=2, max_length=32, block_size=8, prefill_chunk=8)
+        rng = np.random.RandomState(0)
+        shared = rng.randint(0, 32, (1, 11))
+        # first prompt: 17 tokens -> pages 0 and 1 both full and
+        # registered once its 3-chunk prefill completes; the second
+        # diverges at token 11, INSIDE page 1 -> one full-page prefix
+        # hit plus a copy-on-write clone of page 1
+        pa = np.concatenate([shared, rng.randint(0, 32, (1, 6))], axis=1)
+        pb = np.concatenate([shared, rng.randint(0, 32, (1, 4))], axis=1)
+        eng.submit(nd.array(pa, dtype="int32"), 3)
+        for _ in range(3):
+            eng.step()  # drive A's chunked prefill to registration
+        eng.submit(nd.array(pb, dtype="int32"), 3)
+        eng.run()
+        st = eng.stats
+        print("pool         : %d pages x %d tokens, %d in use / %d "
+              "free after drain"
+              % (st["num_blocks"], st["block_size"],
+                 st["blocks_in_use"], st["blocks_free"]))
+        print("sharing      : %d prefix hit(s), %d page(s) shared now, "
+              "%d COW cop%s"
+              % (st["prefix_hits"], st["blocks_shared"],
+                 st["cow_copies"],
+                 "y" if st["cow_copies"] == 1 else "ies"))
+        print("traffic      : %d step(s), %d token(s), %d quarantined, "
+              "%d shed" % (st["steps"], st["tokens_generated"],
+                           st["quarantined"], st["shed"]))
+        healthy = (st["prefix_hits"] >= 1 and st["cow_copies"] >= 1
+                   and st["blocks_in_use"] == 0)
+        print("probe        :", "ok (prefix hit + COW + clean drain)"
+              if healthy else "UNEXPECTED counters %r" % (st,))
+    except Exception as e:
+        print("serving      : FAILED (%s: %s)" % (type(e).__name__, e))
+
+
 def check_resilience():
     """Exercise the fault-injection + retry machinery once (injected
     clock/sleep — no real waiting) and print the process-wide resilience
@@ -257,6 +315,7 @@ def main():
     check_libraries()
     check_environment()
     check_mxtpu()
+    check_serving()
     check_resilience()
     check_guardian()
     check_analysis(full=full)
