@@ -622,6 +622,153 @@ def _bench_paged_decode():
     print(json.dumps(rec), flush=True)
 
 
+def _bench_speculative_decode():
+    """Speculative decoding in the pooled decode step (round-13
+    tentpole): n-gram self-drafting + batched verification vs the plain
+    pooled step on a REPETITIVE/templated workload — the regime
+    prompt-lookup drafting targets (decode is HBM-bandwidth-bound, so
+    k accepted drafts per cache read is a direct tokens/s multiplier).
+    Two metrics:
+
+    - ``accepted_tokens_per_step``: emitted tokens per pooled decode
+      iteration (1.0 exactly without speculation; every accepted draft
+      raises it).  Host-side counters over a DETERMINISTIC workload —
+      honest acceptance evidence on any platform.
+    - ``decode_tokens_per_sec_speculative``: useful tokens/sec with the
+      non-speculative engine column alongside (CPU wall clock labeled
+      NOISE-DOMINATED, per bench conventions — the counter record above
+      is the platform-independent evidence; TPU tokens/s deferred to
+      the bench battery)."""
+    import numpy as np
+    import jax
+    import mxtpu as mx
+    from mxtpu import nd
+    from mxtpu.models import transformer
+    from mxtpu.models.transformer import TransformerLM
+    from mxtpu.parallel import ContinuousBatchingEngine, make_mesh
+
+    platform = jax.devices()[0].platform
+    cpu = platform == "cpu"
+    if cpu:
+        # the pinned cycling micro model (tests/test_speculative.py):
+        # greedy continuations fall into short cycles, so prompt-lookup
+        # accepts are a deterministic property of the workload, not luck
+        mx.random.seed(1)
+        lm = TransformerLM(20, units=32, hidden_size=64, num_layers=1,
+                           num_heads=4, num_kv_heads=2)
+        slots, n_req, max_len, vocab, spec_k = 4, 12, 64, 20, 3
+        glo, ghi = 12, 24
+    else:
+        mx.random.seed(1)
+        lm = transformer.llama_3_8b(vocab_size=32000, width_factor=0.25,
+                                    depth_factor=0.25)
+        slots, n_req, max_len, vocab, spec_k = 8, 16, 256, 32000, 3
+        glo, ghi = 24, 64
+    lm.initialize()
+    mesh = make_mesh(dp=1)
+    rules = transformer.transformer_lm_sharding_rules()
+
+    R = np.random.RandomState(0)
+    # templated prompts: short patterns tiled — the repetition structure
+    # the n-gram lookup exploits
+    prompts = []
+    for _ in range(n_req):
+        pat = R.randint(0, vocab, (1, int(R.randint(3, 6))))
+        prompts.append(nd.array(
+            np.tile(pat, int(R.randint(3, 5)))[:, :max_len // 2]
+            .astype(np.int32)))
+    news = R.randint(glo, ghi + 1, n_req).tolist()
+    useful = float(sum(news))
+
+    from mxtpu.analysis import get_ledger
+    _led = get_ledger()
+    _verify_before = sum(_led.miss_counts(
+        ("serving.verify_slots",)).values())
+
+    spec = ContinuousBatchingEngine(lm, mesh, rules, num_slots=slots,
+                                    max_length=max_len, spec_k=spec_k)
+    plain = ContinuousBatchingEngine(lm, mesh, rules, num_slots=slots,
+                                     max_length=max_len)
+
+    def drive(eng):
+        t0 = time.perf_counter()
+        for p, n in zip(prompts, news):
+            eng.submit(p, n)
+        eng.run()
+        return time.perf_counter() - t0
+
+    drive(spec)                    # compile warmup
+    s0 = spec.stats
+    spec_dt = drive(spec)
+    s1 = spec.stats
+    drive(plain)                   # compile warmup
+    plain_dt = drive(plain)
+
+    slot_iters = s1["slot_iterations"] - s0["slot_iterations"]
+    toks = s1["tokens_generated"] - s0["tokens_generated"]
+    drafted = s1["drafted_tokens"] - s0["drafted_tokens"]
+    accepted = s1["accepted_tokens"] - s0["accepted_tokens"]
+    cfg = {"num_slots": slots, "requests": n_req, "spec_k": spec_k,
+           "new_tokens": [glo, ghi], "max_length": max_len,
+           "workload": "tiled 3-5 token patterns (templated)"}
+    rec = {
+        "metric": "accepted_tokens_per_step",
+        # per SLOT-iteration (one slot's share of one pooled call) —
+        # the per-cache-read multiplier: non-speculative decode is 1.0
+        # exactly, every accepted draft raises it
+        "value": round(toks / max(slot_iters, 1), 3),
+        "unit": "tokens/slot-iteration",
+        "vs_baseline": 1.0,
+        "platform": platform,
+        "drafted_tokens": drafted,
+        "accepted_tokens": accepted,
+        "draft_hit_rate": round(accepted / drafted, 3) if drafted
+        else 0.0,
+        "verify_calls": s1["verify_calls"] - s0["verify_calls"],
+        "pooled_tokens_per_iteration": round(
+            toks / max(s1["steps"] - s0["steps"], 1), 3),
+        "config": cfg,
+        "baseline_note": "non-speculative decode emits exactly 1.0 "
+                         "token per slot-iteration by construction; "
+                         "value is a deterministic host-side counter "
+                         "(timer-free), honest on any platform — every "
+                         "stream stays bit-identical to "
+                         "non-speculative decode",
+    }
+    if cpu:
+        rec["config_note"] = ("CPU fallback runs the LABELED pinned "
+                              "cycling micro model — acceptance "
+                              "evidence, NOT a TPU serving number")
+    print(json.dumps(rec), flush=True)
+
+    rec = {
+        "metric": "decode_tokens_per_sec_speculative",
+        "value": round(useful / spec_dt, 2),
+        "unit": "tokens/sec",
+        "vs_baseline": None,
+        "platform": platform,
+        "non_speculative_tokens_per_sec": round(useful / plain_dt, 2),
+        "speedup_vs_non_speculative": round(plain_dt / spec_dt, 3),
+        # verify-program family compiled over warmup+timed: the number
+        # the pow2 window ladder bounds (<= |ladder|)
+        "compiled_program_count": sum(_led.miss_counts(
+            ("serving.verify_slots",)).values()) - _verify_before,
+        "config": cfg,
+        "baseline_note": "no upstream analogue; comparison column is "
+                         "this repo's own non-speculative slot engine "
+                         "on the identical templated workload",
+    }
+    if cpu:
+        rec["config_note"] = ("CPU wall-clock comparison is "
+                              "NOISE-DOMINATED on the oversubscribed "
+                              "host (speculation trades compute for "
+                              "HBM reads — a win the CPU backend "
+                              "cannot show); accepted_tokens_per_step "
+                              "above is the deterministic evidence, "
+                              "TPU tokens/s when the tunnel heals")
+    print(json.dumps(rec), flush=True)
+
+
 def _bench_analysis():
     """Static-analysis wall time (round-11 tentpole: compile-discipline
     and device-memory static analysis).  Times every pass the repo
@@ -919,6 +1066,7 @@ def _child_main():
     _bench_attention()
     _bench_continuous_decode()
     _bench_paged_decode()
+    _bench_speculative_decode()
 
 
 def _probe_main():

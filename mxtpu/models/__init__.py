@@ -18,5 +18,5 @@ from .transformer import (MultiHeadAttention, TransformerEncoderLayer,
 from . import moe
 from .moe import SwitchMoE, MoEDecoderLayer, moe_sharding_rules
 from . import sampler
-from .sampler import (BeamSearchSampler, SequenceSampler,
+from .sampler import (BeamSearchSampler, NGramDrafter, SequenceSampler,
                       beam_search)

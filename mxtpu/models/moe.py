@@ -183,6 +183,32 @@ class MoEDecoderLayer(HybridBlock):
         return x + self.moe.decode_forward(self.ffn_norm(x)), \
             cache_k, cache_v
 
+    def verify_slots(self, x, cache_k, cache_v, pos, valid_len):
+        """Speculative verification window (W candidate tokens per row;
+        see Attention.verify_slots).  The routed FFN runs
+        capacity-unbounded like step_slots — BUT the unbounded capacity
+        NUMBER is a function of the window batch (S = B*W tokens), so a
+        W-token window is not guaranteed to route bit-identically to W
+        sequential one-token steps.  The serving engines therefore opt
+        MoE blocks OUT of speculation automatically (the same caveat
+        class as prefix sharing / prefill bucketing); this method exists
+        for parity experiments and future capacity-pinned routing."""
+        h, cache_k, cache_v = self.attn.verify_slots(
+            self.attn_norm(x), cache_k, cache_v, pos, valid_len)
+        x = x + h
+        return x + self.moe.decode_forward(self.ffn_norm(x)), \
+            cache_k, cache_v
+
+    def verify_pages(self, x, pool_k, pool_v, tables, pos, valid_len):
+        """Block-paged speculative verification window (see
+        verify_slots for the MoE routing caveat — the serving engines
+        opt MoE blocks out of speculation)."""
+        h, pool_k, pool_v = self.attn.verify_pages(
+            self.attn_norm(x), pool_k, pool_v, tables, pos, valid_len)
+        x = x + h
+        return x + self.moe.decode_forward(self.ffn_norm(x)), \
+            pool_k, pool_v
+
     def prefill(self, x, cache_k, cache_v, start_pos=0, total_len=None):
         """Chunked prompt ingestion (see Attention.prefill).  The routed
         FFN uses the TRAINING capacity budgeted from the FULL prompt

@@ -16,8 +16,8 @@ import numpy as onp
 from .. import ndarray as nd
 from ..ndarray import NDArray
 
-__all__ = ["BeamSearchSampler", "SequenceSampler", "beam_search",
-           "sample_next_token"]
+__all__ = ["BeamSearchSampler", "NGramDrafter", "SequenceSampler",
+           "beam_search", "sample_next_token"]
 
 _NEG_INF = -1e30
 
@@ -126,6 +126,58 @@ def sample_next_token(logits, key, temperature=1.0, top_k=0, top_p=0.0,
     if active_mask is not None:
         out = jnp.where(jnp.asarray(active_mask, bool), out, 0)
     return out
+
+
+class NGramDrafter:
+    """Host-side self-drafter for speculative decoding: n-gram /
+    prompt-lookup proposals (prompt-lookup decoding / PLD lineage — no
+    draft model, no extra weights, no extra HBM).
+
+    Given a request's own token history (prompt + everything emitted so
+    far), ``propose`` finds the MOST RECENT prior occurrence of the
+    longest trailing n-gram (``max_ngram`` down to ``min_ngram``) and
+    proposes the tokens that followed it.  Repetitive / templated text
+    — code, structured output, retrieval-augmented prompts — makes such
+    continuations likely to be accepted by the batched verification
+    step, turning k cache reads into one.
+
+    Fully DETERMINISTIC: proposals are a pure function of (history, k),
+    so fault-plan replays and seeded reruns reproduce drafts
+    bit-for-bit.  Proposals are always copied from the history, so they
+    are valid vocabulary ids by construction.  The CALLER clamps ``k``
+    to its cache extent (the serving engines clamp at the slot /
+    page-chain budget so a window can never outrun its allocation).
+    """
+
+    def __init__(self, max_ngram=3, min_ngram=1):
+        if min_ngram < 1 or max_ngram < min_ngram:
+            raise ValueError(
+                "NGramDrafter needs 1 <= min_ngram <= max_ngram, got "
+                "min=%d max=%d" % (min_ngram, max_ngram))
+        self._max = int(max_ngram)
+        self._min = int(min_ngram)
+
+    def propose(self, history, k):
+        """Up to ``k`` drafted continuation tokens of ``history`` (a
+        1-D int sequence), or ``[]`` when k <= 0 or no prior n-gram
+        match exists (empty / too-short history included).  Longest
+        trailing n-gram wins; among equal-length matches, the most
+        recent occurrence wins — both choices are what makes the
+        proposal deterministic AND what tracks the local repetition
+        structure the lookup exploits."""
+        k = int(k)
+        H = [int(t) for t in history]
+        L = len(H)
+        if k <= 0 or L < 2:
+            return []
+        for n in range(min(self._max, L - 1), self._min - 1, -1):
+            pat = H[L - n:]
+            # most recent occurrence strictly before the trailing one
+            # (i + n <= L-1, so at least one continuation token exists)
+            for i in range(L - n - 1, -1, -1):
+                if H[i:i + n] == pat:
+                    return H[i + n:i + n + k]
+        return []
 
 
 class BeamSearchSampler:

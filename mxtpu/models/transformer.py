@@ -205,6 +205,100 @@ class MultiHeadAttention(HybridBlock):
         out = out.reshape(B, 1, H * D)
         return self.out_proj(out), cache_k, cache_v
 
+    def verify_slots(self, x, cache_k, cache_v, pos, valid_len):
+        """Batched speculative verification: x (B, W, C) is a window of
+        W candidate tokens per row — the last sampled token followed by
+        W-1 drafts — with row b's window starting at its own cache
+        position ``pos[b]``.  All W positions' K/V are written in one
+        scatter (first ``valid_len[b]`` lanes; the rest drop — see
+        _internal_cache_write_span) and all W queries attend the cache
+        in ONE read: query w of row b sees positions <= pos[b]+w.  By
+        construction this is step_slots() run W times with the loop
+        folded into the batch axis — same projections, same masked
+        softmax extent per query, same GQA fold — so the logits at
+        window index w are bit-identical to the sequential step's
+        (probe-verified on this XLA build; asserted stream-level in
+        tests/test_speculative.py).  Rejected lanes simply roll the
+        host position back: their writes sit beyond every validity
+        mask until sequential re-writes overtake them."""
+        B, W, _ = x.shape
+        H, KV, D = self._heads, self._kv_heads, self._head_dim
+        Tmax = cache_k.shape[2]
+        qkv = self.qkv(x)  # (B, W, (H+2KV)*D)
+        q = qkv[:, :, :H * D].reshape(B, W, H, D).transpose((0, 2, 1, 3))
+        k = qkv[:, :, H * D:(H + KV) * D].reshape(
+            B, W, KV, D).transpose((0, 2, 1, 3))
+        v = qkv[:, :, (H + KV) * D:].reshape(
+            B, W, KV, D).transpose((0, 2, 1, 3))
+        if self._rotary:
+            q = nd.rope(q, offset=pos)  # (B,) offset + intra-window arange
+            k = nd.rope(k, offset=pos)
+        cache_k = nd._internal_cache_write_span(cache_k, k, pos=pos,
+                                                valid_len=valid_len)
+        cache_v = nd._internal_cache_write_span(cache_v, v, pos=pos,
+                                                valid_len=valid_len)
+        # the step_slots GQA fold with W queries; validity is per-row
+        # AND per-window-index: query w sees keys <= pos[b]+w
+        rep = H // KV
+        q_r = q.reshape(B * KV, rep * W, D)
+        keys = cache_k.reshape(B * KV, Tmax, D)
+        values = cache_v.reshape(B * KV, Tmax, D)
+        scores = nd.batch_dot(q_r, keys,
+                              transpose_b=True) / math.sqrt(D)
+        valid = (nd.arange(0, Tmax).reshape((1, 1, Tmax))
+                 <= (pos.reshape((B, 1)) + nd.arange(0, W).reshape(
+                     (1, W))).reshape((B, W, 1)))  # (B, W, Tmax)
+        attn = nd.masked_softmax(
+            scores.reshape(B, KV, rep, W, Tmax),
+            mask=valid.reshape((B, 1, 1, W, Tmax)).astype("bool"))
+        out = nd.batch_dot(attn.reshape(B * KV, rep * W, Tmax), values)
+        out = out.reshape(B, KV, rep, W, D).transpose(
+            (0, 3, 1, 2, 4)).reshape(B, W, H * D)
+        return self.out_proj(out), cache_k, cache_v
+
+    def verify_pages(self, x, pool_k, pool_v, tables, pos, valid_len):
+        """Batched speculative verification over the BLOCK-PAGED pool —
+        verify_slots() with the cache read/write routed through the
+        per-row block tables (gather into sequence order, then exactly
+        the same math on the same shapes).  Invalid window lanes write
+        the null page; rejected lanes need only a host position
+        roll-back, never a page operation (every page the window can
+        touch was allocated at admission)."""
+        B, W, _ = x.shape
+        H, KV, D = self._heads, self._kv_heads, self._head_dim
+        Tmax = tables.shape[1] * pool_k.shape[2]
+        qkv = self.qkv(x)
+        q = qkv[:, :, :H * D].reshape(B, W, H, D).transpose((0, 2, 1, 3))
+        k = qkv[:, :, H * D:(H + KV) * D].reshape(
+            B, W, KV, D).transpose((0, 2, 1, 3))
+        v = qkv[:, :, (H + KV) * D:].reshape(
+            B, W, KV, D).transpose((0, 2, 1, 3))
+        if self._rotary:
+            q = nd.rope(q, offset=pos)
+            k = nd.rope(k, offset=pos)
+        pool_k = nd._paged_cache_write_span(pool_k, k, tables, pos=pos,
+                                            valid_len=valid_len)
+        pool_v = nd._paged_cache_write_span(pool_v, v, tables, pos=pos,
+                                            valid_len=valid_len)
+        keys = nd._paged_cache_gather(pool_k, tables).reshape(
+            B * KV, Tmax, D)
+        values = nd._paged_cache_gather(pool_v, tables).reshape(
+            B * KV, Tmax, D)
+        rep = H // KV
+        q_r = q.reshape(B * KV, rep * W, D)
+        scores = nd.batch_dot(q_r, keys,
+                              transpose_b=True) / math.sqrt(D)
+        valid = (nd.arange(0, Tmax).reshape((1, 1, Tmax))
+                 <= (pos.reshape((B, 1)) + nd.arange(0, W).reshape(
+                     (1, W))).reshape((B, W, 1)))  # (B, W, Tmax)
+        attn = nd.masked_softmax(
+            scores.reshape(B, KV, rep, W, Tmax),
+            mask=valid.reshape((B, 1, 1, W, Tmax)).astype("bool"))
+        out = nd.batch_dot(attn.reshape(B * KV, rep * W, Tmax), values)
+        out = out.reshape(B, KV, rep, W, D).transpose(
+            (0, 3, 1, 2, 4)).reshape(B, W, H * D)
+        return self.out_proj(out), pool_k, pool_v
+
     def init_block_pool(self, num_blocks, block_size, dtype="float32"):
         """Block-paged KV cache: (num_blocks, KV_heads, block_size, D)
         per tensor — the pool the continuous-batching engine's block
@@ -509,6 +603,28 @@ class LlamaDecoderLayer(HybridBlock):
         h = self.down_proj(nd.swish(self.gate_proj(h)) * self.up_proj(h))
         return x + h, cache_k, cache_v
 
+    def verify_slots(self, x, cache_k, cache_v, pos, valid_len):
+        """Speculative verification window through this layer (W
+        candidate tokens per row at per-row positions; see
+        Attention.verify_slots).  The FFN is per-token, so the window
+        batch changes nothing."""
+        h, cache_k, cache_v = self.attn.verify_slots(
+            self.attn_norm(x), cache_k, cache_v, pos, valid_len)
+        x = x + h
+        h = self.ffn_norm(x)
+        h = self.down_proj(nd.swish(self.gate_proj(h)) * self.up_proj(h))
+        return x + h, cache_k, cache_v
+
+    def verify_pages(self, x, pool_k, pool_v, tables, pos, valid_len):
+        """Speculative verification window through the block-paged pool
+        (see Attention.verify_pages)."""
+        h, pool_k, pool_v = self.attn.verify_pages(
+            self.attn_norm(x), pool_k, pool_v, tables, pos, valid_len)
+        x = x + h
+        h = self.ffn_norm(x)
+        h = self.down_proj(nd.swish(self.gate_proj(h)) * self.up_proj(h))
+        return x + h, pool_k, pool_v
+
     def step_pages(self, x, pool_k, pool_v, tables, pos):
         """One-token decode through the block-paged pool (continuous
         batching); see Attention.step_pages."""
@@ -630,6 +746,39 @@ class TransformerLM(HybridBlock):
             x, ck, cv = layer.step_slots(x, ck, cv, pos)
             new_caches.append((ck, cv))
         return self._logits(x), new_caches
+
+    def verify_slots(self, token_ids, caches, pos, valid_len):
+        """Score a speculative window of W candidate tokens per slot in
+        ONE forward: token_ids (B, W) — row b holds its last sampled
+        token followed by up to W-1 drafted tokens, starting at cache
+        position ``pos[b]`` — → (logits (B, W, V), new_caches).  The
+        logits at window index w are bit-identical to what W sequential
+        step_slots() calls would produce at that position, which is
+        what lets the serving engine verify k drafts against ONE cache
+        read and keep per-stream output bit-exact (speculative
+        decoding).  ``valid_len`` (B,) masks each row's real window
+        extent; lanes past it (padding, inactive slots at 0) write
+        nothing.  Same functional-cache contract as step_slots()."""
+        x = self.embed(token_ids)
+        new_caches = []
+        for layer, (ck, cv) in zip(self.layers, caches):
+            x, ck, cv = layer.verify_slots(x, ck, cv, pos, valid_len)
+            new_caches.append((ck, cv))
+        return self._logits(x), new_caches
+
+    def verify_pages(self, token_ids, pools, tables, pos, valid_len):
+        """Speculative-window scoring through the block-paged pool:
+        verify_slots() with the cache traffic routed through ``tables``
+        (B, M) — see Attention.verify_pages.  Rollback on rejection is a
+        host position fix-up only: every page a window can touch was
+        allocated at admission and stays with the slot."""
+        x = self.embed(token_ids)
+        new_pools = []
+        for layer, (pk, pv) in zip(self.layers, pools):
+            x, pk, pv = layer.verify_pages(x, pk, pv, tables, pos,
+                                           valid_len)
+            new_pools.append((pk, pv))
+        return self._logits(x), new_pools
 
     def prefill(self, token_ids, caches, start_pos=0, total_len=None):
         """Ingest the whole prompt in ONE forward: token_ids (B, T) →

@@ -1078,6 +1078,29 @@ def _internal_cache_write_rows(cache, new, pos):
     return cache.at[rows, :, p, :].set(new[:, :, 0, :].astype(cache.dtype))
 
 
+@register_op("_internal_cache_write_span", differentiable=False)
+def _internal_cache_write_span(cache, new, pos, valid_len):
+    """Speculative-window KV-cache write: row b of ``new`` (B, KV, W, D)
+    lands at positions ``pos[b] .. pos[b]+W-1`` of cache row b, but only
+    its first ``valid_len[b]`` window lanes — the batched-verification
+    write of speculative decode, where every row verifies its own draft
+    window in one call.  Invalid lanes (padding past a row's drafts, and
+    whole rows with valid_len 0 — inactive pool slots) are routed to the
+    out-of-bounds position T_max, which the scatter DROPS, so they can
+    never scribble a live row.  Shapes stay static: one compiled verify
+    program per window-size bucket serves every position combination."""
+    B = cache.shape[0]
+    Tmax = cache.shape[2]
+    W = new.shape[2]
+    p = (jnp.asarray(pos, jnp.int32).reshape(-1, 1)
+         + jnp.arange(W, dtype=jnp.int32)[None, :])          # (B, W)
+    valid = (jnp.arange(W, dtype=jnp.int32)[None, :]
+             < jnp.asarray(valid_len, jnp.int32).reshape(-1, 1))
+    p = jnp.where(valid, p, Tmax)    # OOB scatter indices are dropped
+    vals = new.transpose(0, 2, 1, 3).astype(cache.dtype)     # (B, W, KV, D)
+    return cache.at[jnp.arange(B)[:, None], :, p, :].set(vals)
+
+
 @register_op("_internal_cache_write_slot", differentiable=False)
 def _internal_cache_write_slot(cache, new, slot=0, pos=0):
     """Write a single sequence's cache block ``new`` (1, KV, T, D) into
@@ -1151,6 +1174,32 @@ def _paged_cache_write_rows(pool, new, tables, pos):
     rows = jnp.arange(t.shape[0])
     blk, off = t[rows, p // bs], p % bs
     return pool.at[blk, :, off, :].set(new[:, :, 0, :].astype(pool.dtype))
+
+
+@register_op("_paged_cache_write_span", differentiable=False)
+def _paged_cache_write_span(pool, new, tables, pos, valid_len):
+    """Speculative-window write through the block tables: row b of
+    ``new`` (B, KV, W, D) lands at logical positions ``pos[b] ..
+    pos[b]+W-1`` of the sequence described by ``tables[b]``, first
+    ``valid_len[b]`` lanes only.  Invalid lanes — window padding past a
+    row's drafts, rows with valid_len 0, and any position whose page
+    index would fall off the table — are routed to the reserved null
+    page 0, which absorbs garbage by design (mxtpu.parallel.paging).
+    Valid lanes of distinct live rows own disjoint pages (allocator
+    invariant), so the scatter is conflict-free where it matters."""
+    t = tables.astype(jnp.int32)                             # (B, M)
+    bs = pool.shape[2]
+    M = t.shape[1]
+    W = new.shape[2]
+    p = (jnp.asarray(pos, jnp.int32).reshape(-1, 1)
+         + jnp.arange(W, dtype=jnp.int32)[None, :])          # (B, W)
+    valid = (jnp.arange(W, dtype=jnp.int32)[None, :]
+             < jnp.asarray(valid_len, jnp.int32).reshape(-1, 1))
+    blk = jnp.take_along_axis(t, jnp.clip(p // bs, 0, M - 1), axis=1)
+    blk = jnp.where(valid & (p // bs < M), blk, 0)
+    off = p % bs
+    vals = new.transpose(0, 2, 1, 3).astype(pool.dtype)      # (B, W, KV, D)
+    return pool.at[blk, :, off, :].set(vals)
 
 
 @register_op("_paged_block_copy", differentiable=False)

@@ -210,6 +210,25 @@ class ShardedDecoder:
                                               NDArray(slot))
 
     @staticmethod
+    def _verify_slots_body(block, caches, tokens, pos, valid_len):
+        """Pooled speculative verification: ``tokens`` (B, W) is each
+        row's candidate window (last sampled token + drafts) at traced
+        per-row start positions — ONE compiled program per window-size
+        bucket scores every draft position against the cache in one
+        read (see TransformerLM.verify_slots)."""
+        return block.verify_slots(NDArray(tokens), caches, NDArray(pos),
+                                  NDArray(valid_len))
+
+    @staticmethod
+    def _verify_pages_body(block, caches, tokens, tables, pos,
+                           valid_len):
+        """Block-paged speculative verification (traced tables +
+        per-row positions; see TransformerLM.verify_pages)."""
+        return block.verify_pages(NDArray(tokens), caches,
+                                  NDArray(tables), NDArray(pos),
+                                  NDArray(valid_len))
+
+    @staticmethod
     def _step_pages_body(block, caches, token, tables, pos):
         """Block-paged pool decode step: ``tables`` (B, M) block tables
         and ``pos`` (B,) positions are both traced — ONE compiled
@@ -298,6 +317,43 @@ class ShardedDecoder:
         param_leaves = tuple(p.data()._data for p in self._params)
         return self._jit_cache[key](param_leaves, cache_leaves, tokens,
                                     slot)
+
+    def _verify_slots_jitted(self, cache_leaves, tokens, pos, valid_len):
+        """Speculative verify step over the slot pool: the window width
+        W in ``tokens`` (B, W) comes from the engine's power-of-two
+        ladder, so this site compiles at most |ladder| programs — the
+        bounded family the compile discipline allows (C004, never
+        C001)."""
+        key = ("verify_slots",
+               tuple(ck.shape for ck, _ in cache_leaves),
+               cache_leaves[0][0].dtype, tokens.shape, tokens.dtype)
+        hit = key in self._jit_cache
+        self._ledger_report("verify_slots", cache_leaves, (tokens,), hit)
+        if not hit:
+            self._jit_cache[key] = self._build_program(
+                self._verify_slots_body, len(cache_leaves),
+                n_extra_inputs=3)
+        param_leaves = tuple(p.data()._data for p in self._params)
+        return self._jit_cache[key](param_leaves, cache_leaves, tokens,
+                                    pos, valid_len)
+
+    def _verify_pages_jitted(self, cache_leaves, tokens, tables, pos,
+                             valid_len):
+        """Block-paged speculative verify step (same bounded
+        window-ladder family as _verify_slots_jitted)."""
+        key = ("verify_pages",
+               tuple(ck.shape for ck, _ in cache_leaves),
+               cache_leaves[0][0].dtype, tokens.shape, tokens.dtype,
+               tables.shape)
+        hit = key in self._jit_cache
+        self._ledger_report("verify_pages", cache_leaves, (tokens,), hit)
+        if not hit:
+            self._jit_cache[key] = self._build_program(
+                self._verify_pages_body, len(cache_leaves),
+                n_extra_inputs=4)
+        param_leaves = tuple(p.data()._data for p in self._params)
+        return self._jit_cache[key](param_leaves, cache_leaves, tokens,
+                                    tables, pos, valid_len)
 
     def _step_pages_jitted(self, cache_leaves, token, tables, pos):
         key = ("step_pages", tuple(ck.shape for ck, _ in cache_leaves),

@@ -8,11 +8,22 @@ ordered, allocation order is a function of the request sequence alone,
 and no clock or randomness is consulted, so fault-plan replays
 (docs/resilience.md) reproduce block assignments bit-for-bit.
 
-Page 0 is the NULL page: never allocated, it absorbs the writes of
-dead/prefilling pool lanes (which flow through the fixed-shape compiled
-step with garbage tokens) and pads every table's tail.  Null-page
-contents are garbage by design; every position that could gather them
-sits beyond some request's validity mask.
+Page 0 is the NULL page (:data:`NULL_PAGE`): never allocated, it
+absorbs the writes of dead/prefilling pool lanes (which flow through
+the fixed-shape compiled step with garbage tokens), pads every table's
+tail, and soaks up the invalid window lanes of speculative
+verification (`_paged_cache_write_span`).  Null-page contents are
+garbage by design; every position that could gather them sits beyond
+some request's validity mask.
+
+Speculative decoding invariant (docs/inference.md): a verify window is
+clamped to the slot's allocated page chain, so its writes only ever
+touch pages the slot already owns — and only DECODE-region pages
+(positions >= the prompt length), which are never registered in the
+prefix index and never shared.  A rejected draft therefore needs no
+page operation at all: the host position rolls back and the stale rows
+are overwritten by sequential writes before any validity mask can
+reach them.
 
 The prefix index shares only IMMUTABLE pages: a page is registered once
 the prompt tokens covering it are fully written and the owning request
@@ -31,7 +42,11 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 from ..base import MXTPUError
 
-__all__ = ["BlockPool", "BlockPoolExhausted", "PrefixIndex"]
+__all__ = ["BlockPool", "BlockPoolExhausted", "NULL_PAGE",
+           "PrefixIndex"]
+
+#: the reserved garbage-absorbing page id (module docstring)
+NULL_PAGE = 0
 
 
 class BlockPoolExhausted(MXTPUError):

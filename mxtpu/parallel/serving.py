@@ -34,6 +34,35 @@ only its own [0, pos] prefix, so every request's token stream is
 IDENTICAL to an isolated ``ShardedDecoder.generate`` call with the same
 seed (asserted in tests/test_serving.py).
 
+Speculative decoding (``spec_k > 0``; docs/inference.md): decode is
+HBM-bandwidth-bound, so verifying k drafted tokens against the KV cache
+in ONE compiled call is a direct tokens/s multiplier.  A host-side
+n-gram / prompt-lookup drafter (``models.sampler.NGramDrafter`` — no
+extra weights, no extra HBM) proposes up to ``spec_k`` tokens per slot
+from the request's own prompt+output history; one pooled
+``TransformerLM.verify_slots`` / ``verify_pages`` program scores every
+row's window in one cache read and the engine accepts the longest
+prefix whose candidates equal what sequential decode would have
+emitted.  Parity is preserved EXACTLY: the emitted token at each
+position is computed from that position's logits with the same
+greedy/penalty rule — or the same per-slot RNG key (keys are PEEKED
+for the whole window and the stream advanced by only the tokens
+actually emitted) — as the non-speculative path, so every stream stays
+bit-identical to its isolated ``ShardedDecoder.generate`` reference;
+rejection merely bounds how many positions one call may emit.  Window
+sizes come from a power-of-two ladder, so the verify program family is
+bounded (|ladder| programs, C004-bucketed).  Rejected lanes roll the
+host position back; their cache writes sit beyond every validity mask
+until sequential re-writes overtake them (for the paged engine the
+pages past the accept point stay with the slot — rollback never
+touches the allocator).  An optional small draft model
+(``draft_block=``) rides the same verify program with greedy pooled
+drafting over its own slot-cache pool.  MoE blocks opt OUT of
+speculation automatically (unbounded decode-routing capacity is a
+function of the window batch — the same caveat class as prefix
+sharing).  New fault sites ``serving.draft`` / ``serving.verify``
+quarantine only the offending slot, like ``serving.step``.
+
 Failure paths (docs/resilience.md): a host-side exception in a
 per-slot path — admission prefill, the ``serving.step`` /
 ``serving.admit`` fault-injection sites, the per-slot eos check —
@@ -70,7 +99,7 @@ from ..resilience.counters import bump as _bump
 from ..resilience.faults import inject as _inject
 from .decode import ShardedDecoder, _bucket
 from .mesh import DeviceMesh
-from .paging import BlockPool, PrefixIndex
+from .paging import NULL_PAGE, BlockPool, PrefixIndex
 from .sharding import ShardingRules
 
 __all__ = ["ContinuousBatchingEngine", "PagedContinuousBatchingEngine",
@@ -82,11 +111,12 @@ class Request:
 
     __slots__ = ("rid", "prompt", "max_new_tokens", "temperature",
                  "top_k", "top_p", "repetition_penalty", "seed",
-                 "eos_id", "deadline_at", "retries_left")
+                 "eos_id", "deadline_at", "retries_left", "speculative")
 
     def __init__(self, rid, prompt, max_new_tokens, temperature=0.0,
                  top_k=0, top_p=0.0, repetition_penalty=1.0, seed=None,
-                 eos_id=None, deadline_at=None, retries=0):
+                 eos_id=None, deadline_at=None, retries=0,
+                 speculative=None):
         self.rid = rid
         self.prompt = prompt            # (1, Tp) int32 numpy
         self.max_new_tokens = int(max_new_tokens)
@@ -98,6 +128,7 @@ class Request:
         self.eos_id = eos_id
         self.deadline_at = deadline_at  # absolute clock() value or None
         self.retries_left = int(retries)
+        self.speculative = speculative  # None = engine default
 
     @property
     def sampled(self):
@@ -122,14 +153,32 @@ def _slot_keys(seed):
     return _random._KeyRing(int(seed))
 
 
+class _SpecTokens:
+    """One speculative iteration's emitted tokens for ONE slot (host
+    int array, >= 1 long) — the per-slot entry form of ``_Slot.emitted``
+    for verify iterations.  Plain-step iterations keep appending the
+    pooled (B,) device vector (the deferred-materialization fast path);
+    ``_finish`` handles both."""
+
+    __slots__ = ("toks",)
+
+    def __init__(self, toks):
+        self.toks = toks
+
+
 class _Slot:
     """Host-side state of one cache row.  ``emitted`` holds references
     to the pool-wide (B,) token vector of each iteration — row ``row``
     is this slot's token; materializing per-slot streams is deferred to
     finish time so the steady-state loop dispatches O(1) host ops per
-    iteration, not O(slots)."""
+    iteration, not O(slots).  Speculative slots additionally carry a
+    host mirror of their token ``history`` (prompt + emitted — what the
+    n-gram drafter proposes from) and append :class:`_SpecTokens`
+    entries on verify iterations; ``n_emitted`` counts emitted tokens
+    across both entry forms."""
 
-    __slots__ = ("req", "row", "pos", "emitted", "keys")
+    __slots__ = ("req", "row", "pos", "emitted", "keys", "history",
+                 "n_emitted")
 
     def __init__(self, req, row, pos, first_tokens, keys):
         self.req = req
@@ -138,6 +187,8 @@ class _Slot:
         #                            token (the next step writes here)
         self.emitted = [first_tokens]  # list of (B,) device vectors
         self.keys = keys
+        self.history = None        # host ints; set when speculating
+        self.n_emitted = 1
 
 
 class ContinuousBatchingEngine:
@@ -155,6 +206,18 @@ class ContinuousBatchingEngine:
     bucket_prefill : right-pad prompts to power-of-two buckets so mixed
         prompt lengths share a handful of compiled slot-prefills
         (disabled automatically for MoE blocks, same as ShardedDecoder).
+    spec_k : maximum drafted tokens per slot per iteration (0 = no
+        speculation, the default).  With spec_k > 0 the engine
+        self-drafts with an n-gram prompt-lookup drafter and verifies
+        each slot's window in one pooled compiled call — every stream
+        stays bit-identical to its non-speculative reference (module
+        docstring).  Disabled automatically for MoE blocks.
+    spec_ngram : longest n-gram the self-drafter matches (>= 1).
+    draft_block : optional small TransformerLM-like DENSE draft model;
+        proposals come from pooled greedy decode over its own slot-cache
+        pool instead of the n-gram lookup (the verify side is
+        identical).  Requires spec_k >= 1.
+    draft_rules : ShardingRules for the draft model (default: ``rules``).
     """
 
     def __init__(self, block, mesh: DeviceMesh,
@@ -164,7 +227,9 @@ class ContinuousBatchingEngine:
                  cache_spec: P = P(None, "tp", None, None),
                  bucket_prefill: bool = True,
                  max_pending: Optional[int] = None, clock=None,
-                 history: int = 1024):
+                 history: int = 1024, spec_k: int = 0,
+                 spec_ngram: int = 3, draft_block=None,
+                 draft_rules: Optional[ShardingRules] = None):
         self._dec = ShardedDecoder(block, mesh, rules, cache_spec,
                                    bucket_prefill)
         self._block = block
@@ -197,6 +262,50 @@ class ContinuousBatchingEngine:
         self._retries = 0
         self._deadline_evictions = 0
         self._shed = 0
+        # -- speculative decoding (docs/inference.md) --------------------
+        if spec_k < 0:
+            raise ValueError("spec_k must be >= 0, got %d" % spec_k)
+        self._spec_k = int(spec_k)
+        # MoE decode routing capacity is a function of the window batch,
+        # so a W-token window is not routing-parity-safe — same opt-out
+        # class as prefix sharing / prefill bucketing
+        self._spec_on = self._spec_k > 0 and not self._dec._block_has_moe()
+        self._drafter = None
+        if self._spec_on and draft_block is None:
+            from ..models.sampler import NGramDrafter
+            self._drafter = NGramDrafter(max_ngram=spec_ngram)
+        self._draft_block = draft_block
+        self._draft_dec = None
+        self._draft_pool = None
+        if draft_block is not None:
+            if self._spec_k < 1:
+                raise ValueError(
+                    "draft_block needs spec_k >= 1 (it bounds the "
+                    "drafted window)")
+            if not self._spec_on:
+                # self-drafting silently opts out for MoE targets, but
+                # an EXPLICIT draft model is a configuration the user
+                # asked for — fail loudly instead of no-op'ing
+                raise ValueError(
+                    "draft_block speculation is unsupported for MoE "
+                    "target blocks: their decode routing is not "
+                    "window-parity-safe, so MoE targets opt out of "
+                    "speculation entirely (docs/inference.md)")
+            ddec = ShardedDecoder(draft_block, mesh,
+                                  draft_rules or rules, cache_spec,
+                                  bucket_prefill)
+            if ddec._block_has_moe():
+                raise ValueError(
+                    "draft_block must be a dense block: MoE decode "
+                    "routing is not window-parity-safe (the same "
+                    "reason MoE targets opt out of speculation)")
+            self._draft_dec = ddec
+        self._drafted_tokens = 0
+        self._accepted_tokens = 0
+        self._verify_calls = 0
+        self._slot_iterations = 0   # slot-participations in decode
+        #                             calls: tokens/slot_iterations is
+        #                             the per-cache-read multiplier
 
     # -- introspection ---------------------------------------------------
     @property
@@ -223,6 +332,13 @@ class ContinuousBatchingEngine:
                 "retries": self._retries,
                 "deadline_evictions": self._deadline_evictions,
                 "shed": self._shed,
+                "drafted_tokens": self._drafted_tokens,
+                "accepted_tokens": self._accepted_tokens,
+                "slot_iterations": self._slot_iterations,
+                "draft_hit_rate": (
+                    self._accepted_tokens / self._drafted_tokens
+                    if self._drafted_tokens else 0.0),
+                "verify_calls": self._verify_calls,
                 "compiled_programs": sorted(
                     k[0] for k in self._dec._jit_cache)}
 
@@ -241,7 +357,8 @@ class ContinuousBatchingEngine:
     # -- request intake --------------------------------------------------
     def submit(self, prompt_ids, max_new_tokens, temperature=0.0,
                top_k=0, top_p=0.0, repetition_penalty=1.0, seed=None,
-               eos_id=None, deadline_s=None, retries=0) -> int:
+               eos_id=None, deadline_s=None, retries=0,
+               speculative=None) -> int:
         """Queue one request; returns its id.  Sampling knobs follow the
         ``generate`` contract (temperature=0 greedy; seed reproduces).
 
@@ -251,7 +368,11 @@ class ContinuousBatchingEngine:
         how many times a quarantined (step/admission-failed) request is
         re-queued and restarted from scratch before it is marked
         ``"failed"`` — a restart is bit-identical to a fresh submit
-        (per-slot RNG streams re-derive from the seed)."""
+        (per-slot RNG streams re-derive from the seed).
+        ``speculative``: per-request opt-out (False) from a
+        speculation-enabled engine, or the engine default (None); the
+        output is bit-identical either way — speculation only changes
+        how many positions one iteration may emit."""
         prompt_ids = prompt_ids if isinstance(prompt_ids, NDArray) \
             else nd_array(prompt_ids)
         if prompt_ids.ndim != 2 or prompt_ids.shape[0] != 1:
@@ -281,13 +402,14 @@ class ContinuousBatchingEngine:
         self._queue.append(Request(
             rid, prompt, max_new_tokens, temperature, top_k, top_p,
             repetition_penalty, seed, eos_id, deadline_at=deadline_at,
-            retries=retries))
+            retries=retries, speculative=speculative))
         self._status[rid] = "queued"
         return rid
 
     # -- pool plumbing ---------------------------------------------------
     def _ensure_pool(self, sample_prompt):
         self._dec._ensure_staged(sample_prompt)
+        self._ensure_draft_pool(sample_prompt)
         if self._pool is not None:
             return
         jm = self._mesh.jax_mesh
@@ -298,15 +420,46 @@ class ContinuousBatchingEngine:
             for ck, cv in self._block.init_cache(
                 self._num_slots, self._max_length, self._cache_dtype))
 
+    def _ensure_draft_pool(self, sample_prompt):
+        """Stage the optional draft model and allocate its own slot
+        pool (same rows/length as the target pool — the draft cache
+        mirrors the target row/position-wise, which is what makes
+        rollback a shared host position fix-up)."""
+        if self._draft_dec is None or self._draft_pool is not None:
+            return
+        self._draft_dec._ensure_staged(sample_prompt)
+        jm = self._mesh.jax_mesh
+        dsh = NamedSharding(jm, self._draft_dec._cache_spec)
+        self._draft_pool = tuple(
+            (jax.device_put(ck._data, dsh),
+             jax.device_put(cv._data, dsh))
+            for ck, cv in self._draft_block.init_cache(
+                self._num_slots, self._max_length, self._cache_dtype))
+
     def _ensure_seen(self, vocab):
         if self._seen is None or self._seen.shape[-1] != vocab:
             self._seen = jnp.zeros((self._num_slots, vocab), bool)
 
     # -- admission -------------------------------------------------------
+    @staticmethod
+    def _emitted_count(emitted):
+        """Token count of an ``emitted`` list (mixed pooled-vector /
+        _SpecTokens entries)."""
+        return sum(len(e.toks) if isinstance(e, _SpecTokens) else 1
+                   for e in emitted or [])
+
     def _finish(self, slot_idx_or_none, req, emitted, row, status="ok"):
         prompt = jnp.asarray(req.prompt, jnp.int32)
-        if emitted:
+        if emitted and not any(isinstance(e, _SpecTokens)
+                               for e in emitted):
+            # fast path: every entry is a pooled (B,) vector
             toks = jnp.stack(emitted)[:, row].reshape(1, -1)
+            out = jnp.concatenate([prompt, toks], axis=1)
+        elif emitted:
+            parts = [e.toks.reshape(-1) if isinstance(e, _SpecTokens)
+                     else e[row].reshape(1) for e in emitted]
+            toks = jnp.concatenate(
+                [jnp.asarray(p, jnp.int32) for p in parts]).reshape(1, -1)
             out = jnp.concatenate([prompt, toks], axis=1)
         else:
             out = prompt
@@ -347,7 +500,7 @@ class ContinuousBatchingEngine:
         request has retries left (a from-scratch restart — bit-identical
         to a fresh submit), else finish it with status ``failed`` and
         its partial output."""
-        self._record_error(req, exc, site, len(emitted or []))
+        self._record_error(req, exc, site, self._emitted_count(emitted))
         if req.retries_left > 0:
             req.retries_left -= 1
             self._retries += 1
@@ -451,23 +604,368 @@ class ContinuousBatchingEngine:
         if self._slot_done(slot):
             self._finish(None, req, slot.emitted, slot_idx)
             return
+        # arm BEFORE occupying: a failed admission (incl. a draft-pool
+        # prefill fault) must never leave the slot assigned
+        self._arm_speculation(slot, req, tok[0])
         self._slots[slot_idx] = slot
         self._status[req.rid] = "active"
 
     def _slot_done(self, slot):
-        if len(slot.emitted) >= slot.req.max_new_tokens:
+        if slot.n_emitted >= slot.req.max_new_tokens:
             return True
         if slot.req.eos_id is not None:
+            last = slot.emitted[-1]
+            if isinstance(last, _SpecTokens):
+                return int(last.toks[-1]) == slot.req.eos_id
             # eos needs a host read; only requests that opted into an
             # eos token pay the sync
             return int(jax.device_get(
-                slot.emitted[-1][slot.row])) == slot.req.eos_id
+                last[slot.row])) == slot.req.eos_id
         return False
+
+    # -- speculative decoding --------------------------------------------
+    def _speculates(self, req):
+        """Whether this request self-drafts: engine speculation on
+        (spec_k > 0, non-MoE block) and the request did not opt out."""
+        return (self._spec_on and req.speculative is not False
+                and req.max_new_tokens > 1)
+
+    def _arm_speculation(self, slot, req, first_tok):
+        """Admission tail for speculating requests: start the host
+        history mirror (prompt + first token — what the drafter
+        proposes from; one small host read per admission) and, in
+        draft-model mode, prefill the slot's draft-cache row."""
+        if not self._speculates(req):
+            return
+        slot.history = [int(t) for t in req.prompt[0]] + [int(first_tok)]
+        if self._draft_dec is not None:
+            self._draft_prefill(slot.row, req)
+
+    def _draft_prefill(self, row, req):
+        """Ingest the prompt into the draft model's cache row (same
+        bucketed slot-prefill machinery as the target)."""
+        Tp = req.prompt.shape[1]
+        raw = jnp.asarray(req.prompt, jnp.int32)
+        if self._draft_dec._bucket_prefill:  # draft block is dense
+            Tb = min(_bucket(Tp), self._max_length)
+            if Tb > Tp:
+                raw = jnp.pad(raw, ((0, 0), (0, Tb - Tp)))
+        _, self._draft_pool = self._draft_dec._slot_prefill_jitted(
+            self._draft_pool, raw, jnp.int32(row))
+
+    def _spec_extent(self, slot):
+        """Hard cache extent of one slot in positions — drafted windows
+        clamp so pos + drafts never outruns it (for the paged engine:
+        the slot's allocated page chain)."""
+        return self._max_length
+
+    def _spec_budget(self, slot):
+        """Per-slot draft budget this iteration: never draft past the
+        request's remaining tokens (a window emits between 1 and
+        drafts+1 tokens) nor the slot/page extent."""
+        return min(self._spec_k,
+                   slot.req.max_new_tokens - slot.n_emitted - 1,
+                   self._spec_extent(slot) - 1 - slot.pos)
+
+    def _draft_phase(self, active):
+        """Collect draft proposals for every speculating active slot
+        ({row: [tokens]}).  The ``serving.draft`` fault site fires per
+        slot (keyed by rid) BEFORE its proposal; a raise — or a drafter
+        error — quarantines only that slot."""
+        if not self._spec_on:
+            return {}
+        spec_rows = []
+        for i in list(active):
+            s = self._slots[i]
+            if s.history is None:
+                continue
+            try:
+                _inject("serving.draft", key=s.req.rid)
+            except Exception as exc:
+                self._quarantine(i, exc, "serving.draft")
+                active.remove(i)
+                continue
+            spec_rows.append(i)
+        if not spec_rows:
+            return {}
+        if self._draft_dec is not None:
+            return self._propose_model(spec_rows)
+        out = {}
+        for i in list(spec_rows):
+            s = self._slots[i]
+            try:
+                k = self._spec_budget(s)
+                d = self._drafter.propose(s.history, k) if k > 0 else []
+            except Exception as exc:
+                self._quarantine(i, exc, "serving.draft")
+                active.remove(i)
+                continue
+            if d:
+                out[i] = d
+        return out
+
+    def _propose_model(self, rows):
+        """Pooled greedy drafting with the small draft model: j
+        proposals per row from j+1 pooled draft decode steps — the
+        extra step writes the last draft's K/V so the draft cache never
+        gaps when a whole window is accepted.  The draft cache mirrors
+        the target row/position-wise; rejections share the host
+        position roll-back (stale draft rows are overwritten before any
+        validity mask can reach them, the same argument as the target
+        cache).  A failure here is pool-level, like the pooled step."""
+        B = self._num_slots
+        j = max(0, min(self._spec_k,
+                       max(self._spec_budget(self._slots[i])
+                           for i in rows)))
+        pos = onp.zeros((B,), onp.int32)
+        for i in rows:
+            pos[i] = self._slots[i].pos
+        tok = self._last_tokens.reshape(-1, 1)
+        proposals = []
+        # non-drafting rows flow through with garbage (fixed shapes);
+        # their draft rows are dead and absorb the writes
+        for w in range(j + 1):
+            logits, self._draft_pool = self._draft_dec._step_slots_jitted(
+                self._draft_pool, tok, jnp.asarray(pos + w))
+            if w < j:
+                nxt = jnp.argmax(logits[:, 0], axis=-1).astype(jnp.int32)
+                proposals.append(nxt)
+                tok = nxt.reshape(-1, 1)
+        if not proposals:
+            return {}
+        mat = onp.asarray(jax.device_get(jnp.stack(proposals, axis=1)))
+        out = {}
+        for i in rows:
+            k = self._spec_budget(self._slots[i])
+            if k > 0:
+                out[i] = [int(t) for t in mat[i, :k]]
+        return out
+
+    def _decode_state(self, active):
+        """Traced inputs of the pooled decode/verify programs (the slot
+        engine needs only the per-row positions; the paged engine adds
+        block tables)."""
+        pos = onp.zeros((self._num_slots,), onp.int32)
+        for i in active:
+            pos[i] = self._slots[i].pos
+        return pos
+
+    def _run_step(self, state):
+        logits, self._pool = self._dec._step_slots_jitted(
+            self._pool, self._last_tokens.reshape(-1, 1),
+            jnp.asarray(state))
+        return logits
+
+    def _run_verify(self, state, window, valid_len):
+        logits, self._pool = self._dec._verify_slots_jitted(
+            self._pool, window, jnp.asarray(state),
+            jnp.asarray(valid_len))
+        return logits
+
+    def _decode_active(self, active):
+        """The pooled decode tail shared by both engines: draft, then
+        either ONE plain step or ONE batched verify call for every
+        active slot."""
+        from ..models.sampler import sample_next_token
+
+        drafts = self._draft_phase(active)  # may quarantine members
+        if not active:
+            return
+        if drafts:
+            self._decode_verify(active, drafts, sample_next_token)
+        else:
+            self._decode_plain(active, sample_next_token)
+
+    def _decode_plain(self, active, sample_next_token):
+        """The non-speculative pooled step (the original decode tail);
+        speculating slots still mirror their emitted token into the
+        host history so the next iteration can draft."""
+        logits = self._run_step(self._decode_state(active))
+        last = logits[:, 0]                          # (B, V)
+        self._sample_pool(last, active, sample_next_token)
+        self._steps += 1
+        self._tokens_generated += len(active)
+        self._slot_iterations += len(active)
+        hist_rows = [i for i in active
+                     if self._slots[i].history is not None]
+        if hist_rows:
+            toks = onp.asarray(jax.device_get(self._last_tokens))
+            for i in hist_rows:
+                self._slots[i].history.append(int(toks[i]))
+        for i in active:
+            s = self._slots[i]
+            s.pos += 1
+            s.n_emitted += 1
+            s.emitted.append(self._last_tokens)
+            try:
+                done = self._slot_done(s)
+            except Exception as exc:  # per-slot eos host read
+                self._quarantine(i, exc, "serving.step")
+                continue
+            if done:
+                self._finish(i, s.req, s.emitted, s.row)
+
+    def _decode_verify(self, active, drafts, sample_next_token):
+        """Speculative iteration: ONE compiled verify call scores every
+        row's candidate window (last token + drafts) against the cache,
+        candidate draws are computed per window position with the SAME
+        rule and RNG keys sequential decode would use (keys peeked,
+        then advanced by the emitted count), and each row advances by
+        its accepted prefix + 1 — so every stream stays bit-identical
+        to non-speculative decode while accepted drafts cost one cache
+        read instead of k.  The ``serving.verify`` fault site fires per
+        participating slot (keyed by rid) before the pooled call."""
+        B = self._num_slots
+        for i in list(active):
+            try:
+                _inject("serving.verify", key=self._slots[i].req.rid)
+            except Exception as exc:
+                self._quarantine(i, exc, "serving.verify")
+                active.remove(i)
+                drafts.pop(i, None)
+        if not active:
+            return
+        jmax = max((len(d) for d in drafts.values()), default=0)
+        if jmax == 0:
+            self._decode_plain(active, sample_next_token)
+            return
+        # window width from the power-of-two ladder: the verify program
+        # family stays <= |ladder| (C004-bucketed, never C001)
+        W = _bucket(jmax + 1, base=2)
+        state = self._decode_state(active)
+        dr = onp.zeros((B, W - 1), onp.int32)
+        vl = onp.zeros((B,), onp.int32)
+        nreal = 0
+        for i in active:
+            s = self._slots[i]
+            d = drafts.get(i, ())[:W - 1]
+            vl[i] = 1 + len(d)
+            if d:
+                dr[i, :len(d)] = d
+                nreal += len(d)
+        window = jnp.concatenate(
+            [self._last_tokens.reshape(-1, 1).astype(jnp.int32),
+             jnp.asarray(dr)], axis=1)                # (B, W)
+        logits = self._run_verify(state, window, vl)  # (B, W, V)
+        M = self._sample_window(logits, active, window, W,
+                                sample_next_token)    # (B, W) candidates
+        # accepted prefix per row: candidate w must equal draft w+1
+        vld = jnp.asarray(vl)
+        match = (M[:, :W - 1] == window[:, 1:]) & \
+            (jnp.arange(W - 1)[None, :] < (vld - 1)[:, None])
+        counts = 1 + jnp.sum(jnp.cumprod(
+            match.astype(jnp.int32), axis=1), axis=1)  # (B,) emitted
+        self._last_tokens = jnp.take_along_axis(
+            M, jnp.clip(counts - 1, 0, W - 1)[:, None],
+            axis=1)[:, 0].astype(jnp.int32)
+        self._update_seen_window(active, M, counts, W)
+        # ONE pooled host sync: accept counts + the emitted candidates
+        counts_h = onp.asarray(jax.device_get(counts))
+        M_h = onp.asarray(jax.device_get(M))
+        self._steps += 1
+        self._verify_calls += 1
+        self._drafted_tokens += nreal
+        self._slot_iterations += len(active)
+        for i in active:
+            s = self._slots[i]
+            m = int(counts_h[i])
+            toks = M_h[i, :m]
+            if s.req.eos_id is not None:
+                hits = onp.nonzero(toks == s.req.eos_id)[0]
+                if hits.size:  # stop AT eos, exactly like sequential
+                    m = int(hits[0]) + 1
+                    toks = toks[:m]
+            self._accepted_tokens += m - 1
+            self._tokens_generated += m
+            s.pos += m
+            s.n_emitted += m
+            if s.keys is not None:
+                s.keys.advance(m)  # commit exactly the emitted draws
+            if s.history is not None:
+                s.history.extend(int(t) for t in toks)
+            s.emitted.append(_SpecTokens(toks.copy()))
+            if (s.n_emitted >= s.req.max_new_tokens
+                    or (s.req.eos_id is not None
+                        and int(toks[-1]) == s.req.eos_id)):
+                self._finish(i, s.req, s.emitted, s.row)
+
+    def _sample_window(self, logits, active, window, W,
+                       sample_next_token):
+        """Candidate draws for every window position: position w of row
+        b is sampled from logits[b, w] with EXACTLY the key / penalty
+        state sequential decode would use there (key = the slot's w-th
+        future draw; penalty mask = base seen + window drafts 1..w),
+        grouped by sampling config like _sample_pool.  Rows whose
+        prefix rejects discard the later columns unconsumed."""
+        B = self._num_slots
+        V = logits.shape[-1]
+        self._ensure_seen(V)
+        groups: Dict[Any, List[int]] = {}
+        for i in active:
+            groups.setdefault(self._slots[i].req.sample_config,
+                              []).append(i)
+        pen = [i for i in active if self._slots[i].req.penalized]
+        seen_w = [self._seen] * W
+        if pen:
+            pr = onp.zeros((B,), bool)
+            pr[pen] = True
+            pr = jnp.asarray(pr)
+            rows = jnp.arange(B)
+            seen_w = [self._seen]
+            cur = self._seen
+            for w in range(1, W):
+                upd = cur.at[rows, window[:, w]].set(True)
+                cur = jnp.where(pr[:, None], upd, cur)
+                seen_w.append(cur)
+        cols: List[Any] = [None] * W
+        for (temp, top_k, top_p, rep), members in groups.items():
+            mask = onp.zeros((B,), bool)
+            mask[members] = True
+            mask = jnp.asarray(mask)
+            keys_w = None
+            if temp > 0.0:
+                dummy = jax.random.key(0)
+                keys_w = []
+                for w in range(W):
+                    per_row = [self._slots[i].keys.peek_key(w)
+                               if i in members and self._slots[i].keys
+                               else dummy for i in range(B)]
+                    keys_w.append(jax.random.wrap_key_data(jnp.stack(
+                        [jax.random.key_data(k) for k in per_row])))
+            for w in range(W):
+                out = sample_next_token(
+                    logits[:, w], keys_w[w] if keys_w else None,
+                    temp, top_k, top_p, rep,
+                    seen_mask=seen_w[w] if rep != 1.0 else None,
+                    active_mask=mask)
+                cols[w] = out if cols[w] is None \
+                    else jnp.where(mask, out, cols[w])
+        return jnp.stack(cols, axis=1).astype(jnp.int32)
+
+    def _update_seen_window(self, active, M, counts, W):
+        """Persistent penalty bookkeeping: add each penalized row's
+        EMITTED window tokens (candidates 0..counts-1) to its seen row
+        — the multi-token form of _sample_pool's per-draw scatter."""
+        pen = [i for i in active if self._slots[i].req.penalized]
+        if not pen:
+            return
+        B = self._num_slots
+        pr = onp.zeros((B,), bool)
+        pr[pen] = True
+        pr = jnp.asarray(pr)
+        rows = jnp.arange(B)
+        cur = self._seen
+        for w in range(W):
+            upd = cur.at[rows, M[:, w]].set(True)
+            take = pr & (counts > w)
+            cur = jnp.where(take[:, None], upd, cur)
+        self._seen = cur
 
     # -- one scheduler iteration ----------------------------------------
     def step(self):
         """One iteration: evict deadline-expired requests, admit queued
-        requests into free slots, then run ONE pooled decode step for
+        requests into free slots, then run ONE pooled decode step — or,
+        when speculation produced drafts, ONE batched verify call — for
         every active slot.  Returns the list of request ids finished
         this iteration (any terminal status).
 
@@ -475,8 +973,6 @@ class ContinuousBatchingEngine:
         (admission prefill, the per-slot fault sites, the eos check)
         quarantines that slot only — the iteration proceeds for every
         other slot with bit-identical results."""
-        from ..models.sampler import sample_next_token
-
         finished_before = set(self._results)
         self._evict_expired()
         if self._queue:
@@ -512,27 +1008,7 @@ class ContinuousBatchingEngine:
                 self._quarantine(i, exc, "serving.step")
                 active.remove(i)
         if active:
-            pos = onp.zeros((self._num_slots,), onp.int32)
-            for i in active:
-                pos[i] = self._slots[i].pos
-            logits, self._pool = self._dec._step_slots_jitted(
-                self._pool, self._last_tokens.reshape(-1, 1),
-                jnp.asarray(pos))
-            last = logits[:, 0]                          # (B, V)
-            self._sample_pool(last, active, sample_next_token)
-            self._steps += 1
-            self._tokens_generated += len(active)
-            for i in active:
-                s = self._slots[i]
-                s.pos += 1
-                s.emitted.append(self._last_tokens)
-                try:
-                    done = self._slot_done(s)
-                except Exception as exc:  # per-slot eos host read
-                    self._quarantine(i, exc, "serving.step")
-                    continue
-                if done:
-                    self._finish(i, s.req, s.emitted, s.row)
+            self._decode_active(active)
         return [r for r in self._results if r not in finished_before]
 
     def _sample_pool(self, last, active, sample_next_token):
@@ -590,7 +1066,7 @@ class ContinuousBatchingEngine:
             (1 + r.retries_left) * r.max_new_tokens
             for r in self._queue) + sum(
             (1 + s.req.retries_left) * s.req.max_new_tokens
-            - len(s.emitted)
+            - s.n_emitted
             for s in self._slots if s is not None)
         limit = 4 * (outstanding + len(self._queue)
                      + self._num_slots + 1)
@@ -627,6 +1103,8 @@ class _PagedSlot(_Slot):
         self.pos = None
         self.emitted = []
         self.keys = None
+        self.history = None
+        self.n_emitted = 0
         self.Tp = Tp
         self.chunks = chunks          # [(start, T_actual, T_bucketed)]
         self.chunk_i = 0
@@ -704,10 +1182,13 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
                  max_pending: Optional[int] = None, clock=None,
                  history: int = 1024, block_size: int = 16,
                  num_blocks: Optional[int] = None,
-                 prefill_chunk: int = 64):
+                 prefill_chunk: int = 64, spec_k: int = 0,
+                 spec_ngram: int = 3, draft_block=None,
+                 draft_rules: Optional[ShardingRules] = None):
         super().__init__(block, mesh, rules, num_slots, max_length,
                          cache_dtype, cache_spec, bucket_prefill,
-                         max_pending, clock, history)
+                         max_pending, clock, history, spec_k,
+                         spec_ngram, draft_block, draft_rules)
         bs = int(block_size)
         chunk = int(prefill_chunk)
         if bs < 1:
@@ -752,6 +1233,7 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
     # -- paged pool plumbing ---------------------------------------------
     def _ensure_pool(self, sample_prompt):
         self._dec._ensure_staged(sample_prompt)
+        self._ensure_draft_pool(sample_prompt)
         if self._pool is not None:
             return
         jm = self._mesh.jax_mesh
@@ -783,7 +1265,7 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
             self._release_row(row)
 
     def _table_row(self, row):
-        t = onp.zeros((self._M,), onp.int32)
+        t = onp.full((self._M,), NULL_PAGE, onp.int32)
         pages = self._slot_pages[row]
         if pages:
             t[:len(pages)] = pages
@@ -829,7 +1311,8 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
 
     def submit(self, prompt_ids, max_new_tokens, temperature=0.0,
                top_k=0, top_p=0.0, repetition_penalty=1.0, seed=None,
-               eos_id=None, deadline_s=None, retries=0) -> int:
+               eos_id=None, deadline_s=None, retries=0,
+               speculative=None) -> int:
         """Same contract as the slot engine's submit(); additionally a
         request whose worst-case page need exceeds the WHOLE pool can
         never be admitted and sheds immediately with LoadShedError
@@ -849,7 +1332,7 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
                     % (need, self._bp.capacity, self._bs))
         return super().submit(pids, max_new_tokens, temperature, top_k,
                               top_p, repetition_penalty, seed, eos_id,
-                              deadline_s, retries)
+                              deadline_s, retries, speculative)
 
     def _admit(self, req, slot_idx):
         """Paged admission: prefix lookup + page allocation + chunk
@@ -949,6 +1432,8 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
         slot.pos = Tp
         slot.keys = keys
         slot.emitted = [self._last_tokens]
+        slot.n_emitted = 1
+        self._arm_speculation(slot, req, tok[0])
         if not moe:
             # prompt pages fully below Tp are now immutable: decode
             # writes land at >= Tp, chunk padding past Tp never touches
@@ -959,16 +1444,44 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
         if self._slot_done(slot):
             self._finish(slot_idx, req, slot.emitted, slot_idx)
 
+    # -- speculative decoding hooks (paged forms) ------------------------
+    def _spec_extent(self, slot):
+        """Token capacity of the slot's allocated page chain — drafted
+        windows clamp here, so a verify write can NEVER need a page the
+        slot does not already own (rollback stays a position fix-up)."""
+        pages = self._slot_pages[slot.row]
+        return len(pages) * self._bs if pages else 0
+
+    def _decode_state(self, active):
+        pos = onp.zeros((self._num_slots,), onp.int32)
+        tables = onp.zeros((self._num_slots, self._M), onp.int32)
+        for i in active:
+            pos[i] = self._slots[i].pos
+            tables[i] = self._table_row(i)
+        return pos, tables
+
+    def _run_step(self, state):
+        pos, tables = state
+        logits, self._pool = self._dec._step_pages_jitted(
+            self._pool, self._last_tokens.reshape(-1, 1),
+            jnp.asarray(tables), jnp.asarray(pos))
+        return logits
+
+    def _run_verify(self, state, window, valid_len):
+        pos, tables = state
+        logits, self._pool = self._dec._verify_pages_jitted(
+            self._pool, window, jnp.asarray(tables), jnp.asarray(pos),
+            jnp.asarray(valid_len))
+        return logits
+
     # -- one scheduler iteration ----------------------------------------
     def step(self):
         """One iteration: deadline sweep, admissions (deferring at the
         queue head on transient page exhaustion), ONE prefill chunk per
-        prefilling slot, then ONE pooled paged decode step over every
-        DECODING slot.  Same per-slot failure containment as the slot
-        engine; chunk-prefill faults quarantine under the admission
-        site."""
-        from ..models.sampler import sample_next_token
-
+        prefilling slot, then ONE pooled paged decode step — or batched
+        verify call — over every DECODING slot.  Same per-slot failure
+        containment as the slot engine; chunk-prefill faults quarantine
+        under the admission site."""
         finished_before = set(self._results)
         self._evict_expired()
         # chunked prefill FIRST: slots already prefilling advance one
@@ -1013,29 +1526,7 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
                 self._quarantine(i, exc, "serving.step")
                 active.remove(i)
         if active:
-            pos = onp.zeros((self._num_slots,), onp.int32)
-            tables = onp.zeros((self._num_slots, self._M), onp.int32)
-            for i in active:
-                pos[i] = self._slots[i].pos
-                tables[i] = self._table_row(i)
-            logits, self._pool = self._dec._step_pages_jitted(
-                self._pool, self._last_tokens.reshape(-1, 1),
-                jnp.asarray(tables), jnp.asarray(pos))
-            last = logits[:, 0]                          # (B, V)
-            self._sample_pool(last, active, sample_next_token)
-            self._steps += 1
-            self._tokens_generated += len(active)
-            for i in active:
-                s = self._slots[i]
-                s.pos += 1
-                s.emitted.append(self._last_tokens)
-                try:
-                    done = self._slot_done(s)
-                except Exception as exc:  # per-slot eos host read
-                    self._quarantine(i, exc, "serving.step")
-                    continue
-                if done:
-                    self._finish(i, s.req, s.emitted, s.row)
+            self._decode_active(active)
         return [r for r in self._results if r not in finished_before]
 
     # -- drain -----------------------------------------------------------
@@ -1052,7 +1543,7 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
                 req.max_new_tokens + chunks) - emitted_n
 
         outstanding = sum(iters(r) for r in self._queue) + sum(
-            iters(s.req, len(s.emitted))
+            iters(s.req, s.n_emitted)
             for s in self._slots if s is not None)
         limit = 4 * (outstanding + len(self._queue)
                      + self._num_slots + 1) + \

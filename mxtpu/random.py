@@ -47,6 +47,21 @@ class _KeyRing:
         self._counter += 1
         return k
 
+    def peek_key(self, ahead=0):
+        """The key ``ahead`` draws in the future WITHOUT consuming it —
+        key_i is a pure function of (root, counter), so speculative
+        verification can compute candidate draws for a whole window and
+        afterwards :meth:`advance` by only the number of tokens actually
+        emitted, leaving the stream bit-identical to having drawn them
+        one by one (mxtpu.parallel.serving speculative decode)."""
+        if self._root is None:
+            self._root = jax.random.key(self._seed)
+        return jax.random.fold_in(self._root, self._counter + int(ahead))
+
+    def advance(self, n):
+        """Consume ``n`` draws (the commit half of peek_key)."""
+        self._counter += int(n)
+
 
 class _TraceKeyCtx:
     """Deterministic per-trace key derivation; pushed while tracing."""

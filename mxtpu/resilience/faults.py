@@ -26,6 +26,17 @@ site                fires at
                     admission path), keyed by rid — a raise models the
                     pool-exhausted path; genuine transient exhaustion
                     defers admission, it never raises
+``serving.draft``   once per speculating active slot per iteration,
+                    keyed by rid, BEFORE its draft proposal
+                    (``ContinuousBatchingEngine._draft_phase``) — a
+                    raise models a corrupt drafter/history and
+                    quarantines only that slot
+``serving.verify``  once per active slot participating in a batched
+                    speculative verification, keyed by rid, BEFORE the
+                    pooled verify call
+                    (``ContinuousBatchingEngine._decode_verify``) —
+                    same per-slot quarantine contract as
+                    ``serving.step``
 ``kvstore.reduce``  inside the (retried) cross-worker reduce of
                     ``KVStore.push`` / ``pushpull``
 ``checkpoint.save`` inside the preemption save callback
@@ -94,9 +105,9 @@ __all__ = ["InjectedFault", "FaultRule", "FaultPlan", "fault_plan",
 
 #: the documented injection sites (see module docstring for locations)
 SITES = ("serving.step", "serving.admit", "serving.prefix_lookup",
-         "serving.block_alloc", "kvstore.reduce",
-         "checkpoint.save", "engine.flush", "guardian.check",
-         "ckpt.write", "ckpt.verify")
+         "serving.block_alloc", "serving.draft", "serving.verify",
+         "kvstore.reduce", "checkpoint.save", "engine.flush",
+         "guardian.check", "ckpt.write", "ckpt.verify")
 
 
 class InjectedFault(MXTPUError):

@@ -305,6 +305,48 @@ def test_paged_engine_holds_compile_budget():
     assert len([k for k in cache if k[0] == "step_pages"]) == 1
 
 
+def test_speculative_engine_holds_compile_budget():
+    """ISSUE-8 acceptance: the speculative mixed workload (greedy +
+    seeded-sampled + a non-speculative rider) stays within
+    compile_budget(#prefill buckets + 1 step + |W ladder| verify
+    programs) — window widths come off the pow2 ladder (W in {2, 4} at
+    spec_k=3), so serving.verify_slots is a bounded bucketed family:
+    no per-k or per-length program churn (C001-clean).  Cycling tiny
+    model (tests/test_speculative.py) so drafts really fire; smallest
+    possible engine — the invariant is in the PROGRAM COUNT."""
+    from mxtpu.models.transformer import TransformerLM
+    from mxtpu.parallel.mesh import DeviceMesh
+
+    mx.random.seed(1)
+    tiny = TransformerLM(20, units=32, hidden_size=64, num_layers=1,
+                         num_heads=4, num_kv_heads=2)
+    tiny.initialize()
+    eng = ContinuousBatchingEngine(tiny, DeviceMesh(dp=1),
+                                   transformer_lm_sharding_rules(),
+                                   num_slots=2, max_length=32,
+                                   spec_k=3)
+    rng = np.random.RandomState(31)
+    # prompt lengths 3, 5, 12 -> buckets 8, 16 = 2 slot-prefill
+    # programs; ONE pooled step; <= 2 verify windows = budget 5
+    with compile_budget(5, sites=("serving.slot_prefill",
+                                  "serving.step_slots",
+                                  "serving.verify_slots")):
+        eng.submit(nd.array(rng.randint(0, 20, (1, 3)),
+                            dtype="int32"), 12)
+        eng.submit(nd.array(rng.randint(0, 20, (1, 5)), dtype="int32"),
+                   10, temperature=0.8, top_k=10, seed=7)
+        eng.submit(nd.array(rng.randint(0, 20, (1, 12)),
+                            dtype="int32"), 8, speculative=False)
+        eng.run()
+    assert eng.stats["drafted_tokens"] > 0    # speculation really ran
+    assert "serving.verify_slots" not in [
+        d.subject for d in check_compiles().filter(code="C001")]
+    cache = eng._dec._jit_cache
+    assert 1 <= len([k for k in cache if k[0] == "verify_slots"]) <= 2
+    assert len([k for k in cache if k[0] == "step_slots"]) == 1
+    assert len([k for k in cache if k[0] == "slot_prefill"]) == 2
+
+
 def test_seeded_bucketing_regression_fails_budget():
     """Turn bucketing OFF (the seeded regression): one prefill program
     per distinct prompt length — the (buckets + 1) budget that holds in

@@ -484,6 +484,15 @@ CASES.update({
     "_internal_cache_write_slot": C(
         lambda: (A(2, 3, 8, 4), A(1, 3, 4, 4)), {"slot": 1, "pos": 2},
         grad=False),
+    # speculative-verify window writes (ISSUE 8): per-row W-token spans
+    # with valid_len masking (invalid lanes drop / hit the null page)
+    "_internal_cache_write_span": C(
+        lambda: (A(2, 3, 8, 4), A(2, 3, 4, 4)),
+        {"pos": jnp.asarray([2, 5]),
+         "valid_len": jnp.asarray([4, 2])}, grad=False),
+    "_paged_cache_write_span": C(
+        lambda: (A(5, 3, 4, 2), A(2, 3, 4, 2), IDX(2, 3, n=5),
+                 jnp.asarray([3, 2]), jnp.asarray([4, 2])), grad=False),
     # block-paged cache family (PagedContinuousBatchingEngine): pool
     # (pages=5, KV=3, block=4, D=2); tables are int32 page indices
     "_paged_cache_gather": C(

@@ -246,6 +246,77 @@ def test_stats_exposes_resilience_counters(eng):
         assert key in eng.stats
 
 
+# ------------------------------------- speculative fault sites (ISSUE 8)
+
+@pytest.fixture(scope="module")
+def spec_eng(mesh):
+    """Speculation-enabled engine over the cycling tiny model (model
+    seed 1 / vocab 20 — see tests/test_speculative.py) so the draft /
+    verify sites actually fire; its own isolated reference shares the
+    module mesh."""
+    from mxtpu.models.transformer import TransformerLM
+
+    mx.random.seed(1)
+    net = TransformerLM(20, units=32, hidden_size=64, num_layers=1,
+                        num_heads=4, num_kv_heads=2)
+    net.initialize()
+    eng = ContinuousBatchingEngine(net, mesh,
+                                   transformer_lm_sharding_rules(),
+                                   num_slots=2, max_length=64,
+                                   spec_k=3)
+    iso = ShardedDecoder(net, mesh, transformer_lm_sharding_rules())
+    return eng, iso
+
+
+def test_draft_fault_quarantines_only_offending_slot(spec_eng):
+    """A ``serving.draft`` fault fails only its request; the SAMPLED
+    neighbor's speculative stream stays bit-identical to the fault-free
+    isolated run (per-slot key streams make draft failures local)."""
+    eng, iso = spec_eng
+    rng = np.random.RandomState(3)
+    p1, p2 = _prompts(rng, (6, 5), vocab=20)
+    before = eng.stats
+    r1 = eng.submit(p1, 14, temperature=0.8, top_k=10, seed=101)
+    r2 = eng.submit(p2, 12)
+    with fault_plan("serving.draft#%d@2:raise=OSError(bad-history)"
+                    % r2) as plan:
+        res = eng.run()
+    assert plan.stats()["serving.draft"]["fired"] == 1
+    np.testing.assert_array_equal(
+        res[r1].asnumpy(),
+        iso.generate(p1, max_new_tokens=14, max_length=64,
+                     temperature=0.8, top_k=10, seed=101).asnumpy())
+    assert eng.status(r2) == "failed"
+    assert eng.error(r2)["site"] == "serving.draft"
+    assert eng.stats["quarantined"] - before["quarantined"] == 1
+    assert eng.free_slots == eng.num_slots
+
+
+def test_verify_fault_retry_completes_bit_identically(spec_eng):
+    """A ``serving.verify`` fault quarantines only its slot; with a
+    retry budget the request restarts from scratch and completes
+    bit-identical to the fault-free reference (ISSUE-8 acceptance on
+    the slot engine; the paged half lives in
+    tests/test_speculative_paged.py)."""
+    eng, iso = spec_eng
+    rng = np.random.RandomState(7)
+    p1, p2 = _prompts(rng, (6, 4), vocab=20)
+    r1 = eng.submit(p1, 14)
+    r2 = eng.submit(p2, 12, retries=1)
+    with fault_plan("serving.verify#%d@1:raise=RuntimeError(poisoned)"
+                    % r2) as plan:
+        res = eng.run()
+    assert plan.stats()["serving.verify"]["fired"] == 1
+    np.testing.assert_array_equal(
+        res[r1].asnumpy(),
+        iso.generate(p1, max_new_tokens=14, max_length=64).asnumpy())
+    assert eng.status(r2) == "ok"
+    np.testing.assert_array_equal(
+        res[r2].asnumpy(),
+        iso.generate(p2, max_new_tokens=12, max_length=64).asnumpy())
+    assert eng.error(r2)["site"] == "serving.verify"
+
+
 def test_terminal_status_history_is_bounded(tiny, mesh):
     """Per-request status/error bookkeeping must not grow without bound
     on a long-lived engine: only the last `history` completions keep

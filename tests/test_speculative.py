@@ -1,0 +1,298 @@
+"""Speculative decoding in the pooled decode step (ISSUE 8): the
+n-gram self-drafter in isolation, and the slot engine's batched
+verification — every speculative stream must be bit-identical to its
+non-speculative ``ShardedDecoder.generate`` reference (greedy exactly;
+seeded-sampled deterministic and bit-identical too, because acceptance
+draws each position from the target distribution with the SAME per-slot
+key sequential decode would use).  Also the optional small-draft-model
+mode and the MoE opt-out.
+
+Compile discipline: ONE module-scoped engine over a deliberately
+CYCLING tiny model (random tiny LMs decay into short greedy cycles —
+model seed 1 at vocab 20 is pinned for that) serves every parity test,
+so accepts and rejections are both exercised while the file compiles a
+handful of programs once.  The paged-engine half lives in
+tests/test_speculative_paged.py."""
+
+from types import SimpleNamespace
+
+import numpy as np
+import pytest
+
+import mxtpu as mx
+from mxtpu import nd
+from mxtpu.models.sampler import NGramDrafter
+from mxtpu.models.transformer import (TransformerLM,
+                                      transformer_lm_sharding_rules)
+from mxtpu.parallel import ContinuousBatchingEngine, ShardedDecoder
+from mxtpu.parallel.mesh import DeviceMesh
+
+MAXLEN = 64
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    # model seed 1 / vocab 20: greedy continuations fall into short
+    # cycles, so the prompt-lookup drafter gets real accepts (and real
+    # rejections) — the acceptance evidence is deterministic
+    mx.random.seed(1)
+    net = TransformerLM(20, units=32, hidden_size=64, num_layers=1,
+                        num_heads=4, num_kv_heads=2)
+    net.initialize()
+    return net
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    return DeviceMesh(dp=1)
+
+
+@pytest.fixture(scope="module")
+def isolated(tiny, mesh):
+    return ShardedDecoder(tiny, mesh, transformer_lm_sharding_rules())
+
+
+@pytest.fixture(scope="module")
+def eng(tiny, mesh):
+    """Shared speculative slot pool (spec_k=3, n-gram self-drafting)."""
+    return ContinuousBatchingEngine(tiny, mesh,
+                                    transformer_lm_sharding_rules(),
+                                    num_slots=2, max_length=MAXLEN,
+                                    spec_k=3)
+
+
+def _prompts(rng, lengths, vocab=20):
+    return [nd.array(rng.randint(0, vocab, (1, t)), dtype="int32")
+            for t in lengths]
+
+
+def _want(isolated, p, n, **kw):
+    return isolated.generate(p, max_new_tokens=n, max_length=MAXLEN,
+                             **kw).asnumpy()
+
+
+# ---------------------------------------------------- drafter unit block
+
+def test_drafter_longest_recent_match_wins():
+    d = NGramDrafter(max_ngram=3)
+    # trailing [2,3,4] occurred before -> continuation [1,2,3]
+    assert d.propose([1, 2, 3, 4, 1, 2, 3, 4, 1, 2, 3, 4], 3) == [1, 2, 3]
+    # longest match preferred over a shorter, more recent one: trailing
+    # 2-gram [9,5] matches at index 2 (-> 6); the mere 1-gram [5] at
+    # index 6 must not win
+    assert d.propose([7, 8, 9, 5, 6, 0, 5, 1, 9, 5], 2) == [6, 0]
+    # among equal-length matches the MOST RECENT occurrence wins
+    assert d.propose([4, 1, 7, 4, 1, 8, 4, 1], 1) == [8]
+
+
+def test_drafter_deterministic_and_clamped():
+    d = NGramDrafter(max_ngram=3)
+    h = [3, 1, 4, 1, 5, 9, 2, 6, 3, 1, 4, 1]
+    first = d.propose(h, 4)
+    assert all(d.propose(h, 4) == first for _ in range(5))
+    # proposal length clamps at k AND at the history tail
+    assert len(d.propose(h, 2)) <= 2
+    assert d.propose([1, 2, 1], 10) == [2, 1]   # only 2 tokens follow
+
+
+def test_drafter_empty_and_edge_histories():
+    d = NGramDrafter(max_ngram=3)
+    assert d.propose([], 3) == []
+    assert d.propose([5], 3) == []              # nothing precedes the tail
+    assert d.propose([5, 5], 0) == []           # k=0 never proposes
+    assert d.propose([5, 6], 3) == []           # no prior match
+    assert d.propose([5, 5], 3) == [5]          # 1-gram self-match
+
+
+def test_drafter_proposals_are_history_tokens():
+    """Vocab edge: proposals are copied from the history, so they are
+    valid ids by construction — even at vocab boundaries 0 / V-1."""
+    d = NGramDrafter(max_ngram=2)
+    h = [0, 19, 0, 19, 0]
+    out = d.propose(h, 3)
+    assert out and set(out) <= set(h)
+
+
+def test_drafter_validates_ngram_range():
+    with pytest.raises(ValueError, match="min_ngram"):
+        NGramDrafter(max_ngram=0)
+    with pytest.raises(ValueError, match="min_ngram"):
+        NGramDrafter(max_ngram=2, min_ngram=3)
+
+
+def test_spec_budget_clamps_at_slot_extent(eng):
+    """The drafted window can never outrun the slot's cache extent:
+    with the request boundary and the cache boundary both one token
+    away, the budget is zero (plain step)."""
+    s = SimpleNamespace(req=SimpleNamespace(max_new_tokens=10),
+                        n_emitted=4, pos=20, row=0)
+    assert eng._spec_budget(s) == 3                  # spec_k binds
+    s.n_emitted = 9
+    assert eng._spec_budget(s) == 0                  # remaining binds
+    s.n_emitted = 4
+    s.pos = MAXLEN - 1
+    assert eng._spec_budget(s) == 0                  # slot extent binds
+
+
+# -------------------------------------------- slot-engine parity block
+
+def test_spec_greedy_parity_with_real_accepts(eng, isolated):
+    """Greedy speculative streams are bit-identical to the isolated
+    non-speculative reference, and the cycling model guarantees the
+    run actually drafted AND accepted tokens (the claim is not
+    vacuous)."""
+    rng = np.random.RandomState(0)
+    p1, p2 = _prompts(rng, (6, 4))
+    before = eng.stats
+    r1 = eng.submit(p1, 20)
+    r2 = eng.submit(p2, 16)
+    res = eng.run()
+    np.testing.assert_array_equal(res[r1].asnumpy(), _want(isolated, p1, 20))
+    np.testing.assert_array_equal(res[r2].asnumpy(), _want(isolated, p2, 16))
+    after = eng.stats
+    assert after["drafted_tokens"] > before["drafted_tokens"]
+    assert after["accepted_tokens"] > before["accepted_tokens"]
+    assert after["verify_calls"] > before["verify_calls"]
+
+
+def test_spec_seeded_sampled_parity_and_rerun_determinism(eng, isolated):
+    """Sampled speculation draws every window position from the target
+    distribution with the slot's own peeked-then-committed keys, so the
+    stream is bit-identical to the non-speculative seeded reference —
+    and trivially deterministic across reruns."""
+    rng = np.random.RandomState(7)
+    p1, p2 = _prompts(rng, (5, 4))
+    want1 = _want(isolated, p1, 16, temperature=0.8, top_k=10, seed=101)
+    want2 = _want(isolated, p2, 12, temperature=0.7, top_p=0.9, seed=55)
+
+    def run_once():
+        r1 = eng.submit(p1, 16, temperature=0.8, top_k=10, seed=101)
+        r2 = eng.submit(p2, 12, temperature=0.7, top_p=0.9, seed=55)
+        res = eng.run()
+        return res[r1].asnumpy(), res[r2].asnumpy()
+
+    a1, a2 = run_once()
+    np.testing.assert_array_equal(a1, want1)
+    np.testing.assert_array_equal(a2, want2)
+    b1, b2 = run_once()
+    np.testing.assert_array_equal(a1, b1)
+    np.testing.assert_array_equal(a2, b2)
+
+
+def test_spec_penalized_parity(eng, isolated):
+    """Repetition penalty under speculation: position w of a window is
+    penalized by base-seen + the window's earlier drafts, which on the
+    accepted path is exactly the sequential bookkeeping."""
+    rng = np.random.RandomState(11)
+    (p,) = _prompts(rng, (5,))
+    r = eng.submit(p, 14, repetition_penalty=1.3)
+    res = eng.run()
+    np.testing.assert_array_equal(
+        res[r].asnumpy(), _want(isolated, p, 14, repetition_penalty=1.3))
+
+
+def test_mixed_spec_nonspec_pool_parity(eng, isolated):
+    """A speculative=False rider shares verify iterations (its window
+    lane is just 1 wide) without its stream shifting — mixed pools are
+    first-class."""
+    rng = np.random.RandomState(13)
+    p1, p2, p3 = _prompts(rng, (6, 4, 5))
+    r1 = eng.submit(p1, 18)
+    r2 = eng.submit(p2, 12, speculative=False)
+    r3 = eng.submit(p3, 10, temperature=0.6, seed=33, speculative=False)
+    res = eng.run()
+    np.testing.assert_array_equal(res[r1].asnumpy(), _want(isolated, p1, 18))
+    np.testing.assert_array_equal(res[r2].asnumpy(), _want(isolated, p2, 12))
+    np.testing.assert_array_equal(
+        res[r3].asnumpy(), _want(isolated, p3, 10, temperature=0.6,
+                                 seed=33))
+
+
+def test_spec_eos_stops_inside_window(eng, isolated):
+    """An eos token emitted mid-window truncates the stream exactly
+    where sequential decode would stop (accepted tokens past eos are
+    discarded, and so are their RNG draws).  Reference: the SAME engine
+    with speculation opted out — its plain path is the proven non-spec
+    engine."""
+    rng = np.random.RandomState(0)
+    (p,) = _prompts(rng, (6,))
+    eos = int(_want(isolated, p, 20)[0][p.shape[1] + 9])
+    r_ref = eng.submit(p, 20, eos_id=eos, speculative=False)
+    ref = eng.run()[r_ref].asnumpy()
+    r = eng.submit(p, 20, eos_id=eos)
+    out = eng.run()[r].asnumpy()
+    np.testing.assert_array_equal(out, ref)
+    assert out.shape[1] < p.shape[1] + 20       # eos actually fired
+
+
+def test_spec_stats_and_bounded_program_family(eng):
+    st = eng.stats
+    for key in ("drafted_tokens", "accepted_tokens", "draft_hit_rate",
+                "verify_calls"):
+        assert key in st
+    assert 0.0 <= st["draft_hit_rate"] <= 1.0
+    # the verify window ladder is powers of two (W in {2, 4} at
+    # spec_k=3): at most 2 verify programs no matter the traffic above
+    verifies = [k for k in st["compiled_programs"] if k == "verify_slots"]
+    assert 1 <= len(verifies) <= 2
+
+
+def test_draft_model_mode_full_acceptance(tiny, mesh, isolated):
+    """draft_block mode: with the draft model == the target model,
+    greedy drafts are bit-identical to what the target emits, so every
+    window accepts fully — tokens/step ~ spec_k+1 — while parity holds
+    for greedy AND sampled riders (the verify side is identical)."""
+    rng = np.random.RandomState(17)
+    eng = ContinuousBatchingEngine(tiny, mesh,
+                                   transformer_lm_sharding_rules(),
+                                   num_slots=2, max_length=MAXLEN,
+                                   spec_k=3, draft_block=tiny)
+    p1, p2 = _prompts(rng, (6, 4))
+    r1 = eng.submit(p1, 16)
+    r2 = eng.submit(p2, 12, temperature=0.8, top_k=10, seed=7)
+    res = eng.run()
+    np.testing.assert_array_equal(res[r1].asnumpy(), _want(isolated, p1, 16))
+    np.testing.assert_array_equal(
+        res[r2].asnumpy(), _want(isolated, p2, 12, temperature=0.8,
+                                 top_k=10, seed=7))
+    st = eng.stats
+    assert st["drafted_tokens"] > 0 and st["accepted_tokens"] > 0
+    # the greedy request's windows accept fully (draft == target);
+    # pooled with a sampled rider the per-STEP average still clears 1
+    assert st["tokens_generated"] / st["steps"] > 1.0
+
+
+def test_moe_blocks_opt_out_of_speculation(mesh):
+    """MoE targets: speculation silently disables (decode-routing
+    capacity is a function of the window batch — docs/inference.md);
+    an MoE DRAFT block is rejected up front for the same reason."""
+    mx.random.seed(9)
+    moe = TransformerLM(vocab_size=20, units=16, hidden_size=32,
+                        num_layers=1, num_heads=4, num_kv_heads=2,
+                        num_experts=4, capacity_factor=4.0)
+    moe.initialize()
+    eng = ContinuousBatchingEngine(moe, mesh,
+                                   transformer_lm_sharding_rules(),
+                                   num_slots=2, max_length=16, spec_k=3)
+    assert eng._spec_on is False
+    mx.random.seed(10)
+    dense = TransformerLM(20, units=16, hidden_size=32, num_layers=1,
+                          num_heads=2, num_kv_heads=2)
+    dense.initialize()
+    with pytest.raises(ValueError, match="dense"):
+        ContinuousBatchingEngine(dense, mesh,
+                                 transformer_lm_sharding_rules(),
+                                 num_slots=2, max_length=16, spec_k=3,
+                                 draft_block=moe)
+    with pytest.raises(ValueError, match="spec_k"):
+        ContinuousBatchingEngine(dense, mesh,
+                                 transformer_lm_sharding_rules(),
+                                 num_slots=2, max_length=16,
+                                 draft_block=dense)
+    # an EXPLICIT draft model on an MoE target fails loudly — the
+    # silent opt-out is only for the implicit self-drafting default
+    with pytest.raises(ValueError, match="MoE target"):
+        ContinuousBatchingEngine(moe, mesh,
+                                 transformer_lm_sharding_rules(),
+                                 num_slots=2, max_length=16, spec_k=3,
+                                 draft_block=dense)

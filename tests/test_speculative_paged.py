@@ -1,0 +1,208 @@
+"""Speculative decoding on the PAGED continuous-batching engine
+(ISSUE 8): the batched verify step rides the block tables — every
+speculative stream bit-identical to its non-speculative
+``ShardedDecoder.generate`` reference while composing with chunked
+prefill, cross-request prefix sharing, rollback (a position fix-up,
+never a page operation), and the fault/retry machinery.  Compile
+discipline: the verify window ladder is pinned with ``compile_budget``.
+
+Same cycling tiny model as tests/test_speculative.py (model seed 1 /
+vocab 20) so accepts and rejections are both real; ONE module-scoped
+engine serves the parity tests."""
+
+import numpy as np
+import pytest
+
+import mxtpu as mx
+from mxtpu import nd
+from mxtpu.analysis import check_compiles, compile_budget
+from mxtpu.models.transformer import (TransformerLM,
+                                      transformer_lm_sharding_rules)
+from mxtpu.parallel import (PagedContinuousBatchingEngine,
+                            ShardedDecoder)
+from mxtpu.parallel.mesh import DeviceMesh
+from mxtpu.resilience import fault_plan
+
+MAXLEN = 64
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    mx.random.seed(1)
+    net = TransformerLM(20, units=32, hidden_size=64, num_layers=1,
+                        num_heads=4, num_kv_heads=2)
+    net.initialize()
+    return net
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    return DeviceMesh(dp=1)
+
+
+@pytest.fixture(scope="module")
+def isolated(tiny, mesh):
+    return ShardedDecoder(tiny, mesh, transformer_lm_sharding_rules())
+
+
+@pytest.fixture(scope="module")
+def eng(tiny, mesh):
+    return PagedContinuousBatchingEngine(
+        tiny, mesh, transformer_lm_sharding_rules(), num_slots=3,
+        max_length=MAXLEN, block_size=8, prefill_chunk=8, spec_k=3)
+
+
+def _prompts(rng, lengths, vocab=20):
+    return [nd.array(rng.randint(0, vocab, (1, t)), dtype="int32")
+            for t in lengths]
+
+
+def _want(isolated, p, n, **kw):
+    return isolated.generate(p, max_new_tokens=n, max_length=MAXLEN,
+                             **kw).asnumpy()
+
+
+def test_paged_spec_parity_with_accepts_and_clean_drain(eng, isolated):
+    """Greedy + seeded-sampled + penalized speculative streams through
+    the paged pool are bit-identical to the isolated reference; the run
+    really drafted/accepted; every page returns to the pool (rejected
+    windows released nothing mid-flight — rollback never touched the
+    allocator)."""
+    rng = np.random.RandomState(0)
+    p1, p2, p3 = _prompts(rng, (6, 4, 5))
+    before = eng.stats
+    r1 = eng.submit(p1, 20)
+    r2 = eng.submit(p2, 16, temperature=0.8, top_k=10, seed=101)
+    r3 = eng.submit(p3, 12, repetition_penalty=1.3)
+    res = eng.run()
+    np.testing.assert_array_equal(res[r1].asnumpy(), _want(isolated, p1, 20))
+    np.testing.assert_array_equal(
+        res[r2].asnumpy(), _want(isolated, p2, 16, temperature=0.8,
+                                 top_k=10, seed=101))
+    np.testing.assert_array_equal(
+        res[r3].asnumpy(), _want(isolated, p3, 12,
+                                 repetition_penalty=1.3))
+    st = eng.stats
+    assert st["drafted_tokens"] > before["drafted_tokens"]
+    assert st["accepted_tokens"] > before["accepted_tokens"]
+    # a speculative run also REJECTS (the cycling model is not purely
+    # periodic), so the rollback path is genuinely exercised
+    assert st["accepted_tokens"] - before["accepted_tokens"] < \
+        st["drafted_tokens"] - before["drafted_tokens"]
+    assert st["blocks_in_use"] == 0
+
+
+def test_paged_spec_interleaves_with_chunked_prefill(eng, isolated):
+    """A long prompt chunk-prefilling one page at a time shares
+    iterations with slots that are speculating — decode never stalls
+    and both streams stay bit-identical."""
+    rng = np.random.RandomState(5)
+    (p1,) = _prompts(rng, (6,))
+    long_p = nd.array(np.concatenate(
+        [p1.asnumpy(), rng.randint(0, 20, (1, 18))], axis=1)
+        .astype(np.int32))
+    r1 = eng.submit(p1, 18)
+    eng.step()                      # r1 decodes (and drafts) already
+    r2 = eng.submit(long_p, 8, temperature=0.7, seed=55)
+    res = eng.run()
+    np.testing.assert_array_equal(res[r1].asnumpy(), _want(isolated, p1, 18))
+    np.testing.assert_array_equal(
+        res[r2].asnumpy(), _want(isolated, long_p, 8, temperature=0.7,
+                                 seed=55))
+    assert eng.stats["blocks_in_use"] == 0
+
+
+def test_paged_spec_composes_with_prefix_sharing(eng, isolated):
+    """Shared-prefix admission + speculation: the donor speculates
+    while the follower shares its prompt pages; verify windows only
+    ever write decode-region pages the slot owns solely, so sharing
+    stays bit-exact."""
+    rng = np.random.RandomState(9)
+    shared = rng.randint(0, 20, (1, 17))
+    pa = nd.array(np.concatenate(
+        [shared, rng.randint(0, 20, (1, 4))], axis=1).astype(np.int32))
+    pb = nd.array(np.concatenate(
+        [shared, rng.randint(0, 20, (1, 3))], axis=1).astype(np.int32))
+    before = eng.stats
+    ra = eng.submit(pa, 14)
+    for _ in range(4):
+        eng.step()                  # donor prefills + registers pages
+    rb = eng.submit(pb, 12, temperature=0.6, seed=21)
+    res = eng.run()
+    np.testing.assert_array_equal(res[ra].asnumpy(), _want(isolated, pa, 14))
+    np.testing.assert_array_equal(
+        res[rb].asnumpy(), _want(isolated, pb, 12, temperature=0.6,
+                                 seed=21))
+    st = eng.stats
+    assert st["prefix_hits"] > before["prefix_hits"]
+    assert st["blocks_in_use"] == 0
+
+
+def test_paged_verify_fault_quarantines_and_retry_completes(
+        eng, isolated):
+    """ISSUE-8 acceptance: under a ``serving.verify`` fault plan with
+    retries, the quarantined request restarts bit-identically and its
+    neighbor's speculative stream never shifts."""
+    rng = np.random.RandomState(13)
+    p1, p2 = _prompts(rng, (6, 4))
+    r1 = eng.submit(p1, 16)
+    r2 = eng.submit(p2, 14, retries=1)
+    with fault_plan("serving.verify#%d@2:raise=RuntimeError(bad-verify)"
+                    % r2) as plan:
+        res = eng.run()
+    assert plan.stats()["serving.verify"]["fired"] == 1
+    np.testing.assert_array_equal(res[r1].asnumpy(), _want(isolated, p1, 16))
+    assert eng.status(r2) == "ok"
+    np.testing.assert_array_equal(res[r2].asnumpy(), _want(isolated, p2, 14))
+    assert eng.error(r2)["site"] == "serving.verify"
+    assert eng.stats["blocks_in_use"] == 0
+
+
+def test_paged_spec_rerun_deterministic(eng):
+    """Same speculative workload twice → identical outputs and
+    identical draft/accept counters (host drafting, page allocation and
+    key peeking are all deterministic)."""
+    rng = np.random.RandomState(17)
+    p1, p2 = _prompts(rng, (6, 5))
+
+    def scenario():
+        before = eng.stats
+        r1 = eng.submit(p1, 14)
+        r2 = eng.submit(p2, 10, temperature=0.9, top_p=0.9, seed=3)
+        res = eng.run()
+        after = eng.stats
+        return (res[r1].asnumpy(), res[r2].asnumpy(),
+                after["drafted_tokens"] - before["drafted_tokens"],
+                after["accepted_tokens"] - before["accepted_tokens"])
+
+    a, b = scenario(), scenario()
+    np.testing.assert_array_equal(a[0], b[0])
+    np.testing.assert_array_equal(a[1], b[1])
+    assert a[2:] == b[2:]
+
+
+def test_paged_spec_engine_holds_compile_budget(tiny, mesh):
+    """The speculative paged workload stays within (#chunk buckets + 1
+    step + |W ladder| verify) compiled programs: windows come off the
+    pow2 ladder, so serving.verify_pages is a bounded bucketed family
+    (C004), never per-length churn (C001).  Fresh engine so the
+    program table starts empty."""
+    eng = PagedContinuousBatchingEngine(
+        tiny, mesh, transformer_lm_sharding_rules(), num_slots=2,
+        max_length=32, block_size=8, prefill_chunk=16, spec_k=3)
+    rng = np.random.RandomState(31)
+    # prompt lengths 3, 12 -> chunk buckets 8, 16 = 2 prefill programs;
+    # ONE paged step; verify windows W in {2, 4} = <= 2 programs
+    with compile_budget(5, sites=("serving.page_prefill",
+                                  "serving.step_pages",
+                                  "serving.verify_pages")):
+        for t, n in ((3, 12), (12, 10), (5, 12)):
+            eng.submit(nd.array(rng.randint(0, 20, (1, t)),
+                                dtype="int32"), n)
+        eng.run()
+    assert eng.stats["drafted_tokens"] > 0
+    assert "serving.verify_pages" not in [
+        d.subject for d in check_compiles().filter(code="C001")]
+    cache = eng._dec._jit_cache
+    assert len([k for k in cache if k[0] == "verify_pages"]) <= 2
+    assert len([k for k in cache if k[0] == "step_pages"]) == 1

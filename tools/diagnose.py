@@ -176,6 +176,55 @@ def check_serving():
               if healthy else "UNEXPECTED counters %r" % (st,))
     except Exception as e:
         print("serving      : FAILED (%s: %s)" % (type(e).__name__, e))
+    check_speculative()
+
+
+def check_speculative():
+    """Exercise speculative decoding once (docs/inference.md): the
+    pinned cycling micro model (tests/test_speculative.py) under a
+    repetitive prompt forces real draft accepts, so a healthy install
+    shows accepted tokens and >1.0 tokens per slot-iteration — while
+    the stream stays bit-identical to non-speculative decode."""
+    print("----------Serving (speculative decode)----------")
+    try:
+        import numpy as np
+
+        import mxtpu as mx
+        from mxtpu import nd
+        from mxtpu.models.transformer import (
+            TransformerLM, transformer_lm_sharding_rules)
+        from mxtpu.parallel import ContinuousBatchingEngine
+        from mxtpu.parallel.mesh import DeviceMesh
+
+        mx.random.seed(1)   # cycling greedy continuations at vocab 20
+        lm = TransformerLM(20, units=32, hidden_size=64, num_layers=1,
+                           num_heads=4, num_kv_heads=2)
+        lm.initialize()
+        eng = ContinuousBatchingEngine(
+            lm, DeviceMesh(dp=1), transformer_lm_sharding_rules(),
+            num_slots=2, max_length=64, spec_k=3)
+        rng = np.random.RandomState(0)
+        pat = rng.randint(0, 20, (1, 4))
+        prompt = nd.array(np.tile(pat, 4).astype(np.int32))
+        eng.submit(prompt, 16)
+        eng.submit(nd.array(rng.randint(0, 20, (1, 5)),
+                            dtype="int32"), 12)
+        eng.run()
+        st = eng.stats
+        rate = (st["tokens_generated"] / st["slot_iterations"]
+                if st["slot_iterations"] else 0.0)
+        print("drafting     : %d drafted, %d accepted (hit rate %.2f), "
+              "%d verify call(s)"
+              % (st["drafted_tokens"], st["accepted_tokens"],
+                 st["draft_hit_rate"], st["verify_calls"]))
+        print("throughput   : %.2f tokens/slot-iteration "
+              "(non-speculative = 1.0)" % rate)
+        healthy = (st["drafted_tokens"] > 0 and st["accepted_tokens"] > 0
+                   and st["verify_calls"] > 0 and rate > 1.0)
+        print("probe        :", "ok (accepts + >1.0 tokens/slot-iter)"
+              if healthy else "UNEXPECTED counters %r" % (st,))
+    except Exception as e:
+        print("speculative  : FAILED (%s: %s)" % (type(e).__name__, e))
 
 
 def check_resilience():
