@@ -461,6 +461,19 @@ def estimate_jit_memory(fn, *sample_args,
     return est
 
 
+def _flat_cache_pair(pair):
+    """Flatten one layer's (k, v) cache entry to raw arrays — an int8
+    cache leaf is a (payload, scales) pair (models.transformer), a float
+    leaf one array."""
+    out = []
+    for leaf in pair:
+        if isinstance(leaf, tuple):
+            out.extend(part._data for part in leaf)
+        else:
+            out.append(leaf._data)
+    return tuple(out)
+
+
 def kv_cache_residency(block, batch: int, max_length: int,
                        dtype: str = "float32", cache_spec=None,
                        mesh=None) -> Tuple[int, List[Tuple[tuple, str]]]:
@@ -470,9 +483,9 @@ def kv_cache_residency(block, batch: int, max_length: int,
     import jax
 
     def _mk():
-        return tuple((ck._data, cv._data)
-                     for ck, cv in block.init_cache(batch, max_length,
-                                                    dtype))
+        return tuple(_flat_cache_pair(pair)
+                     for pair in block.init_cache(batch, max_length,
+                                                  dtype))
 
     try:
         leaves = jax.eval_shape(_mk)
@@ -481,8 +494,11 @@ def kv_cache_residency(block, batch: int, max_length: int,
     axis_sizes = _axis_sizes(mesh)
     shapes: List[Tuple[tuple, str]] = []
     total = 0
-    for ck, cv in leaves:
-        for leaf in (ck, cv):
+    for pair in leaves:
+        for leaf in pair:
+            # an int8 cache's (B, KV, T) scale tensors drop only the
+            # trailing head-dim, so the payload spec prices them too
+            # (_sharded_nbytes ignores spec axes past the leaf's ndim)
             shapes.append((tuple(leaf.shape), str(leaf.dtype)))
             total += _sharded_nbytes(tuple(leaf.shape), leaf.dtype,
                                      cache_spec, axis_sizes)
@@ -527,8 +543,8 @@ def paged_kv_cache_residency(block, num_blocks: int, block_size: int,
         mesh = engine._mesh
 
     def _mk():
-        return tuple((pk._data, pv._data)
-                     for pk, pv in block.init_block_pool(
+        return tuple(_flat_cache_pair(pair)
+                     for pair in block.init_block_pool(
                          num_blocks + 1, block_size, dtype))
 
     try:
@@ -539,8 +555,12 @@ def paged_kv_cache_residency(block, num_blocks: int, block_size: int,
     shapes: List[Tuple[tuple, str]] = []
     total = 0
     per_block = 0
-    for pk, pv in leaves:
-        for leaf in (pk, pv):
+    for pair in leaves:
+        for leaf in pair:
+            # int8 pools carry (N, KV, bs) scale tensors page-aligned
+            # beside their payload pages: same axis-0 page granularity,
+            # same spec truncation as kv_cache_residency — so
+            # bytes_per_block prices a page's payload PLUS its scales
             shapes.append((tuple(leaf.shape), str(leaf.dtype)))
             nbytes = _sharded_nbytes(tuple(leaf.shape), leaf.dtype,
                                      cache_spec, axis_sizes)

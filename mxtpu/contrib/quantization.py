@@ -21,10 +21,12 @@ from jax import lax
 
 from ..base import MXTPUError, register_op
 from .. import ndarray as nd
+from ..gluon.nn.basic_layers import Dense as _Dense
 from ..ndarray import NDArray
 
 __all__ = ["quantize_model", "quantize_net", "quantize_params",
-           "optimal_thresholds"]
+           "optimal_thresholds", "quantize_weights", "QuantizedDense",
+           "pack_int4", "unpack_int4"]
 
 QUANTIZABLE = ("FullyConnected", "Convolution")
 
@@ -99,6 +101,294 @@ def quantized_conv(x, weight, x_min, x_max, w_min, w_max, bias=None,
     if not no_bias and bias is not None:
         out = out + bias.reshape((1, -1) + (1,) * ndim)
     return out
+
+
+# --------------------------------------------- weight-only int8/int4 path
+# Decode is HBM-bandwidth-bound: the weights cross HBM once per token,
+# so halving (int8) or quartering (int4) their bytes is a direct
+# tokens/s multiplier in that regime — and the activations stay float,
+# so no calibration data is needed.  The dequantize is FUSED into the
+# matmul program: the int8 payload feeds the contraction directly and
+# the per-output-channel scale lands in the epilogue (int4 adds
+# group-wise scales over the input dim, applied per contraction group).
+# A float copy of the weight is never materialized.
+
+
+def _wq_flatten(x, flatten):
+    if flatten and x.ndim > 2:
+        return jnp.reshape(x, (x.shape[0], -1))
+    return x
+
+
+@register_op("wq_matmul_i8", differentiable=False)
+def wq_matmul_i8(x, qweight, wscale, bias=None, flatten=False,
+                 no_bias=False):
+    """Weight-only int8 matmul: y = (x · q^T) * s [+ bias] with
+    ``qweight`` (O, I) int8 and per-output-channel ``wscale`` (O,).
+    The scale distributes over the contraction, so it applies AFTER the
+    matmul — the epilogue form XLA fuses — and the accumulation runs in
+    fp32 regardless of x's dtype (the serving numerics contract)."""
+    x = _wq_flatten(x, flatten)
+    prec = lax.Precision.HIGHEST if x.dtype == jnp.float32 else None
+    acc = lax.dot_general(
+        x.astype(jnp.float32), qweight.astype(jnp.float32),
+        (((x.ndim - 1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32, precision=prec)
+    out = acc * wscale.astype(jnp.float32)
+    if not no_bias and bias is not None:
+        out = out + bias.astype(jnp.float32)
+    return out.astype(x.dtype)
+
+
+@register_op("wq_matmul_i4", differentiable=False)
+def wq_matmul_i4(x, qweight, wscale, bias=None, flatten=False,
+                 no_bias=False, group_size=0, in_units=0):
+    """Weight-only int4 matmul: ``qweight`` (O, I//2) int8 packs two
+    nibbles per byte (even input index low, odd high); ``wscale``
+    (O, G) holds one scale per output channel per input GROUP of
+    ``group_size`` (G = I / group_size).  Unpack is sign-extending
+    shift arithmetic in-program; the group scales fold into the
+    contraction as einsum('ngi,ogi,og->no')."""
+    x = _wq_flatten(x, flatten)
+    O = qweight.shape[0]
+    I = int(in_units) or qweight.shape[1] * 2
+    gs = int(group_size) or I
+    # sign-extending nibble unpack: int8 arithmetic shifts
+    lo = jnp.right_shift(jnp.left_shift(qweight, 4), 4)
+    hi = jnp.right_shift(qweight, 4)
+    w = jnp.stack([lo, hi], axis=-1).reshape(O, I).astype(jnp.float32)
+    lead = x.shape[:-1]
+    xg = x.astype(jnp.float32).reshape(-1, I // gs, gs)
+    wg = w.reshape(O, I // gs, gs)
+    prec = lax.Precision.HIGHEST if x.dtype == jnp.float32 else None
+    out = jnp.einsum("ngi,ogi,og->no", xg, wg,
+                     wscale.astype(jnp.float32),
+                     preferred_element_type=jnp.float32, precision=prec)
+    out = out.reshape(lead + (O,))
+    if not no_bias and bias is not None:
+        out = out + bias.astype(jnp.float32)
+    return out.astype(x.dtype)
+
+
+# contrib ops register AFTER the generated mx.nd / mx.sym namespaces are
+# built, so bind the weight-only matmuls in explicitly — QuantizedDense's
+# hybrid_forward addresses them as F.<op> under both dispatch modes
+def _bind_namespaces():
+    from .. import ndarray as _ndm
+    from .. import symbol as _symm
+
+    for _n in ("wq_matmul_i8", "wq_matmul_i4"):
+        if not hasattr(_ndm, _n):
+            setattr(_ndm, _n, _ndm._make_op_fn(_n))
+        if not hasattr(_symm, _n):
+            setattr(_symm, _n, _symm._make_sym_op(_n))
+
+
+_bind_namespaces()
+
+
+def pack_int4(q):
+    """Pack an int4-valued int8 array (O, I) into (O, I//2) bytes —
+    even input index in the low nibble, odd in the high (the
+    wq_matmul_i4 layout).  Host-side numpy; runs once at quantize
+    time."""
+    q = np.asarray(q, np.int8)
+    if q.shape[-1] % 2:
+        raise MXTPUError("pack_int4 needs an even input dim, got %r"
+                         % (q.shape,))
+    lo = q[..., 0::2].astype(np.uint8) & 0xF
+    hi = q[..., 1::2].astype(np.uint8) & 0xF
+    return ((hi << 4) | lo).astype(np.uint8).view(np.int8)
+
+
+def unpack_int4(packed):
+    """Inverse of pack_int4 (tests / inspection)."""
+    b = np.asarray(packed, np.int8)
+    lo = (b.astype(np.int8) << 4).astype(np.int8) >> 4
+    hi = b >> 4
+    out = np.stack([lo, hi], axis=-1)
+    return out.reshape(b.shape[:-1] + (b.shape[-1] * 2,))
+
+
+def _i4_group(in_units, group_size):
+    """Largest divisor of ``in_units`` <= the requested group size —
+    group boundaries must tile the input dim exactly."""
+    g = max(1, min(int(group_size), in_units))
+    while in_units % g:
+        g -= 1
+    return g
+
+
+class QuantizedDense(_Dense):
+    """Weight-only quantized Dense: packed int8/int4 weight + scale
+    params, forward through the fused wq_matmul ops.  Subclasses Dense
+    so :func:`quantize_weights` can swap it into a parent block under
+    the attribute-type guard; built from an INITIALIZED Dense.
+
+    The packed ``weight`` parameter keeps the original parameter NAME
+    (so existing TP sharding rules — e.g. ``qkv_weight$`` → column
+    parallel — apply unchanged); the new ``wscale`` parameter gets an
+    exact-name rule appended by quantize_weights."""
+
+    def __init__(self, units, in_units, bits=8, group_size=64,
+                 use_bias=True, flatten=False, activation=None,
+                 dtype="float32", **kwargs):
+        from ..gluon.block import HybridBlock
+        from ..gluon.nn.basic_layers import Activation
+
+        HybridBlock.__init__(self, **kwargs)
+        if bits not in (8, 4):
+            raise MXTPUError("weight-only bits must be 8 or 4, got %r"
+                             % (bits,))
+        if bits == 4 and in_units % 2:
+            raise MXTPUError(
+                "int4 packing needs an even input dim, got %d" % in_units)
+        self._units = units
+        self._in_units = in_units
+        self._flatten = flatten
+        self._bits = bits
+        self._gs = _i4_group(in_units, group_size) if bits == 4 else 0
+        with self.name_scope():
+            wshape = ((units, in_units) if bits == 8
+                      else (units, in_units // 2))
+            sshape = ((units,) if bits == 8
+                      else (units, in_units // self._gs))
+            self.weight = self.params.get(
+                "weight", shape=wshape, dtype="int8", grad_req="null",
+                init="zeros")
+            self.wscale = self.params.get(
+                "wscale", shape=sshape, dtype="float32", grad_req="null",
+                init="ones")
+            if use_bias:
+                self.bias = self.params.get(
+                    "bias", shape=(units,), dtype=dtype, init="zeros")
+            else:
+                self.bias = None
+            if activation is not None:
+                self.act = Activation(activation, prefix=activation + "_")
+            else:
+                self.act = None
+
+    def infer_shape(self, x, *args):
+        pass  # shapes are concrete at construction
+
+    def hybrid_forward(self, F, x, weight=None, wscale=None, bias=None):
+        if self._bits == 8:
+            out = F.wq_matmul_i8(x, weight, wscale, bias,
+                                 flatten=self._flatten,
+                                 no_bias=bias is None)
+        else:
+            out = F.wq_matmul_i4(x, weight, wscale, bias,
+                                 flatten=self._flatten,
+                                 no_bias=bias is None,
+                                 group_size=self._gs,
+                                 in_units=self._in_units)
+        if self.act is not None:
+            out = self.act(out)
+        return out
+
+    def __repr__(self):
+        return ("%s(%d -> %d, int%d%s)"
+                % (type(self).__name__, self._in_units, self._units,
+                   self._bits,
+                   ", gs=%d" % self._gs if self._bits == 4 else ""))
+
+
+def _quantize_dense(dense, bits, group_size):
+    """Build the QuantizedDense replacement for one initialized Dense."""
+    from .. import ndarray as _nd
+
+    w = dense.weight.data().asnumpy().astype(np.float32)
+    O, I = w.shape
+    act = dense.act._act_type if dense.act is not None else None
+    qd = QuantizedDense(O, I, bits=bits, group_size=group_size,
+                        use_bias=dense.bias is not None,
+                        flatten=dense._flatten, activation=act,
+                        prefix=dense.prefix)
+    qd.initialize()
+    if bits == 8:
+        s = np.maximum(np.abs(w).max(axis=1), 1e-8) / 127.0    # (O,)
+        q = np.clip(np.round(w / s[:, None]), -127, 127).astype(np.int8)
+        qd.weight.set_data(_nd.array(q))
+        qd.wscale.set_data(_nd.array(s.astype(np.float32)))
+    else:
+        gs = qd._gs
+        wg = w.reshape(O, I // gs, gs)
+        s = np.maximum(np.abs(wg).max(axis=2), 1e-8) / 7.0     # (O, G)
+        q = np.clip(np.round(wg / s[..., None]), -7, 7).astype(
+            np.int8).reshape(O, I)
+        qd.weight.set_data(_nd.array(pack_int4(q)))
+        qd.wscale.set_data(_nd.array(s.astype(np.float32)))
+    if dense.bias is not None:
+        qd.bias.set_data(dense.bias.data())
+    return qd
+
+
+def quantize_weights(block, bits=8, group_size=64, rules=None,
+                     exclude=()):
+    """Rewrite every initialized ``nn.Dense`` under ``block`` —
+    attention/FFN projections, lm heads — to a packed-weight
+    :class:`QuantizedDense` (weight-only int8 or int4; activations and
+    the KV cache are untouched — pair with ``cache_dtype="int8"`` for
+    the full quantized serving path, docs/inference.md).
+
+    ``rules``: the block's TP ShardingRules; returns a NEW ShardingRules
+    extending them with exact-name rules for each ``wscale`` parameter
+    (an int8 scale shards with its weight's output-channel axis; int4
+    group scales shard the output-channel axis and replicate the group
+    axis), so the result drops into ``ShardedDecoder`` under tensor
+    parallelism unchanged.  ``exclude``: parameter-name substrings to
+    leave in float (e.g. ``("lm_head",)``).
+
+    Embedding weights (and a tied lm head, which reads the embedding)
+    are never touched.  Raises on uninitialized parameters — quantize
+    after ``initialize()`` + shape resolution (one forward if shapes
+    were deferred)."""
+    import re as _re
+
+    from ..parallel.sharding import PartitionSpec as _P, ShardingRules
+
+    if bits not in (8, 4):
+        raise MXTPUError("weight-only bits must be 8 or 4, got %r"
+                         % (bits,))
+    base = rules.iter_rules() if rules is not None else []
+    out_rules = ShardingRules(list(base))
+    quantized = []
+
+    def walk(parent):
+        for name, child in list(parent._children.items()):
+            if type(child) is _Dense and not any(
+                    token in child.weight.name for token in exclude):
+                if child.weight._data is None and not \
+                        child.weight._deferred_init:
+                    raise MXTPUError(
+                        "quantize_weights: parameter %r is uninitialized"
+                        " — call initialize() first" % child.weight.name)
+                if child.weight._deferred_init or 0 in child.weight.shape:
+                    raise MXTPUError(
+                        "quantize_weights: parameter %r has a deferred "
+                        "shape — run one forward pass first"
+                        % child.weight.name)
+                qd = _quantize_dense(child, bits, group_size)
+                if getattr(parent, name, None) is child:
+                    setattr(parent, name, qd)   # re-registers the child
+                else:
+                    parent._children[name] = qd
+                wspec = tuple(rules.spec_for(child.weight.name, 2)) \
+                    if rules is not None else ()
+                col = wspec[0] if wspec else None
+                sspec = _P(col) if bits == 8 else _P(col, None)
+                out_rules.add(_re.escape(qd.wscale.name) + "$", sspec)
+                quantized.append(child.weight.name)
+            else:
+                walk(child)
+
+    walk(block)
+    if not quantized:
+        raise MXTPUError("quantize_weights: no initialized Dense layers "
+                         "found under %r" % (block,))
+    out_rules.quantized_params = tuple(quantized)
+    return out_rules
 
 
 # ----------------------------------------------------------- calibration

@@ -32,6 +32,115 @@ __all__ = ["MultiHeadAttention", "TransformerEncoderLayer",
            "transformer_lm_sharding_rules", "bert_sharding_rules"]
 
 
+# -------------------------------------------------------- KV-cache leaves
+# A cache leaf is either one float tensor (the original layout) or, with
+# cache_dtype="int8", a (payload, scales) PAIR: int8 payload of the same
+# shape plus a float32 per-head-per-position scale tensor (payload shape
+# minus the trailing D axis).  The helpers below dispatch every cache
+# read/write on the leaf form, so the attention math stays written once
+# — quantized decode is the same program with a dequantize fused into
+# the cache read and a quantize fused into the write.
+
+def _q8cache(leaf):
+    """True when a cache leaf is the quantized (payload, scales) pair."""
+    return isinstance(leaf, tuple)
+
+
+def _cache_fp(leaf):
+    """Float view of a cache leaf for the attention contraction."""
+    return nd._internal_cache_dequant(*leaf) if _q8cache(leaf) else leaf
+
+
+def _payload(leaf):
+    """The payload tensor of a leaf (shape/dtype carrier)."""
+    return leaf[0] if _q8cache(leaf) else leaf
+
+
+def _cache_write(leaf, new, pos):
+    if _q8cache(leaf):
+        return tuple(nd._internal_cache_write_q8(leaf[0], leaf[1], new,
+                                                 pos=pos))
+    return nd._internal_cache_write(leaf, new, pos=pos)
+
+
+def _cache_write_rows(leaf, new, pos):
+    if _q8cache(leaf):
+        return tuple(nd._internal_cache_write_rows_q8(
+            leaf[0], leaf[1], new, pos))
+    return nd._internal_cache_write_rows(leaf, new, pos=pos)
+
+
+def _cache_write_span(leaf, new, pos, valid_len):
+    if _q8cache(leaf):
+        return tuple(nd._internal_cache_write_span_q8(
+            leaf[0], leaf[1], new, pos, valid_len))
+    return nd._internal_cache_write_span(leaf, new, pos=pos,
+                                         valid_len=valid_len)
+
+
+def _cache_write_slot(leaf, slot_leaf, slot, pos=0):
+    if _q8cache(leaf):
+        return tuple(nd._internal_cache_write_slot_q8(
+            leaf[0], leaf[1], slot_leaf[0], slot_leaf[1], slot=slot,
+            pos=pos))
+    return nd._internal_cache_write_slot(leaf, slot_leaf, slot=slot,
+                                         pos=pos)
+
+
+def _paged_write(leaf, new, table, start_pos=0):
+    if _q8cache(leaf):
+        return tuple(nd._paged_cache_write_q8(leaf[0], leaf[1], new,
+                                              table, start_pos=start_pos))
+    return nd._paged_cache_write(leaf, new, table, start_pos=start_pos)
+
+
+def _paged_write_rows(leaf, new, tables, pos):
+    if _q8cache(leaf):
+        return tuple(nd._paged_cache_write_rows_q8(
+            leaf[0], leaf[1], new, tables, pos))
+    return nd._paged_cache_write_rows(leaf, new, tables, pos=pos)
+
+
+def _paged_write_span(leaf, new, tables, pos, valid_len):
+    if _q8cache(leaf):
+        return tuple(nd._paged_cache_write_span_q8(
+            leaf[0], leaf[1], new, tables, pos, valid_len))
+    return nd._paged_cache_write_span(leaf, new, tables, pos=pos,
+                                      valid_len=valid_len)
+
+
+def _paged_gather(leaf, table):
+    """Sequence-order float view of a paged cache leaf."""
+    if _q8cache(leaf):
+        return nd._paged_cache_gather_q8(leaf[0], leaf[1], table)
+    return nd._paged_cache_gather(leaf, table)
+
+
+def _page_copy(leaf, src, dst):
+    """Copy-on-write page clone — payload AND scales for int8 leaves
+    (the same axis-0 page copy applies to both)."""
+    if _q8cache(leaf):
+        return (nd._paged_block_copy(leaf[0], src=src, dst=dst),
+                nd._paged_block_copy(leaf[1], src=src, dst=dst))
+    return nd._paged_block_copy(leaf, src=src, dst=dst)
+
+
+def _paged_kernel_attention(q, pool_k, pool_v, tables, pos):
+    """Route the paged cache read through the ragged Pallas kernel
+    (ops/pallas/paged_attention — gated MXTPU_PALLAS_PAGED_ATTN); q is
+    (B, H, W, D) post-rope, returns (B, H, W, D)."""
+    if _q8cache(pool_k):
+        return nd.paged_decode_attention(
+            q, pool_k[0], pool_v[0], tables, pos,
+            k_scales=pool_k[1], v_scales=pool_v[1])
+    return nd.paged_decode_attention(q, pool_k, pool_v, tables, pos)
+
+
+def _paged_attn_on():
+    from ..ops.pallas.paged_attention import paged_attention_enabled
+    return paged_attention_enabled()
+
+
 class RMSNorm(HybridBlock):
     def __init__(self, units, eps=1e-6, **kwargs):
         super().__init__(**kwargs)
@@ -121,9 +230,19 @@ class MultiHeadAttention(HybridBlock):
     def init_cache(self, batch_size, max_length, dtype="float32"):
         """Static-size KV cache: (B, KV_heads, T_max, D) per tensor.  The
         fixed shape is deliberate — every decode step reuses one compiled
-        program instead of recompiling per sequence length."""
+        program instead of recompiling per sequence length.
+
+        ``dtype="int8"`` returns the QUANTIZED layout instead: each leaf
+        is an (int8 payload, float32 (B, KV, T_max) scales) pair — half
+        the cache bytes plus one scale per head per position (docs/
+        inference.md "Quantized serving")."""
         KV, D = self._kv_heads, self._head_dim
         shape = (batch_size, KV, max_length, D)
+        if str(dtype) == "int8":
+            def leaf():
+                return (nd.zeros(shape, dtype="int8"),
+                        nd.zeros(shape[:-1], dtype="float32"))
+            return (leaf(), leaf())
         return (nd.zeros(shape, dtype=dtype), nd.zeros(shape, dtype=dtype))
 
     def step(self, x, cache_k, cache_v, pos):
@@ -134,7 +253,7 @@ class MultiHeadAttention(HybridBlock):
         """
         B = x.shape[0]
         H, KV, D = self._heads, self._kv_heads, self._head_dim
-        Tmax = cache_k.shape[2]
+        Tmax = _payload(cache_k).shape[2]
         qkv = self.qkv(x)  # (B, 1, (H+2KV)*D)
         q = qkv[:, :, :H * D].reshape(B, 1, H, D).transpose((0, 2, 1, 3))
         k = qkv[:, :, H * D:(H + KV) * D].reshape(
@@ -147,8 +266,8 @@ class MultiHeadAttention(HybridBlock):
         # dynamic_update_slice write: pos may be a python int (eager
         # generate) or a traced scalar (ShardedDecoder's single compiled
         # step for every position)
-        cache_k = nd._internal_cache_write(cache_k, k, pos=pos)
-        cache_v = nd._internal_cache_write(cache_v, v, pos=pos)
+        cache_k = _cache_write(cache_k, k, pos)
+        cache_v = _cache_write(cache_v, v, pos)
         # GQA without materializing repeated caches: fold the rep axis
         # into the query rows and contract against the UNrepeated cache
         # (decode is bandwidth-bound; nd.repeat would copy the whole
@@ -157,8 +276,8 @@ class MultiHeadAttention(HybridBlock):
         # interleaving.
         rep = H // KV
         q_r = q.reshape(B * KV, rep, D)            # (B*KV, rep, D)
-        keys = cache_k.reshape(B * KV, Tmax, D)
-        values = cache_v.reshape(B * KV, Tmax, D)
+        keys = _cache_fp(cache_k).reshape(B * KV, Tmax, D)
+        values = _cache_fp(cache_v).reshape(B * KV, Tmax, D)
         scores = nd.batch_dot(q_r, keys,
                               transpose_b=True) / math.sqrt(D)
         valid = nd.arange(0, Tmax) <= pos  # causal+occupancy in one mask
@@ -177,7 +296,7 @@ class MultiHeadAttention(HybridBlock):
         compiled step serves every position combination."""
         B = x.shape[0]
         H, KV, D = self._heads, self._kv_heads, self._head_dim
-        Tmax = cache_k.shape[2]
+        Tmax = _payload(cache_k).shape[2]
         qkv = self.qkv(x)  # (B, 1, (H+2KV)*D)
         q = qkv[:, :, :H * D].reshape(B, 1, H, D).transpose((0, 2, 1, 3))
         k = qkv[:, :, H * D:(H + KV) * D].reshape(
@@ -187,13 +306,13 @@ class MultiHeadAttention(HybridBlock):
         if self._rotary:
             q = nd.rope(q, offset=pos)  # (B,) offset: per-row rotation
             k = nd.rope(k, offset=pos)
-        cache_k = nd._internal_cache_write_rows(cache_k, k, pos=pos)
-        cache_v = nd._internal_cache_write_rows(cache_v, v, pos=pos)
+        cache_k = _cache_write_rows(cache_k, k, pos)
+        cache_v = _cache_write_rows(cache_v, v, pos)
         # same GQA fold as step(); the validity mask is per-ROW here
         rep = H // KV
         q_r = q.reshape(B * KV, rep, D)            # (B*KV, rep, D)
-        keys = cache_k.reshape(B * KV, Tmax, D)
-        values = cache_v.reshape(B * KV, Tmax, D)
+        keys = _cache_fp(cache_k).reshape(B * KV, Tmax, D)
+        values = _cache_fp(cache_v).reshape(B * KV, Tmax, D)
         scores = nd.batch_dot(q_r, keys,
                               transpose_b=True) / math.sqrt(D)
         valid = (nd.arange(0, Tmax).reshape((1, Tmax))
@@ -223,7 +342,7 @@ class MultiHeadAttention(HybridBlock):
         mask until sequential re-writes overtake them."""
         B, W, _ = x.shape
         H, KV, D = self._heads, self._kv_heads, self._head_dim
-        Tmax = cache_k.shape[2]
+        Tmax = _payload(cache_k).shape[2]
         qkv = self.qkv(x)  # (B, W, (H+2KV)*D)
         q = qkv[:, :, :H * D].reshape(B, W, H, D).transpose((0, 2, 1, 3))
         k = qkv[:, :, H * D:(H + KV) * D].reshape(
@@ -233,16 +352,14 @@ class MultiHeadAttention(HybridBlock):
         if self._rotary:
             q = nd.rope(q, offset=pos)  # (B,) offset + intra-window arange
             k = nd.rope(k, offset=pos)
-        cache_k = nd._internal_cache_write_span(cache_k, k, pos=pos,
-                                                valid_len=valid_len)
-        cache_v = nd._internal_cache_write_span(cache_v, v, pos=pos,
-                                                valid_len=valid_len)
+        cache_k = _cache_write_span(cache_k, k, pos, valid_len)
+        cache_v = _cache_write_span(cache_v, v, pos, valid_len)
         # the step_slots GQA fold with W queries; validity is per-row
         # AND per-window-index: query w sees keys <= pos[b]+w
         rep = H // KV
         q_r = q.reshape(B * KV, rep * W, D)
-        keys = cache_k.reshape(B * KV, Tmax, D)
-        values = cache_v.reshape(B * KV, Tmax, D)
+        keys = _cache_fp(cache_k).reshape(B * KV, Tmax, D)
+        values = _cache_fp(cache_v).reshape(B * KV, Tmax, D)
         scores = nd.batch_dot(q_r, keys,
                               transpose_b=True) / math.sqrt(D)
         valid = (nd.arange(0, Tmax).reshape((1, 1, Tmax))
@@ -266,7 +383,7 @@ class MultiHeadAttention(HybridBlock):
         touch was allocated at admission)."""
         B, W, _ = x.shape
         H, KV, D = self._heads, self._kv_heads, self._head_dim
-        Tmax = tables.shape[1] * pool_k.shape[2]
+        Tmax = tables.shape[1] * _payload(pool_k).shape[2]
         qkv = self.qkv(x)
         q = qkv[:, :, :H * D].reshape(B, W, H, D).transpose((0, 2, 1, 3))
         k = qkv[:, :, H * D:(H + KV) * D].reshape(
@@ -276,13 +393,18 @@ class MultiHeadAttention(HybridBlock):
         if self._rotary:
             q = nd.rope(q, offset=pos)
             k = nd.rope(k, offset=pos)
-        pool_k = nd._paged_cache_write_span(pool_k, k, tables, pos=pos,
-                                            valid_len=valid_len)
-        pool_v = nd._paged_cache_write_span(pool_v, v, tables, pos=pos,
-                                            valid_len=valid_len)
-        keys = nd._paged_cache_gather(pool_k, tables).reshape(
+        pool_k = _paged_write_span(pool_k, k, tables, pos, valid_len)
+        pool_v = _paged_write_span(pool_v, v, tables, pos, valid_len)
+        if _paged_attn_on():
+            # ragged Pallas kernel: walk each row's block table, read
+            # only valid rows, per-lane causal extent pos[b]+w
+            out = _paged_kernel_attention(q, pool_k, pool_v, tables,
+                                          pos)                # (B,H,W,D)
+            out = out.transpose((0, 2, 1, 3)).reshape(B, W, H * D)
+            return self.out_proj(out), pool_k, pool_v
+        keys = _paged_gather(pool_k, tables).reshape(
             B * KV, Tmax, D)
-        values = nd._paged_cache_gather(pool_v, tables).reshape(
+        values = _paged_gather(pool_v, tables).reshape(
             B * KV, Tmax, D)
         rep = H // KV
         q_r = q.reshape(B * KV, rep * W, D)
@@ -303,9 +425,19 @@ class MultiHeadAttention(HybridBlock):
         """Block-paged KV cache: (num_blocks, KV_heads, block_size, D)
         per tensor — the pool the continuous-batching engine's block
         tables index into.  Like init_cache, the fixed shape is the
-        point: one compiled program serves every table content."""
+        point: one compiled program serves every table content.
+
+        ``dtype="int8"`` stores each pool as an (int8 payload, float32
+        (num_blocks, KV, block_size) scales) pair — the paged form of
+        the quantized cache (scales live page-aligned beside their
+        payload pages, so allocation/sharing/COW stay page-granular)."""
         KV, D = self._kv_heads, self._head_dim
         shape = (num_blocks, KV, block_size, D)
+        if str(dtype) == "int8":
+            def leaf():
+                return (nd.zeros(shape, dtype="int8"),
+                        nd.zeros(shape[:-1], dtype="float32"))
+            return (leaf(), leaf())
         return (nd.zeros(shape, dtype=dtype), nd.zeros(shape, dtype=dtype))
 
     def step_pages(self, x, pool_k, pool_v, tables, pos):
@@ -318,7 +450,7 @@ class MultiHeadAttention(HybridBlock):
         the same math on the same shapes."""
         B = x.shape[0]
         H, KV, D = self._heads, self._kv_heads, self._head_dim
-        Tmax = tables.shape[1] * pool_k.shape[2]
+        Tmax = tables.shape[1] * _payload(pool_k).shape[2]
         qkv = self.qkv(x)  # (B, 1, (H+2KV)*D)
         q = qkv[:, :, :H * D].reshape(B, 1, H, D).transpose((0, 2, 1, 3))
         k = qkv[:, :, H * D:(H + KV) * D].reshape(
@@ -328,12 +460,20 @@ class MultiHeadAttention(HybridBlock):
         if self._rotary:
             q = nd.rope(q, offset=pos)  # (B,) offset: per-row rotation
             k = nd.rope(k, offset=pos)
-        pool_k = nd._paged_cache_write_rows(pool_k, k, tables, pos=pos)
-        pool_v = nd._paged_cache_write_rows(pool_v, v, tables, pos=pos)
+        pool_k = _paged_write_rows(pool_k, k, tables, pos)
+        pool_v = _paged_write_rows(pool_v, v, tables, pos)
+        if _paged_attn_on():
+            # ragged Pallas kernel replaces the gather+softmax read:
+            # each (slot, kv-head) walks its own block-table chain and
+            # touches only rows <= pos[b] (docs/inference.md)
+            out = _paged_kernel_attention(q, pool_k, pool_v, tables,
+                                          pos)                # (B,H,1,D)
+            out = out.transpose((0, 2, 1, 3)).reshape(B, 1, H * D)
+            return self.out_proj(out), pool_k, pool_v
         # gather the pages into sequence order, then the step_slots math
-        keys = nd._paged_cache_gather(pool_k, tables).reshape(
+        keys = _paged_gather(pool_k, tables).reshape(
             B * KV, Tmax, D)
-        values = nd._paged_cache_gather(pool_v, tables).reshape(
+        values = _paged_gather(pool_v, tables).reshape(
             B * KV, Tmax, D)
         rep = H // KV
         q_r = q.reshape(B * KV, rep, D)            # (B*KV, rep, D)
@@ -359,7 +499,7 @@ class MultiHeadAttention(HybridBlock):
         entirely."""
         B, T, _ = x.shape
         H, KV, D = self._heads, self._kv_heads, self._head_dim
-        Tmax = table.shape[-1] * pool_k.shape[2]
+        Tmax = table.shape[-1] * _payload(pool_k).shape[2]
         qkv = self.qkv(x)
         q = qkv[:, :, :H * D].reshape(B, T, H, D).transpose((0, 2, 1, 3))
         k = qkv[:, :, H * D:(H + KV) * D].reshape(
@@ -369,13 +509,11 @@ class MultiHeadAttention(HybridBlock):
         if self._rotary:
             q = nd.rope(q, offset=start_pos)
             k = nd.rope(k, offset=start_pos)
-        pool_k = nd._paged_cache_write(pool_k, k, table,
-                                       start_pos=start_pos)
-        pool_v = nd._paged_cache_write(pool_v, v, table,
-                                       start_pos=start_pos)
-        keys = nd._paged_cache_gather(pool_k, table).reshape(
+        pool_k = _paged_write(pool_k, k, table, start_pos=start_pos)
+        pool_v = _paged_write(pool_v, v, table, start_pos=start_pos)
+        keys = _paged_gather(pool_k, table).reshape(
             B * KV, Tmax, D)
-        values = nd._paged_cache_gather(pool_v, table).reshape(
+        values = _paged_gather(pool_v, table).reshape(
             B * KV, Tmax, D)
         rep = H // KV
         q_r = q.reshape(B * KV, rep * T, D)
@@ -404,7 +542,7 @@ class MultiHeadAttention(HybridBlock):
         functional: thread the returned caches forward."""
         B, T, _ = x.shape
         H, KV, D = self._heads, self._kv_heads, self._head_dim
-        Tmax = cache_k.shape[2]
+        Tmax = _payload(cache_k).shape[2]
         qkv = self.qkv(x)
         q = qkv[:, :, :H * D].reshape(B, T, H, D).transpose((0, 2, 1, 3))
         k = qkv[:, :, H * D:(H + KV) * D].reshape(
@@ -414,14 +552,14 @@ class MultiHeadAttention(HybridBlock):
         if self._rotary:
             q = nd.rope(q, offset=start_pos)
             k = nd.rope(k, offset=start_pos)
-        cache_k = nd._internal_cache_write(cache_k, k, pos=start_pos)
-        cache_v = nd._internal_cache_write(cache_v, v, pos=start_pos)
+        cache_k = _cache_write(cache_k, k, start_pos)
+        cache_v = _cache_write(cache_v, v, start_pos)
         # GQA over the UNrepeated cache (same fold as step(): q head
         # h = kv*rep + r, kv-major — matches hybrid_forward's repeat)
         rep = H // KV
         q_r = q.reshape(B * KV, rep * T, D)
-        keys = cache_k.reshape(B * KV, Tmax, D)
-        values = cache_v.reshape(B * KV, Tmax, D)
+        keys = _cache_fp(cache_k).reshape(B * KV, Tmax, D)
+        values = _cache_fp(cache_v).reshape(B * KV, Tmax, D)
         scores = nd.batch_dot(q_r, keys,
                               transpose_b=True) / math.sqrt(D)
         # query at sequence position start_pos+t sees keys <= its own
@@ -803,8 +941,8 @@ class TransformerLM(HybridBlock):
         ``slot`` may be a traced scalar; returns new pool caches
         (functional, like step/prefill)."""
         return [
-            (nd._internal_cache_write_slot(ck, sk, slot=slot, pos=pos),
-             nd._internal_cache_write_slot(cv, sv, slot=slot, pos=pos))
+            (_cache_write_slot(ck, sk, slot, pos=pos),
+             _cache_write_slot(cv, sv, slot, pos=pos))
             for (ck, cv), (sk, sv) in zip(caches, slot_caches)]
 
     # -- block-paged decode (PagedContinuousBatchingEngine) ------------
@@ -849,8 +987,7 @@ class TransformerLM(HybridBlock):
         the admission-time copy-on-write of prefix sharing.  ``src`` /
         ``dst`` may be traced scalars; ``src == dst`` is a bit-exact
         no-op (how the fused prefill program skips COW)."""
-        return [(nd._paged_block_copy(pk, src=src, dst=dst),
-                 nd._paged_block_copy(pv, src=src, dst=dst))
+        return [(_page_copy(pk, src, dst), _page_copy(pv, src, dst))
                 for pk, pv in pools]
 
     def generate(self, prompt_ids, max_new_tokens, max_length=None,

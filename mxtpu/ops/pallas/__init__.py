@@ -3,3 +3,4 @@ src/operator/contrib/transformer.cc fused attention + fusion/fused_op RTC —
 where the reference hand-wrote CUDA, mxtpu hand-writes Pallas)."""
 
 from .flash_attention import flash_attention
+from .paged_attention import paged_decode_attention

@@ -1,0 +1,243 @@
+"""Ragged paged decode-attention kernel in Pallas (TPU).
+
+Serving's first Pallas kernel (ROADMAP item 3): the paged engines'
+decode/verify read is a gather of EVERY table entry — M pages per slot,
+padded entries included — followed by a masked softmax over the full
+padded extent.  This kernel walks each slot's int32 block table with
+scalar-prefetched indices instead: grid (B, KV, M), each step DMAs ONE
+page of one kv head selected by ``tables[b, j]``, pages past the slot's
+valid extent are routed to the reserved null page 0 (a single-page
+no-op read) and skipped by ``pl.when`` — so HBM traffic is
+O(valid pages), not O(table width), which is the one-cache-read claim
+of speculative verify at kernel granularity.
+
+Softmax runs in online (max/denominator-carrying) form across the page
+walk, fp32 accumulation, exactly the flash_attention discipline.  The
+verify window rides the same kernel: q carries W lanes per query head
+and lane w of slot b attends logical positions <= pos[b] + w.
+
+int8 variant: with ``k_scales`` / ``v_scales`` the pools are int8
+payloads and the per-head-per-position scales dequantize INSIDE the
+kernel — the cache crosses HBM at one byte per element and never
+materializes a float copy.
+
+Gating mirrors the training kernels: ``MXTPU_PALLAS_PAGED_ATTN=1``
+routes ``TransformerLM.step_pages`` / ``verify_pages`` through this
+kernel (default off — the XLA gather path is the bit-exact parity
+reference for the serving engines); interpret mode on CPU, verified
+against the XLA path in tests/test_paged_attention_pallas.py.  Note:
+TPU-native lowering wants block_size a multiple of the dtype tile
+sublane (8 fp32 / 32 int8) and D a multiple of 128 for full MXU
+utilization; the engines' CPU-test geometries run in interpret mode
+only.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from ...base import env_bool, register_op
+
+__all__ = ["paged_decode_attention", "paged_attention_enabled"]
+
+_NEG_INF = -1e30
+
+# trace-time invocation counter: tests assert step_pages/verify_pages
+# actually ride the kernel when the gate is on (one bump per traced
+# pallas_call, not per execution)
+_invocations = 0
+
+
+def paged_attention_enabled() -> bool:
+    """True when MXTPU_PALLAS_PAGED_ATTN routes the paged engines' cache
+    read through this kernel (docs/inference.md "Quantized serving")."""
+    return env_bool("MXTPU_PALLAS_PAGED_ATTN", False)
+
+
+def invocation_count() -> int:
+    return _invocations
+
+
+def _kernel(tbl_ref, pos_ref, nv_ref, q_ref, k_ref, *rest,
+            sm_scale, bs, W, n_pages, quant):
+    """One (slot b, kv head) pair walks its block-table chain; carries
+    online-softmax state in VMEM scratch across the page walk.  With
+    ``quant`` the pools are int8 payloads and ``rest`` carries their
+    scale refs — the page dequantizes (payload × per-head-per-position
+    scale) inside the kernel, then the identical online softmax."""
+    if quant:
+        ks_ref, v_ref, vs_ref, o_ref, m_ref, l_ref, acc_ref = rest
+    else:
+        v_ref, o_ref, m_ref, l_ref, acc_ref = rest
+    b = pl.program_id(0)
+    j = pl.program_id(2)
+
+    @pl.when(j == 0)
+    def _init():
+        m_ref[...] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    @pl.when(j < nv_ref[b])
+    def _page():
+        q = q_ref[0, 0].astype(jnp.float32) * sm_scale      # (rep*W, D)
+        lanes, d = q.shape
+        k = k_ref[0, 0].astype(jnp.float32)                 # (bs, D)
+        v = v_ref[0, 0].astype(jnp.float32)
+        if quant:
+            k = k * ks_ref[0, 0].astype(jnp.float32)[:, None]
+            v = v * vs_ref[0, 0].astype(jnp.float32)[:, None]
+        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32)
+        # logical key positions of this page vs each lane's extent:
+        # lane l = r*W + w attends positions <= pos[b] + (l % W)
+        k_pos = j * bs + jax.lax.broadcasted_iota(
+            jnp.int32, (lanes, bs), 1)
+        w = jax.lax.broadcasted_iota(jnp.int32, (lanes, bs), 0) % W
+        s = jnp.where(k_pos <= pos_ref[b] + w, s, _NEG_INF)
+        m_prev = m_ref[...]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        corr = jnp.exp(m_prev - m_new)
+        m_ref[...] = m_new
+        l_ref[...] = l_ref[...] * corr + jnp.sum(p, axis=-1,
+                                                 keepdims=True)
+        acc_ref[...] = acc_ref[...] * corr + jnp.dot(
+            p, v, preferred_element_type=jnp.float32)
+
+    @pl.when(j == n_pages - 1)
+    def _fin():
+        o_ref[0, 0] = (acc_ref[...]
+                       / jnp.maximum(l_ref[...], 1e-30)).astype(
+            o_ref.dtype)
+
+
+def _page_index(b, kv, j, tbl, pos, nv):
+    """Block-table page selection for the pool BlockSpecs: valid steps
+    read ``tables[b, j]``; steps past the slot's valid extent read the
+    reserved null page 0 (one small no-op DMA, skipped by pl.when)."""
+    return (jnp.where(j < nv[b], tbl[b, j], 0), kv, 0, 0)
+
+
+def _scale_index(b, kv, j, tbl, pos, nv):
+    return (jnp.where(j < nv[b], tbl[b, j], 0), kv, 0)
+
+
+def paged_decode_attention(q, pool_k, pool_v, tables, pos,
+                           k_scales=None, v_scales=None, scale=None):
+    """Ragged paged attention over block tables.
+
+    q : (B, H, W, D) queries — W = 1 for the plain decode step, > 1 for
+        a speculative verify window (lane w attends <= pos[b] + w).
+    pool_k / pool_v : (N, KV, bs, D) page pools (float, or int8 payload
+        when ``k_scales``/``v_scales`` (N, KV, bs) are given).
+    tables : (B, M) int32 block tables (page 0 = reserved null page).
+    pos : (B,) int32 per-slot positions (the last written position of
+        window lane 0).
+
+    Returns (B, H, W, D) in q's dtype.  H = KV * rep, kv-major (head
+    h = kv*rep + r — the models' GQA fold).
+    """
+    global _invocations
+    B, H, W, D = q.shape
+    N, KV, bs, _ = pool_k.shape
+    M = tables.shape[-1]
+    rep = H // KV
+    sm_scale = float(scale if scale is not None else 1.0 / math.sqrt(D))
+    quant = k_scales is not None
+
+    qr = q.reshape(B, KV, rep * W, D)
+    tables = tables.astype(jnp.int32)
+    pos = jnp.asarray(pos, jnp.int32).reshape(-1)
+    # pages this slot's window can touch: positions 0 .. pos + W - 1
+    nv = jnp.clip((pos + (W - 1)) // bs + 1, 1, M).astype(jnp.int32)
+
+    lanes = rep * W
+    grid = (B, KV, M)
+    in_specs = [
+        pl.BlockSpec((1, 1, lanes, D),
+                     lambda b, kv, j, tbl, pos, nv: (b, kv, 0, 0)),
+        pl.BlockSpec((1, 1, bs, D), _page_index),
+    ]
+    args = [qr, pool_k]
+    if quant:
+        in_specs.append(pl.BlockSpec((1, 1, bs), _scale_index))
+        args.append(k_scales)
+    in_specs.append(pl.BlockSpec((1, 1, bs, D), _page_index))
+    args.append(pool_v)
+    if quant:
+        in_specs.append(pl.BlockSpec((1, 1, bs), _scale_index))
+        args.append(v_scales)
+
+    kernel = functools.partial(_kernel, sm_scale=sm_scale, bs=bs,
+                               W=W, n_pages=M, quant=quant)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=3,
+        grid=grid,
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec(
+            (1, 1, lanes, D),
+            lambda b, kv, j, tbl, pos, nv: (b, kv, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((lanes, 1), jnp.float32),
+            pltpu.VMEM((lanes, 1), jnp.float32),
+            pltpu.VMEM((lanes, D), jnp.float32),
+        ],
+    )
+    interpret = jax.default_backend() == "cpu"
+    _invocations += 1
+    out = pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((B, KV, lanes, D), q.dtype),
+        grid_spec=grid_spec,
+        interpret=interpret,
+    )(tables, pos, nv, *args)
+    return out.reshape(B, KV, rep, W, D).reshape(B, H, W, D)
+
+
+def xla_reference(q, pool_k, pool_v, tables, pos, k_scales=None,
+                  v_scales=None, scale=None):
+    """The XLA gather path on raw arrays — the reference the kernel is
+    verified against (the same math the models' step_pages/verify_pages
+    run when the gate is off)."""
+    B, H, W, D = q.shape
+    N, KV, bs, _ = pool_k.shape
+    M = tables.shape[-1]
+    rep = H // KV
+    sm_scale = float(scale if scale is not None else 1.0 / math.sqrt(D))
+    t = tables.astype(jnp.int32)
+    pos = jnp.asarray(pos, jnp.int32).reshape(-1)
+
+    def gather(pool, scales):
+        g = pool[t].astype(jnp.float32)          # (B, M, KV, bs, D)
+        if scales is not None:
+            g = g * scales[t].astype(jnp.float32)[..., None]
+        return g.transpose(0, 2, 1, 3, 4).reshape(B, KV, M * bs, D)
+
+    keys = gather(pool_k, k_scales)
+    values = gather(pool_v, v_scales)
+    qr = q.reshape(B, KV, rep * W, D).astype(jnp.float32) * sm_scale
+    s = jnp.einsum("bkld,bktd->bklt", qr, keys,
+                   preferred_element_type=jnp.float32)
+    k_pos = jnp.arange(M * bs, dtype=jnp.int32)
+    w = jnp.arange(rep * W, dtype=jnp.int32) % W
+    valid = (k_pos[None, None, :]
+             <= pos[:, None, None] + w[None, :, None])     # (B, l, t)
+    s = jnp.where(valid[:, None], s, _NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    o = jnp.einsum("bklt,bktd->bkld", p, values)
+    return o.reshape(B, KV, rep, W, D).reshape(B, H, W, D).astype(
+        q.dtype)
+
+
+@register_op("paged_decode_attention", differentiable=False)
+def paged_decode_attention_op(q, pool_k, pool_v, tables, pos,
+                              k_scales=None, v_scales=None, scale=None):
+    return paged_decode_attention(q, pool_k, pool_v, tables, pos,
+                                  k_scales=k_scales, v_scales=v_scales,
+                                  scale=scale)

@@ -1217,6 +1217,200 @@ def _paged_block_copy(pool, src=0, dst=0):
 
 
 # ---------------------------------------------------------------------------
+# int8 KV cache (quantized serving): the cache payload is stored int8
+# with ONE symmetric per-head-per-position scale — payload (B, KV, T, D)
+# int8 rides a (B, KV, T) float32 scale tensor (paged: (N, KV, bs, D) +
+# (N, KV, bs)).  Every op below is the quantized twin of an existing
+# _internal_cache_write_* / _paged_cache_* op: identical index math on
+# the payload, the SAME scatter on the scale tensor (minus the D axis),
+# and shapes stay static so the compiled-program families do not widen.
+# Quantization is per token (scale = max|x| over D / 127), so a token's
+# stored cache entry is a pure function of that token's K/V vector —
+# chunked prefill, prefix sharing, and speculative span writes all
+# produce bit-identical cache content to a single-pass write, which is
+# what keeps the engines' parity invariant intact at int8.
+# ---------------------------------------------------------------------------
+
+_Q8_EPS = 1e-8  # scale floor (matches contrib.quantization._q_scale)
+
+
+def _q8_quantize(new):
+    """(…, D) float → ((…, D) int8, (…,) float32 scale): symmetric
+    round-to-nearest per-vector quantization.  All-zero vectors get the
+    floor scale, so dequantize(quantize(0)) == 0 exactly."""
+    x = new.astype(jnp.float32)
+    s = jnp.maximum(jnp.max(jnp.abs(x), axis=-1), _Q8_EPS) / 127.0
+    q = jnp.clip(jnp.round(x / s[..., None]), -127, 127).astype(jnp.int8)
+    return q, s
+
+
+@register_op("_internal_cache_dequant", differentiable=False)
+def _internal_cache_dequant(cache, scales):
+    """int8 cache payload → float32 view: q * scale, broadcasting the
+    per-head-per-position scale over D.  Positions never written keep
+    the zero-init scale floor times zero payload = exact zeros."""
+    return cache.astype(jnp.float32) * scales[..., None].astype(
+        jnp.float32)
+
+
+@register_op("_internal_cache_write_q8", differentiable=False,
+             num_outputs=2)
+def _internal_cache_write_q8(cache, scales, new, pos=0):
+    """Quantized twin of _internal_cache_write: quantize the (B, KV, T,
+    D) block per token and write payload + scales at column ``pos``
+    (prefill and the single-sequence decode step)."""
+    start = pos.astype(jnp.int32) if hasattr(pos, "astype") \
+        else jnp.int32(pos)
+    q, s = _q8_quantize(new)
+    cache = jax.lax.dynamic_update_slice_in_dim(
+        cache, q.astype(cache.dtype), start, axis=2)
+    scales = jax.lax.dynamic_update_slice_in_dim(
+        scales, s.astype(scales.dtype), start, axis=2)
+    return cache, scales
+
+
+@register_op("_internal_cache_write_rows_q8", differentiable=False,
+             num_outputs=2)
+def _internal_cache_write_rows_q8(cache, scales, new, pos):
+    """Quantized twin of _internal_cache_write_rows: row b of ``new``
+    (B, KV, 1, D) quantizes and lands at position ``pos[b]`` of payload
+    row b and scale row b (the pooled continuous-batching step)."""
+    p = jnp.asarray(pos, jnp.int32).reshape(-1)
+    rows = jnp.arange(cache.shape[0])
+    q, s = _q8_quantize(new)
+    cache = cache.at[rows, :, p, :].set(q[:, :, 0, :].astype(cache.dtype))
+    scales = scales.at[rows, :, p].set(s[:, :, 0].astype(scales.dtype))
+    return cache, scales
+
+
+@register_op("_internal_cache_write_span_q8", differentiable=False,
+             num_outputs=2)
+def _internal_cache_write_span_q8(cache, scales, new, pos, valid_len):
+    """Quantized twin of _internal_cache_write_span: the speculative
+    window write, invalid lanes routed to the dropped OOB position on
+    BOTH the payload and the scale scatter."""
+    B = cache.shape[0]
+    Tmax = cache.shape[2]
+    W = new.shape[2]
+    p = (jnp.asarray(pos, jnp.int32).reshape(-1, 1)
+         + jnp.arange(W, dtype=jnp.int32)[None, :])          # (B, W)
+    valid = (jnp.arange(W, dtype=jnp.int32)[None, :]
+             < jnp.asarray(valid_len, jnp.int32).reshape(-1, 1))
+    p = jnp.where(valid, p, Tmax)    # OOB scatter indices are dropped
+    q, s = _q8_quantize(new)
+    qv = q.transpose(0, 2, 1, 3).astype(cache.dtype)         # (B, W, KV, D)
+    sv = s.transpose(0, 2, 1).astype(scales.dtype)           # (B, W, KV)
+    rows = jnp.arange(B)[:, None]
+    cache = cache.at[rows, :, p, :].set(qv)
+    scales = scales.at[rows, :, p].set(sv)
+    return cache, scales
+
+
+@register_op("_internal_cache_write_slot_q8", differentiable=False,
+             num_outputs=2)
+def _internal_cache_write_slot_q8(cache, scales, new_q, new_s, slot=0,
+                                  pos=0):
+    """Quantized twin of _internal_cache_write_slot: copy an ALREADY
+    quantized batch-1 scratch block (payload (1, KV, T, D) int8 + its
+    (1, KV, T) scales — the slot-prefill scratch) into pool row
+    ``slot`` at column ``pos``.  No requantization: the pool row holds
+    bit-identical content to the scratch prefill."""
+    sl = slot.astype(jnp.int32) if hasattr(slot, "astype") \
+        else jnp.int32(slot)
+    p = pos.astype(jnp.int32) if hasattr(pos, "astype") \
+        else jnp.int32(pos)
+    zero = jnp.int32(0)
+    cache = jax.lax.dynamic_update_slice(
+        cache, new_q.astype(cache.dtype), (sl, zero, p, zero))
+    scales = jax.lax.dynamic_update_slice(
+        scales, new_s.astype(scales.dtype), (sl, zero, p))
+    return cache, scales
+
+
+@register_op("_paged_cache_gather_q8", differentiable=False)
+def _paged_cache_gather_q8(pool, scales, table):
+    """Quantized twin of _paged_cache_gather: gather payload AND scale
+    pages through the block table, dequantize, and return the float32
+    (..., KV, M*bs, D) sequence-order view in one op (on TPU the Pallas
+    ragged kernel replaces this read; this is the XLA path and the
+    parity reference)."""
+    t = table.astype(jnp.int32)
+    g = pool[t]                      # (..., M, KV, bs, D)
+    gs = scales[t]                   # (..., M, KV, bs)
+    m, kv, bs, d = g.shape[-4:]
+    lead = g.shape[:-4]
+    perm = tuple(range(len(lead))) + tuple(
+        len(lead) + a for a in (1, 0, 2, 3))
+    deq = g.astype(jnp.float32) * gs[..., None].astype(jnp.float32)
+    return deq.transpose(perm).reshape(lead + (kv, m * bs, d))
+
+
+@register_op("_paged_cache_write_q8", differentiable=False,
+             num_outputs=2)
+def _paged_cache_write_q8(pool, scales, new, table, start_pos=0):
+    """Quantized twin of _paged_cache_write: one prefill chunk (1, KV,
+    T, D) quantizes per token and scatters payload + scales through the
+    block table from logical position ``start_pos``."""
+    t = table.astype(jnp.int32).reshape(-1)
+    bs = pool.shape[2]
+    start = start_pos.astype(jnp.int32) if hasattr(start_pos, "astype") \
+        else jnp.int32(start_pos)
+    p = start + jnp.arange(new.shape[2], dtype=jnp.int32)
+    blk, off = t[p // bs], p % bs
+    q, s = _q8_quantize(new)
+    pool = pool.at[blk, :, off, :].set(
+        q[0].transpose(1, 0, 2).astype(pool.dtype))
+    scales = scales.at[blk, :, off].set(
+        s[0].transpose(1, 0).astype(scales.dtype))
+    return pool, scales
+
+
+@register_op("_paged_cache_write_rows_q8", differentiable=False,
+             num_outputs=2)
+def _paged_cache_write_rows_q8(pool, scales, new, tables, pos):
+    """Quantized twin of _paged_cache_write_rows: the pooled paged
+    decode write; dead lanes' all-null tables scribble only the null
+    page (payload and scales alike)."""
+    t = tables.astype(jnp.int32)
+    bs = pool.shape[2]
+    p = jnp.asarray(pos, jnp.int32).reshape(-1)
+    rows = jnp.arange(t.shape[0])
+    blk, off = t[rows, p // bs], p % bs
+    q, s = _q8_quantize(new)
+    pool = pool.at[blk, :, off, :].set(q[:, :, 0, :].astype(pool.dtype))
+    scales = scales.at[blk, :, off].set(s[:, :, 0].astype(scales.dtype))
+    return pool, scales
+
+
+@register_op("_paged_cache_write_span_q8", differentiable=False,
+             num_outputs=2)
+def _paged_cache_write_span_q8(pool, scales, new, tables, pos,
+                               valid_len):
+    """Quantized twin of _paged_cache_write_span: the speculative
+    window write through the block tables — invalid lanes (window
+    padding, valid_len 0 rows, off-table positions) route to the
+    reserved null page 0 on BOTH scatters, preserving the null-page
+    absorption contract."""
+    t = tables.astype(jnp.int32)                             # (B, M)
+    bs = pool.shape[2]
+    M = t.shape[1]
+    W = new.shape[2]
+    p = (jnp.asarray(pos, jnp.int32).reshape(-1, 1)
+         + jnp.arange(W, dtype=jnp.int32)[None, :])          # (B, W)
+    valid = (jnp.arange(W, dtype=jnp.int32)[None, :]
+             < jnp.asarray(valid_len, jnp.int32).reshape(-1, 1))
+    blk = jnp.take_along_axis(t, jnp.clip(p // bs, 0, M - 1), axis=1)
+    blk = jnp.where(valid & (p // bs < M), blk, 0)
+    off = p % bs
+    q, s = _q8_quantize(new)
+    qv = q.transpose(0, 2, 1, 3).astype(pool.dtype)          # (B, W, KV, D)
+    sv = s.transpose(0, 2, 1).astype(scales.dtype)           # (B, W, KV)
+    pool = pool.at[blk, :, off, :].set(qv)
+    scales = scales.at[blk, :, off].set(sv)
+    return pool, scales
+
+
+# ---------------------------------------------------------------------------
 # upstream mx.np internal op names (python/mxnet/numpy calls lower to
 # `_npi_*`-registered kernels in the reference — src/operator/numpy/**).
 # Aliased here ONLY where our canonical op already has exact numpy

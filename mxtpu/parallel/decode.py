@@ -43,6 +43,63 @@ def _bucket(n, base=8):
     return b
 
 
+# -- quantized-cache leaves -------------------------------------------------
+# With cache_dtype="int8" every cache leaf is a (payload, scales) PAIR
+# (models.transformer docstring).  The helpers below keep the decoder's
+# jit plumbing shape-generic: leaves wrap/unwrap structurally, sharding
+# trees map the payload to cache_spec and the (D-less) scale tensors to
+# cache_spec minus its trailing axis, and jit-cache keys read the
+# payload's shape/dtype so int8 programs key separately from float ones.
+
+def _leaf_q8(leaf):
+    return isinstance(leaf, tuple)
+
+
+def _leaf_payload(leaf):
+    return leaf[0] if _leaf_q8(leaf) else leaf
+
+
+def _wrap_leaf(leaf):
+    if _leaf_q8(leaf):
+        return (NDArray(leaf[0]), NDArray(leaf[1]))
+    return NDArray(leaf)
+
+
+def _unwrap_leaf(leaf):
+    if _leaf_q8(leaf):
+        return (leaf[0]._data, leaf[1]._data)
+    return leaf._data
+
+
+def _cache_shapes(cache_leaves):
+    return tuple(tuple(_leaf_payload(ck).shape)
+                 for ck, _ in cache_leaves)
+
+
+def _cache_dt(cache_leaves):
+    ck = cache_leaves[0][0]
+    return "int8" if _leaf_q8(ck) else str(ck.dtype)
+
+
+def _paged_attn_gate():
+    """MXTPU_PALLAS_PAGED_ATTN read for the paged jit-cache keys: the
+    kernel choice is baked at trace time, so flipping the env mid-
+    process must key a distinct program, not silently reuse one."""
+    from ..ops.pallas.paged_attention import paged_attention_enabled
+    return bool(paged_attention_enabled())
+
+
+def resolve_cache_dtype(cache_dtype):
+    """None → the ambient default: MXTPU_CACHE_DTYPE (e.g. "int8" to
+    run every engine/generate quantized without touching call sites),
+    falling back to float32."""
+    import os
+
+    if cache_dtype is not None:
+        return cache_dtype
+    return os.environ.get("MXTPU_CACHE_DTYPE", "float32")
+
+
 class ShardedDecoder:
     """Jitted KV-cache decode over a mesh with tp-sharded parameters.
 
@@ -136,16 +193,49 @@ class ShardedDecoder:
         self._staged = True
 
     # -- the compiled programs -------------------------------------------
-    def _build_program(self, body, n_caches, n_extra_inputs):
+    def _scale_spec(self):
+        """PartitionSpec of an int8 cache's scale tensors: the payload
+        spec minus its trailing head-dim axis (a (B, KV, T, D) spec
+        prices/shards its (B, KV, T) scales identically head-wise)."""
+        return P(*tuple(self._cache_spec)[:-1])
+
+    def _leaf_sharding(self, leaf):
+        jm = self._mesh.jax_mesh
+        if _leaf_q8(leaf):
+            return (NamedSharding(jm, self._cache_spec),
+                    NamedSharding(jm, self._scale_spec()))
+        return NamedSharding(jm, self._cache_spec)
+
+    def _cache_sharding_tree(self, cache_template):
+        return tuple((self._leaf_sharding(ck), self._leaf_sharding(cv))
+                     for ck, cv in cache_template)
+
+    def _place_cache(self, nd_caches):
+        """device_put a freshly-built NDArray cache tree onto the mesh
+        (payload by cache_spec; int8 scales by the derived scale spec).
+        Shared by generate() and both serving engines' pools."""
+        def put(leaf):
+            if isinstance(leaf, tuple):
+                sh = self._leaf_sharding((leaf[0]._data, leaf[1]._data))
+                return (jax.device_put(leaf[0]._data, sh[0]),
+                        jax.device_put(leaf[1]._data, sh[1]))
+            return jax.device_put(
+                leaf._data, self._leaf_sharding(leaf._data))
+        return tuple((put(ck), put(cv)) for ck, cv in nd_caches)
+
+    def _build_program(self, body, cache_template, n_extra_inputs):
         """Shared jit scaffolding for the decode programs: the param
         holder swap/restore protocol, sharding trees (params by rules,
-        caches by cache_spec, everything else replicated) and cache
-        donation live HERE once — both the one-token step and the
-        chunked prefill specialize only the traced ``body``.
+        caches by cache_spec — int8 (payload, scales) pairs map
+        structurally, scales on the derived scale spec — everything
+        else replicated) and cache donation live HERE once — both the
+        one-token step and the chunked prefill specialize only the
+        traced ``body``.
 
         body(block, caches, *extra) -> (logits NDArray, new_caches).
         Specialization happens through the _jit_cache key + jax.jit's
-        own shape cache; only the cache count shapes the sharding trees.
+        own shape cache; only the cache TREE (count + leaf form) shapes
+        the sharding trees.
         """
         block = self._block
         params = self._params
@@ -158,23 +248,22 @@ class ShardedDecoder:
                 holder._data = leaf
             try:
                 with autograd.pause(train_mode=False):
-                    caches = [(NDArray(ck), NDArray(cv))
+                    caches = [(_wrap_leaf(ck), _wrap_leaf(cv))
                               for ck, cv in cache_leaves]
                     logits, new_caches = body(block, caches, *extra)
             finally:
                 for holder, data in saved:
                     holder._data = data
             return logits._data, tuple(
-                (ck._data, cv._data) for ck, cv in new_caches)
+                (_unwrap_leaf(ck), _unwrap_leaf(cv))
+                for ck, cv in new_caches)
 
         jm = self._mesh.jax_mesh
         rep = NamedSharding(jm, P())
         param_sh = tuple(
             self._rules.sharding_for(p.name, p.data().ndim, self._mesh)
             for p in params)
-        cache_sh = tuple(
-            (NamedSharding(jm, self._cache_spec),) * 2
-            for _ in range(n_caches))
+        cache_sh = self._cache_sharding_tree(cache_template)
         in_sh = (param_sh, cache_sh) + (rep,) * n_extra_inputs
         # donate the caches: each write supersedes the old buffer
         return jax.jit(program, in_shardings=in_sh,
@@ -203,7 +292,8 @@ class ShardedDecoder:
         The scratch cache is an in-program constant; XLA fuses the
         zero-init away."""
         tokens = NDArray(tokens)
-        dt = str(caches[0][0].dtype)
+        ck0 = caches[0][0]
+        dt = "int8" if isinstance(ck0, tuple) else str(ck0.dtype)
         scratch = block.init_cache(1, tokens.shape[1], dt)
         logits, scratch = block.prefill(tokens, scratch)
         return logits, block.write_cache_slot(caches, scratch,
@@ -263,56 +353,56 @@ class ShardedDecoder:
         if not ledger_enabled():
             return
         record("serving.%s" % kind, Signature(
-            shapes=tuple(tuple(ck.shape) for ck, _ in cache_leaves)
+            shapes=_cache_shapes(cache_leaves)
             + tuple(tuple(e.shape) for e in extras),
-            dtypes=(str(cache_leaves[0][0].dtype),)
+            dtypes=(_cache_dt(cache_leaves),)
             + tuple(str(e.dtype) for e in extras),
             weak=(),
             static=(kind,)), hit=hit)
 
     def _step_jitted(self, cache_leaves, token, pos):
-        key = ("step", tuple(ck.shape for ck, _ in cache_leaves),
-               cache_leaves[0][0].dtype, token.shape, token.dtype)
+        key = ("step", _cache_shapes(cache_leaves),
+               _cache_dt(cache_leaves), token.shape, token.dtype)
         hit = key in self._jit_cache
         self._ledger_report("step", cache_leaves, (token,), hit)
         if not hit:
             self._jit_cache[key] = self._build_program(
-                self._step_body, len(cache_leaves), n_extra_inputs=2)
+                self._step_body, cache_leaves, n_extra_inputs=2)
         param_leaves = tuple(p.data()._data for p in self._params)
         return self._jit_cache[key](param_leaves, cache_leaves, token, pos)
 
     def _prefill_jitted(self, cache_leaves, tokens):
-        key = ("prefill", tuple(ck.shape for ck, _ in cache_leaves),
-               cache_leaves[0][0].dtype, tokens.shape, tokens.dtype)
+        key = ("prefill", _cache_shapes(cache_leaves),
+               _cache_dt(cache_leaves), tokens.shape, tokens.dtype)
         hit = key in self._jit_cache
         self._ledger_report("prefill", cache_leaves, (tokens,), hit)
         if not hit:
             self._jit_cache[key] = self._build_program(
-                self._prefill_body, len(cache_leaves), n_extra_inputs=1)
+                self._prefill_body, cache_leaves, n_extra_inputs=1)
         param_leaves = tuple(p.data()._data for p in self._params)
         return self._jit_cache[key](param_leaves, cache_leaves, tokens)
 
     def _step_slots_jitted(self, cache_leaves, token, pos):
-        key = ("step_slots", tuple(ck.shape for ck, _ in cache_leaves),
-               cache_leaves[0][0].dtype, token.shape, token.dtype)
+        key = ("step_slots", _cache_shapes(cache_leaves),
+               _cache_dt(cache_leaves), token.shape, token.dtype)
         hit = key in self._jit_cache
         self._ledger_report("step_slots", cache_leaves, (token,), hit)
         if not hit:
             self._jit_cache[key] = self._build_program(
-                self._step_slots_body, len(cache_leaves),
+                self._step_slots_body, cache_leaves,
                 n_extra_inputs=2)
         param_leaves = tuple(p.data()._data for p in self._params)
         return self._jit_cache[key](param_leaves, cache_leaves, token, pos)
 
     def _slot_prefill_jitted(self, cache_leaves, tokens, slot):
         key = ("slot_prefill",
-               tuple(ck.shape for ck, _ in cache_leaves),
-               cache_leaves[0][0].dtype, tokens.shape, tokens.dtype)
+               _cache_shapes(cache_leaves),
+               _cache_dt(cache_leaves), tokens.shape, tokens.dtype)
         hit = key in self._jit_cache
         self._ledger_report("slot_prefill", cache_leaves, (tokens,), hit)
         if not hit:
             self._jit_cache[key] = self._build_program(
-                self._slot_prefill_body, len(cache_leaves),
+                self._slot_prefill_body, cache_leaves,
                 n_extra_inputs=2)
         param_leaves = tuple(p.data()._data for p in self._params)
         return self._jit_cache[key](param_leaves, cache_leaves, tokens,
@@ -325,13 +415,13 @@ class ShardedDecoder:
         bounded family the compile discipline allows (C004, never
         C001)."""
         key = ("verify_slots",
-               tuple(ck.shape for ck, _ in cache_leaves),
-               cache_leaves[0][0].dtype, tokens.shape, tokens.dtype)
+               _cache_shapes(cache_leaves),
+               _cache_dt(cache_leaves), tokens.shape, tokens.dtype)
         hit = key in self._jit_cache
         self._ledger_report("verify_slots", cache_leaves, (tokens,), hit)
         if not hit:
             self._jit_cache[key] = self._build_program(
-                self._verify_slots_body, len(cache_leaves),
+                self._verify_slots_body, cache_leaves,
                 n_extra_inputs=3)
         param_leaves = tuple(p.data()._data for p in self._params)
         return self._jit_cache[key](param_leaves, cache_leaves, tokens,
@@ -342,28 +432,28 @@ class ShardedDecoder:
         """Block-paged speculative verify step (same bounded
         window-ladder family as _verify_slots_jitted)."""
         key = ("verify_pages",
-               tuple(ck.shape for ck, _ in cache_leaves),
-               cache_leaves[0][0].dtype, tokens.shape, tokens.dtype,
-               tables.shape)
+               _cache_shapes(cache_leaves),
+               _cache_dt(cache_leaves), tokens.shape, tokens.dtype,
+               tables.shape, _paged_attn_gate())
         hit = key in self._jit_cache
         self._ledger_report("verify_pages", cache_leaves, (tokens,), hit)
         if not hit:
             self._jit_cache[key] = self._build_program(
-                self._verify_pages_body, len(cache_leaves),
+                self._verify_pages_body, cache_leaves,
                 n_extra_inputs=4)
         param_leaves = tuple(p.data()._data for p in self._params)
         return self._jit_cache[key](param_leaves, cache_leaves, tokens,
                                     tables, pos, valid_len)
 
     def _step_pages_jitted(self, cache_leaves, token, tables, pos):
-        key = ("step_pages", tuple(ck.shape for ck, _ in cache_leaves),
-               cache_leaves[0][0].dtype, token.shape, token.dtype,
-               tables.shape)
+        key = ("step_pages", _cache_shapes(cache_leaves),
+               _cache_dt(cache_leaves), token.shape, token.dtype,
+               tables.shape, _paged_attn_gate())
         hit = key in self._jit_cache
         self._ledger_report("step_pages", cache_leaves, (token,), hit)
         if not hit:
             self._jit_cache[key] = self._build_program(
-                self._step_pages_body, len(cache_leaves),
+                self._step_pages_body, cache_leaves,
                 n_extra_inputs=3)
         param_leaves = tuple(p.data()._data for p in self._params)
         return self._jit_cache[key](param_leaves, cache_leaves, token,
@@ -375,15 +465,15 @@ class ShardedDecoder:
         import functools
 
         key = ("page_prefill",
-               tuple(ck.shape for ck, _ in cache_leaves),
-               cache_leaves[0][0].dtype, tokens.shape, tokens.dtype,
+               _cache_shapes(cache_leaves),
+               _cache_dt(cache_leaves), tokens.shape, tokens.dtype,
                table.shape, total_len)
         hit = key in self._jit_cache
         self._ledger_report("page_prefill", cache_leaves, (tokens,), hit)
         if not hit:
             self._jit_cache[key] = self._build_program(
                 functools.partial(self._page_prefill_body, total_len),
-                len(cache_leaves), n_extra_inputs=5)
+                cache_leaves, n_extra_inputs=5)
         param_leaves = tuple(p.data()._data for p in self._params)
         return self._jit_cache[key](param_leaves, cache_leaves, tokens,
                                     table, start_pos, cow_src, cow_dst)
@@ -408,11 +498,15 @@ class ShardedDecoder:
     def generate(self, prompt_ids, max_new_tokens, max_length=None,
                  temperature=0.0, top_k=0, top_p=0.0,
                  repetition_penalty=1.0, seed=None,
-                 cache_dtype="float32"):
+                 cache_dtype=None):
         """Same contract as ``TransformerLM.generate`` but sharded: the
         params keep their mesh shardings; returns (B, T_prompt +
         max_new_tokens) ids as a host NDArray.  temperature=0 decodes
-        greedily and ignores top_k/top_p (same gating as generate)."""
+        greedily and ignores top_k/top_p (same gating as generate).
+        ``cache_dtype``: the KV-cache dtype ("int8" = quantized cache
+        with per-head scales, docs/inference.md); None reads the
+        MXTPU_CACHE_DTYPE default (float32)."""
+        cache_dtype = resolve_cache_dtype(cache_dtype)
         prompt_ids = prompt_ids if isinstance(prompt_ids, NDArray) \
             else nd_array(prompt_ids)
         self._ensure_staged(prompt_ids)
@@ -429,13 +523,8 @@ class ShardedDecoder:
             raise ValueError("max_length %d < prompt+new %d"
                              % (max_length, total))
 
-        jm = self._mesh.jax_mesh
-        cache_sh = NamedSharding(jm, self._cache_spec)
-        cache_leaves = tuple(
-            (jax.device_put(ck._data, cache_sh),
-             jax.device_put(cv._data, cache_sh))
-            for ck, cv in self._block.init_cache(B, max_length,
-                                                 cache_dtype))
+        cache_leaves = self._place_cache(
+            self._block.init_cache(B, max_length, cache_dtype))
 
         tokens = [prompt_ids]
         # chunked prefill: one compiled forward ingests the whole

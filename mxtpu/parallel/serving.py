@@ -90,14 +90,14 @@ from typing import Any, Dict, List, Optional
 import jax
 import jax.numpy as jnp
 import numpy as onp
-from jax.sharding import NamedSharding, PartitionSpec as P
+from jax.sharding import PartitionSpec as P
 
 from .. import random as _random
 from ..ndarray import NDArray, array as nd_array
 from ..resilience import LoadShedError
 from ..resilience.counters import bump as _bump
 from ..resilience.faults import inject as _inject
-from .decode import ShardedDecoder, _bucket
+from .decode import ShardedDecoder, _bucket, resolve_cache_dtype
 from .mesh import DeviceMesh
 from .paging import NULL_PAGE, BlockPool, PrefixIndex
 from .sharding import ShardingRules
@@ -223,7 +223,7 @@ class ContinuousBatchingEngine:
     def __init__(self, block, mesh: DeviceMesh,
                  rules: Optional[ShardingRules] = None,
                  num_slots: int = 4, max_length: int = 256,
-                 cache_dtype: str = "float32",
+                 cache_dtype: Optional[str] = None,
                  cache_spec: P = P(None, "tp", None, None),
                  bucket_prefill: bool = True,
                  max_pending: Optional[int] = None, clock=None,
@@ -236,7 +236,8 @@ class ContinuousBatchingEngine:
         self._mesh = mesh
         self._num_slots = int(num_slots)
         self._max_length = int(max_length)
-        self._cache_dtype = cache_dtype
+        # None → MXTPU_CACHE_DTYPE default ("int8" = quantized cache)
+        self._cache_dtype = resolve_cache_dtype(cache_dtype)
         self._pool = None                       # cache leaves, lazy
         self._slots: List[Optional[_Slot]] = [None] * self._num_slots
         self._queue: List[Request] = []
@@ -412,13 +413,8 @@ class ContinuousBatchingEngine:
         self._ensure_draft_pool(sample_prompt)
         if self._pool is not None:
             return
-        jm = self._mesh.jax_mesh
-        cache_sh = NamedSharding(jm, self._dec._cache_spec)
-        self._pool = tuple(
-            (jax.device_put(ck._data, cache_sh),
-             jax.device_put(cv._data, cache_sh))
-            for ck, cv in self._block.init_cache(
-                self._num_slots, self._max_length, self._cache_dtype))
+        self._pool = self._dec._place_cache(self._block.init_cache(
+            self._num_slots, self._max_length, self._cache_dtype))
 
     def _ensure_draft_pool(self, sample_prompt):
         """Stage the optional draft model and allocate its own slot
@@ -428,12 +424,8 @@ class ContinuousBatchingEngine:
         if self._draft_dec is None or self._draft_pool is not None:
             return
         self._draft_dec._ensure_staged(sample_prompt)
-        jm = self._mesh.jax_mesh
-        dsh = NamedSharding(jm, self._draft_dec._cache_spec)
-        self._draft_pool = tuple(
-            (jax.device_put(ck._data, dsh),
-             jax.device_put(cv._data, dsh))
-            for ck, cv in self._draft_block.init_cache(
+        self._draft_pool = self._draft_dec._place_cache(
+            self._draft_block.init_cache(
                 self._num_slots, self._max_length, self._cache_dtype))
 
     def _ensure_seen(self, vocab):
@@ -1176,7 +1168,7 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
     def __init__(self, block, mesh: DeviceMesh,
                  rules: Optional[ShardingRules] = None,
                  num_slots: int = 4, max_length: int = 256,
-                 cache_dtype: str = "float32",
+                 cache_dtype: Optional[str] = None,
                  cache_spec: P = P(None, "tp", None, None),
                  bucket_prefill: bool = True,
                  max_pending: Optional[int] = None, clock=None,
@@ -1236,13 +1228,8 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
         self._ensure_draft_pool(sample_prompt)
         if self._pool is not None:
             return
-        jm = self._mesh.jax_mesh
-        cache_sh = NamedSharding(jm, self._dec._cache_spec)
-        self._pool = tuple(
-            (jax.device_put(pk._data, cache_sh),
-             jax.device_put(pv._data, cache_sh))
-            for pk, pv in self._block.init_block_pool(
-                self._bp.capacity + 1, self._bs, self._cache_dtype))
+        self._pool = self._dec._place_cache(self._block.init_block_pool(
+            self._bp.capacity + 1, self._bs, self._cache_dtype))
 
     def _release_row(self, row):
         """Drop row's page references (idempotent — every terminal path

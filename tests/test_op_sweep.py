@@ -39,6 +39,16 @@ def IDX(*shape, n=4):
     return jnp.asarray(R.randint(0, n, shape).astype("int32"))
 
 
+def I8(*shape):
+    """int8 payload input (quantized cache / packed-weight ops)."""
+    return jnp.asarray(R.randint(-127, 128, shape).astype("int8"))
+
+
+def SCL(*shape):
+    """Small positive per-head scales (quantized-cache scale tensors)."""
+    return POS(*shape, lo=0.01, hi=0.1)
+
+
 def SPD(n=3):
     """Well-conditioned symmetric positive-definite matrix."""
     a = R.randn(n, n).astype("float32")
@@ -402,6 +412,9 @@ SKIP = {
                 "grad over the lattice is O(T*V) slow",
     "flash_attention": "covered by tests/test_flash_attention.py "
                        "(fwd parity + gradients)",
+    "paged_decode_attention": "ragged Pallas kernel; covered by "
+                              "tests/test_paged_attention_pallas.py "
+                              "(XLA-path parity matrix incl. int8)",
     "ring_attention": "needs a device mesh; covered by "
                       "tests/test_parallel.py exact-vs-dense test",
     "ROIAlign": "covered by detection-op usage; numeric grad unstable at "
@@ -505,6 +518,48 @@ CASES.update({
                  jnp.asarray([5, 2])), grad=False),
     "_paged_block_copy": C(
         lambda: (A(5, 3, 4, 2),), {"src": 1, "dst": 3}, grad=False),
+    # int8 KV-cache family (ISSUE 10): quantized twins of the cache
+    # writes — payload int8 + per-head-per-position f32 scales; the
+    # bf16 leg compares only the float outputs (scales/dequant), the
+    # int8 payloads are exact by construction
+    "_internal_cache_dequant": C(
+        lambda: (I8(2, 3, 8, 4), SCL(2, 3, 8)), grad=False),
+    "_internal_cache_write_q8": C(
+        lambda: (I8(2, 3, 8, 4), SCL(2, 3, 8), A(2, 3, 2, 4)),
+        {"pos": 5}, grad=False, bf16=False),   # bf16 rounding can move
+    #                                            a value one int8 level
+    "_internal_cache_write_rows_q8": C(
+        lambda: (I8(2, 3, 8, 4), SCL(2, 3, 8), A(2, 3, 1, 4),
+                 jnp.asarray([5, 2])), grad=False, bf16=False),
+    "_internal_cache_write_span_q8": C(
+        lambda: (I8(2, 3, 8, 4), SCL(2, 3, 8), A(2, 3, 4, 4),
+                 jnp.asarray([2, 4]), jnp.asarray([4, 2])),
+        grad=False, bf16=False),
+    "_internal_cache_write_slot_q8": C(
+        lambda: (I8(2, 3, 8, 4), SCL(2, 3, 8), I8(1, 3, 4, 4),
+                 SCL(1, 3, 4)), {"slot": 1, "pos": 2}, grad=False),
+    "_paged_cache_gather_q8": C(
+        lambda: (I8(5, 3, 4, 2), SCL(5, 3, 4), IDX(2, 3, n=5)),
+        grad=False),
+    "_paged_cache_write_q8": C(
+        lambda: (I8(5, 3, 4, 2), SCL(5, 3, 4), A(1, 3, 6, 2),
+                 IDX(3, n=5)), {"start_pos": 2}, grad=False,
+        bf16=False),
+    "_paged_cache_write_rows_q8": C(
+        lambda: (I8(5, 3, 4, 2), SCL(5, 3, 4), A(2, 3, 1, 2),
+                 IDX(2, 3, n=5), jnp.asarray([5, 2])), grad=False,
+        bf16=False),
+    "_paged_cache_write_span_q8": C(
+        lambda: (I8(5, 3, 4, 2), SCL(5, 3, 4), A(2, 3, 4, 2),
+                 IDX(2, 3, n=5), jnp.asarray([3, 2]),
+                 jnp.asarray([4, 2])), grad=False, bf16=False),
+    # weight-only packed matmuls (contrib.quantization): dequant fused
+    # into the contraction; scales kept small so outputs stay O(1)
+    "wq_matmul_i8": C(
+        lambda: (A(3, 4), I8(5, 4), SCL(5)), grad=False),
+    "wq_matmul_i4": C(
+        lambda: (A(3, 4), I8(5, 2), SCL(5, 2)),
+        {"group_size": 2, "in_units": 4}, grad=False),
     "_npi_einsum": C(lambda: (A(2, 3), A(3, 4)),
                      {"subscripts": "ij,jk->ik"}),
     "gradientmultiplier": C(lambda: (A(3, 4),), {"scalar": 1.0}),

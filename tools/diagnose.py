@@ -225,6 +225,62 @@ def check_speculative():
               if healthy else "UNEXPECTED counters %r" % (st,))
     except Exception as e:
         print("speculative  : FAILED (%s: %s)" % (type(e).__name__, e))
+    check_quantized()
+
+
+def check_quantized():
+    """Exercise the quantized serving path once (docs/inference.md
+    "Quantized serving"): weight-only int8 matmuls + int8 KV cache on
+    the paged engine, one request asserted bit-identical to the
+    isolated quantized generate, plus the cache-byte ratio from the
+    abstract-eval pricer.  A healthy install shows exact stream parity
+    and a ratio of 0.5 + 2/head_dim."""
+    print("----------Serving (quantized int8)----------")
+    try:
+        import numpy as np
+
+        import mxtpu as mx
+        from mxtpu import nd
+        from mxtpu.analysis.memory_estimate import kv_cache_residency
+        from mxtpu.contrib.quantization import quantize_weights
+        from mxtpu.models.transformer import (
+            TransformerLM, transformer_lm_sharding_rules)
+        from mxtpu.parallel import (PagedContinuousBatchingEngine,
+                                    ShardedDecoder)
+        from mxtpu.parallel.mesh import DeviceMesh
+
+        mx.random.seed(7)
+        lm = TransformerLM(32, units=16, hidden_size=32, num_layers=1,
+                           num_heads=2, num_kv_heads=2)
+        lm.initialize()
+        rng = np.random.RandomState(0)
+        prompt = nd.array(rng.randint(0, 32, (1, 9)), dtype="int32")
+        lm(prompt)  # resolve deferred shapes before the weight rewrite
+        rules = quantize_weights(lm, bits=8,
+                                 rules=transformer_lm_sharding_rules())
+        bf, _ = kv_cache_residency(lm, 2, 32, "bfloat16")
+        i8, _ = kv_cache_residency(lm, 2, 32, "int8")
+        print("weights      : %d Dense layer(s) -> packed int8 + scales"
+              % len(rules.quantized_params))
+        print("cache bytes  : int8/bf16 = %.4f (0.5 payload + scales)"
+              % (i8 / bf))
+        mesh = DeviceMesh(dp=1)
+        want = ShardedDecoder(lm, mesh, rules).generate(
+            prompt, max_new_tokens=4, max_length=32,
+            cache_dtype="int8").asnumpy()
+        eng = PagedContinuousBatchingEngine(
+            lm, mesh, rules, num_slots=2, max_length=32, block_size=8,
+            prefill_chunk=8, cache_dtype="int8")
+        rid = eng.submit(prompt, 4)
+        got = eng.run()[rid].asnumpy()
+        exact = bool(np.array_equal(got, want))
+        print("parity       : engine stream %s isolated quantized "
+              "generate" % ("==" if exact else "!="))
+        healthy = exact and eng.stats["blocks_in_use"] == 0
+        print("probe        :", "ok (bit-exact int8 stream + clean "
+              "drain)" if healthy else "UNEXPECTED %r" % (eng.stats,))
+    except Exception as e:
+        print("quantized    : FAILED (%s: %s)" % (type(e).__name__, e))
 
 
 def check_resilience():
