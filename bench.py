@@ -622,6 +622,182 @@ def _bench_paged_decode():
     print(json.dumps(rec), flush=True)
 
 
+def _bench_hierarchical_cache():
+    """Hierarchical prefix cache (round-15 tentpole): persistent HBM
+    pinning + host-RAM tiering + multi-turn sessions vs the overlap-
+    only sharing of PR 7, on a BURSTY, SESSION-STRUCTURED Poisson
+    workload — bursts of conversation turns separated by full drains
+    (traffic lulls), every prompt opening with one shared system
+    prompt.  The overlap-only engine loses all sharing at every lull
+    and re-prefills whole transcripts each turn; the hierarchical
+    engine pins chains across lulls and reuses each session's pages.
+    Two metrics, both DETERMINISTIC host counters:
+
+    - ``prefill_tokens_avoided``: prompt tokens whose prefill was
+      skipped (radix hit on pinned/restored/session pages).
+      Acceptance: >= 2x the overlap-only engine's count.
+    - ``prefix_hit_rate_bursty``: admissions that hit at least one
+      shared/pinned page.
+
+    CPU wall-clock is reported as an extra and NOISE-labeled; the
+    counters are the evidence."""
+    import numpy as np
+    import jax
+    import mxtpu as mx
+    from mxtpu import nd
+    from mxtpu.models import transformer
+    from mxtpu.parallel import PagedContinuousBatchingEngine, make_mesh
+
+    platform = jax.devices()[0].platform
+    cpu = platform == "cpu"
+    mx.random.seed(7)
+    if cpu:
+        lm = transformer.llama_tiny(vocab_size=256)
+        slots, max_len, bs, chunk = 4, 96, 8, 16
+        n_sessions, n_turns, sys_len, msg_lo, msg_hi, glo, ghi = \
+            4, 4, 16, 4, 8, 4, 8
+        # pool sized so later turn-bursts create POOL PRESSURE: session
+        # chains spill to the host tier and swap back in at the next
+        # turn — the full three-tier round trip under one workload
+        vocab, num_blocks = 256, 20
+    else:
+        lm = transformer.llama_3_8b(vocab_size=32000, width_factor=0.25,
+                                    depth_factor=0.25)
+        slots, max_len, bs, chunk = 8, 512, 16, 64
+        n_sessions, n_turns, sys_len, msg_lo, msg_hi, glo, ghi = \
+            8, 4, 64, 16, 32, 16, 32
+        vocab, num_blocks = 32000, 512
+    lm.initialize()
+    mesh = make_mesh(dp=1)
+    rules = transformer.transformer_lm_sharding_rules()
+
+    R = np.random.RandomState(0)
+    system = R.randint(0, vocab, (1, sys_len))
+    # session-structured turns: turn prompts are built from the LIVE
+    # transcript as each engine emits it, so both engines see the
+    # identical token streams (greedy decode, identical models)
+    first_msgs = [R.randint(0, vocab, (1, int(R.randint(msg_lo,
+                                                        msg_hi + 1))))
+                  for _ in range(n_sessions)]
+    next_msgs = [[R.randint(0, vocab, (1, int(R.randint(msg_lo,
+                                                        msg_hi + 1))))
+                  for _ in range(n_turns - 1)]
+                 for _ in range(n_sessions)]
+    news = R.randint(glo, ghi + 1, size=(n_sessions, n_turns))
+    # bursty Poisson arrivals WITHIN each turn-burst (in scheduler
+    # iterations); the drain between bursts is the lull
+    offsets = np.cumsum(R.poisson(1, size=(n_turns, n_sessions)),
+                        axis=1)
+
+    from mxtpu.analysis import get_ledger
+    _led = get_ledger()
+    _swap_before = sum(_led.miss_counts(("serving.swap",)).values())
+
+    def drive(use_sessions):
+        eng = PagedContinuousBatchingEngine(
+            lm, mesh, rules, num_slots=slots, max_length=max_len,
+            block_size=bs, num_blocks=num_blocks, prefill_chunk=chunk,
+            pin_bytes="256MiB" if use_sessions else 0,
+            host_cache_bytes="1GiB" if use_sessions else 0)
+        transcripts = [np.asarray(system) for _ in range(n_sessions)]
+        for s in range(n_sessions):
+            transcripts[s] = np.concatenate(
+                [transcripts[s], first_msgs[s]], axis=1)
+        t0 = time.perf_counter()
+        for turn in range(n_turns):
+            rids, nxt, it = {}, 0, 0
+            while nxt < n_sessions or eng.pending or eng.active:
+                while nxt < n_sessions and offsets[turn][nxt] <= it:
+                    s = nxt
+                    rids[s] = eng.submit(
+                        nd.array(transcripts[s], dtype="int32"),
+                        int(news[s][turn]),
+                        session=("s%d" % s) if use_sessions else None)
+                    nxt += 1
+                if eng.pending or eng.active:
+                    eng.step()
+                it += 1
+            res = eng.run()            # full drain = the lull
+            for s in range(n_sessions):
+                transcripts[s] = np.asarray(res[rids[s]].asnumpy())
+                if turn < n_turns - 1:
+                    transcripts[s] = np.concatenate(
+                        [transcripts[s], next_msgs[s][turn]], axis=1)
+        dt = time.perf_counter() - t0
+        st = eng.stats
+        for s in range(n_sessions):
+            eng.close_session("s%d" % s)
+        admissions = n_sessions * n_turns
+        return st, dt, st["prefix_hits"] / admissions, transcripts
+
+    st_h, dt_h, rate_h, tr_h = drive(True)
+    st_o, dt_o, rate_o, tr_o = drive(False)
+    # identical greedy streams on both engines: the counters compare
+    # the same work, and the hierarchy changed no output
+    streams_equal = all(np.array_equal(a, b)
+                        for a, b in zip(tr_h, tr_o))
+    gain = (st_h["prefill_tokens_avoided"]
+            / max(st_o["prefill_tokens_avoided"], 1))
+    cfg = {"sessions": n_sessions, "turns": n_turns,
+           "system_prompt_len": sys_len,
+           "message_len": [msg_lo, msg_hi],
+           "new_tokens": [glo, ghi], "slots": slots,
+           "max_length": max_len, "block_size": bs,
+           "num_blocks": num_blocks, "prefill_chunk": chunk,
+           "arrivals": "poisson(1)/iteration within each burst, "
+                       "full drain (lull) between bursts"}
+    rec = {
+        "metric": "prefill_tokens_avoided",
+        "value": int(st_h["prefill_tokens_avoided"]),
+        "unit": "prompt tokens skipped",
+        "vs_baseline": None,
+        "platform": platform,
+        "overlap_only_avoided": int(st_o["prefill_tokens_avoided"]),
+        "gain_vs_overlap_only": round(gain, 3),
+        "session_hits": int(st_h["session_hits"]),
+        "pinned_blocks_peak_end": int(st_h["pinned_blocks"]),
+        "spilled_blocks_end": int(st_h["spilled_blocks"]),
+        "swap_ins": int(st_h["swap_ins"]),
+        "swap_outs": int(st_h["swap_outs"]),
+        "streams_bit_identical_to_overlap_only": streams_equal,
+        "compiled_program_count_swap": sum(_led.miss_counts(
+            ("serving.swap",)).values()) - _swap_before,
+        "config": cfg,
+        "baseline_note": "comparison column is this repo's own paged "
+                         "engine with PR-7 overlap-only sharing on the "
+                         "IDENTICAL bursty session workload; counters "
+                         "are deterministic host-side page math "
+                         "(acceptance: gain >= 2x; the lull drains kill "
+                         "overlap-only sharing by construction)",
+    }
+    if cpu:
+        rec["config_note"] = ("CPU fallback runs a LABELED llama_tiny "
+                              "config — plumbing evidence only")
+    print(json.dumps(rec), flush=True)
+
+    rec = {
+        "metric": "prefix_hit_rate_bursty",
+        "value": round(rate_h, 3),
+        "unit": "admissions hitting shared/pinned pages",
+        "vs_baseline": None,
+        "platform": platform,
+        "overlap_only_hit_rate": round(rate_o, 3),
+        "prefill_tokens_avoided": int(st_h["prefill_tokens_avoided"]),
+        "wall_s_hierarchical": round(dt_h, 2),
+        "wall_s_overlap_only": round(dt_o, 2),
+        "config": cfg,
+        "baseline_note": "deterministic admission counters; the wall_s "
+                         "extras are CPU host wall-clock and NOISE-"
+                         "DOMINATED on the oversubscribed builder — the "
+                         "hit-rate/avoided-token counters are the "
+                         "evidence, TPU tokens/s when the tunnel heals",
+    }
+    if cpu:
+        rec["config_note"] = ("CPU fallback runs a LABELED llama_tiny "
+                              "config — plumbing evidence only")
+    print(json.dumps(rec), flush=True)
+
+
 def _bench_quantized_decode():
     """Quantized serving (round-14 tentpole): int8 KV cache with
     per-head scales vs the bf16 paged engine.  Two metrics, BOTH
@@ -1190,6 +1366,7 @@ def _child_main():
     _bench_paged_decode()
     _bench_speculative_decode()
     _bench_quantized_decode()
+    _bench_hierarchical_cache()
 
 
 def _probe_main():

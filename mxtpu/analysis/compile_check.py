@@ -3,9 +3,11 @@
 The discipline being checked is PERF.md round 5's: the number of
 compiled programs per workload must be bounded by design (prefill
 buckets + one pooled step + the pow2 speculative-verify window ladder
-for serving — sites ``serving.slot_prefill`` / ``serving.step_slots``
-/ ``serving.verify_slots`` and their paged forms; one step program per
-batch signature for training), never by traffic.  The ledger records every
++ the hierarchical cache's ONE bounded swap-copy program for serving —
+sites ``serving.slot_prefill`` / ``serving.step_slots`` /
+``serving.verify_slots`` and their paged forms, plus ``serving.swap``;
+one step program per batch signature for training), never by
+traffic.  The ledger records every
 jit-cache lookup with its signature pre-split into shapes / dtypes /
 weak-type flags / static parts, so each growth mode gets its own code:
 

@@ -47,6 +47,9 @@ M003        INFO      accounting breakdown (params / inputs / activations
 M004        INFO      top liveness contributors (largest intermediates)
 M005        WARNING   nodes whose shapes could not be inferred — the
                       estimate is a LOWER bound
+M006        ERROR     host-RAM KV tier exceeds its host budget (the
+                      hierarchical cache's spilled chains live in host
+                      memory, never HBM — they are budgeted separately)
 ==========  ========  =====================================================
 """
 
@@ -509,6 +512,8 @@ def paged_kv_cache_residency(block, num_blocks: int, block_size: int,
                              dtype: str = "float32", cache_spec=None,
                              mesh=None, blocks_in_use: Optional[int] = None,
                              shared_extra_refs: int = 0,
+                             pinned_blocks: int = 0,
+                             spilled_blocks: int = 0,
                              engine=None) -> Dict[str, Any]:
     """Per-device byte accounting of a BLOCK-PAGED KV cache
     (:class:`~mxtpu.parallel.PagedContinuousBatchingEngine`):
@@ -525,11 +530,20 @@ def paged_kv_cache_residency(block, num_blocks: int, block_size: int,
       what an unshared layout would ADDITIONALLY hold resident right
       now.  Refcounted pages are deliberately priced ONCE in
       ``resident_bytes`` — a page shared by N requests is one page.
+    - the HIERARCHICAL tiers (docs/inference.md), priced SEPARATELY:
+      ``pinned_bytes`` = ``pinned_blocks`` × bytes_per_block is the
+      slice of ``resident_bytes`` the cache is holding past its last
+      table reference — it counts against the HBM budget like any
+      resident page; ``spilled_bytes_host`` = ``spilled_blocks`` ×
+      ``bytes_per_block_host`` prices the host-RAM tier at UNSHARDED
+      page bytes (host copies are full replicated pages) and belongs
+      to a HOST budget, never the HBM one (:func:`check_memory`'s
+      ``host_budget_bytes``).
 
     Pass a live engine (``engine=``) to read ``num_blocks`` /
-    ``block_size`` / occupancy / sharing — and the pool's actual
-    cache dtype, sharding spec and mesh — from it instead of spelling
-    them out."""
+    ``block_size`` / occupancy / sharing / tier counters — and the
+    pool's actual cache dtype, sharding spec and mesh — from it
+    instead of spelling them out."""
     import jax
 
     if engine is not None:
@@ -538,6 +552,8 @@ def paged_kv_cache_residency(block, num_blocks: int, block_size: int,
         block_size = st["block_size"]
         blocks_in_use = st["blocks_in_use"]
         shared_extra_refs = st["shared_extra_refs"]
+        pinned_blocks = st.get("pinned_blocks", 0)
+        spilled_blocks = st.get("spilled_blocks", 0)
         dtype = engine._cache_dtype
         cache_spec = engine._dec._cache_spec
         mesh = engine._mesh
@@ -555,6 +571,7 @@ def paged_kv_cache_residency(block, num_blocks: int, block_size: int,
     shapes: List[Tuple[tuple, str]] = []
     total = 0
     per_block = 0
+    per_block_host = 0
     for pair in leaves:
         for leaf in pair:
             # int8 pools carry (N, KV, bs) scale tensors page-aligned
@@ -566,9 +583,15 @@ def paged_kv_cache_residency(block, num_blocks: int, block_size: int,
                                      cache_spec, axis_sizes)
             total += nbytes
             per_block += nbytes // leaf.shape[0]
+            # host copies are unsharded full pages (the swap program
+            # replicates its read)
+            per_block_host += _sharded_nbytes(
+                tuple(leaf.shape), leaf.dtype, None,
+                axis_sizes) // leaf.shape[0]
     out = {
         "total_bytes": total,
         "bytes_per_block": per_block,
+        "bytes_per_block_host": per_block_host,
         "num_blocks": int(num_blocks),
         "block_size": int(block_size),
         "shapes": shapes,
@@ -580,6 +603,10 @@ def paged_kv_cache_residency(block, num_blocks: int, block_size: int,
                              - int(blocks_in_use)) * per_block
     out["shared_extra_refs"] = int(shared_extra_refs)
     out["shared_savings_bytes"] = int(shared_extra_refs) * per_block
+    out["pinned_blocks"] = int(pinned_blocks)
+    out["pinned_bytes"] = int(pinned_blocks) * per_block
+    out["spilled_blocks"] = int(spilled_blocks)
+    out["spilled_bytes_host"] = int(spilled_blocks) * per_block_host
     return out
 
 
@@ -616,12 +643,22 @@ def xla_memory_stats(fn, *sample_args, in_shardings=None,
 def check_memory(target, budget_bytes=None, known_shapes=None, rules=None,
                  mesh=None, kv_caches=(), sample_args=None,
                  headroom: float = 0.9, top_k: int = 3,
+                 host_budget_bytes=None, host_kv_bytes: int = 0,
                  **shape_kwargs) -> Report:
     """Budget check over a Symbol graph (or a jittable callable when
     ``sample_args`` is given); returns a Report of M0xx diagnostics.
 
     budget_bytes: int or a string like ``"16GiB"``; None checks nothing
-    but still reports the M003 breakdown."""
+    but still reports the M003 breakdown.
+
+    The hierarchical cache's tiers are priced SEPARATELY
+    (docs/inference.md "Hierarchical prefix cache"): pinned pages are
+    part of the device pool — whatever ``kv_caches`` shapes carry them
+    already counts against ``budget_bytes`` — while spilled chains
+    live in HOST RAM and must not inflate the HBM verdict.  Pass their
+    bytes (``paged_kv_cache_residency(...)["spilled_bytes_host"]``) as
+    ``host_kv_bytes`` with a ``host_budget_bytes`` cap to get an M006
+    ERROR when the host tier outgrows its budget."""
     report = Report()
     if callable(target) and not hasattr(target, "_topo"):
         if sample_args is None:
@@ -638,11 +675,15 @@ def check_memory(target, budget_bytes=None, known_shapes=None, rules=None,
         subject = getattr(target, "name", "graph")
 
     bd = est.breakdown()
+    # host tier reported beside the device breakdown but NEVER summed
+    # into it — spilled chains are host RAM, not HBM
+    bd3 = dict(bd, host_kv_cache=int(host_kv_bytes)) if host_kv_bytes \
+        else bd
     report.add(Diagnostic(
         _PASS, "M003", Severity.INFO, subject,
         "per-device estimate: %s" % ", ".join(
-            "%s=%s" % (k, format_bytes(v)) for k, v in bd.items()),
-        details=bd))
+            "%s=%s" % (k, format_bytes(v)) for k, v in bd3.items()),
+        details=bd3))
     for name, nbytes in est.contributors[:top_k]:
         report.add(Diagnostic(
             _PASS, "M004", Severity.INFO, name,
@@ -681,6 +722,20 @@ def check_memory(target, budget_bytes=None, known_shapes=None, rules=None,
                     format_bytes(total), format_bytes(budget),
                     int(headroom * 100)),
                 details=bd))
+    if host_budget_bytes is not None:
+        host_budget = parse_bytes(host_budget_bytes)
+        if int(host_kv_bytes) > host_budget:
+            report.add(Diagnostic(
+                _PASS, "M006", Severity.ERROR, subject,
+                "host-RAM KV tier %s exceeds the %s host budget by %s "
+                "— shrink host_cache_bytes or let the LRU evict "
+                "(spilled chains are host memory, priced separately "
+                "from the HBM budget)" % (
+                    format_bytes(int(host_kv_bytes)),
+                    format_bytes(host_budget),
+                    format_bytes(int(host_kv_bytes) - host_budget)),
+                details={"host_kv_bytes": int(host_kv_bytes),
+                         "host_budget_bytes": host_budget}))
     return report
 
 

@@ -342,6 +342,54 @@ class ShardedDecoder:
                                    NDArray(table), NDArray(start_pos),
                                    total_len=total_len)
 
+    def _build_swap_program(self, cache_template):
+        """ONE bounded copy program for the hierarchical cache's
+        device↔host page moves (docs/inference.md): reads page ``bid``
+        of every pool leaf (replicated out, so the host copy sees the
+        full page) and — under the traced ``write`` flag — overwrites
+        that page with ``content``.  Swap-out passes write=0 (the
+        content arg is an ignored zero template), swap-in passes
+        write=1 and discards the read; both directions therefore share
+        a SINGLE compiled program per pool shape, the only program the
+        swap tier ever adds (site ``serving.swap``)."""
+        jm = self._mesh.jax_mesh
+        rep = NamedSharding(jm, P())
+        cache_sh = self._cache_sharding_tree(cache_template)
+        rep_tree = jax.tree_util.tree_map(lambda _: rep, cache_sh)
+
+        def program(cache_leaves, content, bid, write):
+            read = jax.tree_util.tree_map(
+                lambda l: jax.lax.dynamic_index_in_dim(
+                    l, bid, 0, keepdims=False), cache_leaves)
+
+            def wr(leaf, c):
+                return jax.lax.cond(
+                    write > 0,
+                    lambda a: jax.lax.dynamic_update_slice_in_dim(
+                        a, c[None].astype(a.dtype), bid, 0),
+                    lambda a: a, leaf)
+
+            new = jax.tree_util.tree_map(wr, cache_leaves, content)
+            return read, new
+
+        return jax.jit(program,
+                       in_shardings=(cache_sh, rep_tree, rep, rep),
+                       out_shardings=(rep_tree, cache_sh),
+                       donate_argnums=(0,))
+
+    def _swap_page_jitted(self, cache_leaves, content, bid, write):
+        """The hierarchical cache's page copy (see
+        :meth:`_build_swap_program`); returns ``(page_content,
+        new_cache_leaves)``."""
+        key = ("swap", _cache_shapes(cache_leaves),
+               _cache_dt(cache_leaves))
+        hit = key in self._jit_cache
+        self._ledger_report("swap", cache_leaves, (), hit)
+        if not hit:
+            self._jit_cache[key] = self._build_swap_program(cache_leaves)
+        return self._jit_cache[key](cache_leaves, content,
+                                    jnp.int32(bid), jnp.int32(write))
+
     def _ledger_report(self, kind, cache_leaves, extras, hit):
         """Report one program-cache lookup into the process compile
         ledger (docs/analysis.md): the bucketed prefill and pooled decode

@@ -99,7 +99,8 @@ from ..resilience.counters import bump as _bump
 from ..resilience.faults import inject as _inject
 from .decode import ShardedDecoder, _bucket, resolve_cache_dtype
 from .mesh import DeviceMesh
-from .paging import NULL_PAGE, BlockPool, PrefixIndex
+from .paging import (NULL_PAGE, BlockPool, HierarchicalCache,
+                     PrefixIndex)
 from .sharding import ShardingRules
 
 __all__ = ["ContinuousBatchingEngine", "PagedContinuousBatchingEngine",
@@ -111,12 +112,13 @@ class Request:
 
     __slots__ = ("rid", "prompt", "max_new_tokens", "temperature",
                  "top_k", "top_p", "repetition_penalty", "seed",
-                 "eos_id", "deadline_at", "retries_left", "speculative")
+                 "eos_id", "deadline_at", "retries_left", "speculative",
+                 "session")
 
     def __init__(self, rid, prompt, max_new_tokens, temperature=0.0,
                  top_k=0, top_p=0.0, repetition_penalty=1.0, seed=None,
                  eos_id=None, deadline_at=None, retries=0,
-                 speculative=None):
+                 speculative=None, session=None):
         self.rid = rid
         self.prompt = prompt            # (1, Tp) int32 numpy
         self.max_new_tokens = int(max_new_tokens)
@@ -129,6 +131,7 @@ class Request:
         self.deadline_at = deadline_at  # absolute clock() value or None
         self.retries_left = int(retries)
         self.speculative = speculative  # None = engine default
+        self.session = session          # paged engine only
 
     @property
     def sampled(self):
@@ -356,10 +359,15 @@ class ContinuousBatchingEngine:
         return self._errors.get(rid)
 
     # -- request intake --------------------------------------------------
+    #: whether this engine honors ``submit(session=...)`` (the paged
+    #: engine's hierarchical cache; the slot engine has no page chains
+    #: to pin, so it rejects the knob loudly instead of no-op'ing)
+    _supports_sessions = False
+
     def submit(self, prompt_ids, max_new_tokens, temperature=0.0,
                top_k=0, top_p=0.0, repetition_penalty=1.0, seed=None,
                eos_id=None, deadline_s=None, retries=0,
-               speculative=None) -> int:
+               speculative=None, session=None) -> int:
         """Queue one request; returns its id.  Sampling knobs follow the
         ``generate`` contract (temperature=0 greedy; seed reproduces).
 
@@ -373,7 +381,17 @@ class ContinuousBatchingEngine:
         ``speculative``: per-request opt-out (False) from a
         speculation-enabled engine, or the engine default (None); the
         output is bit-identical either way — speculation only changes
-        how many positions one iteration may emit."""
+        how many positions one iteration may emit.  ``session``: a
+        conversation handle (paged engine only, docs/inference.md
+        "Hierarchical prefix cache") — the finished request's page
+        chain stays pinned so the NEXT turn's prompt (this transcript
+        plus the new message) prefills only the new suffix; release
+        with ``close_session``."""
+        if session is not None and not self._supports_sessions:
+            raise ValueError(
+                "submit(session=...) needs the paged engine's "
+                "hierarchical cache (PagedContinuousBatchingEngine) — "
+                "the slot engine has no page chains to pin")
         prompt_ids = prompt_ids if isinstance(prompt_ids, NDArray) \
             else nd_array(prompt_ids)
         if prompt_ids.ndim != 2 or prompt_ids.shape[0] != 1:
@@ -403,7 +421,7 @@ class ContinuousBatchingEngine:
         self._queue.append(Request(
             rid, prompt, max_new_tokens, temperature, top_k, top_p,
             repetition_penalty, seed, eos_id, deadline_at=deadline_at,
-            retries=retries, speculative=speculative))
+            retries=retries, speculative=speculative, session=session))
         self._status[rid] = "queued"
         return rid
 
@@ -1163,7 +1181,22 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
     prefill_chunk : tokens ingested per iteration during admission
         (power of two >= 8; prompts shorter than one chunk admit in a
         single iteration, exactly like the slot engine).
+    pin_bytes : device-tier budget of the HIERARCHICAL prefix cache
+        (docs/inference.md "Hierarchical prefix cache"): finished
+        requests' full-page chains stay pinned in HBM under an LRU
+        policy holding at most ``pin_bytes // bytes_per_block`` distinct
+        pages, so a popular prompt survives traffic lulls instead of
+        recomputing.  Accepts an int or a "16MiB"-style string; None
+        reads ``MXTPU_PIN_BYTES`` (default 0 = off).  Session chains
+        pin regardless of this budget (they are explicit handles).
+    host_cache_bytes : host-RAM tier budget — chains evicted from the
+        pinned tier spill to host arrays (``serving.swap_out``) and
+        re-admit on a radix hit (``serving.swap_in``) through ONE
+        bounded copy program.  Same forms; None reads
+        ``MXTPU_HOST_CACHE_BYTES`` (default 0 = off).
     """
+
+    _supports_sessions = True
 
     def __init__(self, block, mesh: DeviceMesh,
                  rules: Optional[ShardingRules] = None,
@@ -1176,7 +1209,8 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
                  num_blocks: Optional[int] = None,
                  prefill_chunk: int = 64, spec_k: int = 0,
                  spec_ngram: int = 3, draft_block=None,
-                 draft_rules: Optional[ShardingRules] = None):
+                 draft_rules: Optional[ShardingRules] = None,
+                 pin_bytes=None, host_cache_bytes=None):
         super().__init__(block, mesh, rules, num_slots, max_length,
                          cache_dtype, cache_spec, bucket_prefill,
                          max_pending, clock, history, spec_k,
@@ -1205,6 +1239,19 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
             [None] * self._num_slots
         self._prefix_hits = 0
         self._cow_copies = 0
+        # -- hierarchical prefix cache (docs/inference.md) ---------------
+        self._pin_bytes = self._budget_bytes(pin_bytes,
+                                             "MXTPU_PIN_BYTES")
+        self._host_bytes = self._budget_bytes(host_cache_bytes,
+                                              "MXTPU_HOST_CACHE_BYTES")
+        self._hc: Optional[HierarchicalCache] = None  # built with pool
+        self._bytes_per_block = None
+        self._swap_zero = None          # content template, built lazily
+        self._sessions: Dict[Any, int] = {}   # sid -> turns submitted
+        self._swap_ins = 0              # pages restored host -> device
+        self._swap_outs = 0             # pages spilled device -> host
+        self._session_hits = 0
+        self._prefill_tokens_avoided = 0
 
     # -- introspection ---------------------------------------------------
     @property
@@ -1219,6 +1266,16 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
             "cow_copies": self._cow_copies,
             "block_size": self._bs,
             "num_blocks": self._bp.capacity,
+            # hierarchical prefix cache (0s while disabled)
+            "pinned_blocks": (self._hc.pinned_blocks
+                              if self._hc is not None else 0),
+            "spilled_blocks": (self._hc.spilled_blocks
+                               if self._hc is not None else 0),
+            "swap_ins": self._swap_ins,
+            "swap_outs": self._swap_outs,
+            "session_hits": self._session_hits,
+            "sessions_open": len(self._sessions),
+            "prefill_tokens_avoided": self._prefill_tokens_avoided,
         })
         return out
 
@@ -1230,6 +1287,234 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
             return
         self._pool = self._dec._place_cache(self._block.init_block_pool(
             self._bp.capacity + 1, self._bs, self._cache_dtype))
+        self._init_hierarchy()
+
+    # -- hierarchical prefix cache (docs/inference.md) -------------------
+    @staticmethod
+    def _budget_bytes(value, env):
+        """Resolve one tier budget: an explicit int / "16MiB"-style
+        string, else the env var, else 0 (tier off)."""
+        import os
+
+        from ..analysis.memory_estimate import parse_bytes
+
+        if value is None:
+            value = os.environ.get(env, 0)
+        return int(parse_bytes(value))
+
+    def _init_hierarchy(self):
+        """Price a page from the ACTUAL placed pool (int8 caches halve
+        bytes_per_block, which doubles both tier budgets for free) and
+        build the policy object.  The two budgets price DIFFERENT
+        memories: ``pin_bytes`` is per-device HBM, so a tp-sharded
+        pool's pages divide by their shard count, while
+        ``host_cache_bytes`` prices the host copies the swap program
+        replicates — full unsharded pages (matching
+        ``paged_kv_cache_residency``'s bytes_per_block vs
+        bytes_per_block_host split).  MoE blocks opt out entirely —
+        they opt out of prefix sharing, and a chain that cannot be
+        shared cannot be reused."""
+        def _device_nbytes(leaf):
+            # per-device bytes of one sharded leaf (all shards of the
+            # pool are even: kv-head divisibility is validated at
+            # construction); fall back to global bytes when the
+            # backend exposes no addressable shards
+            shards = getattr(leaf, "addressable_shards", None)
+            return shards[0].data.nbytes if shards else leaf.nbytes
+
+        leaves = jax.tree_util.tree_leaves(self._pool)
+        per_block_host = sum(l.nbytes // l.shape[0] for l in leaves)
+        per_block_dev = sum(
+            _device_nbytes(l) // l.shape[0] for l in leaves)
+        self._bytes_per_block = per_block_host
+        if self._dec._block_has_moe():
+            return
+        self._hc = HierarchicalCache(
+            self._bp, self._prefix,
+            pin_blocks=self._pin_bytes // per_block_dev,
+            host_blocks=self._host_bytes // per_block_host)
+
+    def _hierarchy_on(self):
+        """Whether finished chains are worth pinning at all: an auto-pin
+        budget, a host tier to spill into, or at least one live
+        session."""
+        return self._hc is not None and (
+            self._hc.pin_blocks > 0 or self._hc.host_blocks > 0
+            or bool(self._sessions))
+
+    def _swap_template(self):
+        """Zero content template for swap-out calls (the copy program
+        takes a content arg in both directions; write=0 ignores it)."""
+        if self._swap_zero is None:
+            self._swap_zero = jax.tree_util.tree_map(
+                lambda l: jnp.zeros(l.shape[1:], l.dtype), self._pool)
+        return self._swap_zero
+
+    def _read_page(self, bid):
+        """Device→host copy of one page through the bounded copy
+        program (the swap tier's ONLY compiled program; ledger site
+        ``serving.swap``); returns a host pytree of numpy arrays."""
+        content, self._pool = self._dec._swap_page_jitted(
+            self._pool, self._swap_template(), bid, 0)
+        return jax.tree_util.tree_map(
+            lambda l: onp.asarray(jax.device_get(l)), content)
+
+    def _write_page(self, bid, content):
+        """Host→device restore of one page (same program, write=1)."""
+        _, self._pool = self._dec._swap_page_jitted(
+            self._pool, content, bid, 1)
+
+    def _spill_chain(self, chain):
+        """Evict one pinned chain from the device tier: copy its pages
+        to host (budget permitting) then unpin.  The ``serving.swap_out``
+        fault site fires once per spill; a raise — or any copy failure —
+        degrades to dropping the chain WITHOUT a host copy (a cache
+        loss costs recompute, never correctness), so the spill path can
+        never poison the request that triggered the eviction."""
+        content = None
+        if self._hc.host_blocks >= len(chain.pages):
+            try:
+                _inject("serving.swap_out")
+                content = [self._read_page(bid) for bid in chain.pages]
+            except Exception:
+                content = None
+        if content is not None:
+            self._hc.spill(chain, content)
+            self._swap_outs += len(chain.pages)
+        else:
+            self._hc.drop_chain(chain)
+
+    def _enforce_pin_budget(self):
+        while self._hc is not None:
+            victim = self._hc.pick_budget_victim()
+            if victim is None:
+                return
+            self._spill_chain(victim)
+
+    def _reclaim(self, short):
+        """Pool pressure: spill pinned chains (non-session LRU first,
+        sessions last) until ``short`` pages freed or nothing evictable
+        remains — live admissions always beat cached prefixes, so a
+        request only defers once the pinned tier cannot help."""
+        while short > 0 and self._hc is not None:
+            victim = self._hc.pick_pressure_victim()
+            if victim is None:
+                return
+            before = self._bp.free_count
+            self._spill_chain(victim)
+            short -= self._bp.free_count - before
+
+    def _try_swap_in(self, req, full):
+        """Host-tier lookup at admission: when a spilled chain matches
+        MORE of the prompt than the device radix walk did, restore the
+        missing pages (alloc + the bounded copy program per page),
+        stitch them into the device index, and re-pin the chain —
+        the caller then re-runs the device lookup and shares them like
+        any other prefix hit.  Returns True whenever the pool was
+        TOUCHED (pages restored, or a reclaim ran for a restore that
+        then could not fit) — the caller must re-walk the index in
+        either case, since a reclaim may have freed pages the first
+        walk returned.  The ``serving.swap_in`` fault site fires before
+        the restore; a raise releases every restore-allocated page and
+        propagates through the admission quarantine path (retries
+        restart the request bit-identically)."""
+        if self._hc is None or not self._hc.host_chains:
+            return False
+        Tp = req.prompt.shape[1]
+        match = self._hc.host_match(req.prompt[0], limit=Tp - 1)
+        if match is None or match[1] <= len(full):
+            return False
+        chain, npages = match
+        extra = npages - len(full)
+        # hold the device-matched prefix across the reclaim below: a
+        # spill may otherwise free (and recycle) exactly these pages
+        for bid in full:
+            self._bp.retain(bid)
+        try:
+            if extra > self._bp.free_count:
+                self._reclaim(extra - self._bp.free_count)
+            if extra > self._bp.free_count:
+                return True         # pool too hot to restore — but the
+                #                     reclaim mutated it: caller re-walks
+            _inject("serving.swap_in", key=req.rid)
+            fresh = self._bp.alloc(extra)
+            try:
+                for bid, content in zip(fresh,
+                                        chain.content[len(full):npages]):
+                    self._write_page(bid, content)
+            except Exception:
+                for bid in fresh:
+                    self._bp.release(bid)
+                raise
+            tokens = chain.tokens[:npages * self._bs]
+            self._prefix.register(tokens, list(full) + fresh)
+            pages, _ = self._prefix.lookup(tokens, limit=len(tokens))
+            self._hc.pin_chain(tokens, pages, sid=chain.sid)
+            if npages == len(chain.content):
+                self._hc.drop_host(chain)
+            # else: a PARTIAL restore (this prompt matched only a
+            # prefix of the spilled chain) keeps the host copy — a
+            # session transcript's unrestored tail must stay
+            # recoverable for the conversation's next turn
+            # the alloc reference hands over to the pin: restored pages
+            # are owned by the chain (and whoever shares them), not by
+            # this admission
+            for bid in fresh:
+                self._bp.release(bid)
+        finally:
+            for bid in full:
+                self._bp.release(bid)
+        self._swap_ins += len(fresh)
+        return True
+
+    def _offer_chain(self, row, req):
+        """Finish-time tail of a successful request: register the FULL
+        written pages of its final sequence (prompt + emitted — K/V at
+        position i is a pure function of tokens[:i+1], so a finished
+        transcript's pages are as immutable and shareable as prompt
+        pages) and pin the chain in the device tier.  Non-session
+        chains need an auto-pin budget OR a host tier (with
+        ``pin_bytes=0`` the pin is transient: the budget sweep spills
+        the chain straight through to host RAM); session chains always
+        pin (the session handle is the release)."""
+        sid = req.session
+        if sid is not None and sid not in self._sessions:
+            # the session closed while this request was in flight — a
+            # sid-tagged pin now would leak (no future close_session
+            # releases it); degrade to an ordinary budget-governed pin
+            sid = None
+        if self._hc is None or (sid is None
+                                and self._hc.pin_blocks <= 0
+                                and self._hc.host_blocks <= 0):
+            return
+        pages = self._slot_pages[row]
+        res = self._results.get(req.rid)
+        if not pages or res is None:
+            return
+        seq = [int(t) for t in onp.asarray(res.asnumpy())[0]]
+        # the LAST token's K/V may be unwritten (it is never fed back),
+        # so only pages fully below len(seq)-1 are complete
+        fullp = min((len(seq) - 1) // self._bs, len(pages))
+        if fullp <= 0:
+            return
+        self._prefix.register(seq, pages[:fullp])
+        tokens = tuple(seq[:fullp * self._bs])
+        chain_pages, _ = self._prefix.lookup(tokens, limit=len(tokens))
+        if len(chain_pages) < fullp:
+            return                      # raced an eviction: nothing to pin
+        self._hc.pin_chain(tokens, chain_pages, sid=sid)
+        self._enforce_pin_budget()
+
+    def close_session(self, sid) -> int:
+        """Release one conversation's pinned chain from BOTH tiers
+        (device pins unpin — pages free unless shared — and host
+        copies drop).  Unknown sids are a no-op; in-flight requests of
+        the session keep their own page references and are unaffected.
+        Returns the number of device pages freed."""
+        self._sessions.pop(sid, None)
+        if self._hc is None:
+            return 0
+        return self._hc.close_session(sid)
 
     def _release_row(self, row):
         """Drop row's page references (idempotent — every terminal path
@@ -1249,6 +1534,10 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
     def _finish(self, slot_idx_or_none, req, emitted, row, status="ok"):
         super()._finish(slot_idx_or_none, req, emitted, row, status)
         if slot_idx_or_none is not None:
+            if status == "ok" and self._hierarchy_on():
+                # pin BEFORE the release below so the chain's pages
+                # never transiently free
+                self._offer_chain(row, req)
             self._release_row(row)
 
     def _table_row(self, row):
@@ -1299,12 +1588,26 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
     def submit(self, prompt_ids, max_new_tokens, temperature=0.0,
                top_k=0, top_p=0.0, repetition_penalty=1.0, seed=None,
                eos_id=None, deadline_s=None, retries=0,
-               speculative=None) -> int:
+               speculative=None, session=None) -> int:
         """Same contract as the slot engine's submit(); additionally a
         request whose worst-case page need exceeds the WHOLE pool can
         never be admitted and sheds immediately with LoadShedError
         (transient exhaustion — pages held by live requests — defers
-        admission instead, it never sheds)."""
+        admission instead, it never sheds).
+
+        ``session``: a conversation handle (any hashable).  The
+        finished request's full-page chain stays PINNED so the next
+        turn — whose prompt is this turn's transcript plus the new
+        message — prefills only the new suffix; ``close_session``
+        releases it (docs/inference.md "Hierarchical prefix cache").
+        Pinning requires prefix sharing, so MoE blocks reject the
+        knob (their prefix K/V is not donor-independent)."""
+        if session is not None and self._dec._block_has_moe():
+            raise ValueError(
+                "submit(session=...) is unsupported for MoE blocks: "
+                "they opt out of prefix sharing (expert capacity "
+                "budgets from the FULL prompt length), and a chain "
+                "that cannot be shared cannot be reused across turns")
         pids = prompt_ids if isinstance(prompt_ids, NDArray) \
             else nd_array(prompt_ids)
         if pids.ndim == 2 and pids.shape[0] == 1:
@@ -1317,9 +1620,14 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
                     "request needs %d page(s) > pool capacity %d "
                     "(block_size=%d): can never be admitted — shed"
                     % (need, self._bp.capacity, self._bs))
-        return super().submit(pids, max_new_tokens, temperature, top_k,
-                              top_p, repetition_penalty, seed, eos_id,
-                              deadline_s, retries, speculative)
+        rid = super().submit(pids, max_new_tokens, temperature, top_k,
+                             top_p, repetition_penalty, seed, eos_id,
+                             deadline_s, retries, speculative,
+                             session=session)
+        if session is not None:
+            self._sessions[session] = \
+                self._sessions.get(session, 0) + 1
+        return rid
 
     def _admit(self, req, slot_idx):
         """Paged admission: prefix lookup + page allocation + chunk
@@ -1337,20 +1645,54 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
             _inject("serving.prefix_lookup", key=req.rid)
             full, partial = self._prefix.lookup(req.prompt[0],
                                                 limit=Tp - 1)
+            if self._try_swap_in(req, full):
+                # re-walk the index whenever the swap-in path touched
+                # the pool: a restore ADDS pages, and the reclaim
+                # inside a restore attempt (even a failed one) may have
+                # FREED pages the first walk returned — the stale list
+                # must never reach retain()
+                full, partial = self._prefix.lookup(req.prompt[0],
+                                                    limit=Tp - 1)
         n_shared = len(full) * self._bs + (partial[1] if partial else 0)
         chunks, extent = self._plan_chunks(n_shared, Tp, bucketing)
         n_pages = -(-max(Tp + req.max_new_tokens, extent) // self._bs)
         need = n_pages - len(full)
         _inject("serving.block_alloc", key=req.rid)
-        if need > self._bp.free_count:
-            raise _AdmissionDeferred()
-        fresh = self._bp.alloc(need)
-        pages = list(full) + fresh
-        for bid in full:
+        # hold the matched pages (and the COW donor) across the pinned-
+        # tier reclaim: spilling a chain frees pages whose only ref is
+        # its pin, and the lookup results above must not be among them
+        held = list(full) + ([partial[0]] if partial else [])
+        for bid in held:
             self._bp.retain(bid)
+        try:
+            if need > self._bp.free_count:
+                self._reclaim(need - self._bp.free_count)
+            if need > self._bp.free_count:
+                raise _AdmissionDeferred()
+            fresh = self._bp.alloc(need)
+        except BaseException:
+            for bid in held:
+                self._bp.release(bid)
+            raise
+        if partial:
+            # the donor hold only had to span the reclaim — the COW
+            # copy runs inside this admission's first chunk, before any
+            # other request could release it
+            self._bp.release(partial[0])
+        pages = list(full) + fresh
+        # the holds on `full` stay: they ARE this table's references
         self._slot_pages[slot_idx] = pages   # release path armed NOW
         if full or partial:
             self._prefix_hits += 1
+        # hit accounting only AFTER a successful allocation: a deferred
+        # admission retries this whole path every iteration and must
+        # not re-count the same hit (the bench's headline metric)
+        if n_shared:
+            self._prefill_tokens_avoided += n_shared
+            if self._hc is not None:
+                self._hc.touch_prefix(req.prompt[0], Tp - 1)
+            if req.session is not None:
+                self._session_hits += 1
         cow = None
         if partial:
             cow = (partial[0], pages[len(full)])

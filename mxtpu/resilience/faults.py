@@ -26,6 +26,20 @@ site                fires at
                     admission path), keyed by rid — a raise models the
                     pool-exhausted path; genuine transient exhaustion
                     defers admission, it never raises
+``serving.swap_out``
+                    before the hierarchical cache spills one pinned
+                    chain to the host tier
+                    (``PagedContinuousBatchingEngine._spill_chain``) —
+                    a raise models a failed device→host copy: the chain
+                    is DROPPED (recompute on the next miss), never
+                    stored half-copied, and the request that triggered
+                    the eviction proceeds unharmed
+``serving.swap_in``
+                    before the hierarchical cache restores a spilled
+                    chain at admission
+                    (``PagedContinuousBatchingEngine._try_swap_in``),
+                    keyed by rid — a raise releases every restore-
+                    allocated page and quarantines only that request
 ``serving.draft``   once per speculating active slot per iteration,
                     keyed by rid, BEFORE its draft proposal
                     (``ContinuousBatchingEngine._draft_phase``) — a
@@ -105,7 +119,8 @@ __all__ = ["InjectedFault", "FaultRule", "FaultPlan", "fault_plan",
 
 #: the documented injection sites (see module docstring for locations)
 SITES = ("serving.step", "serving.admit", "serving.prefix_lookup",
-         "serving.block_alloc", "serving.draft", "serving.verify",
+         "serving.block_alloc", "serving.swap_out", "serving.swap_in",
+         "serving.draft", "serving.verify",
          "kvstore.reduce", "checkpoint.save", "engine.flush",
          "guardian.check", "ckpt.write", "ckpt.verify")
 

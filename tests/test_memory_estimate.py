@@ -147,6 +147,89 @@ def test_paged_kv_cache_residency_accounting():
     assert m3.details["kv_cache"] == out["total_bytes"]
 
 
+def test_paged_residency_prices_hierarchical_tiers_separately():
+    """ISSUE-11 satellite: pinned pages count against the HBM side
+    (per-device bytes, a slice of the resident pool) while host-spilled
+    chains price at UNSHARDED full-page bytes against a separate host
+    budget — check_memory raises M006 on a host-tier overflow without
+    touching the HBM verdict, and a live engine feeds both counters
+    through ``engine=``."""
+    from mxtpu.analysis import paged_kv_cache_residency
+    from mxtpu.models.transformer import llama_tiny
+
+    mx.random.seed(0)
+    net = llama_tiny(vocab_size=50)
+    out = paged_kv_cache_residency(net, num_blocks=16, block_size=8,
+                                   blocks_in_use=10, pinned_blocks=4,
+                                   spilled_blocks=6)
+    per_block = F32 * 4 * (2 * 8 * 16)
+    assert out["pinned_bytes"] == 4 * per_block
+    assert out["spilled_bytes_host"] == 6 * per_block
+    # pinned pages are INSIDE the resident pool, never double-counted
+    assert out["pinned_bytes"] <= out["resident_bytes"]
+    # sharded pool: device bytes halve, HOST bytes do not (host copies
+    # are full replicated pages — the swap program replicates its read)
+    sharded = paged_kv_cache_residency(
+        net, num_blocks=16, block_size=8, cache_spec=P(None, "tp"),
+        mesh={"tp": 2}, pinned_blocks=4, spilled_blocks=6)
+    assert sharded["pinned_bytes"] == out["pinned_bytes"] // 2
+    assert sharded["spilled_bytes_host"] == out["spilled_bytes_host"]
+    assert sharded["bytes_per_block_host"] == \
+        2 * sharded["bytes_per_block"]
+    # host tier budgeted separately: HBM budget passes, host overflows
+    rep = check_memory(
+        sym.Variable("tokens"), budget_bytes=out["total_bytes"] * 2,
+        known_shapes={"tokens": (4, 8)},
+        kv_caches=[(s, d) for s, d in out["shapes"]],
+        host_budget_bytes=out["spilled_bytes_host"] - 1,
+        host_kv_bytes=out["spilled_bytes_host"])
+    assert not rep.ok
+    m6 = rep.filter(code="M006").diagnostics
+    assert len(m6) == 1
+    assert m6[0].details["host_kv_bytes"] == out["spilled_bytes_host"]
+    m3 = rep.filter(code="M003").diagnostics[0]
+    assert m3.details["host_kv_cache"] == out["spilled_bytes_host"]
+    # within the host budget: clean
+    assert check_memory(
+        sym.Variable("tokens"), budget_bytes=out["total_bytes"] * 2,
+        known_shapes={"tokens": (4, 8)},
+        kv_caches=[(s, d) for s, d in out["shapes"]],
+        host_budget_bytes="1GiB",
+        host_kv_bytes=out["spilled_bytes_host"]).ok
+
+
+def test_paged_residency_reads_tier_counters_from_live_engine():
+    """``engine=`` carries the hierarchy's live pinned/spilled counters
+    into the pricer."""
+    from mxtpu.analysis import paged_kv_cache_residency
+    from mxtpu.models.transformer import (TransformerLM,
+                                          transformer_lm_sharding_rules)
+    from mxtpu.parallel import PagedContinuousBatchingEngine
+    from mxtpu.parallel.mesh import DeviceMesh
+
+    mx.random.seed(7)
+    lm = TransformerLM(32, units=16, hidden_size=32, num_layers=1,
+                       num_heads=2, num_kv_heads=2)
+    lm.initialize()
+    eng = PagedContinuousBatchingEngine(
+        lm, DeviceMesh(dp=1), transformer_lm_sharding_rules(),
+        num_slots=2, max_length=32, block_size=8, prefill_chunk=8,
+        pin_bytes="1MiB", host_cache_bytes="1MiB")
+    rng = onp.random.RandomState(0)
+    eng.submit(mx.nd.array(rng.randint(0, 32, (1, 17)),
+                           dtype="int32"), 4)
+    eng.run()
+    priced = paged_kv_cache_residency(lm, 0, 0, engine=eng)
+    st = eng.stats
+    assert st["pinned_blocks"] == 2
+    assert priced["pinned_blocks"] == 2
+    assert priced["pinned_bytes"] == 2 * priced["bytes_per_block"]
+    assert priced["spilled_blocks"] == st["spilled_blocks"] == 0
+    # bytes_per_block from the pricer matches the engine's own pricing
+    # of its placed pool (what the byte budgets divide by)
+    assert priced["bytes_per_block_host"] == eng._bytes_per_block
+
+
 # -- the XLA cross-check (acceptance: within 10%) ----------------------
 
 def _rel_err(est_total, xla_total):

@@ -142,12 +142,14 @@ def test_slot_engine_int8_streams_bit_identical(tiny, mesh, isolated):
                                    num_slots=2, max_length=MAXLEN,
                                    cache_dtype="int8")
     rng = np.random.RandomState(0)
+    # token counts trimmed round 15 (tier-1 wall-time budget); the
+    # invariant is one bit-exact stream per sampling MODE, not length
     reqs = [
-        (_prompt(rng, 5), 8, {}),
-        (_prompt(rng, 9), 6, dict(temperature=0.8, top_k=5, seed=11)),
-        (_prompt(rng, 7), 5, dict(temperature=0.7, top_p=0.9, seed=3,
+        (_prompt(rng, 5), 5, {}),
+        (_prompt(rng, 9), 4, dict(temperature=0.8, top_k=5, seed=11)),
+        (_prompt(rng, 7), 4, dict(temperature=0.7, top_p=0.9, seed=3,
                                   repetition_penalty=1.3)),
-        (_prompt(rng, 12), 4, dict(repetition_penalty=1.5)),
+        (_prompt(rng, 12), 3, dict(repetition_penalty=1.5)),
     ]
     rids = [eng.submit(p, n, **kw) for p, n, kw in reqs]
     res = eng.run()
@@ -173,22 +175,23 @@ def test_paged_engine_int8_shared_chunked_speculative(tiny, mesh,
         [shared, rng.randint(0, 50, (1, 4))], axis=1), dtype="int32")
     pb = nd.array(np.concatenate(
         [shared, rng.randint(0, 50, (1, 2))], axis=1), dtype="int32")
-    long = _prompt(rng, 21)             # 3 chunks at prefill_chunk=8
+    long = _prompt(rng, 17)             # 3 chunks at prefill_chunk=8
     sampled = _prompt(rng, 6)
 
-    ra = eng.submit(pa, 6)
+    # token counts trimmed round 15 (tier-1 wall-time budget)
+    ra = eng.submit(pa, 5)
     eng.step()                          # A prefills + registers pages
     eng.step()
-    rb = eng.submit(pb, 5)              # shares A's full prefix pages
-    rc = eng.submit(long, 4)
-    rd = eng.submit(sampled, 6, temperature=0.9, top_k=8, seed=21)
+    rb = eng.submit(pb, 4)              # shares A's full prefix pages
+    rc = eng.submit(long, 3)
+    rd = eng.submit(sampled, 4, temperature=0.9, top_k=8, seed=21)
     res = eng.run()
-    assert np.array_equal(res[ra].asnumpy(), _want(isolated, pa, 6))
-    assert np.array_equal(res[rb].asnumpy(), _want(isolated, pb, 5))
-    assert np.array_equal(res[rc].asnumpy(), _want(isolated, long, 4))
+    assert np.array_equal(res[ra].asnumpy(), _want(isolated, pa, 5))
+    assert np.array_equal(res[rb].asnumpy(), _want(isolated, pb, 4))
+    assert np.array_equal(res[rc].asnumpy(), _want(isolated, long, 3))
     assert np.array_equal(
         res[rd].asnumpy(),
-        _want(isolated, sampled, 6, temperature=0.9, top_k=8, seed=21))
+        _want(isolated, sampled, 4, temperature=0.9, top_k=8, seed=21))
     st = eng.stats
     assert st["prefix_hits"] >= 1
     assert st["blocks_in_use"] == 0     # clean drain
@@ -312,9 +315,9 @@ def test_quantized_weights_tp_parity(mesh):
     rules = quantize_weights(lm, bits=8,
                              rules=transformer_lm_sharding_rules())
     one = ShardedDecoder(lm, DeviceMesh(dp=1), rules).generate(
-        p, max_new_tokens=6, max_length=MAXLEN).asnumpy()
+        p, max_new_tokens=4, max_length=MAXLEN).asnumpy()
     two = ShardedDecoder(lm, mesh, rules).generate(
-        p, max_new_tokens=6, max_length=MAXLEN).asnumpy()
+        p, max_new_tokens=4, max_length=MAXLEN).asnumpy()
     assert np.array_equal(one, two)
 
 
@@ -336,13 +339,13 @@ def test_fully_quantized_engine_bit_identical():
         prefill_chunk=8, cache_dtype="int8")
     rng = np.random.RandomState(6)
     p1, p2 = _prompt(rng, 5), _prompt(rng, 10)
-    r1 = eng.submit(p1, 6)
-    r2 = eng.submit(p2, 5, temperature=0.8, top_k=6, seed=13)
+    r1 = eng.submit(p1, 4)
+    r2 = eng.submit(p2, 4, temperature=0.8, top_k=6, seed=13)
     res = eng.run()
-    assert np.array_equal(res[r1].asnumpy(), _want(iso, p1, 6))
+    assert np.array_equal(res[r1].asnumpy(), _want(iso, p1, 4))
     assert np.array_equal(
         res[r2].asnumpy(),
-        _want(iso, p2, 5, temperature=0.8, top_k=6, seed=13))
+        _want(iso, p2, 4, temperature=0.8, top_k=6, seed=13))
 
 
 # ------------------------------------------------------ compile budgets

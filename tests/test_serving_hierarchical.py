@@ -1,0 +1,681 @@
+"""Hierarchical prefix cache (ISSUE 11): persistent HBM pinning,
+host-RAM KV tiering, and multi-turn session reuse on the paged engine.
+
+The PR-7 radix index shares prefixes only across temporally
+OVERLAPPING requests; this matrix proves the persistent hierarchy on
+top of it — every stream (pinned-hit, swapped-in, multi-turn session,
+donor-evicted, and under ``serving.swap_*`` fault plans with retries)
+stays bit-identical to an isolated ``ShardedDecoder.generate``, on
+both float and int8 caches, and the page pool drains to zero on every
+path once sessions close.
+
+Compile discipline: the swap tier adds exactly ONE bounded copy
+program (ledger site ``serving.swap``) — asserted here with
+``compile_budget`` on top of the paged engine's (#chunk buckets + 1).
+
+Tiny single-purpose engines (1-layer LM, single-device mesh,
+``prefill_chunk=8``) keep the matrix cheap; the invariants are in the
+counters and the bit-exact streams, not the model size."""
+
+import numpy as np
+import pytest
+
+import mxtpu as mx
+from mxtpu import nd
+from mxtpu.base import MXTPUError
+from mxtpu.models.transformer import TransformerLM, \
+    transformer_lm_sharding_rules
+from mxtpu.parallel import PagedContinuousBatchingEngine, ShardedDecoder
+from mxtpu.parallel.mesh import DeviceMesh
+from mxtpu.parallel.paging import BlockPool, HierarchicalCache, \
+    PrefixIndex
+from mxtpu.resilience import fault_plan
+
+MAXLEN = 48
+BS = 8
+VOCAB = 32
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    mx.random.seed(7)
+    net = TransformerLM(VOCAB, units=16, hidden_size=32, num_layers=1,
+                        num_heads=2, num_kv_heads=2)
+    net.initialize()
+    return net
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    return DeviceMesh(dp=1)
+
+
+@pytest.fixture(scope="module")
+def isolated(tiny, mesh):
+    return ShardedDecoder(tiny, mesh, transformer_lm_sharding_rules())
+
+
+def _engine(tiny, mesh, **kw):
+    kw.setdefault("num_slots", 2)
+    kw.setdefault("max_length", MAXLEN)
+    kw.setdefault("block_size", BS)
+    kw.setdefault("prefill_chunk", 8)
+    return PagedContinuousBatchingEngine(
+        tiny, mesh, transformer_lm_sharding_rules(), **kw)
+
+
+def _want(isolated, p, n, **kw):
+    return isolated.generate(p, max_new_tokens=n, max_length=MAXLEN,
+                             **kw).asnumpy()
+
+
+# ------------------------------------------------ BlockPool pin states
+
+def test_block_pool_pin_unpin_and_release_guard():
+    """A pin is a reference PLUS a pin count: pages free only after the
+    last unpin, a table release can never dip into pinned references,
+    and unpinning an unpinned page is a typed error."""
+    freed = []
+    bp = BlockPool(4, 8, on_free=freed.append)
+    (a,) = bp.alloc(1)
+    bp.pin(a)
+    assert bp.pinned_count == 1 and bp.pin_count(a) == 1
+    bp.release(a)                      # the table goes away
+    assert bp.refcount(a) == 1 and not freed   # pin still holds it
+    with pytest.raises(MXTPUError, match="pinned"):
+        bp.release(a)                  # would recycle a pinned page
+    bp.pin(a)
+    bp.unpin(a)
+    assert not freed                   # one pin left
+    bp.unpin(a)
+    assert freed == [a] and bp.pinned_count == 0
+    with pytest.raises(MXTPUError, match="unpin"):
+        bp.unpin(a)
+    with pytest.raises(MXTPUError, match="pin"):
+        bp.pin(99)                     # unallocated
+
+
+def test_hierarchical_cache_policy_units():
+    """Pure-policy invariants: prefix supersede keeps pages pinned
+    through the longer chain, budget eviction is LRU and never targets
+    sessions, pool-pressure eviction prefers non-session chains whose
+    pages would actually free, and the host tier evicts oldest-first
+    at its budget."""
+    idx = PrefixIndex(4)
+    bp = BlockPool(8, 4, on_free=idx.evict)
+    hc = HierarchicalCache(bp, idx, pin_blocks=2, host_blocks=2)
+    toks = list(range(12))
+    pages = bp.alloc(3)
+    c1 = hc.pin_chain(toks[:4], pages[:1])
+    c2 = hc.pin_chain(toks[:8], pages[:2])          # supersedes c1
+    assert hc.device_chains == 1 and c1.tokens not in hc._chains
+    assert bp.pin_count(pages[0]) == 1              # not double-pinned
+    s1 = hc.pin_chain(toks[:12], pages[:3], sid="s")  # supersedes c2
+    assert hc.device_chains == 1 and bp.pinned_count == 3
+    # the table's own refs go away: only pins hold the pages now
+    for bid in pages:
+        bp.release(bid)
+    # budget victim: over budget (3 > 2) but the only chain is a
+    # session -> never budget-evicted
+    assert hc.pick_budget_victim() is None
+    # a non-session chain joins; it is older-ticked after s1 touch
+    extra = bp.alloc(2)
+    c3 = hc.pin_chain([90, 91, 92, 93, 94, 95, 96, 97], extra)
+    for bid in extra:
+        bp.release(bid)
+    hc.touch_prefix(toks, 12)                       # s1 is fresher
+    assert hc.pick_budget_victim() is c3
+    # pressure victim: non-session first even when the session chain
+    # is older
+    assert hc.pick_pressure_victim() is c3
+    hc.spill(c3, ["p0", "p1"])                      # to host (2 pages)
+    assert bp.pinned_count == 3 and hc.spilled_blocks == 2
+    # host budget 2: the next 2-page spill evicts the oldest chain
+    extra2 = bp.alloc(2)
+    c4 = hc.pin_chain([80, 81, 82, 83, 84, 85, 86, 87], extra2)
+    for bid in extra2:
+        bp.release(bid)
+    hc.spill(c4, ["q0", "q1"])
+    assert hc.host_chains == 1
+    got = hc.host_match([80, 81, 82, 83, 84, 85, 99], limit=7)
+    assert got is not None and got[1] == 1          # one full page
+    assert hc.host_match(toks, limit=12) is None    # c3's copy evicted
+    # close the session: its pages free, nothing else does
+    assert hc.close_session("s") == 3
+    assert bp.pinned_count == 0 and bp.in_use == 0
+
+
+# ------------------------------------------- cross-burst pinned re-hit
+
+@pytest.mark.parametrize("cache_dtype", ["float32", "int8"])
+def test_pinned_chain_survives_lull_and_rehits(tiny, mesh, isolated,
+                                               cache_dtype):
+    """The tentpole scenario the overlap-only index cannot serve: the
+    engine drains COMPLETELY (a traffic lull), and a later identical
+    prompt still hits the pinned pages — prefill_tokens_avoided counts
+    the skipped prefix, and the stream stays bit-identical to the
+    isolated generate (fp and int8 caches)."""
+    eng = _engine(tiny, mesh, pin_bytes="1MiB", cache_dtype=cache_dtype)
+    rng = np.random.RandomState(3)
+    p = nd.array(rng.randint(0, VOCAB, (1, 19)), dtype="int32")
+    want = _want(isolated, p, 5, cache_dtype=cache_dtype)
+    r1 = eng.submit(p, 5)
+    res = eng.run()                              # full drain = the lull
+    np.testing.assert_array_equal(res[r1].asnumpy(), want)
+    st = eng.stats
+    assert st["pinned_blocks"] > 0
+    assert st["blocks_in_use"] == st["pinned_blocks"]  # only pins left
+    assert st["prefill_tokens_avoided"] == 0
+    r2 = eng.submit(p, 5)
+    res = eng.run()
+    np.testing.assert_array_equal(res[r2].asnumpy(), want)
+    st = eng.stats
+    assert st["prefix_hits"] >= 1
+    # 19-token prompt + 5 emitted, last token unwritten -> 2 full pages
+    # pinned; the re-hit skips both
+    assert st["prefill_tokens_avoided"] == 2 * BS
+    assert st["swap_ins"] == st["swap_outs"] == 0
+
+
+def test_pin_budget_lru_eviction_order(tiny, mesh, isolated):
+    """Auto-pinning respects pin_bytes: with room for one chain, the
+    LRU chain is evicted (dropped — no host tier here) when the next
+    finishes, and a re-hit on the survivor still works."""
+    eng = _engine(tiny, mesh, pin_bytes="1MiB")
+    rng = np.random.RandomState(5)
+    pa = nd.array(rng.randint(0, VOCAB, (1, 17)), dtype="int32")
+    pb = nd.array(rng.randint(0, VOCAB, (1, 17)), dtype="int32")
+    eng.submit(pa, 4)
+    eng.run()                                    # A's chain pinned
+    assert eng._bytes_per_block > 0
+    assert eng.stats["pinned_blocks"] == 2
+    eng._hc.pin_blocks = 2                       # room for ONE chain
+    eng.submit(pb, 4)                            # pins B -> A is LRU'd
+    eng.run()
+    st = eng.stats
+    assert st["pinned_blocks"] == 2              # A's chain evicted
+    # B re-hits; A recomputes (its chain was the LRU victim)
+    avoided0 = st["prefill_tokens_avoided"]
+    r2 = eng.submit(pb, 4)
+    res = eng.run()
+    np.testing.assert_array_equal(res[r2].asnumpy(),
+                                  _want(isolated, pb, 4))
+    assert eng.stats["prefill_tokens_avoided"] - avoided0 == 2 * BS
+    r3 = eng.submit(pa, 4)
+    res = eng.run()
+    np.testing.assert_array_equal(res[r3].asnumpy(),
+                                  _want(isolated, pa, 4))
+
+
+# ------------------------------------------------- host tier round trip
+
+@pytest.mark.parametrize("cache_dtype", ["float32", "int8"])
+def test_swap_out_swap_in_round_trip_bit_exact(tiny, mesh, isolated,
+                                               cache_dtype):
+    """pin_bytes=1 (budget rounds to 0 pages) makes the device tier a
+    pass-through: every finished chain spills host-ward immediately and
+    restores on the next radix miss — the swapped-in stream must stay
+    bit-identical on both cache dtypes, and the swap counters must
+    show the full round trip."""
+    eng = _engine(tiny, mesh, pin_bytes=1, host_cache_bytes="1MiB",
+                  cache_dtype=cache_dtype)
+    rng = np.random.RandomState(7)
+    p = nd.array(rng.randint(0, VOCAB, (1, 19)), dtype="int32")
+    want = _want(isolated, p, 5, cache_dtype=cache_dtype)
+    r1 = eng.submit(p, 5)
+    res = eng.run()
+    np.testing.assert_array_equal(res[r1].asnumpy(), want)
+    st = eng.stats
+    assert st["pinned_blocks"] == 0 and st["blocks_in_use"] == 0
+    assert st["spilled_blocks"] == 2 and st["swap_outs"] == 2
+    r2 = eng.submit(p, 5)
+    res = eng.run()
+    np.testing.assert_array_equal(res[r2].asnumpy(), want)
+    st = eng.stats
+    assert st["swap_ins"] == 2
+    assert st["prefill_tokens_avoided"] == 2 * BS
+    # the restored chain was re-pinned, then budget-spilled again
+    assert st["swap_outs"] == 4 and st["spilled_blocks"] == 2
+    # ONE bounded copy program serves both directions
+    assert len([k for k in eng._dec._jit_cache if k[0] == "swap"]) == 1
+
+
+def test_swapped_in_seeded_sampled_parity(tiny, mesh, isolated):
+    """Sampled draws ride restored chains bit-exactly: the per-slot RNG
+    stream derivation is position-based, so a swapped-in prefix must
+    not shift any draw."""
+    eng = _engine(tiny, mesh, pin_bytes=1, host_cache_bytes="1MiB")
+    rng = np.random.RandomState(11)
+    p = nd.array(rng.randint(0, VOCAB, (1, 18)), dtype="int32")
+    want = _want(isolated, p, 6, temperature=0.8, top_k=12, seed=404)
+    r1 = eng.submit(p, 6, temperature=0.8, top_k=12, seed=404)
+    eng.run()
+    r2 = eng.submit(p, 6, temperature=0.8, top_k=12, seed=404)
+    res = eng.run()
+    np.testing.assert_array_equal(res[r2].asnumpy(), want)
+    assert eng.stats["swap_ins"] == 2
+
+
+# --------------------------------------------------- multi-turn sessions
+
+@pytest.mark.parametrize("cache_dtype", ["float32", "int8"])
+def test_session_turns_prefill_only_new_suffix(tiny, mesh, isolated,
+                                               cache_dtype):
+    """Three chat turns on one session handle: each turn's prompt is
+    the previous transcript plus a new message, turn N+1 skips every
+    full page of the transcript (prefill_tokens_avoided grows by the
+    pinned extent), all three streams are bit-identical to isolated
+    generates, and close_session returns the pool to zero."""
+    eng = _engine(tiny, mesh, max_length=96, num_blocks=24,
+                  cache_dtype=cache_dtype)
+    rng = np.random.RandomState(13)
+    prompt = rng.randint(0, VOCAB, (1, 12))
+    avoided = [0]
+    for turn in range(3):
+        want = isolated.generate(
+            nd.array(prompt, dtype="int32"), max_new_tokens=6,
+            max_length=96, cache_dtype=cache_dtype).asnumpy()
+        rid = eng.submit(nd.array(prompt, dtype="int32"), 6,
+                         session="chat-1")
+        res = eng.run()
+        np.testing.assert_array_equal(res[rid].asnumpy(), want)
+        st = eng.stats
+        avoided.append(st["prefill_tokens_avoided"])
+        if turn > 0:
+            # the whole previous transcript's full pages were skipped
+            transcript = prompt.shape[1] - 4     # before the new msg
+            assert avoided[-1] - avoided[-2] == \
+                (transcript - 1) // BS * BS
+            assert st["session_hits"] == turn
+        prompt = np.concatenate(
+            [res[rid].asnumpy(), rng.randint(0, VOCAB, (1, 4))], axis=1)
+    st = eng.stats
+    assert st["pinned_blocks"] > 0 and st["sessions_open"] == 1
+    eng.close_session("chat-1")
+    st = eng.stats
+    assert st["pinned_blocks"] == 0 and st["blocks_in_use"] == 0
+    assert st["sessions_open"] == 0
+
+
+def test_two_sessions_share_system_prompt_pages(tiny, mesh, isolated):
+    """Two concurrent conversations opening with the same system
+    prompt: their pinned chains SHARE the system-prompt pages
+    (refcounted once — pinned_blocks counts distinct pages), closing
+    one session keeps the other's chain intact, and both final streams
+    keep parity."""
+    eng = _engine(tiny, mesh, max_length=96, num_blocks=24)
+    rng = np.random.RandomState(17)
+    system = rng.randint(0, VOCAB, (1, 16))      # 2 full shared pages
+    pa = np.concatenate([system, rng.randint(0, VOCAB, (1, 6))], 1)
+    pb = np.concatenate([system, rng.randint(0, VOCAB, (1, 7))], 1)
+    ra = eng.submit(nd.array(pa, dtype="int32"), 5, session="a")
+    eng.run()
+    rb = eng.submit(nd.array(pb, dtype="int32"), 5, session="b")
+    res = eng.run()
+    np.testing.assert_array_equal(res[rb].asnumpy(),
+                                  _want(isolated, nd.array(
+                                      pb, dtype="int32"), 5))
+    st = eng.stats
+    # A's chain: (22+5-1)//8 = 3 pages; B's: 3 pages, the first TWO of
+    # which are A's system-prompt pages (refcounted, priced once) —
+    # 4 distinct pinned pages, not 6
+    assert st["pinned_blocks"] == 4
+    eng.close_session("a")
+    st = eng.stats
+    assert st["pinned_blocks"] == 3              # B's chain intact
+    # B still re-hits its full transcript
+    tb = np.concatenate([res[rb].asnumpy(),
+                         rng.randint(0, VOCAB, (1, 4))], 1)
+    avoided0 = st["prefill_tokens_avoided"]
+    r2 = eng.submit(nd.array(tb, dtype="int32"), 4, session="b")
+    res = eng.run()
+    np.testing.assert_array_equal(
+        res[r2].asnumpy(),
+        isolated.generate(nd.array(tb, dtype="int32"),
+                          max_new_tokens=4, max_length=96).asnumpy())
+    assert eng.stats["prefill_tokens_avoided"] > avoided0
+    eng.close_session("b")
+    assert eng.stats["blocks_in_use"] == 0
+
+
+# ------------------------------------------- eviction-order edge cases
+
+def test_pool_pressure_evicts_pinned_before_deferring(tiny, mesh,
+                                                      isolated):
+    """Pool exhaustion prefers cached victims over live deferrals: a
+    pinned chain fills most of a tiny pool, and a new admission that
+    needs those pages EVICTS the chain (live traffic beats cache)
+    instead of deferring forever — with a host tier, the chain spills
+    and comes back on the next hit."""
+    eng = _engine(tiny, mesh, num_blocks=6, pin_bytes="1MiB",
+                  host_cache_bytes="1MiB")
+    rng = np.random.RandomState(19)
+    pa = nd.array(rng.randint(0, VOCAB, (1, 19)), dtype="int32")
+    ra = eng.submit(pa, 5)
+    eng.run()
+    eng._hc.pin_blocks = 6                      # plenty: chain stays
+    st = eng.stats
+    assert st["pinned_blocks"] == 2 and st["blocks_free"] == 4
+    # B needs 5 pages > 4 free: the pinned chain must spill to admit it
+    pb = nd.array(rng.randint(0, VOCAB, (1, 21)), dtype="int32")
+    rb = eng.submit(pb, 19)
+    res = eng.run()
+    np.testing.assert_array_equal(res[rb].asnumpy(),
+                                  _want(isolated, pb, 19))
+    st = eng.stats
+    assert st["swap_outs"] == 2                 # spilled, not dropped
+    assert st["spilled_blocks"] == 2
+    # A's prefix restores on the next identical submit
+    r2 = eng.submit(pa, 5)
+    res = eng.run()
+    np.testing.assert_array_equal(res[r2].asnumpy(),
+                                  _want(isolated, pa, 5))
+    assert eng.stats["swap_ins"] == 2
+
+
+def test_session_chains_evict_last_under_pressure(tiny, mesh, isolated):
+    """Victim order under pool pressure: non-session chains go first;
+    the session chain spills only when nothing else can free pages —
+    and comes back from the host tier on its next turn."""
+    eng = _engine(tiny, mesh, num_blocks=8, pin_bytes="1MiB",
+                  host_cache_bytes="1MiB")
+    rng = np.random.RandomState(23)
+    ps = nd.array(rng.randint(0, VOCAB, (1, 22)), dtype="int32")
+    pn = nd.array(rng.randint(0, VOCAB, (1, 17)), dtype="int32")
+    eng.submit(ps, 6, session="s")               # chain: 3 full pages
+    eng.run()
+    eng.submit(pn, 4)                            # non-session: 2 pages
+    eng.run()
+    st = eng.stats
+    assert st["pinned_blocks"] == 5 and st["blocks_free"] == 3
+    # B needs 5 pages: evicting the NON-session chain (2 pages)
+    # suffices; the session chain must survive
+    pb = nd.array(rng.randint(0, VOCAB, (1, 17)), dtype="int32")
+    eng.submit(pb, 23)
+    eng.run()
+    assert any(c.sid == "s" for c in eng._hc._chains.values())
+    # drop B's fresh chain so only the session chain holds pages
+    eng._hc.pin_blocks = 0
+    eng._enforce_pin_budget()
+    st = eng.stats
+    assert st["pinned_blocks"] == 3 and st["blocks_free"] == 5
+    # C needs 6 pages > 5 free: ONLY the session chain can free them
+    pc = nd.array(rng.randint(0, VOCAB, (1, 20)), dtype="int32")
+    rc = eng.submit(pc, 28)
+    res = eng.run()
+    np.testing.assert_array_equal(res[rc].asnumpy(),
+                                  _want(isolated, pc, 28))
+    assert all(c.sid != "s" for c in eng._hc._chains.values())
+    assert eng._hc.host_chains >= 1              # spilled, not lost
+    # the session's next turn restores its transcript from host
+    avoided0 = eng.stats["prefill_tokens_avoided"]
+    r2 = eng.submit(ps, 4, session="s")
+    res = eng.run()
+    np.testing.assert_array_equal(res[r2].asnumpy(),
+                                  _want(isolated, ps, 4))
+    st = eng.stats
+    assert st["swap_ins"] >= 2
+    assert st["prefill_tokens_avoided"] - avoided0 == 2 * BS
+    eng.close_session("s")
+    eng._enforce_pin_budget()
+    assert eng.stats["blocks_in_use"] == eng.stats["pinned_blocks"] == 0
+
+
+def test_pinned_page_as_cow_donor_keeps_refcounts(tiny, mesh, isolated):
+    """Pinned-page refcount vs in-flight COW divergence: a request
+    diverging INSIDE a pinned chain's page clones it copy-on-write —
+    the pinned donor's refcount is untouched by the clone, spilling
+    the donor chain mid-flight leaves the cloner's stream bit-exact,
+    and nothing leaks after the dust settles."""
+    eng = _engine(tiny, mesh, pin_bytes="1MiB", host_cache_bytes="1MiB")
+    rng = np.random.RandomState(29)
+    base = rng.randint(0, VOCAB, (1, 13))
+    pa = nd.array(np.concatenate(
+        [base, rng.randint(0, VOCAB, (1, 4))], 1), dtype="int32")
+    ra = eng.submit(pa, 4)
+    eng.run()                                    # chain pinned (A done)
+    st = eng.stats
+    assert st["pinned_blocks"] >= 2
+    donor_chain = next(iter(eng._hc._chains.values()))
+    donor_pages = list(donor_chain.pages)
+    # B shares page 0 and diverges inside page 1 (token 13 < 16)
+    pb = nd.array(np.concatenate(
+        [base, rng.randint(0, VOCAB, (1, 6))], 1), dtype="int32")
+    rb = eng.submit(pb, 6)
+    eng.step()                                   # B admits: COW clone
+    st = eng.stats
+    assert st["cow_copies"] >= 1
+    assert eng._bp.pin_count(donor_pages[1]) == 1   # donor still pinned
+    # spill the donor chain while B is mid-decode
+    eng._spill_chain(donor_chain)
+    res = eng.run()
+    np.testing.assert_array_equal(res[rb].asnumpy(),
+                                  _want(isolated, pb, 6))
+    # B's own chain is now pinned; drop everything and check drain
+    eng._hc.pin_blocks = 0
+    eng._enforce_pin_budget()
+    assert eng.stats["blocks_in_use"] == eng.stats["pinned_blocks"] == 0
+
+
+# --------------------------------------------------- swap fault plans
+
+def test_swap_in_fault_quarantines_and_retry_restores(tiny, mesh,
+                                                      isolated):
+    """An injected ``serving.swap_in`` raise releases every restore-
+    allocated page and quarantines only that request; with retries the
+    restart swaps in cleanly and the stream is bit-identical.  A
+    concurrent neighbor is never perturbed."""
+    eng = _engine(tiny, mesh, pin_bytes=1, host_cache_bytes="1MiB")
+    rng = np.random.RandomState(31)
+    p = nd.array(rng.randint(0, VOCAB, (1, 19)), dtype="int32")
+    pn = nd.array(rng.randint(0, VOCAB, (1, 6)), dtype="int32")
+    eng.submit(p, 5)
+    eng.run()                                   # chain lives on host now
+    before = eng.stats
+    r2 = eng.submit(p, 5, retries=1)
+    rn = eng.submit(pn, 4, temperature=0.6, seed=99)
+    with fault_plan("serving.swap_in#%d@1:raise=OSError(dma dead)"
+                    % r2) as plan:
+        res = eng.run()
+    assert plan.stats()["serving.swap_in"]["fired"] == 1
+    assert eng.status(r2) == "ok"               # retry completed
+    np.testing.assert_array_equal(res[r2].asnumpy(),
+                                  _want(isolated, p, 5))
+    np.testing.assert_array_equal(
+        res[rn].asnumpy(),
+        _want(isolated, pn, 4, temperature=0.6, seed=99))
+    st = eng.stats
+    assert st["quarantined"] - before["quarantined"] == 1
+    assert st["retries"] - before["retries"] == 1
+    assert st["swap_ins"] == 2                  # the clean retry only
+    assert st["blocks_in_use"] == 0
+
+
+def test_swap_out_fault_drops_chain_without_poisoning(tiny, mesh,
+                                                      isolated):
+    """An injected ``serving.swap_out`` raise degrades the spill to a
+    drop: no half-copied host chain exists, the request that triggered
+    the eviction (or the budget sweep) proceeds unharmed, and the
+    dropped prefix simply recomputes on the next miss."""
+    eng = _engine(tiny, mesh, pin_bytes=1, host_cache_bytes="1MiB")
+    rng = np.random.RandomState(37)
+    p = nd.array(rng.randint(0, VOCAB, (1, 19)), dtype="int32")
+    with fault_plan("serving.swap_out@1:raise=OSError(copy dead)"):
+        r1 = eng.submit(p, 5)
+        res = eng.run()
+    np.testing.assert_array_equal(res[r1].asnumpy(),
+                                  _want(isolated, p, 5))
+    st = eng.stats
+    assert st["spilled_blocks"] == 0 and st["swap_outs"] == 0
+    assert st["pinned_blocks"] == 0 and st["blocks_in_use"] == 0
+    # next submit recomputes (no host copy) and spills cleanly
+    r2 = eng.submit(p, 5)
+    res = eng.run()
+    np.testing.assert_array_equal(res[r2].asnumpy(),
+                                  _want(isolated, p, 5))
+    st = eng.stats
+    assert st["prefill_tokens_avoided"] == 0    # it really recomputed
+    assert st["spilled_blocks"] == 2
+
+
+def test_session_close_zeroes_pool_on_every_fault_path(tiny, mesh):
+    """``blocks_in_use == 0`` after session close on every fault path:
+    step faults, swap_in faults with retries, and deadline evictions
+    all funnel the pages back once the session handle releases."""
+    clock = {"t": 0.0}
+    eng = _engine(tiny, mesh, num_blocks=12, pin_bytes=1,
+                  host_cache_bytes="1MiB", clock=lambda: clock["t"])
+    rng = np.random.RandomState(41)
+    p = nd.array(rng.randint(0, VOCAB, (1, 17)), dtype="int32")
+    # path 1: step fault mid-decode (no retries -> failed, no pin)
+    r1 = eng.submit(p, 6, session="s1")
+    with fault_plan("serving.step#%d@2:raise=RuntimeError(dead)" % r1):
+        eng.run()
+    assert eng.status(r1) == "failed"
+    # path 2: swap_in fault, one retry -> ok.  Session chains never
+    # budget-spill, so force the pressure path by hand
+    r0 = eng.submit(p, 4, session="s2")
+    eng.run()
+    chain = next(c for c in eng._hc._chains.values() if c.sid == "s2")
+    eng._spill_chain(chain)
+    r2 = eng.submit(p, 4, retries=1, session="s2")
+    with fault_plan("serving.swap_in#%d@1:raise=OSError(x)" % r2):
+        eng.run()
+    assert eng.status(r2) == "ok"
+    # path 3: deadline eviction mid-decode
+    r3 = eng.submit(p, 8, session="s3", deadline_s=5.0)
+    eng.step()
+    clock["t"] = 10.0
+    eng.run()
+    assert eng.status(r3) == "expired"
+    for sid in ("s1", "s2", "s3"):
+        eng.close_session(sid)
+    st = eng.stats
+    assert st["pinned_blocks"] == 0
+    assert st["blocks_in_use"] == 0
+    assert st["blocks_free"] == st["num_blocks"]
+
+
+def test_close_session_while_in_flight_never_leaks_pins(tiny, mesh,
+                                                        isolated):
+    """Closing a session while its request is still decoding must not
+    leave an orphaned session pin behind: the finish-time offer
+    degrades to an ordinary budget-governed chain (here budget 0 with
+    no host tier -> no pin at all), the stream keeps parity, and the
+    pool drains to zero with no close handle left to call."""
+    eng = _engine(tiny, mesh)            # pin_bytes=0, no host tier
+    rng = np.random.RandomState(59)
+    p = nd.array(rng.randint(0, VOCAB, (1, 17)), dtype="int32")
+    r = eng.submit(p, 6, session="gone")
+    eng.step()                           # request is mid-flight
+    eng.close_session("gone")            # client hangs up early
+    res = eng.run()
+    np.testing.assert_array_equal(res[r].asnumpy(),
+                                  _want(isolated, p, 6))
+    st = eng.stats
+    assert st["sessions_open"] == 0
+    assert st["pinned_blocks"] == 0      # no orphaned session pin
+    assert st["blocks_in_use"] == 0
+
+
+def test_partial_restore_keeps_host_tail_for_session(tiny, mesh,
+                                                     isolated):
+    """A short prompt matching only a PREFIX of a spilled session
+    transcript restores just that prefix — the unrestored tail must
+    stay in the host tier so the session's next full-transcript turn
+    can still swap it in instead of re-prefilling what it already
+    paid to cache."""
+    eng = _engine(tiny, mesh, max_length=96, num_blocks=24,
+                  pin_bytes="1MiB", host_cache_bytes="1MiB")
+    rng = np.random.RandomState(53)
+    base = rng.randint(0, VOCAB, (1, 16))        # 2 shared full pages
+    t1 = np.concatenate([base, rng.randint(0, VOCAB, (1, 14))], 1)
+    r1 = eng.submit(nd.array(t1, dtype="int32"), 9, session="s")
+    res = eng.run()                              # chain: 4+ full pages
+    transcript = res[r1].asnumpy()
+    chain = next(c for c in eng._hc._chains.values() if c.sid == "s")
+    chain_len = len(chain.pages)
+    assert chain_len >= 4
+    eng._spill_chain(chain)                      # whole transcript host-ward
+    # short unrelated prompt sharing only the 2-page system prefix
+    ps = nd.array(np.concatenate(
+        [base, rng.randint(0, VOCAB, (1, 3))], 1), dtype="int32")
+    rs = eng.submit(ps, 4)
+    res = eng.run()
+    np.testing.assert_array_equal(res[rs].asnumpy(),
+                                  _want(isolated, ps, 4))
+    st = eng.stats
+    assert st["swap_ins"] == 2                   # prefix only
+    assert eng._hc.host_chains >= 1              # tail NOT discarded
+    # the session's next turn restores the rest of its transcript
+    p2 = np.concatenate([transcript, rng.randint(0, VOCAB, (1, 4))], 1)
+    avoided0 = st["prefill_tokens_avoided"]
+    r2 = eng.submit(nd.array(p2, dtype="int32"), 4, session="s")
+    res = eng.run()
+    np.testing.assert_array_equal(
+        res[r2].asnumpy(),
+        isolated.generate(nd.array(p2, dtype="int32"),
+                          max_new_tokens=4, max_length=96).asnumpy())
+    st = eng.stats
+    assert st["swap_ins"] == chain_len           # tail restored too
+    assert st["prefill_tokens_avoided"] - avoided0 == chain_len * BS
+    eng.close_session("s")
+    eng._hc.pin_blocks = 0
+    eng._enforce_pin_budget()
+    assert eng.stats["blocks_in_use"] == 0
+
+
+def test_swap_round_trip_on_tp_sharded_pool(tiny):
+    """The bounded copy program reshards correctly: on a tp=2 pool the
+    page read replicates (full host copy) and the restore write shards
+    back over the kv-head axis — the swapped-in stream stays bit-exact
+    on the virtual multi-device mesh."""
+    from mxtpu.parallel import make_mesh
+
+    mesh2 = make_mesh(dp=1, tp=2)
+    iso = ShardedDecoder(tiny, mesh2, transformer_lm_sharding_rules())
+    eng = PagedContinuousBatchingEngine(
+        tiny, mesh2, transformer_lm_sharding_rules(), num_slots=2,
+        max_length=MAXLEN, block_size=BS, prefill_chunk=8,
+        pin_bytes=1, host_cache_bytes="1MiB")
+    rng = np.random.RandomState(47)
+    p = nd.array(rng.randint(0, VOCAB, (1, 19)), dtype="int32")
+    want = iso.generate(p, max_new_tokens=5,
+                        max_length=MAXLEN).asnumpy()
+    r1 = eng.submit(p, 5)
+    res = eng.run()
+    np.testing.assert_array_equal(res[r1].asnumpy(), want)
+    r2 = eng.submit(p, 5)
+    res = eng.run()
+    np.testing.assert_array_equal(res[r2].asnumpy(), want)
+    st = eng.stats
+    assert st["swap_ins"] == 2 and st["blocks_in_use"] == 0
+
+
+# ------------------------------------------------- compile discipline
+
+def test_swap_tier_adds_one_bounded_copy_program(tiny, mesh):
+    """ISSUE-11 acceptance: the whole hierarchy — pin, spill, restore,
+    sessions — adds exactly ONE compiled program (the bounded copy at
+    ledger site ``serving.swap``) beyond the paged engine's
+    (#chunk buckets + 1)."""
+    from mxtpu.analysis import compile_budget
+
+    eng = _engine(tiny, mesh, pin_bytes=1, host_cache_bytes="1MiB")
+    rng = np.random.RandomState(43)
+    p = nd.array(rng.randint(0, VOCAB, (1, 19)), dtype="int32")
+    with compile_budget(3, sites=("serving.page_prefill",
+                                  "serving.step_pages",
+                                  "serving.swap")):
+        eng.submit(p, 5)
+        eng.run()                   # prefill buckets 8 (+ tail), spill
+        r2 = eng.submit(p, 5)       # swap-in rides the same program
+        eng.run()
+        rid = eng.submit(p, 4, session="z")
+        eng.run()
+        eng.close_session("z")
+    st = eng.stats
+    assert st["swap_ins"] > 0 and st["swap_outs"] > 0
+    cache = eng._dec._jit_cache
+    assert len([k for k in cache if k[0] == "swap"]) == 1
+    assert st["blocks_in_use"] == st["pinned_blocks"]

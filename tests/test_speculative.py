@@ -144,11 +144,12 @@ def test_spec_greedy_parity_with_real_accepts(eng, isolated):
     rng = np.random.RandomState(0)
     p1, p2 = _prompts(rng, (6, 4))
     before = eng.stats
-    r1 = eng.submit(p1, 20)
-    r2 = eng.submit(p2, 16)
+    # trimmed round 15 (tier-1 wall-time budget): still drafts+accepts
+    r1 = eng.submit(p1, 13)
+    r2 = eng.submit(p2, 11)
     res = eng.run()
-    np.testing.assert_array_equal(res[r1].asnumpy(), _want(isolated, p1, 20))
-    np.testing.assert_array_equal(res[r2].asnumpy(), _want(isolated, p2, 16))
+    np.testing.assert_array_equal(res[r1].asnumpy(), _want(isolated, p1, 13))
+    np.testing.assert_array_equal(res[r2].asnumpy(), _want(isolated, p2, 11))
     after = eng.stats
     assert after["drafted_tokens"] > before["drafted_tokens"]
     assert after["accepted_tokens"] > before["accepted_tokens"]

@@ -71,16 +71,18 @@ def test_paged_spec_parity_with_accepts_and_clean_drain(eng, isolated):
     rng = np.random.RandomState(0)
     p1, p2, p3 = _prompts(rng, (6, 4, 5))
     before = eng.stats
-    r1 = eng.submit(p1, 20)
-    r2 = eng.submit(p2, 16, temperature=0.8, top_k=10, seed=101)
-    r3 = eng.submit(p3, 12, repetition_penalty=1.3)
+    # token counts trimmed round 15 (tier-1 wall-time budget): still
+    # long enough for the cycling model to draft, accept AND reject
+    r1 = eng.submit(p1, 12)
+    r2 = eng.submit(p2, 10, temperature=0.8, top_k=10, seed=101)
+    r3 = eng.submit(p3, 8, repetition_penalty=1.3)
     res = eng.run()
-    np.testing.assert_array_equal(res[r1].asnumpy(), _want(isolated, p1, 20))
+    np.testing.assert_array_equal(res[r1].asnumpy(), _want(isolated, p1, 12))
     np.testing.assert_array_equal(
-        res[r2].asnumpy(), _want(isolated, p2, 16, temperature=0.8,
+        res[r2].asnumpy(), _want(isolated, p2, 10, temperature=0.8,
                                  top_k=10, seed=101))
     np.testing.assert_array_equal(
-        res[r3].asnumpy(), _want(isolated, p3, 12,
+        res[r3].asnumpy(), _want(isolated, p3, 8,
                                  repetition_penalty=1.3))
     st = eng.stats
     assert st["drafted_tokens"] > before["drafted_tokens"]

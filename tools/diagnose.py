@@ -281,6 +281,73 @@ def check_quantized():
               "drain)" if healthy else "UNEXPECTED %r" % (eng.stats,))
     except Exception as e:
         print("quantized    : FAILED (%s: %s)" % (type(e).__name__, e))
+    check_hierarchical()
+
+
+def check_hierarchical():
+    """Exercise the hierarchical prefix cache once (docs/inference.md
+    "Hierarchical prefix cache"): pin a finished chain, drain to a
+    LULL, re-hit it, then force a host-tier swap round trip — a healthy
+    install shows prefill tokens avoided on the re-hit, matching
+    swap_out/swap_in page counts, a bit-exact swapped-in stream, and a
+    pool that drains to zero once the pins release."""
+    print("----------Serving (hierarchical cache)----------")
+    try:
+        import numpy as np
+
+        import mxtpu as mx
+        from mxtpu import nd
+        from mxtpu.models.transformer import (
+            TransformerLM, transformer_lm_sharding_rules)
+        from mxtpu.parallel import (PagedContinuousBatchingEngine,
+                                    ShardedDecoder)
+        from mxtpu.parallel.mesh import DeviceMesh
+
+        mx.random.seed(7)
+        lm = TransformerLM(32, units=16, hidden_size=32, num_layers=1,
+                           num_heads=2, num_kv_heads=2)
+        lm.initialize()
+        mesh = DeviceMesh(dp=1)
+        rules = transformer_lm_sharding_rules()
+        eng = PagedContinuousBatchingEngine(
+            lm, mesh, rules, num_slots=2, max_length=32, block_size=8,
+            prefill_chunk=8, pin_bytes="64KiB",
+            host_cache_bytes="64KiB")
+        rng = np.random.RandomState(0)
+        prompt = nd.array(rng.randint(0, 32, (1, 19)), dtype="int32")
+        want = ShardedDecoder(lm, mesh, rules).generate(
+            prompt, max_new_tokens=4, max_length=32).asnumpy()
+        eng.submit(prompt, 4)
+        eng.run()                 # drain completely — the traffic lull
+        pinned = eng.stats["pinned_blocks"]
+        rid = eng.submit(prompt, 4)
+        res = eng.run()           # re-hit the PINNED chain
+        hit_ok = bool(np.array_equal(res[rid].asnumpy(), want))
+        avoided = eng.stats["prefill_tokens_avoided"]
+        # force the host tier: spill every pinned chain, then re-admit
+        for chain in list(eng._hc._chains.values()):
+            eng._spill_chain(chain)
+        spilled = eng.stats["spilled_blocks"]
+        rid = eng.submit(prompt, 4)
+        res = eng.run()           # swap_in restores the chain
+        swap_ok = bool(np.array_equal(res[rid].asnumpy(), want))
+        st = eng.stats
+        print("pinning      : %d page(s) pinned across the lull, "
+              "%d prefill token(s) avoided on the re-hit"
+              % (pinned, avoided))
+        print("host tier    : %d page(s) spilled, %d swapped out / "
+              "%d swapped in" % (spilled, st["swap_outs"],
+                                 st["swap_ins"]))
+        eng._hc.pin_blocks = 0    # release the cache and check drain
+        eng._enforce_pin_budget()
+        clean = eng.stats["blocks_in_use"] == 0
+        healthy = (pinned > 0 and avoided > 0 and st["swap_ins"] > 0
+                   and hit_ok and swap_ok and clean)
+        print("probe        :", "ok (pin -> lull -> re-hit -> swap "
+              "round trip, streams bit-exact, clean drain)"
+              if healthy else "UNEXPECTED counters %r" % (st,))
+    except Exception as e:
+        print("hierarchical : FAILED (%s: %s)" % (type(e).__name__, e))
 
 
 def check_resilience():
