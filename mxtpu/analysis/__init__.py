@@ -4,7 +4,7 @@ the op registry, sharding rules, and the compiled-program discipline
 as static analyses before execution; see PAPER.md §1 layer 6 and
 src/executor/graph_executor.cc in the reference).
 
-Seven shipped passes, each returning a :class:`Report` of located
+Eight shipped passes, each returning a :class:`Report` of located
 :class:`Diagnostic` records instead of silent Nones or deep-in-XLA
 failures:
 
@@ -27,6 +27,11 @@ failures:
   buffers actually alias in the compiled executable and flags missed
   donation opportunities (D0xx); ``check_trainer_donation`` applies it
   to an SPMDTrainer's compiled step.
+- ``check_kernels(specs)`` — static TPU tile-geometry / VMEM-budget /
+  grid-safety verdict over Pallas kernel call descriptors (K0xx),
+  self-applied to the shipped ``ops/pallas`` kernels at their real
+  serving/training geometries; ``kernel_vmem_estimate`` is the
+  per-grid-step VMEM pricer beside the HBM model.
 
 CLI: ``python -m mxtpu.analysis`` (see docs/analysis.md).  Custom passes
 register via :func:`register_pass` and run via :func:`run_pass`.
@@ -39,23 +44,30 @@ from .diagnostics import (Diagnostic, Report, Severity, get_pass,
                           list_passes, register_pass, run_pass)
 from .donation_check import check_donation, check_trainer_donation
 from .graph_verify import verify_graph
+from .kernel_check import (BlockOperand, KernelSpec, ScalarPrefetch,
+                           ScratchOperand, check_kernels,
+                           default_kernel_specs)
 from .memory_estimate import (MemoryEstimate, check_memory,
                               estimate_graph_memory, estimate_jit_memory,
-                              kv_cache_residency,
-                              paged_kv_cache_residency, xla_memory_stats)
-from .registry_audit import audit_registry
+                              kernel_vmem_estimate, kv_cache_residency,
+                              paged_kv_cache_residency, sublane_tile,
+                              xla_memory_stats)
+from .registry_audit import audit_fault_sites, audit_registry
 from .sharding_check import check_sharding
 from .trace_lint import lint_source, trace_lint
 
 __all__ = [
     "Diagnostic", "Report", "Severity",
     "register_pass", "get_pass", "list_passes", "run_pass",
-    "verify_graph", "check_sharding", "audit_registry", "trace_lint",
-    "lint_source",
+    "verify_graph", "check_sharding", "audit_registry",
+    "audit_fault_sites", "trace_lint", "lint_source",
     "CompileLedger", "Signature", "get_ledger", "check_compiles",
     "compile_budget", "CompileBudgetExceeded",
     "MemoryEstimate", "check_memory", "estimate_graph_memory",
     "estimate_jit_memory", "kv_cache_residency",
     "paged_kv_cache_residency", "xla_memory_stats",
+    "kernel_vmem_estimate", "sublane_tile",
     "check_donation", "check_trainer_donation",
+    "KernelSpec", "BlockOperand", "ScratchOperand", "ScalarPrefetch",
+    "check_kernels", "default_kernel_specs",
 ]

@@ -2,7 +2,8 @@
 
 Subcommands:
 
-- ``registry``           audit the full op registry
+- ``registry``           audit the full op registry (+ fault-site
+  coverage of the tests/ tree when one is found)
 - ``lint [PATH ...]``    trace-safety lint (default: the mxtpu package)
 - ``graph FILE.json``    verify a saved symbol.json (``--shape name=2,3``
   repeatable for input shapes)
@@ -13,8 +14,14 @@ Subcommands:
   the in-process probe workload and check the live ledger
 - ``donate``             donation/aliasing self-check: builds a tiny
   SPMDTrainer step and verifies its donated buffers alias
-- ``all``                registry + lint + the compile/memory/donation
-  self-applications (the repo self-lint; default)
+- ``kernel``             Pallas kernel-geometry check: the shipped
+  kernels' KernelSpecs at their real TPU serving/training geometries
+  (``--vmem-budget 16MiB`` to price a different ceiling)
+- ``sharding``           sharding-rule self-check on a reference rule set
+- ``all``                EVERY registered pass, each through its
+  self-application probe (the repo self-lint; default).  A pass
+  registered without a probe wired here gets a P001 ERROR — the gate
+  cannot silently skip a new pass.
 
 Exit status is 1 when diagnostics at or above ``--fail-on`` (default
 ``error``) were produced, so the command slots into CI directly.
@@ -26,7 +33,9 @@ import argparse
 import sys
 
 from . import (Report, Severity, audit_registry, check_compiles,
-               check_memory, trace_lint, verify_graph)
+               check_kernels, check_memory, check_sharding, list_passes,
+               trace_lint, verify_graph)
+from .diagnostics import Diagnostic
 
 
 def _parse_shape_args(pairs):
@@ -37,6 +46,15 @@ def _parse_shape_args(pairs):
         name, dims = p.split("=", 1)
         shapes[name] = tuple(int(d) for d in dims.split(",") if d != "")
     return shapes
+
+
+def _self_apply_registry(include_unverified: bool = False) -> Report:
+    import mxtpu.ndarray  # noqa: F401 — populate the registry
+    return audit_registry(include_unverified=include_unverified)
+
+
+def _self_apply_lint(paths=None) -> Report:
+    return trace_lint(paths or None)
 
 
 def _self_apply_compile() -> Report:
@@ -55,16 +73,42 @@ def _self_apply_compile() -> Report:
     return check_compiles()
 
 
-def _self_apply_memory() -> Report:
-    """Estimate the reference MLP graph (the same one the graph verifier
-    self-checks with) against a generous per-device budget."""
+def _reference_graph():
+    """The reference MLP the graph/memory passes self-check with."""
     from .. import symbol as sym
 
     data = sym.Variable("data")
     fc1 = sym.FullyConnected(data, num_hidden=128, name="selfcheck_fc1")
     act = sym.Activation(fc1, act_type="relu", name="selfcheck_act")
-    net = sym.FullyConnected(act, num_hidden=10, name="selfcheck_fc2")
-    return check_memory(net, budget_bytes="1GiB", data=(32, 64))
+    return sym.FullyConnected(act, num_hidden=10, name="selfcheck_fc2")
+
+
+def _self_apply_graph() -> Report:
+    """Structural + shape/dtype verification of the reference MLP."""
+    return verify_graph(_reference_graph(), data=(32, 64))
+
+
+def _self_apply_memory() -> Report:
+    """Estimate the reference MLP graph against a generous per-device
+    budget."""
+    return check_memory(_reference_graph(), budget_bytes="1GiB",
+                        data=(32, 64))
+
+
+def _self_apply_sharding() -> Report:
+    """Validate a reference Megatron column→row rule pair against
+    matching params on a {dp, tp} mesh."""
+    from ..parallel.sharding import PartitionSpec, ShardingRules
+
+    rules = ShardingRules([
+        (r"\.q_proj\.weight$", PartitionSpec("tp", None)),
+        (r"\.out_proj\.weight$", PartitionSpec(None, "tp")),
+        (r"\.bias$", PartitionSpec(None)),
+    ])
+    params = {"layers.0.attn.q_proj.weight": (64, 64),
+              "layers.0.attn.out_proj.weight": (64, 64),
+              "layers.0.attn.q_proj.bias": (64,)}
+    return check_sharding(rules, params, {"dp": 2, "tp": 4})
 
 
 def _self_apply_donation() -> Report:
@@ -92,15 +136,67 @@ def _self_apply_donation() -> Report:
     return check_trainer_donation(trainer, X, y, compile=False)
 
 
+def _self_apply_kernels(vmem_budget=None) -> Report:
+    """Verdict the shipped Pallas kernels' call geometry at their real
+    TPU serving/training geometries (fp32 + int8, decode + W-wide
+    verify) — the ROADMAP-item-2 merge gate."""
+    kw = {}
+    if vmem_budget is not None:
+        kw["vmem_budget"] = vmem_budget
+    return check_kernels(**kw)
+
+
+# Every registered pass needs a self-application probe here; `all` runs
+# each one and emits a P001 ERROR for any pass left unwired, so a new
+# pass cannot be silently skipped by the CI gate.
+_SELF_APPLY = {
+    "audit_registry": _self_apply_registry,
+    "trace_lint": _self_apply_lint,
+    "compile_check": _self_apply_compile,
+    "verify_graph": _self_apply_graph,
+    "memory_estimate": _self_apply_memory,
+    "check_sharding": _self_apply_sharding,
+    "donation_check": _self_apply_donation,
+    "kernel_check": _self_apply_kernels,
+}
+
+
+def _self_apply_all(lint_paths=None, include_unverified: bool = False,
+                    vmem_budget=None) -> Report:
+    """Every registered pass through its probe; the lint/registry/
+    kernel flags `all` accepts are forwarded to the matching probes."""
+    forwarded = {
+        "audit_registry": dict(include_unverified=include_unverified),
+        "trace_lint": dict(paths=lint_paths),
+        "kernel_check": (dict(vmem_budget=vmem_budget)
+                         if vmem_budget is not None else {}),
+    }
+    report = Report()
+    for name in list_passes():
+        probe = _SELF_APPLY.get(name)
+        if probe is None:
+            report.add(Diagnostic(
+                "analysis_cli", "P001", Severity.ERROR, name,
+                "registered analysis pass %r has no self-application "
+                "probe wired into `python -m mxtpu.analysis all` — the "
+                "CI gate would silently skip it; add a probe to "
+                "_SELF_APPLY in mxtpu/analysis/__main__.py" % name))
+            continue
+        report.extend(probe(**forwarded.get(name, {})))
+    return report
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="python -m mxtpu.analysis",
         description="static graph verifier, sharding checker, registry "
                     "audit, trace-safety lint, compile-discipline "
-                    "checker, HBM estimator, and donation checker")
+                    "checker, HBM estimator, donation checker, and "
+                    "Pallas kernel-geometry checker")
     ap.add_argument("command", nargs="?", default="all",
                     choices=["all", "registry", "lint", "graph",
-                             "memory", "compile", "donate"])
+                             "memory", "compile", "donate", "kernel",
+                             "sharding"])
     ap.add_argument("paths", nargs="*",
                     help="lint: files/dirs; graph/memory: one "
                          "symbol.json; compile: one ledger dump")
@@ -110,6 +206,9 @@ def main(argv=None) -> int:
     ap.add_argument("--budget", default=None, metavar="BYTES",
                     help="memory: per-device budget (e.g. 16GiB); "
                          "over-budget estimates are errors")
+    ap.add_argument("--vmem-budget", default=None, metavar="BYTES",
+                    help="kernel: per-grid-step VMEM budget "
+                         "(default 16MiB)")
     ap.add_argument("--json", action="store_true",
                     help="emit diagnostics as JSON")
     ap.add_argument("--fail-on", default="error",
@@ -120,18 +219,16 @@ def main(argv=None) -> int:
     args = ap.parse_args(argv)
 
     report = Report()
-    if args.command in ("all", "registry"):
-        import mxtpu.ndarray  # noqa: F401 — populate the registry
-        report.extend(audit_registry(
-            include_unverified=args.include_unverified))
-    if args.command in ("all", "lint"):
-        report.extend(trace_lint(args.paths or None))
     if args.command == "all":
-        # self-apply the compile/memory/donation passes on built-in
-        # probe workloads: the CI gate exercises every pass end to end
-        report.extend(_self_apply_compile())
-        report.extend(_self_apply_memory())
-        report.extend(_self_apply_donation())
+        report.extend(_self_apply_all(
+            lint_paths=args.paths or None,
+            include_unverified=args.include_unverified,
+            vmem_budget=args.vmem_budget))
+    if args.command == "registry":
+        report.extend(_self_apply_registry(
+            include_unverified=args.include_unverified))
+    if args.command == "lint":
+        report.extend(_self_apply_lint(args.paths))
     if args.command == "graph":
         if len(args.paths) != 1:
             raise SystemExit("graph: exactly one symbol.json path")
@@ -157,6 +254,10 @@ def main(argv=None) -> int:
             report.extend(_self_apply_compile())
     if args.command == "donate":
         report.extend(_self_apply_donation())
+    if args.command == "kernel":
+        report.extend(_self_apply_kernels(vmem_budget=args.vmem_budget))
+    if args.command == "sharding":
+        report.extend(_self_apply_sharding())
 
     if args.json:
         print(report.to_json())

@@ -50,7 +50,16 @@ M005        WARNING   nodes whose shapes could not be inferred — the
 M006        ERROR     host-RAM KV tier exceeds its host budget (the
                       hierarchical cache's spilled chains live in host
                       memory, never HBM — they are budgeted separately)
+M007        INFO      per-grid-step VMEM pricing of a Pallas kernel call
+                      (emitted by the ``kernel_check`` pass from
+                      :func:`kernel_vmem_estimate` — the on-chip sibling
+                      of the M003 HBM breakdown)
 ==========  ========  =====================================================
+
+Beside the HBM model this module also prices **VMEM** — the ~16 MiB
+on-chip budget every Pallas grid step must fit in
+(:func:`kernel_vmem_estimate`, consumed by
+:mod:`mxtpu.analysis.kernel_check` for its K003 verdict).
 """
 
 from __future__ import annotations
@@ -62,7 +71,8 @@ from .diagnostics import Diagnostic, Report, Severity, register_pass
 
 __all__ = ["MemoryEstimate", "estimate_graph_memory", "estimate_jit_memory",
            "kv_cache_residency", "paged_kv_cache_residency", "check_memory",
-           "xla_memory_stats", "parse_bytes", "format_bytes"]
+           "xla_memory_stats", "parse_bytes", "format_bytes",
+           "LANE", "sublane_tile", "kernel_vmem_estimate"]
 
 _PASS = "memory_estimate"
 
@@ -636,6 +646,98 @@ def xla_memory_stats(fn, *sample_args, in_shardings=None,
            "alias": int(ma.alias_size_in_bytes)}
     out["total"] = sum(out.values())
     return out
+
+
+# -- the VMEM model (Pallas kernel calls) ---------------------------------
+# The HBM model above answers "does the program fit per device"; this
+# answers the on-chip sibling: "does ONE GRID STEP of a Pallas kernel fit
+# in VMEM".  mxtpu.analysis.kernel_check turns the estimate into its
+# K003/M007 diagnostics; the descriptors it consumes are duck-typed (any
+# object with .operands/.scratch/.prefetch of the KernelSpec shape).
+
+#: TPU lane width: the last dim of every VMEM tile is 128 wide.
+LANE = 128
+
+# minimum sublane tile (second-to-last dim) per element width: fp32/int32
+# tile (8, 128), bf16/fp16 (16, 128), int8/fp8 (32, 128)
+_SUBLANE_BY_ITEMSIZE = {1: 32, 2: 16, 4: 8}
+
+
+def sublane_tile(dtype) -> int:
+    """Minimum second-to-last tile dim for ``dtype`` on TPU (8 fp32,
+    16 bf16, 32 int8 — the dtype packing rule kernel_check's K002
+    enforces)."""
+    return _SUBLANE_BY_ITEMSIZE.get(_itemsize(dtype), 8)
+
+
+def _tile_padded_bytes(shape, dtype) -> int:
+    """Bytes one block/scratch buffer occupies in VMEM: the last dim
+    pads to the 128-lane tile, the second-to-last to the dtype's sublane
+    tile (partial tiles are allocated whole); leading dims multiply."""
+    shape = tuple(int(d) for d in shape)
+    if not shape:
+        return _itemsize(dtype)
+    dims = list(shape)
+    dims[-1] = math.ceil(dims[-1] / LANE) * LANE
+    if len(dims) >= 2:
+        sub = sublane_tile(dtype)
+        dims[-2] = math.ceil(dims[-2] / sub) * sub
+    n = _itemsize(dtype)
+    for d in dims:
+        n *= d
+    return n
+
+
+def kernel_vmem_estimate(spec, buffering: int = 2) -> Dict[str, Any]:
+    """Per-grid-step VMEM bytes of one Pallas kernel call described by a
+    :class:`~mxtpu.analysis.kernel_check.KernelSpec`.
+
+    The model: every in/out block is resident tile-padded and — because
+    the Pallas TPU pipeline double-buffers blocks across grid steps —
+    counted ``buffering`` times (default 2; pass 1 for the unpipelined
+    lower bound); scratch buffers are single-resident; scalar-prefetch
+    operands live in SMEM and are priced separately
+    (``smem_prefetch_bytes``), never against the VMEM total.
+
+    Returns a dict: ``in_bytes`` / ``out_bytes`` (single-copy block
+    sums), ``scratch_bytes``, ``smem_prefetch_bytes``, ``buffering``,
+    ``total_bytes`` = buffering × (in + out) + scratch, and
+    ``per_operand`` — (name, kind, block_shape, dtype, padded bytes)
+    tuples for the breakdown diagnostics.
+    """
+    in_bytes = 0
+    out_bytes = 0
+    per_operand = []
+    for op in spec.operands:
+        nbytes = _tile_padded_bytes(op.block_shape, op.dtype)
+        if op.kind == "out":
+            out_bytes += nbytes
+        else:
+            in_bytes += nbytes
+        per_operand.append((op.name, op.kind, tuple(op.block_shape),
+                            str(op.dtype), nbytes))
+    scratch_bytes = 0
+    for sc in spec.scratch:
+        nbytes = _tile_padded_bytes(sc.shape, sc.dtype)
+        scratch_bytes += nbytes
+        per_operand.append((sc.name, "scratch", tuple(sc.shape),
+                            str(sc.dtype), nbytes))
+    import numpy as np
+
+    smem = 0
+    for pf in spec.prefetch:
+        vals = np.asarray(pf.values)
+        smem += int(vals.size) * _itemsize(vals.dtype)
+    buffering = max(int(buffering), 1)
+    return {
+        "in_bytes": in_bytes,
+        "out_bytes": out_bytes,
+        "scratch_bytes": scratch_bytes,
+        "smem_prefetch_bytes": smem,
+        "buffering": buffering,
+        "total_bytes": buffering * (in_bytes + out_bytes) + scratch_bytes,
+        "per_operand": per_operand,
+    }
 
 
 # -- the registered pass --------------------------------------------------

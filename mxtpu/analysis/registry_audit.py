@@ -16,7 +16,24 @@ R002        ERROR     declared num_outputs contradicts abstract eval
 R003        ERROR     differentiable=True but jax.vjp rejects the op
 R004        INFO      op could not be abstractly evaluated on any sample
                       shape (requires structured/static args) — unverified
+R005        WARNING   a declared fault-injection site
+                      (resilience.faults.SITES) is never named by any
+                      fault plan in the test suite — its wiring has lost
+                      deterministic coverage (:func:`audit_fault_sites`)
 ==========  ========  =====================================================
+
+The R005 cross-check (``audit_fault_sites``) scans the STRING LITERALS
+of the tests/ tree for PLAN-shaped mentions of each declared site: the
+site name followed by a ``:raise``/``:delay`` action in the same
+literal.  Bare mentions (comments, docstrings, assertion messages —
+and this audit's own fixtures) never count, and the injector-level
+fault matrix (tests/test_resilience.py) is parametrized over ``SITES``
+with ``"%s@..."`` literals and so proves only the injector; what R005
+protects is the *wiring-level* plans —
+``fault_plan("serving.swap_in@1:raise=...")`` style tests that drive
+the real subsystem through the site — so sites like
+``serving.swap_out/in`` can't silently lose their coverage as suites
+are trimmed.
 
 Sample-shape protocol: positional parameters without defaults are array
 inputs (the invoke_op convention: arrays positional, statics keyword);
@@ -45,7 +62,7 @@ from typing import Dict, Iterable, Optional
 from ..base import _OP_REGISTRY
 from .diagnostics import Diagnostic, Report, Severity, register_pass
 
-__all__ = ["audit_registry"]
+__all__ = ["audit_registry", "audit_fault_sites"]
 
 _PASS = "audit_registry"
 
@@ -170,14 +187,125 @@ def _probe_op(spec, n_req):
     return result
 
 
+# -- R005: fault-site coverage --------------------------------------------
+
+# (paths tuple) -> frozenset of string literals; test sources don't
+# change within a process, and the audit runs several times per suite
+_LITERAL_CACHE: Dict[tuple, frozenset] = {}
+
+
+def _default_test_dir() -> Optional[str]:
+    """The repo's tests/ tree: a sibling of the installed mxtpu package
+    (present in the development checkout, absent in a wheel install)."""
+    import os
+
+    pkg_dir = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    cand = os.path.join(os.path.dirname(pkg_dir), "tests")
+    return cand if os.path.isdir(cand) else None
+
+
+def _string_literals(paths) -> frozenset:
+    """Every str constant in the given python files/dirs (AST-level, so
+    comments never count as coverage)."""
+    import ast
+    import os
+
+    key = tuple(paths)
+    cached = _LITERAL_CACHE.get(key)
+    if cached is not None:
+        return cached
+    files = []
+    for p in paths:
+        if os.path.isdir(p):
+            for root, dirs, names in os.walk(p):
+                dirs.sort()
+                files.extend(os.path.join(root, f) for f in sorted(names)
+                             if f.endswith(".py"))
+        elif p.endswith(".py"):
+            files.append(p)
+    lits = set()
+    for f in files:
+        try:
+            with open(f, encoding="utf-8") as fh:
+                tree = ast.parse(fh.read(), filename=f)
+        except (OSError, SyntaxError):
+            continue
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Constant) and isinstance(node.value,
+                                                             str):
+                lits.add(node.value)
+            elif isinstance(node, ast.JoinedStr):
+                # an f-string plan (f"site@{i}:raise") splits into
+                # fragments; rejoin its constant parts so the
+                # site + action still land in ONE scanned literal
+                lits.add("".join(
+                    v.value for v in node.values
+                    if isinstance(v, ast.Constant)
+                    and isinstance(v.value, str)))
+    result = frozenset(lits)
+    _LITERAL_CACHE[key] = result
+    return result
+
+
+def _plan_rule_re(site: str):
+    """Regex matching ``site`` used as a PLAN RULE inside a literal:
+    the site immediately followed by plan syntax (``#key`` / ``@n`` /
+    ``+`` / ``xN`` / ``%N`` / ``:``, no intervening whitespace or
+    quote) reaching a ``:raise``/``:delay`` action — one TOKEN, so a
+    site list or prose sharing a literal with another site's plan
+    earns no cross-credit."""
+    import re
+
+    return re.compile(re.escape(site)
+                      + r"(?=[#@%x+:])[^\s'\"]*:(?:raise|delay)")
+
+
+def audit_fault_sites(test_paths: Optional[Iterable[str]] = None,
+                      sites: Optional[Iterable[str]] = None) -> Report:
+    """Cross-check ``resilience.faults.SITES`` against the fault plans
+    the test suite actually writes: one R005 WARNING per declared site
+    that no test injects via a PLAN-shaped string literal (the site
+    followed by a ``:raise``/``:delay`` action in the same literal —
+    the ``fault_plan("serving.swap_in#%d@1:raise=...")`` form).
+
+    test_paths: files/dirs to scan (default: the repo tests/ tree; when
+    none is found — wheel installs — the audit is a silent no-op).
+    sites: override the site list (tests use this for red-team
+    fixtures)."""
+    report = Report()
+    if sites is None:
+        from ..resilience.faults import SITES as sites
+    if test_paths is None:
+        d = _default_test_dir()
+        if d is None:
+            return report
+        test_paths = [d]
+    lits = _string_literals(list(test_paths))
+    for site in sites:
+        rx = _plan_rule_re(site)
+        if any(rx.search(lit) for lit in lits):
+            continue
+        report.add(Diagnostic(
+            _PASS, "R005", Severity.WARNING, site,
+            "declared fault site %r is never named by any fault plan "
+            "in the scanned tests — its failure-path wiring has lost "
+            "deterministic coverage; add a fault_plan(%r...) test or "
+            "retire the site from resilience.faults.SITES"
+            % (site, site + "@1:raise")))
+    return report
+
+
 def audit_registry(ops: Optional[Iterable[str]] = None,
-                   include_unverified: bool = False) -> Report:
+                   include_unverified: bool = False,
+                   fault_sites: bool = True) -> Report:
     """Audit registered operators; returns a Report.
 
     ops: optional subset of registry names to audit (default: every
     unique spec).  include_unverified: emit an R004 INFO per op that
     could not be abstractly evaluated (off by default — roughly a third
-    of the registry takes structured args).
+    of the registry takes structured args).  fault_sites: also run the
+    R005 fault-site coverage cross-check over the repo tests/ tree
+    (:func:`audit_fault_sites`; a no-op when no tests dir exists).
     """
     import jax
     import jax.numpy as jnp
@@ -272,6 +400,11 @@ def audit_registry(ops: Optional[Iterable[str]] = None,
                 "fail — register with differentiable=False" %
                 (spec.name, repr(vjp_exc)[:200]),
                 details={"error": repr(vjp_exc)}))
+
+    if fault_sites and ops is None:
+        # full-registry audits carry the suite-level cross-check; a
+        # subset audit (ops=[...]) is about those ops only
+        report.extend(audit_fault_sites())
 
     return report
 

@@ -33,7 +33,36 @@ from jax.experimental import pallas as pl
 
 from ...base import env_bool
 
-__all__ = ["conv3x3_s1", "eligible", "enabled"]
+__all__ = ["conv3x3_s1", "eligible", "enabled", "kernel_spec"]
+
+
+def kernel_spec(N, H, W, Ci, Co, dtype="float32", interpret=False):
+    """KernelSpec descriptor (mxtpu.analysis.kernel_check) for the fused
+    dW+dX pallas_call at one NHWC geometry — same blocks as _pallas_bwd:
+    the grid walks images, dW accumulates in place across steps
+    (constant output index — the sequential-reduction pattern the
+    kernel_check K006 rule admits because the image axis is the
+    innermost/only axis)."""
+    from ...analysis.kernel_check import BlockOperand, KernelSpec
+
+    img_im = lambda n: (n, 0, 0, 0)    # noqa: E731 — mirrors _pallas_bwd
+    w_im = lambda n: (0, 0, 0, 0)      # noqa: E731
+    return KernelSpec(
+        "conv_bwd.dw_dx[%s,%dx%dx%d->%d]" % (dtype, H, W, Ci, Co),
+        grid=(N,),
+        operands=[
+            BlockOperand("xp", "in", (1, H + 2, W + 2, Ci),
+                         (N, H + 2, W + 2, Ci), dtype, img_im),
+            BlockOperand("dy", "in", (1, H, W, Co),
+                         (N, H, W, Co), dtype, img_im),
+            BlockOperand("w", "in", (3, 3, Ci, Co),
+                         (3, 3, Ci, Co), dtype, w_im),
+            BlockOperand("dw", "out", (3, 3, Ci, Co),
+                         (3, 3, Ci, Co), "float32", w_im),
+            BlockOperand("dxp", "out", (1, H + 2, W + 2, Ci),
+                         (N, H + 2, W + 2, Ci), dtype, img_im),
+        ],
+        interpret=interpret)
 
 
 def enabled():

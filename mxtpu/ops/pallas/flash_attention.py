@@ -28,9 +28,80 @@ from jax.experimental import pallas as pl
 
 from ...base import register_op
 
-__all__ = ["flash_attention"]
+__all__ = ["flash_attention", "kernel_specs"]
 
 _NEG_INF = -1e30
+
+
+def kernel_specs(B, H, T, D, dtype="float32", q_block=128, kv_block=128,
+                 backward=True, interpret=False):
+    """KernelSpec descriptors (mxtpu.analysis.kernel_check) for the
+    pallas_calls one flash_attention forward/backward issues at this
+    workload geometry — same padding and block construction as
+    _flash_fwd/_flash_bwd, so the static pass verdicts exactly the
+    calls that would run."""
+    from ...analysis.kernel_check import BlockOperand, KernelSpec
+
+    qb = min(q_block, T)
+    kb = min(kv_block, T)
+    Tq = math.ceil(T / qb) * qb
+    Tk = math.ceil(T / kb) * kb
+    BH = B * H
+
+    def blk(name, kind, shape, array, dt, imap):
+        # D (head_dim) and the q/kv block tiles are chosen parameters:
+        # rank-3 blocks are strict on both trailing dims, the rank-2
+        # lse/delta rows on their (q_block-sized) last dim only
+        strict = (-1, -2) if len(shape) == 3 else (-1,)
+        return BlockOperand(name, kind, shape, array, dt, imap,
+                            strict_dims=strict)
+
+    q_im = lambda b, i: (b, i, 0)      # noqa: E731 — mirrors _flash_fwd
+    full_im = lambda b, i: (b, 0, 0)   # noqa: E731
+    row_im = lambda b, i: (b, i)       # noqa: E731
+    row0_im = lambda b, i: (b, 0)      # noqa: E731
+    specs = [KernelSpec(
+        "flash_attention.fwd[%s,T=%d,D=%d]" % (dtype, T, D),
+        grid=(BH, Tq // qb),
+        operands=[
+            blk("q", "in", (1, qb, D), (BH, Tq, D), dtype, q_im),
+            blk("k", "in", (1, Tk, D), (BH, Tk, D), dtype, full_im),
+            blk("v", "in", (1, Tk, D), (BH, Tk, D), dtype, full_im),
+            blk("o", "out", (1, qb, D), (BH, Tq, D), dtype, q_im),
+            blk("lse", "out", (1, qb), (BH, Tq), "float32", row_im),
+        ],
+        interpret=interpret)]
+    if not backward:
+        return specs
+    specs.append(KernelSpec(
+        "flash_attention.bwd_dq[%s,T=%d,D=%d]" % (dtype, T, D),
+        grid=(BH, Tq // qb),
+        operands=[
+            blk("q", "in", (1, qb, D), (BH, Tq, D), dtype, q_im),
+            blk("k", "in", (1, Tk, D), (BH, Tk, D), dtype, full_im),
+            blk("v", "in", (1, Tk, D), (BH, Tk, D), dtype, full_im),
+            blk("do", "in", (1, qb, D), (BH, Tq, D), dtype, q_im),
+            blk("lse", "in", (1, qb), (BH, Tq), "float32", row_im),
+            blk("delta", "in", (1, qb), (BH, Tq), "float32", row_im),
+            blk("dq", "out", (1, qb, D), (BH, Tq, D), dtype, q_im),
+        ],
+        interpret=interpret))
+    kv_im = lambda b, j: (b, j, 0)     # noqa: E731
+    specs.append(KernelSpec(
+        "flash_attention.bwd_dkv[%s,T=%d,D=%d]" % (dtype, T, D),
+        grid=(BH, Tk // kb),
+        operands=[
+            blk("q", "in", (1, Tq, D), (BH, Tq, D), dtype, full_im),
+            blk("k", "in", (1, kb, D), (BH, Tk, D), dtype, kv_im),
+            blk("v", "in", (1, kb, D), (BH, Tk, D), dtype, kv_im),
+            blk("do", "in", (1, Tq, D), (BH, Tq, D), dtype, full_im),
+            blk("lse", "in", (1, Tq), (BH, Tq), "float32", row0_im),
+            blk("delta", "in", (1, Tq), (BH, Tq), "float32", row0_im),
+            blk("dk", "out", (1, kb, D), (BH, Tk, D), dtype, kv_im),
+            blk("dv", "out", (1, kb, D), (BH, Tk, D), dtype, kv_im),
+        ],
+        interpret=interpret))
+    return specs
 
 
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal,

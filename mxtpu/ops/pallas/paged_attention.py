@@ -25,11 +25,17 @@ Gating mirrors the training kernels: ``MXTPU_PALLAS_PAGED_ATTN=1``
 routes ``TransformerLM.step_pages`` / ``verify_pages`` through this
 kernel (default off — the XLA gather path is the bit-exact parity
 reference for the serving engines); interpret mode on CPU, verified
-against the XLA path in tests/test_paged_attention_pallas.py.  Note:
-TPU-native lowering wants block_size a multiple of the dtype tile
-sublane (8 fp32 / 32 int8) and D a multiple of 128 for full MXU
-utilization; the engines' CPU-test geometries run in interpret mode
-only.
+against the XLA path in tests/test_paged_attention_pallas.py.
+
+Geometry contract: ``mxtpu.analysis.kernel_check`` is the source of
+truth (docs/analysis.md K0xx) — :func:`kernel_spec` describes this
+call's grid/blocks/index-maps/scratch/prefetch for the static pass,
+which enforces lane-aligned D (K001), block_size a multiple of the
+cache dtype's sublane tile (K002: 8 fp32 / 16 bf16 / 32 int8), the
+VMEM budget (K003) and in-pool tables (K004) pre-compile.  On a
+non-interpret backend :func:`validate_call_geometry` mirrors the rules
+at call time and raises naming the violated K-rule; the engines'
+CPU-test geometries are interpret-mode-only (K007).
 """
 
 from __future__ import annotations
@@ -44,7 +50,8 @@ from jax.experimental.pallas import tpu as pltpu
 
 from ...base import env_bool, register_op
 
-__all__ = ["paged_decode_attention", "paged_attention_enabled"]
+__all__ = ["paged_decode_attention", "paged_attention_enabled",
+           "kernel_spec", "validate_call_geometry"]
 
 _NEG_INF = -1e30
 
@@ -117,6 +124,125 @@ def _kernel(tbl_ref, pos_ref, nv_ref, q_ref, k_ref, *rest,
             o_ref.dtype)
 
 
+def _num_valid_pages(pos, W, block_size, M):
+    """Pages a slot's W-wide window can touch: logical positions
+    0 .. pos + W - 1.  ONE definition shared by the runtime call and
+    the kernel_spec model, so the static pass always verdicts the same
+    table walk the kernel performs."""
+    return jnp.clip((pos + (W - 1)) // block_size + 1, 1, M).astype(
+        jnp.int32)
+
+
+def _model_tables(B, M, n_pages, block_size, W, max_length):
+    """Representative ragged (tables, pos) for the static checker:
+    each slot holds a different valid extent, its live entries point at
+    distinct allocated pages (1-based — page 0 is the reserved null
+    page) and every padded entry carries the null page, exactly the
+    engine's table convention."""
+    import numpy as np
+
+    pos = np.asarray([(7 + 13 * b) % max(max_length - W, 1)
+                      for b in range(B)], np.int32)
+    nv = np.asarray(_num_valid_pages(pos, W, block_size, M))
+    tables = np.zeros((B, M), np.int32)
+    page = 1
+    for b in range(B):
+        for j in range(int(nv[b])):
+            tables[b, j] = page
+            page = page % (n_pages - 1) + 1  # stay inside the pool
+    return tables, pos
+
+
+def kernel_spec(B, KV, rep, W, D, block_size, max_length,
+                q_dtype="bfloat16", cache_dtype="float32",
+                num_blocks=None, tables=None, pos=None, interpret=False):
+    """KernelSpec descriptor (mxtpu.analysis.kernel_check) for one
+    paged_decode_attention call — the REAL index maps (_page_index /
+    _scale_index, block-table walk and null-page-0 routing included)
+    over model scalar-prefetch tables, so the static pass evaluates the
+    same functions the pallas_call traces."""
+    import numpy as np
+
+    from ...analysis.kernel_check import (BlockOperand, KernelSpec,
+                                          ScalarPrefetch, ScratchOperand)
+
+    bs = int(block_size)
+    M = math.ceil(max_length / bs)
+    N = int(num_blocks) if num_blocks is not None else B * M + 1
+    quant = str(cache_dtype) == "int8"
+    # caller overrides apply INDEPENDENTLY (auditing a real engine's
+    # table must never silently fall back to clean model tables just
+    # because pos was omitted); the int32 cast mirrors the runtime's,
+    # so the spec describes the call as traced, not the caller's
+    # pre-cast dtype
+    model_tables, model_pos = _model_tables(B, M, N, bs, W, max_length)
+    tables = model_tables if tables is None \
+        else np.asarray(tables).astype(np.int32)
+    pos = model_pos if pos is None \
+        else np.asarray(pos).astype(np.int32)
+    nv = np.asarray(_num_valid_pages(pos, W, bs, M))
+    lanes = rep * W
+    q_im = lambda b, kv, j, tbl, pos, nv: (b, kv, 0, 0)  # noqa: E731
+    pool_dtype = "int8" if quant else cache_dtype
+    # strict_dims: D (head_dim) and bs (block_size) are engine-chosen
+    # tile parameters — the full-axis exemption must not absolve a
+    # sub-tile choice there (bs IS the pool's full sublane axis); the
+    # rep*W lane count and the scale rows are workload-determined and
+    # pad legally
+    operands = [
+        BlockOperand("q", "in", (1, 1, lanes, D), (B, KV, lanes, D),
+                     q_dtype, q_im, strict_dims=(-1,)),
+        BlockOperand("pool_k", "in", (1, 1, bs, D), (N, KV, bs, D),
+                     pool_dtype, _page_index, strict_dims=(-1, -2)),
+    ]
+    if quant:
+        operands.append(BlockOperand(
+            "k_scales", "in", (1, 1, bs), (N, KV, bs), "float32",
+            _scale_index))
+    operands.append(BlockOperand(
+        "pool_v", "in", (1, 1, bs, D), (N, KV, bs, D), pool_dtype,
+        _page_index, strict_dims=(-1, -2)))
+    if quant:
+        operands.append(BlockOperand(
+            "v_scales", "in", (1, 1, bs), (N, KV, bs), "float32",
+            _scale_index))
+    operands.append(BlockOperand(
+        "o", "out", (1, 1, lanes, D), (B, KV, lanes, D), q_dtype, q_im,
+        strict_dims=(-1,)))
+    return KernelSpec(
+        "paged_attention[%s,W=%d,bs=%d,D=%d]" % (pool_dtype, W, bs, D),
+        grid=(B, KV, M),
+        operands=operands,
+        scratch=[ScratchOperand("m", (lanes, 1), "float32"),
+                 ScratchOperand("l", (lanes, 1), "float32"),
+                 ScratchOperand("acc", (lanes, D), "float32")],
+        prefetch=[ScalarPrefetch("tables", tables, valid_range=(0, N)),
+                  ScalarPrefetch("pos", pos,
+                                 valid_range=(0, max_length)),
+                  ScalarPrefetch("nv", nv, valid_range=(1, M + 1))],
+        interpret=interpret)
+
+
+def validate_call_geometry(D, block_size, pool_dtype):
+    """The runtime mirror of the kernel_check static rules for THIS
+    kernel: returns the list of violated-rule messages (empty = TPU
+    legal).  K001 — head_dim must be lane-aligned (multiple of 128);
+    K002 — block_size must be a multiple of the cache dtype's sublane
+    tile (8 fp32 / 16 bf16 / 32 int8)."""
+    from ...analysis.memory_estimate import LANE, sublane_tile
+
+    errs = []
+    if D % LANE != 0:
+        errs.append("K001: head_dim D=%d is not a multiple of the "
+                    "%d-lane tile" % (D, LANE))
+    sub = sublane_tile(pool_dtype)
+    if block_size % sub != 0:
+        errs.append("K002: block_size=%d is not a multiple of the %s "
+                    "sublane tile %d (8 fp32 / 16 bf16 / 32 int8)"
+                    % (block_size, pool_dtype, sub))
+    return errs
+
+
 def _page_index(b, kv, j, tbl, pos, nv):
     """Block-table page selection for the pool BlockSpecs: valid steps
     read ``tables[b, j]``; steps past the slot's valid extent read the
@@ -154,8 +280,7 @@ def paged_decode_attention(q, pool_k, pool_v, tables, pos,
     qr = q.reshape(B, KV, rep * W, D)
     tables = tables.astype(jnp.int32)
     pos = jnp.asarray(pos, jnp.int32).reshape(-1)
-    # pages this slot's window can touch: positions 0 .. pos + W - 1
-    nv = jnp.clip((pos + (W - 1)) // bs + 1, 1, M).astype(jnp.int32)
+    nv = _num_valid_pages(pos, W, bs, M)
 
     lanes = rep * W
     grid = (B, KV, M)
@@ -190,6 +315,20 @@ def paged_decode_attention(q, pool_k, pool_v, tables, pos,
         ],
     )
     interpret = jax.default_backend() == "cpu"
+    if not interpret:
+        # runtime mirror of the static kernel_check pass: TPU-illegal
+        # geometry fails HERE with the violated K-rule named instead of
+        # deferring to an opaque Mosaic lowering error mid-compile
+        errs = validate_call_geometry(
+            D, bs, "int8" if quant else str(pool_k.dtype))
+        if errs:
+            raise ValueError(
+                "paged_decode_attention: TPU-illegal call geometry — "
+                + "; ".join(errs)
+                + ". Fix the engine's block_size/head_dim (or run "
+                "`python -m mxtpu.analysis kernel` for the full static "
+                "verdict); interpret-mode CPU tests accept this "
+                "geometry, hardware does not.")
     _invocations += 1
     out = pl.pallas_call(
         kernel,

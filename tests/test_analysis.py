@@ -370,6 +370,119 @@ def test_cli_lint_path(tmp_path, capsys):
     assert "L001" in out
 
 
+# -- fault-site coverage audit (ISSUE 12 satellite) --------------------
+
+def test_fault_site_audit_flags_only_uninjected_sites(tmp_path):
+    """R005 WARNING fires for exactly the declared sites no scanned
+    test names in a fault plan — and only for those."""
+    from mxtpu.analysis import audit_fault_sites
+
+    t = tmp_path / "test_fake.py"
+    t.write_text(
+        "def test_a():\n"
+        "    plan = 'serving.step@1:raise=OSError'\n"
+        "    fmt = 'serving.swap_in#%d@1:raise'\n")
+    rep = audit_fault_sites(
+        test_paths=[str(tmp_path)],
+        sites=("serving.step", "serving.swap_in", "serving.swap_out"))
+    bad = rep.filter(code="R005")
+    assert [d.subject for d in bad] == ["serving.swap_out"]
+    assert bad.diagnostics[0].severity == Severity.WARNING
+    assert "serving.swap_out" in bad.diagnostics[0].message
+
+
+def test_fault_site_audit_ignores_comments(tmp_path):
+    """Coverage is judged on STRING LITERALS: a site named only in a
+    comment does not count as an injected plan."""
+    from mxtpu.analysis import audit_fault_sites
+
+    (tmp_path / "test_fake.py").write_text(
+        "# serving.swap_out is great\n"
+        "def test_a():\n    pass\n")
+    rep = audit_fault_sites(test_paths=[str(tmp_path)],
+                            sites=("serving.swap_out",))
+    assert [d.subject for d in rep.filter(code="R005")] == \
+        ["serving.swap_out"]
+
+
+def test_fault_site_audit_bare_mentions_are_not_coverage(tmp_path):
+    """Only PLAN-shaped literals count — a site named in a docstring,
+    an assertion message, or a bare site list (this audit's own
+    fixtures!) must not satisfy the check, or deleting the real wiring
+    test would go unnoticed."""
+    from mxtpu.analysis import audit_fault_sites
+
+    (tmp_path / "test_fake.py").write_text(
+        'SITES = ("serving.swap_out", "serving.swap_in")\n'
+        "def test_a():\n"
+        '    """serving.swap_out spills pages to the host tier."""\n'
+        "    assert True, 'serving.swap_out should have fired'\n"
+        "    plan = 'serving.swap_in#%d@1:raise=OSError(dma)'\n")
+    rep = audit_fault_sites(
+        test_paths=[str(tmp_path)],
+        sites=("serving.swap_out", "serving.swap_in"))
+    assert [d.subject for d in rep.filter(code="R005")] == \
+        ["serving.swap_out"]
+
+
+def test_fault_site_audit_no_cross_credit_within_one_literal(tmp_path):
+    """One literal mentioning site A and carrying site B's plan action
+    must credit B only: the action has to follow the site within the
+    SAME plan token (no whitespace/quote between), or the audit's own
+    multi-line fixtures would self-cover the sites they test."""
+    from mxtpu.analysis import audit_fault_sites
+
+    (tmp_path / "test_fake.py").write_text(
+        "DOC = '''sites: serving.swap_out and more\n"
+        "plan = serving.swap_in#3@1:raise=OSError(dma)'''\n")
+    rep = audit_fault_sites(
+        test_paths=[str(tmp_path)],
+        sites=("serving.swap_out", "serving.swap_in"))
+    assert [d.subject for d in rep.filter(code="R005")] == \
+        ["serving.swap_out"]
+
+
+def test_fault_site_audit_scans_subdirectories(tmp_path):
+    """Plan literals in nested test packages count: reorganizing the
+    flat tests/ tree must not draw spurious R005 warnings."""
+    from mxtpu.analysis import audit_fault_sites
+
+    sub = tmp_path / "serving"
+    sub.mkdir()
+    (sub / "test_nested.py").write_text(
+        "def test_a():\n"
+        "    plan = 'serving.swap_out@1:raise=OSError(copy dead)'\n")
+    rep = audit_fault_sites(test_paths=[str(tmp_path)],
+                            sites=("serving.swap_out",))
+    assert len(rep.filter(code="R005")) == 0
+
+
+def test_fault_site_audit_counts_fstring_plans(tmp_path):
+    """A plan written as an f-string splits into AST fragments; the
+    scanner rejoins them so refactoring a plan literal to an f-string
+    does not draw a false R005."""
+    from mxtpu.analysis import audit_fault_sites
+
+    (tmp_path / "test_fake.py").write_text(
+        "def test_a(i):\n"
+        "    plan = f'serving.swap_in@{i}:raise=OSError(dma)'\n")
+    rep = audit_fault_sites(test_paths=[str(tmp_path)],
+                            sites=("serving.swap_in",))
+    assert len(rep.filter(code="R005")) == 0
+
+
+def test_full_registry_audit_includes_fault_site_check():
+    """audit_registry() (the tier-1 self-lint entry point) carries the
+    R005 cross-check; the repo suite currently covers every site, and a
+    subset audit (ops=[...]) skips the scan."""
+    import mxtpu.ndarray  # noqa: F401 — populate the registry
+    from mxtpu.resilience.faults import SITES
+
+    rep = audit_registry()
+    assert len(rep.filter(code="R005")) == 0, str(rep)
+    assert len(SITES) >= 14     # the scan really had sites to check
+
+
 # -- op bulking rules (PR 3) -------------------------------------------
 
 def test_audit_flags_undeclared_multi_output():

@@ -34,12 +34,15 @@ def test_trace_lint_mxtpu_clean():
 
 
 def test_cli_all_self_applies_every_pass(capsys):
-    """ISSUE 6 acceptance: `python -m mxtpu.analysis all --fail-on=error`
-    passes self-applied, INCLUDING the compile-discipline, memory, and
-    donation passes (their self-check probes run inside `all`)."""
-    from mxtpu.analysis import get_ledger
-    from mxtpu.analysis.__main__ import main
+    """ISSUE 6 + ISSUE 12 acceptance: `python -m mxtpu.analysis all
+    --fail-on=error` passes self-applied, and `all` now iterates EVERY
+    registered pass through its probe (a pass without one draws a P001
+    ERROR — tests/test_kernel_check.py red-teams that), so adding a
+    pass can never be forgotten by this gate."""
+    from mxtpu.analysis import get_ledger, list_passes
+    from mxtpu.analysis.__main__ import _SELF_APPLY, main
 
+    assert set(list_passes()) <= set(_SELF_APPLY)
     # other tests seed deliberate defects into the process-wide ledger;
     # the self-application verdict is about THIS run's probes
     get_ledger().reset()
@@ -48,3 +51,16 @@ def test_cli_all_self_applies_every_pass(capsys):
     assert rc == 0, out
     assert "M003" in out     # memory self-estimate ran
     assert "D003" in out     # donation self-check verified aliasing
+    assert "M007" in out     # kernel-geometry VMEM pricing ran
+    assert "P001" not in out
+
+
+def test_fault_sites_all_covered_by_test_plans():
+    """ISSUE 12 satellite: every declared fault site
+    (resilience.faults.SITES) is named by at least one fault plan in
+    tests/ — a site losing its wiring-level coverage draws R005 here."""
+    from mxtpu.analysis import audit_fault_sites
+
+    rep = audit_fault_sites(test_paths=[os.path.join(
+        os.path.dirname(os.path.abspath(__file__)))])
+    assert len(rep.filter(code="R005")) == 0, str(rep)

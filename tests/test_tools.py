@@ -113,3 +113,6 @@ def test_diagnose_runs():
     # the site and a clean discipline verdict
     assert "engine.bulk" in out.stdout
     assert "discipline   : 0 error(s)" in out.stdout
+    # the Pallas kernel-geometry gate ran and verdicts clean
+    assert "Pallas Kernel Geometry" in out.stdout
+    assert "verdict      : 0 error(s)" in out.stdout

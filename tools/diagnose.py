@@ -470,6 +470,30 @@ def check_analysis(full=False):
                   "`python -m mxtpu.analysis registry`)")
     except Exception as e:
         print("analysis       : FAILED (%s: %s)" % (type(e).__name__, e))
+    check_kernel_geometry()
+
+
+def check_kernel_geometry():
+    """Run the kernel_check pass over the shipped Pallas kernels at
+    their real TPU serving/training geometries (docs/analysis.md K0xx):
+    a healthy checkout verdicts every spec clean and prints each one's
+    per-grid-step VMEM price — the pre-compile gate ROADMAP-item-2
+    kernels land behind."""
+    print("----------Pallas Kernel Geometry----------")
+    try:
+        from mxtpu.analysis import check_kernels, default_kernel_specs
+        specs = default_kernel_specs()
+        rep = check_kernels(specs)
+        print("kernel specs :", len(specs), "pallas_call geometrie(s) "
+              "(flash fwd/bwd, conv_bwd, paged fp32+int8 W=1/8)")
+        print("verdict      :", rep.summary())
+        for d in rep.errors:
+            print("  ", d)
+        for d in rep.filter(code="M007"):
+            print("  %-42s %s" % (d.subject[:42],
+                                  d.message.split(", smem")[0]))
+    except Exception as e:
+        print("kernel check : FAILED (%s: %s)" % (type(e).__name__, e))
 
 
 def check_environment():
