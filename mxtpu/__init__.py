@@ -49,6 +49,7 @@ _LAZY = {
     "mod": ".module",
     "model": ".model",
     "parallel": ".parallel",
+    "serving": ".serving",
     "amp": ".amp",
     "test_utils": ".test_utils",
     "util": ".util",

@@ -113,17 +113,25 @@ class ShardedDecoder:
         default shards the kv-head axis over "tp" (each tp shard holds
         the heads whose q/k/v projections it owns — no cross-shard
         traffic in the attention itself).
+    ledger_tag : optional label appended to this decoder's compile-
+        ledger site names (``serving.step@TAG``) so a multi-replica
+        pool's per-replica program families stay separable in
+        ``check_compiles``/``compile_budget`` — each replica owns its
+        own jit cache, so without the tag N replicas look like N×
+        churn at one site.  Prefix queries (``serving.*``) still match.
     """
 
     def __init__(self, block, mesh: DeviceMesh,
                  rules: Optional[ShardingRules] = None,
                  cache_spec: P = P(None, "tp", None, None),
-                 bucket_prefill: bool = True):
+                 bucket_prefill: bool = True,
+                 ledger_tag: Optional[str] = None):
         self._block = block
         self._mesh = mesh
         self._rules = rules or ShardingRules()
         self._cache_spec = cache_spec
         self._bucket_prefill = bucket_prefill
+        self._ledger_tag = ledger_tag
         self._has_moe = None  # computed once on first generate()
         self._params = sorted(block.collect_params().values(),
                               key=lambda p: p.name)
@@ -400,7 +408,10 @@ class ShardedDecoder:
                                                record)
         if not ledger_enabled():
             return
-        record("serving.%s" % kind, Signature(
+        site = "serving.%s" % kind
+        if self._ledger_tag:
+            site = "%s@%s" % (site, self._ledger_tag)
+        record(site, Signature(
             shapes=_cache_shapes(cache_leaves)
             + tuple(tuple(e.shape) for e in extras),
             dtypes=(_cache_dt(cache_leaves),)

@@ -269,6 +269,16 @@ class PrefixIndex:
             else None
         return full, partial
 
+    def probe(self, tokens: Sequence[int], limit: int) -> int:
+        """Locality probe: how many of ``tokens[:limit]`` a request
+        admitted RIGHT NOW would skip prefilling (full-page matches plus
+        the copy-on-write donor's partial tokens).  A pure read — no
+        refcounts touched, no LRU ticks advanced — cheap enough for a
+        multi-replica router to call on every replica per dispatch
+        (``mxtpu.serving.Router``)."""
+        full, partial = self.lookup(tokens, limit)
+        return len(full) * self._bs + (partial[1] if partial else 0)
+
     def register(self, tokens: Sequence[int], page_ids: Sequence[int]
                  ) -> None:
         """Insert the full prompt pages of one finished prefill:

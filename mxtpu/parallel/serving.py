@@ -221,6 +221,10 @@ class ContinuousBatchingEngine:
         pool instead of the n-gram lookup (the verify side is
         identical).  Requires spec_k >= 1.
     draft_rules : ShardingRules for the draft model (default: ``rules``).
+    ledger_tag : optional per-replica compile-ledger label
+        (``serving.step@TAG`` — see ShardedDecoder); a multi-replica
+        pool (``mxtpu.serving``) tags each replica so per-replica
+        program families stay separable under ``compile_budget``.
     """
 
     def __init__(self, block, mesh: DeviceMesh,
@@ -232,9 +236,11 @@ class ContinuousBatchingEngine:
                  max_pending: Optional[int] = None, clock=None,
                  history: int = 1024, spec_k: int = 0,
                  spec_ngram: int = 3, draft_block=None,
-                 draft_rules: Optional[ShardingRules] = None):
+                 draft_rules: Optional[ShardingRules] = None,
+                 ledger_tag: Optional[str] = None):
         self._dec = ShardedDecoder(block, mesh, rules, cache_spec,
-                                   bucket_prefill)
+                                   bucket_prefill,
+                                   ledger_tag=ledger_tag)
         self._block = block
         self._mesh = mesh
         self._num_slots = int(num_slots)
@@ -297,7 +303,8 @@ class ContinuousBatchingEngine:
                     "speculation entirely (docs/inference.md)")
             ddec = ShardedDecoder(draft_block, mesh,
                                   draft_rules or rules, cache_spec,
-                                  bucket_prefill)
+                                  bucket_prefill,
+                                  ledger_tag=ledger_tag)
             if ddec._block_has_moe():
                 raise ValueError(
                     "draft_block must be a dense block: MoE decode "
@@ -348,8 +355,8 @@ class ContinuousBatchingEngine:
 
     def status(self, rid) -> str:
         """Lifecycle status of one request: ``queued`` / ``active`` /
-        ``ok`` / ``failed`` / ``expired`` (``unknown`` for a rid this
-        engine never issued)."""
+        ``ok`` / ``failed`` / ``expired`` / ``cancelled`` (``unknown``
+        for a rid this engine never issued)."""
         return self._status.get(rid, "unknown")
 
     def error(self, rid) -> Optional[dict]:
@@ -410,7 +417,13 @@ class ContinuousBatchingEngine:
             raise LoadShedError(
                 "admission queue full (%d pending >= max_pending=%d): "
                 "request shed — back off and resubmit"
-                % (len(self._queue), self._max_pending))
+                % (len(self._queue), self._max_pending),
+                queue_depth=len(self._queue), limit=self._max_pending,
+                # queued work drains ~num_slots requests per slot
+                # turnover: a deterministic host-counter estimate of
+                # iterations until a queue position frees
+                retry_after_ticks=max(
+                    1, -(-len(self._queue) // self._num_slots)))
         if self._prompt_dtype is None:
             self._prompt_dtype = prompt_ids.dtype
         rid = self._next_rid
@@ -1062,6 +1075,50 @@ class ContinuousBatchingEngine:
         drains everything at once)."""
         return self._results.pop(rid)
 
+    # -- external control (the multi-replica service layer rides these) --
+    def cancel(self, rid) -> bool:
+        """Cancel one non-terminal request NOW: a queued request
+        finishes immediately with status ``cancelled`` and an empty
+        output; an active one is evicted through the same idempotent
+        scrub/release path every terminal route uses (the paged engine
+        returns its pages to the pool) with its partial output.  Every
+        other in-flight stream is untouched — the same locality argument
+        as quarantine.  Returns False for unknown/terminal rids.  Used
+        by ``mxtpu.serving`` to retire hedge losers and drain dying
+        replicas deterministically."""
+        for i, req in enumerate(self._queue):
+            if req.rid == rid:
+                del self._queue[i]
+                self._finish(None, req, [], 0, status="cancelled")
+                return True
+        for i, slot in enumerate(self._slots):
+            if slot is not None and slot.req.rid == rid:
+                self._slots[i] = None
+                self._scrub_row(slot.row)
+                self._finish(None, slot.req, slot.emitted, slot.row,
+                             status="cancelled")
+                return True
+        return False
+
+    def prefix_probe(self, prompt_ids) -> int:
+        """Locality probe for a multi-replica router: how many of this
+        prompt's tokens THIS engine would skip prefilling if the
+        request were admitted right now.  The slot engine has no prefix
+        reuse, so it always reports 0 (routers fall back to pure load
+        balance); the paged engine walks its radix index and host tier
+        (read-only — see ``PrefixIndex.probe``)."""
+        return 0
+
+    def drop_cache(self) -> int:
+        """Release every CACHED page chain this engine holds beyond its
+        live requests (the paged engine's pinned tier, host tier, and
+        open sessions).  The replica-death drain path: after cancelling
+        all requests and dropping the cache, ``blocks_in_use`` must be
+        0 — nothing on a dead replica may keep pages.  Returns the
+        number of device pages freed (0 on the slot engine, which has
+        no cache tiers)."""
+        return 0
+
     # -- drain -----------------------------------------------------------
     def run(self):
         """Drain the queue and every active slot; returns {request id →
@@ -1194,6 +1251,15 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
         re-admit on a radix hit (``serving.swap_in``) through ONE
         bounded copy program.  Same forms; None reads
         ``MXTPU_HOST_CACHE_BYTES`` (default 0 = off).
+    overlap_swaps : defer host-tier RESTORES to the iteration boundary
+        (default False = restore synchronously inside admission): a
+        cold-chain admission whose prompt matches the host tier defers
+        one iteration, the pooled decode step runs first, and the
+        ``serving.swap_in`` copies land only after it — so in-flight
+        token streams never gap behind a restore (the copies overlap
+        the decode dispatch instead of preceding it).  Streams are
+        bit-identical either way; only the iteration the restore pays
+        in moves.
     """
 
     _supports_sessions = True
@@ -1210,11 +1276,14 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
                  prefill_chunk: int = 64, spec_k: int = 0,
                  spec_ngram: int = 3, draft_block=None,
                  draft_rules: Optional[ShardingRules] = None,
-                 pin_bytes=None, host_cache_bytes=None):
+                 pin_bytes=None, host_cache_bytes=None,
+                 overlap_swaps: bool = False,
+                 ledger_tag: Optional[str] = None):
         super().__init__(block, mesh, rules, num_slots, max_length,
                          cache_dtype, cache_spec, bucket_prefill,
                          max_pending, clock, history, spec_k,
-                         spec_ngram, draft_block, draft_rules)
+                         spec_ngram, draft_block, draft_rules,
+                         ledger_tag=ledger_tag)
         bs = int(block_size)
         chunk = int(prefill_chunk)
         if bs < 1:
@@ -1252,6 +1321,11 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
         self._swap_outs = 0             # pages spilled device -> host
         self._session_hits = 0
         self._prefill_tokens_avoided = 0
+        # -- overlapped swap-ins (docs/inference.md) ---------------------
+        self._overlap_swaps = bool(overlap_swaps)
+        self._swap_pending: Optional[Request] = None
+        self._swap_attempted: set = set()   # rids already deferred once
+        self._deferred_swap_ins = 0
 
     # -- introspection ---------------------------------------------------
     @property
@@ -1273,6 +1347,7 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
                                if self._hc is not None else 0),
             "swap_ins": self._swap_ins,
             "swap_outs": self._swap_outs,
+            "deferred_swap_ins": self._deferred_swap_ins,
             "session_hits": self._session_hits,
             "sessions_open": len(self._sessions),
             "prefill_tokens_avoided": self._prefill_tokens_avoided,
@@ -1516,6 +1591,47 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
             return 0
         return self._hc.close_session(sid)
 
+    def prefix_probe(self, prompt_ids) -> int:
+        """Paged locality probe (base docstring): the radix walk's hit
+        length plus — when a spilled chain would beat it — the host
+        tier's page-aligned match.  Read-only: no refcounts, no LRU
+        ticks, no restores; a router may call it on every replica per
+        dispatch."""
+        arr = prompt_ids.asnumpy() if isinstance(prompt_ids, NDArray) \
+            else onp.asarray(prompt_ids)
+        if arr.ndim != 2 or arr.shape[0] != 1:
+            raise ValueError("prefix_probe takes ONE prompt: (1, T), "
+                             "got %r" % (arr.shape,))
+        if self._dec._block_has_moe():
+            return 0            # MoE opts out of sharing entirely
+        Tp = arr.shape[1]
+        n = self._prefix.probe(arr[0], limit=Tp - 1)
+        if self._hc is not None and self._hc.host_chains:
+            m = self._hc.host_match(arr[0], limit=Tp - 1)
+            if m is not None:
+                n = max(n, m[1] * self._bs)
+        return n
+
+    def drop_cache(self) -> int:
+        """Release BOTH cache tiers and every open session (base
+        docstring — the replica-death drain path).  Pinned chains drop
+        without a host copy (a dead replica's host arrays die with it),
+        sessions close, and the prefix index entries evict through the
+        pool's on_free hook as the pages return."""
+        self._sessions.clear()
+        self._swap_pending = None
+        self._swap_attempted.clear()
+        if self._hc is None:
+            return 0
+        freed = 0
+        for chain in list(self._hc._chains.values()):
+            before = self._bp.free_count
+            self._hc.drop_chain(chain)
+            freed += self._bp.free_count - before
+        for host in list(self._hc._host.values()):
+            self._hc.drop_host(host)
+        return freed
+
     def _release_row(self, row):
         """Drop row's page references (idempotent — every terminal path
         funnels here); last-reference pages return to the free list and
@@ -1533,6 +1649,10 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
 
     def _finish(self, slot_idx_or_none, req, emitted, row, status="ok"):
         super()._finish(slot_idx_or_none, req, emitted, row, status)
+        # every terminal path funnels here: a deferred-swap rid that
+        # ends (cancel, deadline, shed-fail) must not pin the
+        # attempted-set forever
+        self._swap_attempted.discard(req.rid)
         if slot_idx_or_none is not None:
             if status == "ok" and self._hierarchy_on():
                 # pin BEFORE the release below so the chain's pages
@@ -1619,7 +1739,9 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
                 raise LoadShedError(
                     "request needs %d page(s) > pool capacity %d "
                     "(block_size=%d): can never be admitted — shed"
-                    % (need, self._bp.capacity, self._bs))
+                    % (need, self._bp.capacity, self._bs),
+                    queue_depth=len(self._queue), limit=self._bp.capacity,
+                    retry_after_ticks=None, permanent=True)
         rid = super().submit(pids, max_new_tokens, temperature, top_k,
                              top_p, repetition_penalty, seed, eos_id,
                              deadline_s, retries, speculative,
@@ -1645,7 +1767,20 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
             _inject("serving.prefix_lookup", key=req.rid)
             full, partial = self._prefix.lookup(req.prompt[0],
                                                 limit=Tp - 1)
-            if self._try_swap_in(req, full):
+            if self._overlap_swaps:
+                # overlapped mode: restores run ONLY at the iteration
+                # boundary (_service_pending_swap) — a cold-chain
+                # admission defers once, the decode step runs first,
+                # and the next iteration's lookup sees the restored
+                # pages in the device index like any other hit
+                if (req.rid not in self._swap_attempted
+                        and self._hc is not None
+                        and self._hc.host_chains):
+                    m = self._hc.host_match(req.prompt[0], limit=Tp - 1)
+                    if m is not None and m[1] > len(full):
+                        self._swap_pending = req
+                        raise _AdmissionDeferred()
+            elif self._try_swap_in(req, full):
                 # re-walk the index whenever the swap-in path touched
                 # the pool: a restore ADDS pages, and the reclaim
                 # inside a restore attempt (even a failed one) may have
@@ -1700,6 +1835,7 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
         slot = _PagedSlot(req, slot_idx, Tp, chunks, cow)
         self._slots[slot_idx] = slot
         self._status[req.rid] = "active"
+        self._swap_attempted.discard(req.rid)   # bounded bookkeeping
         try:
             self._advance_prefill(slot_idx)
         except Exception:
@@ -1856,7 +1992,42 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
                 active.remove(i)
         if active:
             self._decode_active(active)
+        self._service_pending_swap()
         return [r for r in self._results if r not in finished_before]
+
+    def _service_pending_swap(self):
+        """Iteration-boundary tail of ``overlap_swaps=True``: run the
+        host-tier restore a cold-chain admission deferred — AFTER the
+        pooled decode step above, so in-flight streams already emitted
+        this iteration's tokens (no token gap; asserted by counters in
+        tests).  The deferred request sits back at the queue head; the
+        next iteration's admission re-walks the device index and shares
+        the restored pages like any other prefix hit.  A
+        ``serving.swap_in`` fault here quarantines only the deferred
+        request (retries re-defer and re-attempt the restore,
+        bit-identically); each rid defers at most once per attempt, so
+        run()'s convergence guard holds."""
+        req = self._swap_pending
+        if req is None:
+            return
+        self._swap_pending = None
+        self._swap_attempted.add(req.rid)
+        if all(q.rid != req.rid for q in self._queue):
+            return      # evicted (deadline/cancel) while deferred
+        full, _ = self._prefix.lookup(req.prompt[0],
+                                      limit=req.prompt.shape[1] - 1)
+        try:
+            if self._try_swap_in(req, full):
+                self._deferred_swap_ins += 1
+        except Exception as exc:
+            # the admission-fault contract, minus the row scrub —
+            # nothing was allocated to a row yet (the request never
+            # left the queue)
+            self._queue = [q for q in self._queue if q.rid != req.rid]
+            self._swap_attempted.discard(req.rid)  # retries re-attempt
+            self._quarantined += 1
+            _bump("quarantined_slots")
+            self._requeue_or_fail(req, exc, "serving.admit")
 
     # -- drain -----------------------------------------------------------
     def run(self):

@@ -37,7 +37,7 @@ from .retry import RetryPolicy
 __all__ = [
     "FaultPlan", "FaultRule", "InjectedFault", "fault_plan", "inject",
     "active_plan", "site_stats", "reload_env_plan", "SITES",
-    "RetryPolicy", "LoadShedError",
+    "RetryPolicy", "LoadShedError", "QosShedError", "EngineShedError",
     "bump", "counters", "reset_counters",
     "CheckpointSet", "CorruptCheckpointError", "write_verified",
     "verify", "verify_dir", "rotate_history",
@@ -49,4 +49,44 @@ class LoadShedError(MXTPUError):
     """Typed rejection raised by bounded admission: the serving queue is
     at ``max_pending`` and the engine sheds the request instead of
     growing the queue without bound.  Callers catch this to back off or
-    route elsewhere; it never poisons in-flight work."""
+    route elsewhere; it never poisons in-flight work.
+
+    Structured context (attributes, all optional — the message alone
+    made caller backoff policies guesswork):
+
+    - ``queue_depth``: pending requests at shed time;
+    - ``limit``: the bound that tripped (``max_pending``, a QoS queue
+      bound, a tenant quota, a page-pool capacity);
+    - ``retry_after_ticks``: suggested backoff before resubmitting, in
+      scheduler iterations (deterministic — a host-counter estimate of
+      when capacity frees, never a wall-clock guess), or None when
+      retrying cannot help;
+    - ``permanent``: True when no amount of backoff can admit THIS
+      request (e.g. it needs more pages than the whole pool) — callers
+      must not retry it.
+    """
+
+    def __init__(self, message, queue_depth=None, limit=None,
+                 retry_after_ticks=None, permanent=False):
+        super().__init__(message)
+        self.queue_depth = queue_depth
+        self.limit = limit
+        self.retry_after_ticks = retry_after_ticks
+        self.permanent = bool(permanent)
+
+
+class QosShedError(LoadShedError):
+    """The GATEWAY shed this request by QoS policy — its class lost to
+    higher-priority traffic (queue full, a lower class was displaced,
+    or a per-tenant quota tripped) while the engines below may be
+    perfectly healthy.  Back off ``retry_after_ticks`` and resubmit
+    (possibly at a higher class); see ``mxtpu.serving.Gateway``."""
+
+
+class EngineShedError(LoadShedError):
+    """An ENGINE-level shed surfaced through the gateway: the replica's
+    own admission refused the request (most often ``permanent=True`` —
+    it can never fit the replica's page pool), as opposed to the
+    gateway's QoS policy.  Distinct from :class:`QosShedError` so
+    caller backoff policies can tell "try again later / raise my
+    class" from "this request is malformed for this deployment"."""

@@ -51,6 +51,28 @@ site                fires at
                     (``ContinuousBatchingEngine._decode_verify``) —
                     same per-slot quarantine contract as
                     ``serving.step``
+``gateway.admit``   start of ``mxtpu.serving.Gateway.submit``, keyed by
+                    the gateway request id — a raise models a poisoned
+                    admission path: the request is rejected before any
+                    queue/quota state changes
+``router.dispatch`` once per dispatch ATTEMPT in
+                    ``mxtpu.serving.Router.dispatch``, keyed by the
+                    gateway request id, after replica selection but
+                    before the replica submit — a raised
+                    ``ReplicaDownError`` exercises the typed reroute
+                    path (RetryPolicy retries exclude the failed
+                    replica)
+``replica.health``  once per ALIVE replica per supervisor tick, keyed
+                    by replica id, at the start of the health check
+                    (``mxtpu.serving.InProcessReplica.health``) — a
+                    raise is one failed probe; ``fail_threshold``
+                    consecutive failures declare the replica dead and
+                    drain-and-requeue its requests
+``replica.stream``  once per alive replica per supervisor tick, keyed
+                    by replica id, BEFORE its newly decoded tokens are
+                    polled (``InProcessReplica.poll``) — a raise models
+                    a broken token stream and counts toward the same
+                    consecutive-failure death as ``replica.health``
 ``kvstore.reduce``  inside the (retried) cross-worker reduce of
                     ``KVStore.push`` / ``pushpull``
 ``checkpoint.save`` inside the preemption save callback
@@ -121,6 +143,8 @@ __all__ = ["InjectedFault", "FaultRule", "FaultPlan", "fault_plan",
 SITES = ("serving.step", "serving.admit", "serving.prefix_lookup",
          "serving.block_alloc", "serving.swap_out", "serving.swap_in",
          "serving.draft", "serving.verify",
+         "gateway.admit", "router.dispatch", "replica.health",
+         "replica.stream",
          "kvstore.reduce", "checkpoint.save", "engine.flush",
          "guardian.check", "ckpt.write", "ckpt.verify")
 

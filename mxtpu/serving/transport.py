@@ -1,0 +1,347 @@
+"""Replica transports: the seam between the service layer and one
+engine replica.
+
+The supervisor/router/gateway above never touch an engine directly —
+they speak :class:`ReplicaTransport`, a small imperative protocol
+(submit / step / poll / health / cancel / drain / prefix_probe).  Today
+the only implementation is :class:`InProcessReplica`, which adapts one
+``ContinuousBatchingEngine`` / ``PagedContinuousBatchingEngine``
+instance in this process; the protocol is the seam where a
+process-per-replica or ICI/DCN transport (PAPER.md layer 3, the
+KVStore ``dist_tpu_sync`` heritage) slots in without the service layer
+changing — everything a remote transport needs is already host-side
+data (token ids, specs, counters), never device arrays.
+
+Determinism: a transport call never consults a clock or randomness.
+``poll()`` materializes newly decoded tokens in slot order, ``drain()``
+returns tags in submission order, and the two fault sites
+(``replica.health`` keyed by replica id in :meth:`health`,
+``replica.stream`` keyed by replica id in :meth:`poll`) are
+counter-driven like every site in ``mxtpu.resilience.faults`` — a
+replica death replays bit-for-bit.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as onp
+
+from ..base import MXTPUError
+from ..ndarray import NDArray, array as nd_array
+from ..parallel.serving import _SpecTokens
+from ..resilience.faults import inject as _inject
+
+__all__ = ["ReplicaDownError", "ReplicaTransport", "InProcessReplica",
+           "request_spec"]
+
+#: engine-submit keyword names a request spec may carry (the seed is
+#: part of the spec, which is what makes a drained request's requeue
+#: restart bit-identically on another replica)
+SPEC_KEYS = ("max_new_tokens", "temperature", "top_k", "top_p",
+             "repetition_penalty", "seed", "eos_id", "retries",
+             "speculative")
+
+
+def request_spec(prompt_ids, max_new_tokens, **kw) -> dict:
+    """Normalize one request into the host-side spec the service layer
+    re-dispatches from: the prompt as (1, Tp) int32 numpy plus the
+    engine-submit sampling/seed knobs.  A spec is pure host data — the
+    unit of drain-and-requeue and of hedged duplication."""
+    arr = prompt_ids.asnumpy() if isinstance(prompt_ids, NDArray) \
+        else onp.asarray(prompt_ids)
+    if arr.ndim != 2 or arr.shape[0] != 1:
+        raise ValueError(
+            "request spec takes ONE prompt: (1, T_prompt), got %r"
+            % (arr.shape,))
+    bad = sorted(set(kw) - set(SPEC_KEYS))
+    if bad:
+        raise ValueError("unknown request-spec key(s) %r (valid: %r)"
+                         % (bad, SPEC_KEYS))
+    spec = {"prompt": onp.asarray(arr, dtype=onp.int32),
+            "max_new_tokens": int(max_new_tokens)}
+    spec.update(kw)
+    return spec
+
+
+class ReplicaDownError(MXTPUError):
+    """A dispatch/submit reached a replica that is not accepting work
+    (declared dead by the supervisor, or no alive replica exists).
+    Typed so the router's reroute path can retry OTHER replicas under a
+    ``RetryPolicy(retry_on=(ReplicaDownError,))`` while every other
+    exception propagates."""
+
+
+class ReplicaTransport:
+    """Protocol one replica speaks (module docstring).  Subclasses
+    implement everything; the base class only documents the contract
+    and provides the shared ``alive`` flag the supervisor flips."""
+
+    #: stable identifier ("r0", "r1", ... for pool-built replicas);
+    #: fault-plan keys and router/ledger labels use it
+    replica_id: str = "r?"
+    #: flipped False by the supervisor on declared death; transports
+    #: refuse new work while down
+    alive: bool = True
+
+    # -- capacity / placement signals ------------------------------------
+    @property
+    def capacity(self) -> int:
+        """Concurrent request slots this replica can decode."""
+        raise NotImplementedError
+
+    @property
+    def load(self) -> int:
+        """Requests currently held (active + queued)."""
+        raise NotImplementedError
+
+    @property
+    def free_slots(self) -> int:
+        raise NotImplementedError
+
+    def prefix_probe(self, prompt) -> int:
+        """Prompt tokens this replica's caches would skip prefilling
+        (read-only; the router's locality signal)."""
+        raise NotImplementedError
+
+    # -- work ------------------------------------------------------------
+    def submit(self, spec: dict, tag) -> Any:
+        """Queue one request spec under an opaque ``tag`` (the
+        gateway's request id); raises :class:`ReplicaDownError` when
+        not alive."""
+        raise NotImplementedError
+
+    def step(self) -> None:
+        """Advance the replica one scheduler iteration."""
+        raise NotImplementedError
+
+    def poll(self) -> Tuple[Dict[Any, List[int]],
+                            List[Tuple[Any, str, Optional[NDArray],
+                                       Optional[dict]]],
+                            List[Any]]:
+        """Collect progress since the last poll: ``(tokens, finished,
+        restarts)`` where ``tokens`` maps tag -> newly decoded token
+        ids (stream order), ``finished`` lists ``(tag, status, result,
+        error_record)`` for requests that went terminal (error_record
+        is the engine's last error dict for failed requests, None
+        otherwise), and ``restarts`` lists tags whose request the
+        ENGINE restarted from scratch (quarantine + retry) — their
+        already-streamed tokens are void and the stream replays from
+        token 0 (for an unseeded sampled request the retry redraws, so
+        mixing attempts would corrupt the stream).  Fires
+        ``replica.stream``."""
+        raise NotImplementedError
+
+    def health(self) -> None:
+        """One health probe; raises on an unhealthy replica.  Fires
+        ``replica.health``."""
+        raise NotImplementedError
+
+    def progress(self) -> tuple:
+        """A host-counter tuple that changes whenever the replica makes
+        ANY forward progress (decode steps, tokens, prefill chunks,
+        completions) — the supervisor's stall detector compares
+        consecutive values, never timestamps."""
+        raise NotImplementedError
+
+    def cancel(self, tag) -> bool:
+        """Retire one request (hedge loser / gateway deadline); its
+        partial work is released idempotently."""
+        raise NotImplementedError
+
+    def drain(self) -> List[Any]:
+        """Death path: cancel every held request, release all cache
+        tiers, and return the tags (submission order) for requeueing
+        elsewhere.  After drain the replica holds zero pages."""
+        raise NotImplementedError
+
+
+class InProcessReplica(ReplicaTransport):
+    """ReplicaTransport over one engine instance in this process.
+
+    The adapter owns the tag <-> engine-rid mapping and the per-request
+    streamed-token cursors; the engine keeps its own semantics
+    (quarantine, deadlines, speculation) untouched — an engine-level
+    per-slot fault is the ENGINE's failure path (that request retries
+    or fails), while an exception escaping :meth:`health` /
+    :meth:`step` / :meth:`poll` is a REPLICA-level signal the
+    supervisor counts toward declared death.
+    """
+
+    def __init__(self, engine, replica_id: str = "r0"):
+        self._eng = engine
+        self.replica_id = str(replica_id)
+        self.alive = True
+        self._tags: Dict[int, Any] = {}        # engine rid -> tag
+        self._cursor: Dict[int, List[int]] = {}  # rid -> [entries, toks]
+
+    @property
+    def engine(self):
+        return self._eng
+
+    # -- capacity / placement signals ------------------------------------
+    @property
+    def capacity(self) -> int:
+        return self._eng.num_slots
+
+    @property
+    def load(self) -> int:
+        return self._eng.active + self._eng.pending
+
+    @property
+    def free_slots(self) -> int:
+        return self._eng.free_slots
+
+    def prefix_probe(self, prompt) -> int:
+        return self._eng.prefix_probe(onp.asarray(prompt))
+
+    def stats(self) -> dict:
+        return dict(self._eng.stats)
+
+    # -- work ------------------------------------------------------------
+    def submit(self, spec: dict, tag) -> int:
+        if not self.alive:
+            raise ReplicaDownError(
+                "replica %s is down: submit refused" % self.replica_id)
+        kw = {k: spec[k] for k in SPEC_KEYS if k in spec}
+        rid = self._eng.submit(nd_array(spec["prompt"]),
+                               kw.pop("max_new_tokens"), **kw)
+        self._tags[rid] = tag
+        # [emitted entries consumed, tokens streamed, prompt length,
+        #  the slot object last streamed from] — the slot reference is
+        # the attempt-identity marker: an engine-level retry admits a
+        # FRESH slot, so identity (not counts, which a re-decoded
+        # retry can make equal) detects restarts
+        self._cursor[rid] = [0, 0, int(spec["prompt"].shape[1]), None]
+        return rid
+
+    def step(self) -> None:
+        if self._eng.pending or self._eng.active:
+            self._eng.step()
+
+    def _slot_of(self, rid):
+        for slot in self._eng._slots:
+            if slot is not None and slot.req.rid == rid:
+                return slot
+        return None
+
+    def _new_tokens(self, rid, slot) -> List[int]:
+        """Materialize the entries appended to ``slot.emitted`` since
+        the last poll (pooled (B,) device vectors cost one host read
+        per entry; speculative entries are already host ints)."""
+        import jax
+
+        cur = self._cursor[rid]
+        out: List[int] = []
+        for entry in slot.emitted[cur[0]:]:
+            if isinstance(entry, _SpecTokens):
+                out.extend(int(t) for t in entry.toks)
+            else:
+                out.append(int(jax.device_get(entry[slot.row])))
+        cur[0] = len(slot.emitted)
+        cur[1] += len(out)
+        return out
+
+    def poll(self):
+        _inject("replica.stream", key=self.replica_id)
+        tokens: Dict[Any, List[int]] = {}
+        finished: List[Tuple[Any, str, Optional[NDArray],
+                             Optional[dict]]] = []
+        restarts: List[Any] = []
+        for rid in list(self._tags):
+            st = self._eng.status(rid)
+            if st == "queued":
+                cur = self._cursor[rid]
+                if cur[0] or cur[1]:
+                    # the engine quarantined and re-queued this request
+                    # (its retries=): the restart is from scratch, so
+                    # everything streamed so far is void
+                    self._cursor[rid] = [0, 0, cur[2], None]
+                    restarts.append(self._tags[rid])
+                continue
+            if st == "active":
+                slot = self._slot_of(rid)
+                if slot is not None:
+                    cur = self._cursor[rid]
+                    if cur[3] is not None and cur[3] is not slot:
+                        # a restart that re-admitted between polls (a
+                        # health blip skipped the tick that would have
+                        # observed it queued): a fresh slot OBJECT is
+                        # a fresh attempt, even if it has re-decoded
+                        # exactly as many entries as we had consumed
+                        if cur[0] or cur[1]:
+                            restarts.append(self._tags[rid])
+                        cur[0] = cur[1] = 0
+                    cur[3] = slot
+                if slot is not None and slot.emitted:
+                    new = self._new_tokens(rid, slot)
+                    if new:
+                        tokens[self._tags[rid]] = new
+                continue
+            # terminal: flush the un-streamed tail of the final output,
+            # then hand the result over (pops the engine's record)
+            tag = self._tags.pop(rid)
+            cur = self._cursor.pop(rid)
+            res = self._eng.take_result(rid)
+            seq = onp.asarray(res.asnumpy())[0]
+            tail = [int(t) for t in seq[cur[2] + cur[1]:]]
+            if tail:
+                tokens.setdefault(tag, []).extend(tail)
+            finished.append((tag, st, res, self._eng.error(rid)))
+        return tokens, finished, restarts
+
+    def health(self) -> None:
+        _inject("replica.health", key=self.replica_id)
+        # cheap invariant probe: the stats snapshot must be readable
+        # and internally consistent (a wedged/corrupt engine raises)
+        st = self._eng.stats
+        if st["steps"] < 0:
+            raise MXTPUError("replica %s: corrupt stats %r"
+                             % (self.replica_id, st))
+
+    def progress(self) -> tuple:
+        st = self._eng.stats
+        chunks = sum(getattr(s, "chunk_i", 0)
+                     for s in self._eng._slots if s is not None)
+        return (st["steps"], st["tokens_generated"], st["quarantined"],
+                len(self._eng._done), chunks)
+
+    def cancel(self, tag) -> bool:
+        rid = next((r for r, t in self._tags.items() if t == tag), None)
+        if rid is None:
+            return False
+        self._tags.pop(rid, None)
+        self._cursor.pop(rid, None)
+        if self._eng.cancel(rid):
+            self._eng.take_result(rid)      # discard the partial
+            return True
+        if self._eng.status(rid) in ("ok", "failed", "expired",
+                                     "cancelled"):
+            self._eng.take_result(rid)      # raced its own finish
+        return False
+
+    def drain(self) -> List[Any]:
+        # the tags come FIRST and the engine calls are best-effort: a
+        # replica is usually drained precisely because its engine is
+        # broken, and a raise here must never lose the tag list (the
+        # requests requeue elsewhere either way; a wedged engine's
+        # pages die with its process)
+        tags = [self._tags[rid] for rid in sorted(self._tags)]
+        for rid in sorted(self._tags):
+            try:
+                if self._eng.cancel(rid):
+                    self._eng.take_result(rid)
+                elif rid in self._eng._results:
+                    # finished between the last poll and death: never
+                    # delivered — requeue it like the rest (the
+                    # restart is bit-identical from the seed)
+                    self._eng.take_result(rid)
+            except Exception:  # noqa: BLE001 — best-effort cleanup
+                pass
+        self._tags.clear()
+        self._cursor.clear()
+        try:
+            self._eng.drop_cache()
+        except Exception:  # noqa: BLE001
+            pass
+        return tags

@@ -1,0 +1,713 @@
+"""Fault-tolerant multi-replica serving (ISSUE 13 tentpole): the
+supervised replica pool + prefix-locality router + QoS gateway of
+``mxtpu.serving``.
+
+The acceptance invariant mirrors the engines' own: ANY stream that
+completes through the service layer — routed by locality, hedged,
+rerouted after a ``router.dispatch`` fault, requeued after a mid-decode
+replica death — is BIT-IDENTICAL to an isolated
+``ShardedDecoder.generate`` with the same seed, and a dead replica
+holds zero pages after its drain.  Every failure path is driven by the
+counter-clock fault plans (``gateway.admit``, ``router.dispatch``,
+``replica.health``, ``replica.stream`` — no wall clocks, so every
+scenario replays bit-for-bit).
+
+Compile discipline: THREE module-scoped paged engines (ledger tags
+r0/r1/r2) serve every pool test — gateways are cheap per-test wrappers
+(host bookkeeping only), so the compiled-program families stay one per
+replica and the per-replica ledger sites are themselves asserted."""
+
+import numpy as np
+import pytest
+
+import mxtpu as mx
+from mxtpu import nd
+from mxtpu.models.transformer import (llama_tiny,
+                                      transformer_lm_sharding_rules)
+from mxtpu.parallel import (ContinuousBatchingEngine,
+                            PagedContinuousBatchingEngine,
+                            ShardedDecoder, make_mesh)
+from mxtpu.resilience import (EngineShedError, LoadShedError,
+                              QosShedError, fault_plan)
+from mxtpu.serving import (Gateway, InProcessReplica, ReplicaDownError,
+                           ReplicaSupervisor, ReplicaTransport,
+                           replica_pool)
+
+MAXLEN = 32
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    mx.random.seed(77)
+    net = llama_tiny(vocab_size=50)
+    net.initialize()
+    return net
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    return make_mesh(dp=1)
+
+
+@pytest.fixture(scope="module")
+def isolated(tiny, mesh):
+    return ShardedDecoder(tiny, mesh, transformer_lm_sharding_rules())
+
+
+@pytest.fixture(scope="module")
+def engines(tiny, mesh):
+    """The pool's three engines, compiled once for the whole module
+    (gateway/supervisor/router state is per-test host bookkeeping)."""
+    rules = transformer_lm_sharding_rules()
+    return [PagedContinuousBatchingEngine(
+        tiny, mesh, rules, num_slots=2, max_length=MAXLEN,
+        block_size=8, prefill_chunk=8, pin_bytes="1MiB",
+        ledger_tag="r%d" % i) for i in range(3)]
+
+
+def _gw(engines, n=2, **kw):
+    """Fresh gateway over the first n module engines (new transports,
+    so alive flags / tag maps never leak across tests)."""
+    return Gateway(engines[:n], **kw)
+
+
+def _prompts(seed, lengths, vocab=50):
+    rng = np.random.RandomState(seed)
+    return [nd.array(rng.randint(0, vocab, (1, t)), dtype="int32")
+            for t in lengths]
+
+
+def _want(isolated, p, n, **kw):
+    return isolated.generate(p, max_new_tokens=n, max_length=MAXLEN,
+                             **kw).asnumpy()
+
+
+def _assert_clean(engines, n=2):
+    for eng in engines[:n]:
+        st = eng.stats
+        assert st["blocks_in_use"] == 0 or st["pinned_blocks"] > 0, st
+
+
+# ---------------------------------------------------------------- basics
+
+def test_gateway_parity_and_streaming_fast_anchor(engines, isolated):
+    """The fast bit-exact anchor: greedy, seeded-sampled and penalized
+    requests through a 2-replica gateway all match their isolated
+    references; the token stream equals the final output; TTFT ticks
+    are recorded; no pages leak."""
+    gw = _gw(engines)
+    p1, p2, p3 = _prompts(3, (5, 7, 4))
+    r1 = gw.submit(p1, 6)
+    r2 = gw.submit(p2, 5, temperature=0.8, seed=11)
+    r3 = gw.submit(p3, 4, repetition_penalty=1.3)
+    res = gw.run()
+    np.testing.assert_array_equal(res[r1].asnumpy(),
+                                  _want(isolated, p1, 6))
+    np.testing.assert_array_equal(
+        res[r2].asnumpy(),
+        _want(isolated, p2, 5, temperature=0.8, seed=11))
+    np.testing.assert_array_equal(
+        res[r3].asnumpy(),
+        _want(isolated, p3, 4, repetition_penalty=1.3))
+    # streamed tokens == the generated suffix of the final output
+    assert gw.streamed(r1) == [int(t)
+                               for t in res[r1].asnumpy()[0, 5:]]
+    assert r1 in gw.stats["ttft_ticks"]
+    assert gw.status(r2) == "ok"
+    for eng in engines[:2]:
+        assert eng.stats["blocks_in_use"] >= 0
+    # only cached (pinned) pages may remain resident
+    for eng in engines[:2]:
+        st = eng.stats
+        assert st["blocks_in_use"] == st["pinned_blocks"], st
+
+
+def test_stream_generator_yields_tokens_as_they_decode(engines,
+                                                       isolated):
+    gw = _gw(engines)
+    (p,) = _prompts(9, (6,))
+    rid = gw.submit(p, 6)
+    events = list(gw.stream(rid))
+    toks = [t for ev in events for t in (ev[1] if ev[0] == "tokens"
+                                         else [])]
+    assert all(ev[0] in ("tokens", "reset") for ev in events)
+    assert not any(ev[0] == "reset" for ev in events)  # fault-free
+    want = _want(isolated, p, 6)
+    np.testing.assert_array_equal(gw.result(rid).asnumpy(), want)
+    assert toks == [int(t) for t in want[0, 6:]]
+    # several separate yields — tokens arrived per pump, not at the end
+    assert sum(1 for ev in events if ev[0] == "tokens") > 1
+
+
+def test_router_prefers_prefix_locality_over_round_robin(engines,
+                                                         isolated):
+    """Warm one replica with a prompt, then repeat-submit prefixed
+    requests: the locality router lands every one on the warm replica
+    (prefill skipped), while a round-robin control spreads them."""
+    rng = np.random.RandomState(21)
+    base = rng.randint(0, 50, (1, 16))
+    gw = _gw(engines)
+    r0 = gw.submit(nd.array(base, dtype="int32"), 4)
+    gw.run()
+    warmed = [eng.stats["prefill_tokens_avoided"]
+              for eng in engines[:2]]
+    reps = [nd.array(np.concatenate(
+        [base, rng.randint(0, 50, (1, 2))], axis=1), dtype="int32")
+        for _ in range(3)]
+    rids = [gw.submit(p, 3) for p in reps]
+    res = gw.run()
+    for rid, p in zip(rids, reps):
+        np.testing.assert_array_equal(res[rid].asnumpy(),
+                                      _want(isolated, p, 3))
+    after = [eng.stats["prefill_tokens_avoided"]
+             for eng in engines[:2]]
+    gained = [a - b for a, b in zip(after, warmed)]
+    # every repeat hit the SAME warm replica's cached pages
+    assert sorted(gained)[0] == 0 and sorted(gained)[1] >= 3 * 16, gained
+    assert gw.router.stats["locality_hits"] >= 3
+    assert gw.router.stats["prefix_hit_rate"] > 0.5
+    # round-robin control: placement alternates blindly
+    gw_rr = Gateway(engines[:2], router="round_robin")
+    rids = [gw_rr.submit(p, 3) for p in reps[:2]]
+    res = gw_rr.run()
+    for rid, p in zip(rids, reps[:2]):
+        np.testing.assert_array_equal(res[rid].asnumpy(),
+                                      _want(isolated, p, 3))
+    assert gw_rr.router.stats["policy"] == "round_robin"
+
+
+def test_per_replica_ledger_sites_stay_bounded(engines):
+    """The ledger tag keeps each replica's program family separable:
+    after everything this module compiled so far, each tagged site
+    holds the same bounded family a single engine would (prefill
+    buckets + one step + one swap)."""
+    from mxtpu.analysis import get_ledger
+
+    counts = get_ledger().miss_counts(("serving.*",))
+    for tag in ("@r0", "@r1"):
+        fam = {s: n for s, n in counts.items() if s.endswith(tag)}
+        assert fam, counts
+        assert sum(fam.values()) <= 3 + 1 + 1, fam
+
+
+# ------------------------------------------------- replica death / drain
+
+def test_replica_death_mid_decode_drains_and_requeues_bit_exact(
+        engines, isolated):
+    """THE acceptance scenario: a deterministic ``replica.health``
+    plan kills one replica mid-decode; its in-flight requests drain,
+    requeue from their seeds onto the survivor, and EVERY stream —
+    drained and untouched alike — completes bit-identical to the
+    fault-free run; the dead replica holds zero pages; a rerun under
+    the same plan reproduces the outputs bit-for-bit."""
+    p1, p2, p3, p4 = _prompts(31, (5, 9, 6, 4))
+    want = [_want(isolated, p1, 8),
+            _want(isolated, p2, 7, temperature=0.7, seed=5),
+            _want(isolated, p3, 6),
+            _want(isolated, p4, 5, repetition_penalty=1.2)]
+
+    def drive():
+        gw = _gw(engines, fail_threshold=2)
+        rids = [gw.submit(p1, 8),
+                gw.submit(p2, 7, temperature=0.7, seed=5),
+                gw.submit(p3, 6),
+                gw.submit(p4, 5, repetition_penalty=1.2)]
+        with fault_plan(
+                "replica.health#r1@3x2:raise=OSError(dead-host)") as pl:
+            res = gw.run()
+        assert pl.stats()["replica.health"]["fired"] == 2
+        return gw, rids, res
+
+    gw, rids, res = drive()
+    for rid, w in zip(rids, want):
+        assert gw.status(rid) == "ok"
+        np.testing.assert_array_equal(res[rid].asnumpy(), w)
+    sup = gw.stats["supervisor"]
+    assert sup["deaths"] == 1
+    assert gw.stats["requeued_requests"] >= 1
+    dead = gw.supervisor.replica("r1")
+    assert not dead.alive
+    st = dead.stats()
+    assert st["blocks_in_use"] == 0 and st["pinned_blocks"] == 0, st
+    assert st["sessions_open"] == 0
+    # rerun determinism: same engines, fresh gateway, same plan
+    gw2, rids2, res2 = drive()
+    for rid, w in zip(rids2, want):
+        np.testing.assert_array_equal(res2[rid].asnumpy(), w)
+    assert gw2.stats["supervisor"]["deaths"] == 1
+
+
+def test_stream_fault_transient_blip_vs_death(engines, isolated):
+    """One ``replica.stream`` failure below fail_threshold never kills
+    a replica (streams unaffected); consecutive failures at the
+    threshold do — and the drained request still completes
+    bit-identical via the survivor."""
+    (p,) = _prompts(41, (6,))
+    want = _want(isolated, p, 6)
+    gw = _gw(engines, fail_threshold=2)
+    rid = gw.submit(p, 6)
+    with fault_plan("replica.stream#r0@2:raise=OSError(torn)"):
+        res = gw.run()
+    np.testing.assert_array_equal(res[rid].asnumpy(), want)
+    assert gw.stats["supervisor"]["deaths"] == 0
+    gw = _gw(engines, fail_threshold=2)
+    rid = gw.submit(p, 6)
+    with fault_plan("replica.stream#r0@2x2:raise=OSError(torn)"):
+        res = gw.run()
+    np.testing.assert_array_equal(res[rid].asnumpy(), want)
+    assert gw.stats["supervisor"]["deaths"] in (0, 1)  # r0 only dies
+    # if it was serving the request; either way the stream is exact
+
+
+def test_streaming_reset_after_replica_death(engines, isolated):
+    """A stream interrupted by its replica's death emits a reset and
+    replays from the new dispatch: post-reset tokens == the complete
+    fault-free stream."""
+    (p,) = _prompts(43, (5,))
+    want = _want(isolated, p, 8)
+    gw = _gw(engines, fail_threshold=1)
+    rid = gw.submit(p, 8)
+    toks, resets = [], 0
+    with fault_plan("replica.health#r0@4:raise=OSError(died)"):
+        for ev in gw.stream(rid):
+            if ev[0] == "tokens":
+                toks.extend(ev[1])
+            else:
+                toks, resets = [], resets + 1
+    np.testing.assert_array_equal(gw.result(rid).asnumpy(), want)
+    assert toks == [int(t) for t in want[0, 5:]]
+    # the fault may land before or after r0 started serving this rid;
+    # when it did serve it, the client saw exactly one reset
+    assert resets == gw._reqs[rid].resets
+
+
+def test_engine_retry_resets_stream_not_mixed(engines, isolated):
+    """An ENGINE-level quarantine + retry restarts the request from
+    scratch; the gateway stream must reset rather than mix the two
+    attempts' tokens (an unseeded sampled retry redraws).  Post-reset
+    stream == the final output's generated suffix exactly."""
+    (p,) = _prompts(107, (5,))
+    gw = _gw(engines)
+    rid = gw.submit(p, 6, temperature=0.9, engine_retries=1)
+    toks, resets = [], 0
+    # key the fault to the ENGINE rid the dispatch will get; every
+    # engine counts rids from its own sequence, so fire on any rid at
+    # the 3rd step-site hit of this request's stream instead
+    with fault_plan("serving.step@3:raise=RuntimeError(mid-decode)"):
+        for ev in gw.stream(rid):
+            if ev[0] == "tokens":
+                toks.extend(ev[1])
+            else:
+                toks, resets = [], resets + 1
+    assert gw.status(rid) == "ok"
+    out = gw.result(rid).asnumpy()
+    assert toks == [int(t) for t in out[0, 5:]]
+    assert resets >= 1          # the restart was surfaced, not mixed
+
+
+def test_revive_after_probation_rejoins_pool(engines, isolated):
+    (p,) = _prompts(47, (5,))
+    gw = _gw(engines, fail_threshold=1, revive_after_ticks=3)
+    rid = gw.submit(p, 6)
+    with fault_plan("replica.health#r0@2:raise=OSError(blip)"):
+        res = gw.run()
+    np.testing.assert_array_equal(res[rid].asnumpy(),
+                                  _want(isolated, p, 6))
+    st = gw.stats["supervisor"]
+    assert st["deaths"] == 1 and st["revivals"] == 1
+    assert len(gw.supervisor.alive) == 2
+
+
+def test_stall_detection_declares_dead_and_requeues():
+    """A replica holding work whose progress tuple never changes is
+    declared dead after stall_ticks (pure host logic — stub
+    transport, no device work)."""
+    class Stub(ReplicaTransport):
+        def __init__(self, rid):
+            self.replica_id = rid
+            self.alive = True
+            self.drained = False
+        capacity = property(lambda s: 1)
+        load = property(lambda s: 1)
+        free_slots = property(lambda s: 0)
+
+        def prefix_probe(self, p):
+            return 0
+
+        def submit(self, spec, tag):
+            return tag
+
+        def step(self):
+            pass
+
+        def poll(self):
+            return {}, []
+
+        def health(self):
+            pass
+
+        def progress(self):
+            return (7,)                 # forever unchanged
+
+        def cancel(self, tag):
+            return False
+
+        def drain(self):
+            self.drained = True
+            return [("t", 0)]
+
+    sup = ReplicaSupervisor([Stub("s0")], fail_threshold=3,
+                            stall_ticks=3)
+    requeued = []
+    for _ in range(6):
+        _, _, rq, _ = sup.tick()
+        requeued.extend(rq)
+    assert sup.stats["deaths"] == 1
+    assert requeued == [("t", 0)]
+    assert "stalled" in sup.stats["last_errors"]["s0"]["reason"]
+
+
+# --------------------------------------------------- reroute and hedging
+
+def test_router_dispatch_fault_reroutes_via_retry_policy(engines,
+                                                         isolated):
+    """A typed ReplicaDownError at the ``router.dispatch`` site rides
+    the RetryPolicy onto the next replica — the request completes
+    bit-identical, one reroute counted."""
+    (p,) = _prompts(53, (6,))
+    gw = _gw(engines)
+    rid = gw.submit(p, 5)
+    # the documented key form: the site is keyed by the GATEWAY rid
+    with fault_plan("router.dispatch#%d@1:raise=mxtpu.serving."
+                    "transport.ReplicaDownError(flaky-link)" % rid):
+        res = gw.run()
+    np.testing.assert_array_equal(res[rid].asnumpy(),
+                                  _want(isolated, p, 5))
+    assert gw.router.stats["reroutes"] == 1
+
+
+def test_hedged_redispatch_after_deadline_fraction(engines, isolated):
+    """A request still unfinished after hedge_fraction × deadline is
+    duplicated onto the other replica; the first finisher wins, the
+    loser cancels through the idempotent release path, and the result
+    is bit-exact (same seed ⇒ same stream on any replica)."""
+    (p,) = _prompts(59, (5,))
+    gw = _gw(engines, hedge_fraction=0.25)
+    rid = gw.submit(p, 12, deadline_ticks=40, temperature=0.6, seed=9)
+    res = gw.run()
+    np.testing.assert_array_equal(
+        res[rid].asnumpy(),
+        _want(isolated, p, 12, temperature=0.6, seed=9))
+    assert gw.stats["hedges"] == 1
+    for eng in engines[:2]:
+        st = eng.stats
+        assert st["blocks_in_use"] == st["pinned_blocks"], st
+
+
+def test_gateway_deadline_expires_with_partial_stream(engines,
+                                                      isolated):
+    (p,) = _prompts(61, (5,))
+    gw = _gw(engines, hedge_fraction=None)
+    rid = gw.submit(p, 20, deadline_ticks=5)
+    gw.run()
+    assert gw.status(rid) == "expired"
+    part = gw.result(rid).asnumpy()
+    want = _want(isolated, p, 20)
+    assert p.shape[1] <= part.shape[1] < want.shape[1]
+    np.testing.assert_array_equal(part[0], want[0, :part.shape[1]])
+    for eng in engines[:2]:
+        st = eng.stats
+        assert st["blocks_in_use"] == st["pinned_blocks"], st
+
+
+# --------------------------------------------------------- QoS / shedding
+
+def test_gateway_admit_fault_rejects_before_any_state(engines):
+    (p,) = _prompts(67, (4,))
+    gw = _gw(engines, max_pending=4)
+    with fault_plan("gateway.admit@1:raise=RuntimeError(poisoned)"):
+        with pytest.raises(RuntimeError, match="poisoned"):
+            gw.submit(p, 3)
+    assert gw.pending == 0
+    rid = gw.submit(p, 3)           # the path is healthy again
+    assert gw.status(rid) == "queued"
+    gw.run()
+
+
+def test_qos_overflow_sheds_lowest_class_first(engines, isolated):
+    """A full queue displaces the newest LOWEST-class queued request
+    for an arriving higher-class one; when nothing lower exists the
+    arrival itself sheds with the structured typed error."""
+    p1, p2, p3, p4 = _prompts(71, (4, 5, 6, 4))
+    gw = _gw(engines, n=1, qos_classes=3, max_pending=2)
+    ra = gw.submit(p1, 3, qos=2)
+    rb = gw.submit(p2, 3, qos=2)
+    rc = gw.submit(p3, 3, qos=0)        # displaces rb (newest class-2)
+    assert gw.status(rb) == "shed"
+    err = gw.error(rb)
+    assert err["type"] == "QosShedError"
+    assert isinstance(err["exception"], QosShedError)
+    assert err["exception"].retry_after_ticks >= 1
+    with pytest.raises(QosShedError) as ei:
+        gw.submit(p4, 3, qos=2)          # nothing below class 2 queued
+    assert ei.value.queue_depth == 2 and ei.value.limit == 2
+    assert ei.value.retry_after_ticks >= 1 and not ei.value.permanent
+    with pytest.raises(QosShedError):
+        gw.result(rb)                    # sheds re-raise on result()
+    res = gw.run()
+    np.testing.assert_array_equal(res[ra].asnumpy(),
+                                  _want(isolated, p1, 3))
+    np.testing.assert_array_equal(res[rc].asnumpy(),
+                                  _want(isolated, p3, 3))
+    assert gw.stats["qos_sheds"] == 2
+
+
+def test_tenant_quota_sheds_typed(engines, isolated):
+    p1, p2, p3 = _prompts(73, (4, 5, 4))
+    gw = _gw(engines, tenant_quota=2)
+    r1 = gw.submit(p1, 3, tenant="acme")
+    r2 = gw.submit(p2, 3, tenant="acme")
+    with pytest.raises(QosShedError) as ei:
+        gw.submit(p3, 3, tenant="acme")
+    assert ei.value.limit == 2
+    r3 = gw.submit(p3, 3, tenant="other")   # other tenants unaffected
+    res = gw.run()
+    for rid, p in ((r1, p1), (r2, p2), (r3, p3)):
+        np.testing.assert_array_equal(res[rid].asnumpy(),
+                                      _want(isolated, p, 3))
+    # terminal requests release their quota
+    r4 = gw.submit(p1, 3, tenant="acme")
+    assert gw.status(r4) == "queued"
+    gw.run()
+
+
+def test_engine_shed_maps_to_typed_subclass(tiny, mesh):
+    """A request the ENGINE can never admit (more pages than the whole
+    pool) surfaces through the gateway as EngineShedError with
+    permanent=True — distinct from QoS sheds.  The tiny pool never
+    steps, so nothing compiles."""
+    rules = transformer_lm_sharding_rules()
+    eng = PagedContinuousBatchingEngine(
+        tiny, mesh, rules, num_slots=2, max_length=MAXLEN,
+        block_size=8, prefill_chunk=8, num_blocks=3)
+    gw = Gateway([eng])
+    rng = np.random.RandomState(79)
+    rid = gw.submit(nd.array(rng.randint(0, 50, (1, 18)),
+                             dtype="int32"), 10)
+    gw.run()
+    assert gw.status(rid) == "shed"
+    err = gw.error(rid)
+    assert err["type"] == "EngineShedError"
+    exc = err["exception"]
+    assert isinstance(exc, EngineShedError) and \
+        isinstance(exc, LoadShedError)
+    assert exc.permanent and exc.retry_after_ticks is None
+    with pytest.raises(EngineShedError):
+        gw.result(rid)
+
+
+def test_loadshed_carries_structured_context(tiny, mesh):
+    """Satellite: the engines' own LoadShedError now carries queue
+    depth / limit / retry-after so caller backoff is no longer
+    guesswork (no pool allocation — shed happens at submit)."""
+    rules = transformer_lm_sharding_rules()
+    eng = ContinuousBatchingEngine(tiny, mesh, rules, num_slots=2,
+                                   max_length=MAXLEN, max_pending=1)
+    rng = np.random.RandomState(83)
+    p = nd.array(rng.randint(0, 50, (1, 4)), dtype="int32")
+    eng.submit(p, 3)
+    with pytest.raises(LoadShedError) as ei:
+        eng.submit(p, 3)
+    e = ei.value
+    assert e.queue_depth == 1 and e.limit == 1
+    assert e.retry_after_ticks == 1 and e.permanent is False
+    # paged feasibility shed: permanent, no retry hint
+    paged = PagedContinuousBatchingEngine(
+        tiny, mesh, rules, num_slots=2, max_length=MAXLEN,
+        block_size=8, prefill_chunk=8, num_blocks=2)
+    with pytest.raises(LoadShedError) as ei:
+        paged.submit(nd.array(rng.randint(0, 50, (1, 20)),
+                              dtype="int32"), 10)
+    assert ei.value.permanent and ei.value.retry_after_ticks is None
+    assert ei.value.limit == 2
+
+
+def test_replica_pool_and_env_defaults(monkeypatch):
+    """MXTPU_REPLICAS sizes replica_pool; MXTPU_QOS_CLASSES sets the
+    gateway's class count (stub transports — no device work)."""
+    class StubEng:
+        num_slots = 1
+        active = pending = 0
+        free_slots = 1
+        stats = {"steps": 0, "tokens_generated": 0, "quarantined": 0}
+
+        def prefix_probe(self, p):
+            return 0
+
+    built = []
+    monkeypatch.setenv("MXTPU_REPLICAS", "3")
+    pool = replica_pool(lambda i: built.append(i) or StubEng())
+    assert len(pool) == 3 and built == [0, 1, 2]
+    assert [r.replica_id for r in pool] == ["r0", "r1", "r2"]
+    assert all(isinstance(r, InProcessReplica) for r in pool)
+    monkeypatch.setenv("MXTPU_QOS_CLASSES", "5")
+    gw = Gateway(pool)
+    assert gw._qos_classes == 5
+    with pytest.raises(ValueError):
+        gw.submit(np.zeros((1, 2), np.int32), 1, qos=5)
+    with pytest.raises(ValueError):
+        replica_pool(lambda i: StubEng(), n=0)
+
+
+def test_supervisor_all_dead_raises_typed(engines):
+    (p,) = _prompts(89, (4,))
+    gw = _gw(engines, fail_threshold=1)
+    gw.submit(p, 4)
+    from mxtpu.base import MXTPUError
+    with fault_plan("replica.health+:raise=OSError(rack-down)"):
+        with pytest.raises(MXTPUError, match="all 2 replica"):
+            gw.run()
+    # both replicas drained clean even in the total outage
+    for eng in engines[:2]:
+        st = eng.stats
+        assert st["blocks_in_use"] == 0 and st["pinned_blocks"] == 0
+
+
+# ----------------------------------------------- overlapped swap restores
+
+@pytest.fixture(scope="module")
+def ov_engines(tiny, mesh):
+    """overlap_swaps=True/False twins with a host tier and a zero pin
+    budget (finished chains spill straight through to host RAM)."""
+    rules = transformer_lm_sharding_rules()
+    return {flag: PagedContinuousBatchingEngine(
+        tiny, mesh, rules, num_slots=2, max_length=48, block_size=8,
+        prefill_chunk=8, pin_bytes=0, host_cache_bytes="4MiB",
+        overlap_swaps=flag) for flag in (False, True)}
+
+
+def _drive_cold_chain(eng, isolated, seed):
+    """Shared scenario: spill a chain to host, keep one request
+    decoding, admit a cold-chain request; returns (per-iteration
+    emission deltas of the in-flight slot, engine stats)."""
+    rng = np.random.RandomState(seed)
+    P = rng.randint(0, 50, (1, 16))
+    Q = rng.randint(0, 50, (1, 6))
+    P3 = np.concatenate([P, rng.randint(0, 50, (1, 3))], axis=1)
+    eng.submit(nd.array(P, dtype="int32"), 4)
+    eng.run()
+    assert eng.stats["swap_outs"] >= 2      # chain lives on host now
+    r2 = eng.submit(nd.array(Q, dtype="int32"), 12)
+    for _ in range(3):
+        eng.step()
+    r3 = eng.submit(nd.array(P3, dtype="int32"), 4)
+    deltas = []
+    slot2 = next(s for s in eng._slots
+                 if s is not None and s.req.rid == r2)
+    last = slot2.n_emitted
+    while eng.status(r2) == "active" or eng.status(r3) in ("queued",
+                                                           "active"):
+        eng.step()
+        s2 = next((s for s in eng._slots
+                   if s is not None and s.req.rid == r2), None)
+        if s2 is not None:
+            deltas.append(s2.n_emitted - last)
+            last = s2.n_emitted
+    res2 = eng.take_result(r2).asnumpy()
+    res3 = eng.take_result(r3).asnumpy()
+    np.testing.assert_array_equal(
+        res2, isolated.generate(nd.array(Q, dtype="int32"),
+                                max_new_tokens=12,
+                                max_length=48).asnumpy())
+    np.testing.assert_array_equal(
+        res3, isolated.generate(nd.array(P3, dtype="int32"),
+                                max_new_tokens=4,
+                                max_length=48).asnumpy())
+    return deltas, eng.stats
+
+
+def test_overlap_swaps_defers_restore_without_token_gap(ov_engines,
+                                                        isolated):
+    """Satellite: with overlap_swaps the cold-chain restore moves to
+    the iteration boundary — the in-flight slot emits EXACTLY one
+    token every iteration (no gap, asserted on counters), the restore
+    still happens (swap_ins > 0, one deferral) and both streams stay
+    bit-exact; the synchronous twin produces identical streams."""
+    deltas_s, st_s = _drive_cold_chain(ov_engines[False], isolated, 5)
+    deltas_o, st_o = _drive_cold_chain(ov_engines[True], isolated, 5)
+    assert st_o["deferred_swap_ins"] == 1
+    assert st_s["deferred_swap_ins"] == 0
+    assert st_o["swap_ins"] >= 2 and st_s["swap_ins"] >= 2
+    assert all(d == 1 for d in deltas_o), deltas_o
+    assert st_o["prefill_tokens_avoided"] == \
+        st_s["prefill_tokens_avoided"]
+    assert st_o["blocks_in_use"] == 0 and st_s["blocks_in_use"] == 0
+
+
+def test_overlap_swap_in_fault_retries_bit_exact(ov_engines, isolated):
+    """A serving.swap_in fault at the deferred restore quarantines only
+    the cold request; its retry re-defers, restores, and completes
+    bit-identical."""
+    eng = ov_engines[True]
+    rng = np.random.RandomState(97)
+    P = rng.randint(0, 50, (1, 16))
+    P2 = np.concatenate([P, rng.randint(0, 50, (1, 2))], axis=1)
+    eng.submit(nd.array(P, dtype="int32"), 3)
+    eng.run()
+    assert eng.stats["swap_outs"] >= 2
+    swap_ins0 = eng.stats["swap_ins"]
+    r2 = eng.submit(nd.array(P2, dtype="int32"), 4, retries=1)
+    with fault_plan("serving.swap_in#%d@1:raise=OSError(copy-fail)"
+                    % r2) as plan:
+        res = eng.run()
+    assert plan.stats()["serving.swap_in"]["fired"] == 1
+    assert eng.status(r2) == "ok"
+    np.testing.assert_array_equal(
+        res[r2].asnumpy(),
+        isolated.generate(nd.array(P2, dtype="int32"),
+                          max_new_tokens=4, max_length=48).asnumpy())
+    assert eng.stats["swap_ins"] > swap_ins0     # the retry restored
+    assert eng.stats["blocks_in_use"] == 0
+
+
+# --------------------------------------------------------- slow matrices
+
+@pytest.mark.slow
+def test_multi_replica_matrix_death_hedge_qos_combined(engines,
+                                                       isolated):
+    """The heavy combined matrix: 3 replicas, mixed sampling configs,
+    QoS classes, hedging AND a mid-run replica death — every surviving
+    stream bit-exact, pool drained clean, run replayable."""
+    rng = np.random.RandomState(101)
+    prompts = [nd.array(rng.randint(0, 50, (1, t)), dtype="int32")
+               for t in (5, 8, 11, 6, 4, 9)]
+    cfgs = [dict(), dict(temperature=0.9, seed=3),
+            dict(repetition_penalty=1.4),
+            dict(temperature=0.5, seed=8, top_k=7), dict(),
+            dict(temperature=1.1, seed=13, top_p=0.9)]
+    want = [_want(isolated, p, 7, **c) for p, c in zip(prompts, cfgs)]
+
+    def drive():
+        gw = _gw(engines, n=3, fail_threshold=2, hedge_fraction=0.3)
+        rids = []
+        for i, (p, c) in enumerate(zip(prompts, cfgs)):
+            kw = dict(c)
+            if i % 2:
+                kw["deadline_ticks"] = 60
+            rids.append(gw.submit(p, 7, qos=i % 2, **kw))
+        with fault_plan(
+                "replica.health#r2@2x2:raise=OSError(gone)") as plan:
+            res = gw.run()
+        assert plan.stats()["replica.health"]["fired"] == 2
+        return gw, rids, res
+
+    gw, rids, res = drive()
+    for rid, w in zip(rids, want):
+        assert gw.status(rid) == "ok"
+        np.testing.assert_array_equal(res[rid].asnumpy(), w)
+    assert gw.stats["supervisor"]["deaths"] == 1
+    st = gw.supervisor.replica("r2").stats()
+    assert st["blocks_in_use"] == 0 and st["pinned_blocks"] == 0
+    gw2, rids2, res2 = drive()
+    for rid, w in zip(rids2, want):
+        np.testing.assert_array_equal(res2[rid].asnumpy(), w)

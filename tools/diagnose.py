@@ -348,6 +348,83 @@ def check_hierarchical():
               if healthy else "UNEXPECTED counters %r" % (st,))
     except Exception as e:
         print("hierarchical : FAILED (%s: %s)" % (type(e).__name__, e))
+    check_router()
+
+
+def check_router():
+    """Exercise the multi-replica service layer once (docs/serving.md):
+    a 2-replica micro pool routes a repeat prompt to the warm replica
+    (locality hit), hedges a deadline'd request, then a deterministic
+    ``replica.health`` plan kills one replica mid-decode — a healthy
+    install drains it clean (zero pages), requeues its request, and
+    every stream stays bit-exact to the isolated decode."""
+    print("----------Serving (router / replica pool)----------")
+    try:
+        import numpy as np
+
+        import mxtpu as mx
+        from mxtpu import nd
+        from mxtpu.models.transformer import (
+            TransformerLM, transformer_lm_sharding_rules)
+        from mxtpu.parallel import (PagedContinuousBatchingEngine,
+                                    ShardedDecoder)
+        from mxtpu.parallel.mesh import DeviceMesh
+        from mxtpu.resilience import fault_plan
+        from mxtpu.serving import Gateway, replica_pool
+
+        mx.random.seed(7)
+        lm = TransformerLM(32, units=16, hidden_size=32, num_layers=1,
+                           num_heads=2, num_kv_heads=2)
+        lm.initialize()
+        mesh = DeviceMesh(dp=1)
+        rules = transformer_lm_sharding_rules()
+        iso = ShardedDecoder(lm, mesh, rules)
+        pool = replica_pool(
+            lambda i: PagedContinuousBatchingEngine(
+                lm, mesh, rules, num_slots=2, max_length=32,
+                block_size=8, prefill_chunk=8, pin_bytes="64KiB",
+                ledger_tag="probe-r%d" % i), n=2)
+        gw = Gateway(pool, fail_threshold=2, hedge_fraction=0.25)
+        rng = np.random.RandomState(0)
+        p = nd.array(rng.randint(0, 32, (1, 17)), dtype="int32")
+        want = iso.generate(p, max_new_tokens=6,
+                            max_length=32).asnumpy()
+        r1 = gw.submit(p, 6)
+        gw.run()                  # warms one replica's pinned chain
+        # locality re-hit + a deadline tight enough that the hedge
+        # fires mid-decode (decode takes ~9 ticks; hedge at 12*0.25=3)
+        r2 = gw.submit(p, 6, deadline_ticks=12)
+        res = gw.run()
+        loc = gw.router.stats
+        ok_loc = (bool(np.array_equal(res[r2].asnumpy(), want))
+                  and loc["locality_hits"] >= 1
+                  and gw.stats["hedges"] >= 1)
+        r3 = gw.submit(p, 6)
+        with fault_plan("replica.health#r0@2x2:raise="
+                        "OSError(probe-kill)"):
+            res = gw.run()
+        sup = gw.stats["supervisor"]
+        dead = gw.supervisor.replica("r0")
+        drained = dead.stats()
+        ok_death = (bool(np.array_equal(res[r3].asnumpy(), want))
+                    and sup["deaths"] == 1
+                    and drained["blocks_in_use"] == 0
+                    and drained["pinned_blocks"] == 0)
+        print("routing      : %d dispatch(es), %d locality hit(s), "
+              "hit rate %.2f, %d hedge(s)"
+              % (loc["dispatches"], loc["locality_hits"],
+                 loc["prefix_hit_rate"], gw.stats["hedges"]))
+        print("supervision  : %d death(s), %d request(s) requeued, "
+              "%d alive of %d" % (sup["deaths"],
+                                  sup["requeued_requests"],
+                                  sup["alive"], sup["replicas"]))
+        healthy = ok_loc and ok_death
+        print("probe        :", "ok (locality hit + forced replica "
+              "death + clean drain, streams bit-exact)"
+              if healthy else "UNEXPECTED (locality=%r death=%r %r)"
+              % (ok_loc, ok_death, sup))
+    except Exception as e:
+        print("router       : FAILED (%s: %s)" % (type(e).__name__, e))
 
 
 def check_resilience():
