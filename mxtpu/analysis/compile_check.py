@@ -6,8 +6,9 @@ buckets + one pooled step + the pow2 speculative-verify window ladder
 + the hierarchical cache's ONE bounded swap-copy program for serving —
 sites ``serving.slot_prefill`` / ``serving.step_slots`` /
 ``serving.verify_slots`` and their paged forms, plus ``serving.swap``;
-one step program per batch signature for training), never by
-traffic.  The ledger records every
+one step program per batch signature for training —
+``spmd_trainer.step``, and one fused window program per (N, shapes)
+signature at ``spmd_trainer.step_multi``), never by traffic.  The ledger records every
 jit-cache lookup with its signature pre-split into shapes / dtypes /
 weak-type flags / static parts, so each growth mode gets its own code:
 

@@ -37,7 +37,10 @@ D004        INFO      executable-level verification unavailable on this
 ``check_trainer_donation(trainer, data, label)`` applies the pass to an
 ``SPMDTrainer``'s compiled step (donate_argnums ``(0, 1, 2)`` — params,
 aux, optimizer state); tests seed a ``donate=False`` trainer and assert
-the D002s name the undonated state.
+the D002s name the undonated state.  ``n_steps=N`` checks the fused
+N-step scan window instead: the donated state becomes the scan's loop
+carry and the proof covers the whole window program (D003 carries a
+``loop_carried`` detail + message note).
 """
 
 from __future__ import annotations
@@ -153,9 +156,15 @@ def check_donation(fn, *sample_args, donate_argnums: Sequence[int] = (),
     out_avals = [_aval_of(o) for o in
                  jax.tree_util.tree_leaves(lowered.out_info)]
 
-    alias_map = _lowered_alias_map(lowered.as_text())
+    lowered_text = lowered.as_text()
+    alias_map = _lowered_alias_map(lowered_text)
     backend_unverifiable = any("onation is not implemented" in w
                                for w in drop_warnings)
+    # loop-carried program (lax.scan / while_loop): the aliasing proof
+    # below then covers the donated buffers THROUGH the loop carry —
+    # the whole fused window updates in place, not just a flat step
+    loop_carried = ("stablehlo.while" in lowered_text
+                    or "mhlo.while" in lowered_text)
 
     # -- aval-level greedy matching (XLA's aliasing rule) ----------------
     remaining = list(range(len(out_avals)))
@@ -266,14 +275,17 @@ def check_donation(fn, *sample_args, donate_argnums: Sequence[int] = (),
         if aliased_leaves:
             report.add(Diagnostic(
                 _PASS, "D003", Severity.INFO, "donation",
-                "%d donated leaf/leaves alias outputs (%s saved)%s" % (
+                "%d donated leaf/leaves alias outputs (%s saved)%s%s" % (
                     aliased_leaves, format_bytes(aliased_bytes),
+                    "; aliasing holds through the loop-carried (scan) "
+                    "program" if loop_carried else "",
                     {True: "; executable confirms input_output_alias",
                      False: "; executable shows NO input_output_alias",
                      None: ""}[exec_aliases]),
                 details={"leaves": aliased_leaves,
                          "bytes": aliased_bytes,
-                         "alias_bytes": alias_bytes}))
+                         "alias_bytes": alias_bytes,
+                         "loop_carried": loop_carried}))
             if exec_aliases is False:
                 report.add(Diagnostic(
                     _PASS, "D001", Severity.ERROR, "donation",
@@ -284,16 +296,27 @@ def check_donation(fn, *sample_args, donate_argnums: Sequence[int] = (),
 
 
 def check_trainer_donation(trainer, data, label,
-                           compile: bool = True) -> Report:
+                           compile: bool = True,
+                           n_steps: Optional[int] = None) -> Report:
     """Apply :func:`check_donation` to an ``SPMDTrainer``'s compiled
     step.  Stages the trainer if needed (one imperative forward) and
     lowers the step abstractly — no training step executes.
     ``compile=False`` stops at the lowered aliasing attributes (cheaper;
     skips the executable-level confirmation).
 
-    donate=True trainers must verify clean (D003); donate=False
-    trainers get one D002 per undonated state argument — params, aux
-    and optimizer state each held twice per step."""
+    ``n_steps=N`` (N > 1) checks the fused N-step ``lax.scan`` window
+    program (docs/training.md) instead of the flat step: the donated
+    params / aux / optimizer state become scan loop carries, and the
+    same three-layer proof (aval matching, ``tf.aliasing_output``,
+    executable ``input_output_alias``) must show the window's inputs
+    aliasing its outputs — i.e. the whole fused window updates in
+    place.  Only the shapes matter, so the window's batch/label/key
+    stacks are abstract (``jax.ShapeDtypeStruct``); nothing executes.
+
+    donate=True trainers must verify clean (D003, with the
+    loop-carried note for windows); donate=False trainers get one D002
+    per undonated state argument — params, aux and optimizer state each
+    held twice per step."""
     import jax
     import jax.numpy as jnp
 
@@ -304,18 +327,45 @@ def check_trainer_donation(trainer, data, label,
     label = label if isinstance(label, nd.NDArray) else nd.array(label)
     trainer._ensure_staged(data)
     if trainer._guard and trainer._scale_state is None:
-        trainer._scale_state = (jnp.float32(
-            trainer._scale_cfg[0] if trainer._dyn_scale else 1.0),
-            jnp.int32(0))
+        trainer._scale_state = trainer._init_scale_state()
 
     batch = data._data
     lab = label._data
     sig = (tuple(batch.shape), str(batch.dtype), tuple(lab.shape),
            str(lab.dtype))
-    step_fn = trainer._build_step(*sig)
-
     diff_leaves = tuple(p.data()._data for p in trainer._diff_params)
     aux_leaves = tuple(p.data()._data for p in trainer._aux_params)
+    donated = (0, 1, 2) if trainer._donate else ()
+
+    n = int(n_steps) if n_steps else 1
+    if n > 1:
+        step_fn = trainer._build_multi_step(n, *sig)
+        # abstract window stacks: lowering only needs avals, and a
+        # ShapeDtypeStruct key stack would lose the PRNG dtype — split
+        # a throwaway root instead (never consumed from the ring)
+        batches = jax.ShapeDtypeStruct((n,) + sig[0], sig[1])
+        labels = jax.ShapeDtypeStruct((n,) + sig[2], sig[3])
+        keys = jax.random.split(jax.random.key(0), n)
+        lrs = jnp.zeros((n,), jnp.float32)
+        if trainer._guard:
+            args = [diff_leaves, aux_leaves, tuple(trainer._opt_states),
+                    trainer._scale_state, lrs, jnp.float32(0.0),
+                    batches, labels, keys]
+            names = ["params", "aux_params", "opt_states",
+                     "scale_state", "lrs", "t0", "batches", "labels",
+                     "rng_keys"]
+        else:
+            args = [diff_leaves, aux_leaves, tuple(trainer._opt_states),
+                    lrs, jnp.zeros((n,), jnp.float32), batches, labels,
+                    keys]
+            names = ["params", "aux_params", "opt_states", "lrs", "ts",
+                     "batches", "labels", "rng_keys"]
+        return check_donation(
+            step_fn, *args, donate_argnums=donated,
+            donatable_argnums=(0, 1, 2), arg_names=names,
+            compile=compile)
+
+    step_fn = trainer._build_step(*sig)
     args = [diff_leaves, aux_leaves, tuple(trainer._opt_states),
             jnp.float32(trainer._effective_lr()), jnp.float32(1.0),
             batch, lab, _random.next_key()]
@@ -325,7 +375,6 @@ def check_trainer_donation(trainer, data, label,
         args.append(trainer._scale_state)
         names.append("scale_state")
 
-    donated = (0, 1, 2) if trainer._donate else ()
     # step_fn is already a jax.jit stage with its donate/shardings baked
     # in; re-wrap the underlying behavior by checking THROUGH it: lower
     # directly and reuse check_donation's parsing on the lowered text.

@@ -15,7 +15,7 @@ API parity and single-chip use.
 
 from __future__ import annotations
 
-from typing import Any, Callable, Dict, List, Optional
+from typing import Any, Callable, Dict, List, NamedTuple, Optional
 
 import jax
 import jax.numpy as jnp
@@ -27,7 +27,25 @@ from ..ndarray import NDArray
 from .mesh import DeviceMesh
 from .sharding import ShardingRules
 
-__all__ = ["SPMDTrainer"]
+__all__ = ["SPMDTrainer", "TrainWindow"]
+
+
+class TrainWindow(NamedTuple):
+    """Result of one fused N-step window (:meth:`SPMDTrainer.step_window`).
+
+    losses : (N,) device NDArray of per-step scalar losses — still
+        async; ``.asnumpy()`` blocks.  A skipped step's loss is the
+        non-finite value that triggered the skip (same as the per-step
+        path returns).
+    ok : host bool ndarray (N,) of per-step finiteness verdicts for
+        guarded trainers (reading it is the window's ONE host sync);
+        None when unguarded.
+    num_good : steps whose update actually applied (== N unguarded).
+    """
+
+    losses: Any
+    ok: Any
+    num_good: int
 
 
 class SPMDTrainer:
@@ -113,6 +131,7 @@ class SPMDTrainer:
         self._num_update = 0
         self._params_sharded = False
         self._input_shardings = None  # cached in step()
+        self._window_input_shardings = None  # cached in step_window()
         self._diff_params: List = []
         self._aux_params: List = []
         self._opt_states: List = []
@@ -143,7 +162,11 @@ class SPMDTrainer:
         self._params_sharded = True
 
     # -- the compiled step ----------------------------------------------
-    def _build_step(self, batch_shape, batch_dtype, label_shape, label_dtype):
+    def _make_step_fns(self):
+        """The pure step bodies shared by the per-step program
+        (:meth:`_build_step`) and the fused N-step scan program
+        (:meth:`_build_multi_step`) — built once per compile so both
+        capture captures (wds, clip norm, guard flags) identically."""
         block = self._block
         loss_fn = self._loss_fn
         diff_params = self._diff_params
@@ -279,17 +302,31 @@ class SPMDTrainer:
             return (tuple(new_leaves), new_aux, tuple(new_states), loss,
                     ok, (new_scale, new_clean))
 
+        return step, guarded_step
+
+    def _shardings(self):
+        """(diff, aux, opt-state, replicated) NamedSharding tuples for
+        the staged parameters — the common part of both programs'
+        in/out_shardings."""
         jm = self._mesh.jax_mesh
         rep = NamedSharding(jm, P())
         diff_sh = tuple(self._rules.sharding_for(p.name, p.data().ndim,
                                                  self._mesh)
-                        for p in diff_params)
-        aux_sh = tuple(rep for _ in aux_params)
+                        for p in self._diff_params)
+        aux_sh = tuple(rep for _ in self._aux_params)
         state_sh = tuple(
             jax.tree_util.tree_map(
                 lambda a: NamedSharding(jm, self._rules.spec_for(
                     p.name, getattr(a, "ndim", 0))), st)
-            for p, st in zip(diff_params, self._opt_states))
+            for p, st in zip(self._diff_params, self._opt_states))
+        return diff_sh, aux_sh, state_sh, rep
+
+    def _build_step(self, batch_shape, batch_dtype, label_shape,
+                    label_dtype):
+        step, guarded_step = self._make_step_fns()
+        guard = self._guard
+        jm = self._mesh.jax_mesh
+        diff_sh, aux_sh, state_sh, rep = self._shardings()
         in_sh = (diff_sh, aux_sh, state_sh, rep, rep,
                  NamedSharding(jm, self._batch_spec),
                  NamedSharding(jm, self._label_spec), rep)
@@ -300,6 +337,90 @@ class SPMDTrainer:
         donate = (0, 1, 2) if self._donate else ()
         return jax.jit(guarded_step if guard else step,
                        in_shardings=in_sh, out_shardings=out_sh,
+                       donate_argnums=donate)
+
+    def _build_multi_step(self, n, batch_shape, batch_dtype, label_shape,
+                          label_dtype):
+        """Compile N steps as ONE ``lax.scan`` program (docs/training.md).
+
+        The scan body is the SAME guarded/unguarded step closure the
+        per-step program compiles, so a window's per-step math — the
+        finiteness gate, loss scaling, clipping, optimizer rule — is the
+        per-step math by construction.  The loop state carries params,
+        aux (running stats), optimizer state, the loss-scale automaton
+        and a ``good`` update counter; skipped iterations pass every
+        carry leaf through untouched via the same ``lax.cond`` gate.
+
+        Per-step host bookkeeping becomes traced state:
+
+        - ``t`` (the optimizer's traced step count) advances only on OK
+          iterations: ``t0 + good + 1`` — a mid-window skip leaves the
+          next iteration's bias correction exactly where the per-step
+          path would.
+        - the learning rate is precomputed on host for every possible
+          update count in the window (``lrs[j]`` = schedule at
+          ``num_update0 + j + 1``) and indexed by the carried ``good``
+          counter, so lr schedules stay bit-identical under skips.
+
+        Params, aux and optimizer state are donated (argnums 0-2):
+        XLA aliases the window's inputs to its outputs and the carry
+        updates in place across all N fused steps
+        (``check_trainer_donation(..., n_steps=N)`` proves it)."""
+        step, guarded_step = self._make_step_fns()
+        guard = self._guard
+
+        if guard:
+            def multi(diff_leaves, aux_leaves, opt_states, scale_state,
+                      lrs, t0, batches, labels, keys):
+                def body(carry, xs):
+                    diff, aux, states, sstate, good = carry
+                    batch, label, key = xs
+                    lr = lrs[good]
+                    t = t0 + (good + 1).astype(jnp.float32)
+                    nd_, na, ns, loss, ok, nss = guarded_step(
+                        diff, aux, states, lr, t, batch, label, key,
+                        sstate)
+                    return ((nd_, na, ns, nss,
+                             good + ok.astype(jnp.int32)), (loss, ok))
+
+                init = (tuple(diff_leaves), tuple(aux_leaves),
+                        tuple(opt_states), scale_state, jnp.int32(0))
+                (fd, fa, fs, sstate, good), (losses, oks) = jax.lax.scan(
+                    body, init, (batches, labels, keys))
+                return fd, fa, fs, losses, oks, sstate, good
+        else:
+            def multi(diff_leaves, aux_leaves, opt_states, lrs, ts,
+                      batches, labels, keys):
+                def body(carry, xs):
+                    diff, aux, states = carry
+                    batch, label, key, lr, t = xs
+                    nd_, na, ns, loss = step(diff, aux, states, lr, t,
+                                             batch, label, key)
+                    return (nd_, na, ns), loss
+
+                init = (tuple(diff_leaves), tuple(aux_leaves),
+                        tuple(opt_states))
+                (fd, fa, fs), losses = jax.lax.scan(
+                    body, init, (batches, labels, keys, lrs, ts))
+                return fd, fa, fs, losses
+
+        jm = self._mesh.jax_mesh
+        diff_sh, aux_sh, state_sh, rep = self._shardings()
+        stacked_b = NamedSharding(
+            jm, P(*((None,) + tuple(self._batch_spec))))
+        stacked_l = NamedSharding(
+            jm, P(*((None,) + tuple(self._label_spec))))
+        if guard:
+            in_sh = (diff_sh, aux_sh, state_sh, (rep, rep), rep, rep,
+                     stacked_b, stacked_l, rep)
+            out_sh = (diff_sh, aux_sh, state_sh, rep, rep, (rep, rep),
+                      rep)
+        else:
+            in_sh = (diff_sh, aux_sh, state_sh, rep, rep,
+                     stacked_b, stacked_l, rep)
+            out_sh = (diff_sh, aux_sh, state_sh, rep)
+        donate = (0, 1, 2) if self._donate else ()
+        return jax.jit(multi, in_shardings=in_sh, out_shardings=out_sh,
                        donate_argnums=donate)
 
     # -- public API ------------------------------------------------------
@@ -363,9 +484,7 @@ class SPMDTrainer:
         aux_leaves = tuple(p.data()._data for p in self._aux_params)
         if self._guard:
             if self._scale_state is None:
-                self._scale_state = (jnp.float32(self._scale_cfg[0]
-                                                 if self._dyn_scale
-                                                 else 1.0), jnp.int32(0))
+                self._scale_state = self._init_scale_state()
             new_leaves, new_aux, new_states, loss, ok, scale_state = \
                 jitted(diff_leaves, aux_leaves, tuple(self._opt_states),
                        lr, t, batch, lab, _random.next_key(),
@@ -392,6 +511,142 @@ class SPMDTrainer:
             p.data()._rebind(leaf)
         self._opt_states = list(new_states)
         return NDArray(loss)
+
+    def _init_scale_state(self):
+        """Lazy initial (scale, clean) automaton state — the ONE
+        spelling shared by step, step_window and the donation checker,
+        so the window/analysis paths can never initialize a different
+        automaton than the per-step path."""
+        return (jnp.float32(self._scale_cfg[0] if self._dyn_scale
+                            else 1.0), jnp.int32(0))
+
+    def step_window(self, data, label, count_skips: bool = True):
+        """Run N optimization steps as ONE fused ``lax.scan`` program
+        (docs/training.md "Multi-step capture").
+
+        ``data``/``label`` carry a leading window axis: shape
+        ``(N,) + per_step_shape``.  The window compiles once per
+        (N, shapes, dtypes) signature — ledger site
+        ``spmd_trainer.step_multi`` — with params, aux and optimizer
+        state donated so the carry updates in place across all N steps;
+        the host dispatches one program and, for guarded trainers,
+        synchronizes once per window (the per-step ``ok`` vector) instead
+        of once per step.  Loss/param trajectories are bit-identical to
+        N calls of :meth:`step`, including guardian skip semantics when a
+        non-finite step lands mid-window (the finiteness gate folds per
+        scan iteration; skipped iterations advance neither the update
+        count nor the lr/bias-correction schedule).
+
+        ``count_skips=False`` suppresses the per-skip bump of the
+        process-wide ``guardian_skips`` counter: the windowed guardian
+        drive passes it and counts only the skips its policy actually
+        processes, so a mid-window rollback's discarded tail cannot
+        drift the counter vs the per-step drive.
+
+        Returns a :class:`TrainWindow`; ``losses`` stays async (one more
+        transfer — no extra compute wait — to read)."""
+        from ..resilience.counters import bump
+
+        data = data if isinstance(data, NDArray) else nd.array(data)
+        label = label if isinstance(label, NDArray) else nd.array(label)
+        if data.ndim < 1 or data.shape[0] < 1:
+            raise ValueError(
+                "step_window expects data with a leading window axis "
+                "(N, *batch_shape) with N >= 1; got shape %r"
+                % (tuple(data.shape),))
+        n = int(data.shape[0])
+        if label.ndim < 1 or int(label.shape[0]) != n:
+            raise ValueError(
+                "step_window: label window %r does not match data "
+                "window %d" % (tuple(label.shape), n))
+        self._ensure_staged(data[0])
+
+        # cached stacked input shardings (same rationale as step()'s
+        # _input_shardings: per-call NamedSharding construction is
+        # measurable host overhead, and this is the dispatch-overhead-
+        # elimination path)
+        in_sh = self._window_input_shardings
+        if in_sh is None:
+            jm = self._mesh.jax_mesh
+            in_sh = (NamedSharding(
+                jm, P(*((None,) + tuple(self._batch_spec)))),
+                NamedSharding(
+                jm, P(*((None,) + tuple(self._label_spec)))))
+            self._window_input_shardings = in_sh
+        batch = jax.device_put(data._data, in_sh[0])
+        lab = jax.device_put(label._data, in_sh[1])
+
+        sig = ("multi", n, tuple(batch.shape), str(batch.dtype),
+               tuple(lab.shape), str(lab.dtype))
+        jitted = self._jit_cache.get(sig)
+        from ..analysis.compile_ledger import (Signature, ledger_enabled,
+                                               record)
+        if ledger_enabled():
+            record("spmd_trainer.step_multi", Signature(
+                shapes=(sig[2], sig[4]), dtypes=(sig[3], sig[5]),
+                weak=(), static=(n, self._guard, self._dyn_scale)),
+                hit=jitted is not None)
+        if jitted is None:
+            jitted = self._build_multi_step(n, *sig[2:])
+            self._jit_cache[sig] = jitted
+
+        # per-iteration lr ladder: lrs[j] = what _effective_lr would
+        # return after the (j+1)-th successful update of this window —
+        # indexed on device by the carried good-step counter so
+        # schedules stay bit-identical under mid-window skips
+        nu0 = self._num_update
+        opt = self._optimizer
+        saved_nu = opt.num_update
+        lrs = []
+        try:
+            for j in range(n):
+                opt.num_update = nu0 + j + 1
+                lrs.append(float(self._effective_lr()))
+        finally:
+            opt.num_update = saved_nu
+        lrs = jnp.asarray(lrs, jnp.float32)
+        # one RNG key per step, drawn in ring order — the stream is
+        # bit-identical to N per-step draws (a contained skip still
+        # consumes its key, exactly like the per-step path)
+        keys = jnp.stack([_random.next_key() for _ in range(n)])
+
+        diff_leaves = tuple(p.data()._data for p in self._diff_params)
+        aux_leaves = tuple(p.data()._data for p in self._aux_params)
+        if self._guard:
+            if self._scale_state is None:
+                self._scale_state = self._init_scale_state()
+            (new_leaves, new_aux, new_states, losses, oks, scale_state,
+             _good) = jitted(diff_leaves, aux_leaves,
+                             tuple(self._opt_states), self._scale_state,
+                             lrs, jnp.float32(nu0), batch, lab, keys)
+            self._scale_state = scale_state
+            import numpy as onp
+            ok_host = onp.asarray(jax.device_get(oks))
+            bump("train_window_syncs")  # the ONE host sync of the window
+            num_good = int(ok_host.sum())
+            if count_skips and num_good < n:
+                bump("guardian_skips", n - num_good)
+            self.last_step_ok = bool(ok_host[-1])
+        else:
+            ts = jnp.float32(nu0) + jnp.arange(1, n + 1,
+                                               dtype=jnp.float32)
+            new_leaves, new_aux, new_states, losses = jitted(
+                diff_leaves, aux_leaves, tuple(self._opt_states), lrs,
+                ts, batch, lab, keys)
+            ok_host = None
+            num_good = n
+
+        self._num_update += num_good
+        iuc = self._optimizer._index_update_count
+        for i in range(len(self._diff_params)):
+            iuc[i] = self._num_update
+        self._optimizer.num_update = self._num_update
+        for p, leaf in zip(self._diff_params, new_leaves):
+            p.data()._rebind(leaf)
+        for p, leaf in zip(self._aux_params, new_aux):
+            p.data()._rebind(leaf)
+        self._opt_states = list(new_states)
+        return TrainWindow(NDArray(losses), ok_host, num_good)
 
     def _effective_lr(self):
         """Per-step scalar lr from schedules only (recompile-free: passed

@@ -27,6 +27,8 @@ _COUNTERS = {
     "deadline_evictions": 0,   # requests evicted past their deadline
     "shed_requests": 0,        # submissions rejected by max_pending
     "guardian_skips": 0,       # non-finite steps contained (update gated off)
+    "train_window_syncs": 0,   # one per fused N-step window (the once-per-N
+                               # host sync of SPMDTrainer.step_window)
     "guardian_rollbacks": 0,   # rollback-to-verified-checkpoint recoveries
     "ckpt_writes": 0,          # verified checkpoint payloads written
     "ckpt_corruptions": 0,     # checkpoints that failed verification

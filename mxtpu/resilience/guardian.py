@@ -45,7 +45,8 @@ from .checkpoint import CheckpointSet
 from .counters import bump
 from .faults import inject
 
-__all__ = ["Guardian", "DivergenceError", "guard_enabled_default"]
+__all__ = ["Guardian", "DivergenceError", "guard_enabled_default",
+           "default_window"]
 
 
 class DivergenceError(MXTPUError):
@@ -59,6 +60,17 @@ def guard_enabled_default() -> bool:
     ``MXTPU_GUARDIAN`` turns in-step containment on process-wide."""
     v = os.environ.get("MXTPU_GUARDIAN", "").strip().lower()
     return v not in ("", "0", "false", "off", "no")
+
+
+def default_window() -> int:
+    """Ambient default for :meth:`Guardian.run`'s ``window=`` option:
+    ``MXTPU_TRAIN_WINDOW=N`` drives supervised training in fused N-step
+    scan windows (docs/training.md) process-wide.  Default 1 (per-step
+    drive, the historical behavior)."""
+    try:
+        return max(1, int(os.environ.get("MXTPU_TRAIN_WINDOW", "1")))
+    except ValueError:
+        return 1
 
 
 class Guardian:
@@ -236,7 +248,8 @@ class Guardian:
 
     # -- the supervised loop ----------------------------------------------
     def run(self, trainer, data_fn: Callable[[int], tuple],
-            num_steps: int, start_step: int = 0) -> dict:
+            num_steps: int, start_step: int = 0,
+            window: Optional[int] = None) -> dict:
         """Drive ``trainer`` for ``num_steps`` steps with containment,
         periodic verified checkpoints, and rollback/replay.
 
@@ -246,6 +259,25 @@ class Guardian:
         rewound).  The trainer must have been built with ``guard=True``
         (or ``MXTPU_GUARDIAN``) so skipped steps are contained in-step.
 
+        ``window=N`` (default: ``MXTPU_TRAIN_WINDOW``, 1) drives the
+        trainer in fused N-step :meth:`~mxtpu.parallel.trainer
+        .SPMDTrainer.step_window` scan programs — one dispatch and one
+        host sync per N steps instead of per step (docs/training.md).
+        The windowed drive preserves the per-step policy bit-exactly:
+        the per-iteration ``ok`` verdicts are replayed through the SAME
+        streak/quarantine/spike logic, so a non-finite step landing
+        mid-window produces the identical final parameters and
+        quarantine set as ``window=1`` (a mid-window rollback discards
+        the window's tail — the restore wipes it).  Checkpoint
+        boundaries land on window boundaries: with a window-aligned
+        schedule (``checkpoint_every % window == 0``) step/skip stats
+        and counters also match the per-step drive exactly; a
+        misaligned schedule can place checkpoints up to N-1 steps
+        later, so a rollback replays a longer prefix and execution
+        stats differ while the surviving trajectory does not.  A ragged
+        tail (fewer than N non-quarantined steps left) finishes through
+        the per-step program.
+
         Returns a copy of ``self.stats``.
         """
         if not getattr(trainer, "_guard", False):
@@ -253,6 +285,7 @@ class Guardian:
                 "Guardian.run requires a guarded trainer — construct it "
                 "with guard=True (or set MXTPU_GUARDIAN=1) so non-finite "
                 "steps are contained inside the compiled step")
+        window = default_window() if window is None else max(1, int(window))
         step = int(start_step)
         skip_window: list = []  # step indices of the current skip streak
         if not getattr(trainer, "_params_sharded", True):
@@ -268,6 +301,10 @@ class Guardian:
             # unrecoverable DivergenceError
             self.checkpoint(trainer, step, required=True)
         last_ckpt = step  # boundary covered at entry (baseline or resume)
+        if window > 1:
+            step, last_ckpt = self._drive_windows(
+                trainer, data_fn, num_steps, step, last_ckpt,
+                skip_window, window)
         while step < num_steps:
             # periodic save at the TOP of the loop so every path that
             # advances step — healthy, contained skip, quarantined —
@@ -341,3 +378,100 @@ class Guardian:
                     continue
             step += 1
         return dict(self.stats)
+
+    def _drive_windows(self, trainer, data_fn, num_steps: int, step: int,
+                       last_ckpt: int, skip_window: list,
+                       window: int) -> tuple:
+        """Drive full N-step fused windows; returns ``(step, last_ckpt)``
+        when fewer than N non-quarantined steps remain so the per-step
+        loop can finish the ragged tail (``skip_window`` is shared by
+        reference — a streak spanning the window/tail boundary carries
+        over).
+
+        Policy parity with the per-step loop: the window executes all N
+        iterations on device (a scan cannot stop mid-program), but its
+        per-iteration ``ok`` flags are processed SEQUENTIALLY through
+        the same streak/quarantine/spike logic, truncating at the first
+        rollback trigger — stats count processed steps only, and the
+        rollback's restore discards the window's tail wholesale, so the
+        surviving trajectory is bit-identical to the per-step drive.
+        ``guardian.check`` fires once per step index at window assembly
+        (before any batch is fetched); a planned raise there rolls back
+        before the window runs — the pre-trigger part of the window is
+        never executed (unlike the per-step drive), which the restore
+        makes unobservable in the trajectory."""
+        import numpy as onp
+
+        def _np(x):
+            return x.asnumpy() if hasattr(x, "asnumpy") else onp.asarray(x)
+
+        while step < num_steps:
+            # remaining non-quarantined steps, O(|quarantined|) — a
+            # range scan here would make the host loop quadratic in
+            # num_steps, the exact overhead windows exist to eliminate
+            avail = (num_steps - step) - sum(
+                1 for q in self._quarantined_steps
+                if step <= q < num_steps)
+            if avail < window:
+                break  # ragged tail: the per-step loop finishes it
+            if (step - last_ckpt >= self.checkpoint_every
+                    and not skip_window):
+                self.checkpoint(trainer, step)
+                last_ckpt = step
+            # assemble the window: the next N non-quarantined steps,
+            # each probing the guardian.check site exactly once
+            idxs: list = []
+            probe = step
+            forced = False
+            while len(idxs) < window:
+                try:
+                    inject("guardian.check", key=probe)
+                except Exception:
+                    forced = True
+                    break
+                if probe not in self._quarantined_steps:
+                    idxs.append(probe)
+                probe += 1
+            if forced:
+                step = self.rollback(trainer)
+                last_ckpt = step
+                skip_window.clear()
+                continue
+            datas, labels = zip(*(data_fn(s) for s in idxs))
+            # count_skips=False: the process-wide guardian_skips counter
+            # is bumped below for PROCESSED skips only, so a mid-window
+            # rollback's discarded tail (executed on device, wiped by
+            # the restore) cannot drift it vs the per-step drive
+            res = trainer.step_window(onp.stack([_np(d) for d in datas]),
+                                      onp.stack([_np(l) for l in labels]),
+                                      count_skips=False)
+            loss_host = None
+            rolled = False
+            for i, s in enumerate(idxs):
+                self.stats["steps"] += 1
+                if not bool(res.ok[i]):
+                    self.stats["skips"] += 1
+                    bump("guardian_skips")
+                    skip_window.append(s)
+                    if len(skip_window) >= self.max_skips:
+                        self._quarantined_steps.update(skip_window)
+                        step = self.rollback(trainer)
+                        last_ckpt = step
+                        skip_window.clear()
+                        rolled = True
+                        break
+                    continue
+                skip_window.clear()
+                if self.spike_factor is not None:
+                    if loss_host is None:
+                        loss_host = res.losses.asnumpy()
+                    if self._is_spike(float(loss_host[i])):
+                        self.stats["spikes"] += 1
+                        self._quarantined_steps.add(s)
+                        step = self.rollback(trainer)
+                        last_ckpt = step
+                        rolled = True
+                        break
+            if not rolled:
+                step = probe
+        return step, last_ckpt

@@ -133,6 +133,34 @@ def test_undonated_trainer_step_flagged(trainer_parts):
     assert subjects == ["opt_states", "params"], str(rep)
 
 
+def test_multistep_window_donation_verified_through_scan(trainer_parts):
+    """n_steps=N checks the fused lax.scan window (docs/training.md):
+    params + optimizer state are the scan's loop carries AND the
+    program's donated inputs — the proof must hold through the
+    loop-carried program, executable level included."""
+    net, mesh, X_, y_ = trainer_parts
+    rep = check_trainer_donation(_trainer(net, mesh, guard=True), X_, y_,
+                                 n_steps=8)
+    assert rep.ok and not rep.warnings, str(rep)
+    d3 = rep.filter(code="D003").diagnostics
+    assert len(d3) == 1
+    assert d3[0].details["loop_carried"] is True
+    assert "loop-carried" in d3[0].message
+    assert "executable confirms input_output_alias" in d3[0].message
+
+
+def test_undonated_multistep_window_flagged(trainer_parts):
+    """The seeded defect at window granularity: a donate=False window
+    holds params and optimizer state twice across ALL N fused steps —
+    the same D002s the flat step draws."""
+    net, mesh, X_, y_ = trainer_parts
+    rep = check_trainer_donation(_trainer(net, mesh, guard=True,
+                                          donate=False), X_, y_,
+                                 compile=False, n_steps=8)
+    subjects = sorted(d.subject for d in rep.filter(code="D002"))
+    assert subjects == ["opt_states", "params"], str(rep)
+
+
 # -- CLI ---------------------------------------------------------------
 
 def test_cli_donate_self_check_passes(capsys):

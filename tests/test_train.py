@@ -7,6 +7,7 @@ metric) reaches a hard accuracy threshold, not just "loss went down".
 """
 
 import numpy as np
+import pytest
 
 import mxtpu as mx
 from mxtpu import autograd, nd
@@ -87,6 +88,8 @@ def test_spmd_trainer_trains_to_threshold():
     assert acc >= 0.95, f"validation accuracy {acc:.3f} < 0.95"
 
 
+@pytest.mark.slow  # end-to-end example convergence, ~22s; test_mlp_trains_to_threshold
+# stays as the tier-1 train-example anchor
 def test_llama_train_example_loss_decreases():
     """Drive examples/parallel/llama_train.py end-to-end on the virtual
     mesh: reduced-width llama-3 architecture, dp x tp x sp composed in
@@ -106,6 +109,8 @@ def test_llama_train_example_loss_decreases():
     assert losses[-1] < losses[0] * 0.8, (losses[0], losses[-1])
 
 
+@pytest.mark.slow  # end-to-end example convergence, ~33s; test_mlp_trains_to_threshold
+# stays as the tier-1 train-example anchor
 def test_ssd_example_trains_and_localizes():
     """Drive examples/gluon/ssd.py: multibox train loop + NMS decode.
     The IoU assertion guards head/anchor ORDER alignment — a scrambled
@@ -150,6 +155,8 @@ def test_ssd_example_trains_and_localizes():
     assert hits >= 4, "only %d/8 images localized a GT box" % hits
 
 
+@pytest.mark.slow  # end-to-end example convergence, ~19s; test_mlp_trains_to_threshold
+# stays as the tier-1 train-example anchor
 def test_rnn_lm_example_converges_and_buckets():
     """Drive examples/gluon/rnn_lm.py (VERDICT r4 item 7): CorpusDataset
     file pipeline -> two-bucket jit cache -> fused-scan LSTM; perplexity

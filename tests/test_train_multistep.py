@@ -1,0 +1,420 @@
+"""Multi-step fused training capture (docs/training.md): N steps
+compiled as ONE donated ``lax.scan`` program via
+``SPMDTrainer.step_window``, the guardian's finiteness gate folded per
+scan iteration with skip/scale counters carried in the loop state, and
+``Guardian.run(window=N)`` driving the full skip/quarantine/rollback
+policy over windows.
+
+The acceptance invariant throughout: loss/param trajectories at
+N∈{1,8,64} are BIT-identical to the per-step path — including injected
+guardian skips landing mid-window, dropout RNG streams, lr schedules,
+and the dynamic loss-scale automaton — while the CompileLedger shows
+exactly one trainer program per N across skip/rollback/replay."""
+
+import tempfile
+
+import numpy as np
+import pytest
+
+import mxtpu as mx
+from mxtpu import gluon, nd
+from mxtpu.gluon import nn
+from mxtpu.parallel import make_mesh, SPMDTrainer
+from mxtpu.parallel.trainer import TrainWindow
+from mxtpu.resilience import Guardian, counters, fault_plan
+from mxtpu.analysis import get_ledger
+
+
+def _build_spmd(seed=7, opt="adam", guard=True, **kw):
+    mx.random.seed(seed)
+    net = nn.Dense(4, in_units=8, prefix="d_")
+    net.initialize()
+    tr = SPMDTrainer(net, gluon.loss.L2Loss(), opt, make_mesh(dp=2),
+                     optimizer_params=kw.pop("optimizer_params",
+                                             {"learning_rate": 1e-2}),
+                     guard=guard, **kw)
+    return net, tr
+
+
+def _build_drop(seed=21, guard=True):
+    """Dropout net: every step draws a traced RNG key, so trajectory
+    equality proves the window consumes the key-ring in per-step
+    order."""
+    mx.random.seed(seed)
+    net = nn.HybridSequential(prefix="n_")
+    net.add(nn.Dense(16, in_units=8, prefix="a_"), nn.Dropout(0.5),
+            nn.Dense(4, in_units=16, prefix="b_"))
+    net.initialize()
+    tr = SPMDTrainer(net, gluon.loss.L2Loss(), "sgd", make_mesh(dp=2),
+                     optimizer_params={"learning_rate": 1e-2},
+                     guard=guard)
+    return net, tr
+
+
+def _batches(n, seed=1, nan_steps=()):
+    R = np.random.RandomState(seed)
+    out = []
+    for i in range(n):
+        X = R.randn(8, 8).astype(np.float32)
+        if i in nan_steps:
+            X[0, 0] = np.nan
+        out.append((X, R.randn(8, 4).astype("f")))
+    return out
+
+
+def _stack(bs, lo=0, hi=None):
+    part = bs[lo:hi]
+    return (np.stack([b[0] for b in part]),
+            np.stack([b[1] for b in part]))
+
+
+def _weights(net):
+    p = net[0] if isinstance(net, nn.HybridSequential) else net
+    return p.weight.data().asnumpy()
+
+
+def _state_leaves(tr):
+    import jax
+    return [np.asarray(leaf) for leaf in
+            jax.tree_util.tree_leaves(tuple(tr._opt_states))]
+
+
+# ------------------------------------------------------------ step_window
+
+class TestWindowParity:
+    def test_window_matches_per_step_guarded(self):
+        bs = _batches(8)
+        net1, tr1 = _build_spmd()
+        losses1 = [float(tr1.step(nd.array(X), nd.array(y)).asnumpy())
+                   for X, y in bs]
+        net2, tr2 = _build_spmd()
+        res = tr2.step_window(*_stack(bs))
+        assert isinstance(res, TrainWindow)
+        assert res.num_good == 8 and res.ok.all()
+        np.testing.assert_array_equal(_weights(net1), _weights(net2))
+        for a, b in zip(_state_leaves(tr1), _state_leaves(tr2)):
+            np.testing.assert_array_equal(a, b)
+        np.testing.assert_array_equal(np.asarray(losses1, np.float32),
+                                      res.losses.asnumpy())
+        assert tr1._num_update == tr2._num_update == 8
+
+    def test_window_matches_per_step_unguarded(self):
+        bs = _batches(8, seed=2)
+        net1, tr1 = _build_spmd(guard=False)
+        for X, y in bs:
+            tr1.step(nd.array(X), nd.array(y))
+        net2, tr2 = _build_spmd(guard=False)
+        res = tr2.step_window(*_stack(bs))
+        assert res.ok is None and res.num_good == 8
+        np.testing.assert_array_equal(_weights(net1), _weights(net2))
+
+    def test_n_1_8_64_trajectories_bit_identical_with_dropout(self):
+        """The acceptance matrix: 64 steps driven per-step, as windows
+        of 1, as 8 windows of 8, and as ONE window of 64 — all four
+        param trajectories bit-identical (dropout proves RNG-stream
+        parity; two skips prove the gate folds per iteration)."""
+        bs = _batches(64, seed=5, nan_steps={10, 33})
+
+        def drive(window):
+            net, tr = _build_drop()
+            if window == 0:
+                for X, y in bs:
+                    tr.step(nd.array(X), nd.array(y))
+            else:
+                for w in range(0, 64, window):
+                    tr.step_window(*_stack(bs, w, w + window))
+            return _weights(net), tr
+
+        ref, tr_ref = drive(0)
+        for window in (1, 8, 64):
+            got, tr_w = drive(window)
+            np.testing.assert_array_equal(ref, got)
+            assert tr_w._num_update == tr_ref._num_update == 62
+
+    def test_skip_mid_window_gated_and_counted(self):
+        bs = _batches(8, seed=3, nan_steps={3, 4})
+        c0 = counters()
+        net, tr = _build_spmd()
+        res = tr.step_window(*_stack(bs))
+        c1 = counters()
+        assert list(res.ok) == [True, True, True, False, False, True,
+                                True, True]
+        assert res.num_good == 6 and tr._num_update == 6
+        losses = res.losses.asnumpy()
+        assert not np.isfinite(losses[3]) and not np.isfinite(losses[4])
+        assert np.isfinite(np.delete(losses, [3, 4])).all()
+        assert c1["guardian_skips"] == c0["guardian_skips"] + 2
+        # the once-per-N sync counter: ONE bump for the whole window
+        assert c1["train_window_syncs"] == c0["train_window_syncs"] + 1
+
+    def test_lr_schedule_parity_under_mid_window_skip(self):
+        """The on-host lr ladder indexed by the carried good-step
+        counter: a schedule that changes every update must stay
+        bit-identical when a skip shifts the update count mid-window."""
+        from mxtpu.optimizer import lr_scheduler
+        bs = _batches(8, seed=6, nan_steps={2})
+
+        def build():
+            return _build_spmd(opt="sgd", optimizer_params={
+                "learning_rate": 1e-2,
+                "lr_scheduler": lr_scheduler.FactorScheduler(
+                    step=2, factor=0.5, stop_factor_lr=1e-6)})
+
+        net1, tr1 = build()
+        for X, y in bs:
+            tr1.step(nd.array(X), nd.array(y))
+        net2, tr2 = build()
+        tr2.step_window(*_stack(bs))
+        np.testing.assert_array_equal(_weights(net1), _weights(net2))
+        assert tr1._num_update == tr2._num_update == 7
+
+    def test_dynamic_loss_scale_automaton_carried(self):
+        """The (scale, clean) automaton rides the scan carry: a
+        mid-window overflow backs the scale off exactly where the
+        per-step path would."""
+        bs = _batches(8, seed=7, nan_steps={5})
+
+        def build():
+            return _build_spmd(opt="sgd", dynamic_loss_scale=True,
+                               loss_scale_window=3)
+
+        net1, tr1 = build()
+        for X, y in bs:
+            tr1.step(nd.array(X), nd.array(y))
+        net2, tr2 = build()
+        res = tr2.step_window(*_stack(bs))
+        assert res.num_good == 7
+        np.testing.assert_array_equal(_weights(net1), _weights(net2))
+        assert tr1.loss_scale == tr2.loss_scale
+
+    def test_window_shape_validation(self):
+        _, tr = _build_spmd()
+        X, y = _batches(4)[0]
+        with pytest.raises(ValueError, match="label window"):
+            tr.step_window(np.stack([X] * 4), np.stack([y] * 3))
+
+    def test_mixed_step_and_window_drive(self):
+        """step() and step_window() interleave freely: bookkeeping
+        (num_update, scale state, RNG ring) is shared."""
+        bs = _batches(12, seed=9)
+        net1, tr1 = _build_spmd()
+        for X, y in bs:
+            tr1.step(nd.array(X), nd.array(y))
+        net2, tr2 = _build_spmd()
+        X, y = bs[0]
+        tr2.step(nd.array(X), nd.array(y))
+        tr2.step_window(*_stack(bs, 1, 9))
+        for X, y in bs[9:]:
+            tr2.step(nd.array(X), nd.array(y))
+        np.testing.assert_array_equal(_weights(net1), _weights(net2))
+
+
+class TestWindowCompileDiscipline:
+    def test_one_program_per_n_across_skips(self):
+        """Exactly ONE spmd_trainer.step_multi program per window size,
+        no retrace when a window contains skips."""
+        led = get_ledger()
+        before = dict(led.miss_counts(("spmd_trainer.step_multi",)))
+        bs = _batches(24, seed=11, nan_steps={5, 12})
+        _, tr = _build_spmd(seed=31)
+        for w in range(0, 24, 8):
+            tr.step_window(*_stack(bs, w, w + 8))
+        _, tr64 = _build_spmd(seed=31)
+        tr64.step_window(*_stack(_batches(64, seed=12)))
+        after = led.miss_counts(("spmd_trainer.step_multi",))
+        new = (after.get("spmd_trainer.step_multi", 0)
+               - before.get("spmd_trainer.step_multi", 0))
+        assert new == 2  # one program at N=8, one at N=64
+
+
+# --------------------------------------------------- windowed guardian
+
+class TestGuardianWindowed:
+    def test_nan_mid_window_across_ckpt_boundary_matches_per_step(
+            self, tmp_path):
+        """The satellite acceptance: a counter-driven non-finite
+        injection landing mid-scan-window, across a checkpoint
+        boundary, produces the IDENTICAL param trajectory, stats and
+        quarantine set as the N=1 per-step drive — and both equal a run
+        that never saw the quarantined batches."""
+        bs = _batches(16, seed=4, nan_steps={9, 10})
+
+        def data_fn(s):
+            return nd.array(bs[s][0]), nd.array(bs[s][1])
+
+        net1, tr1 = _build_drop()
+        g1 = Guardian(str(tmp_path / "per_step"), max_skips=2,
+                      checkpoint_every=4)
+        st1 = g1.run(tr1, data_fn, 16)  # window=1 (default)
+
+        net2, tr2 = _build_drop()
+        g2 = Guardian(str(tmp_path / "windowed"), max_skips=2,
+                      checkpoint_every=4)
+        st2 = g2.run(tr2, data_fn, 16, window=4)
+
+        assert st1 == st2
+        assert st2["skips"] == 2 and st2["rollbacks"] == 1
+        assert g1._quarantined_steps == g2._quarantined_steps == {9, 10}
+        np.testing.assert_array_equal(_weights(net1), _weights(net2))
+        # both equal the never-saw-those-batches reference
+        net3, tr3 = _build_drop()
+        for i in range(16):
+            if i not in (9, 10):
+                tr3.step(data_fn(i)[0], data_fn(i)[1])
+        np.testing.assert_array_equal(_weights(net2), _weights(net3))
+
+    def test_rollback_discarded_tail_does_not_drift_skip_counter(
+            self, tmp_path):
+        """NaNs at {9, 10, 11} with window=4, max_skips=2: the window
+        [8..11] executes all four steps on device, the rollback at step
+        10 discards step 11's contained skip, and the replay re-skips
+        it once — the process-wide guardian_skips counter must match
+        the per-step drive exactly (the guardian counts processed
+        skips, not device-executed ones)."""
+        bs = _batches(16, seed=4, nan_steps={9, 10, 11})
+
+        def data_fn(s):
+            return nd.array(bs[s][0]), nd.array(bs[s][1])
+
+        def drive(window, d):
+            net, tr = _build_spmd(seed=53)
+            g = Guardian(str(tmp_path / d), max_skips=2,
+                         checkpoint_every=4, max_rollbacks=5)
+            c0 = counters()["guardian_skips"]
+            st = g.run(tr, data_fn, 16, window=window)
+            return (_weights(net), st,
+                    counters()["guardian_skips"] - c0,
+                    set(g._quarantined_steps))
+
+        w1, st1, sk1, q1 = drive(1, "a")
+        w4, st4, sk4, q4 = drive(4, "b")
+        assert sk1 == sk4 and st1 == st4 and q1 == q4
+        np.testing.assert_array_equal(w1, w4)
+
+    def test_misaligned_checkpoint_schedule_trajectory_invariant(
+            self, tmp_path):
+        """checkpoint_every NOT a multiple of window: checkpoint
+        placement (and hence replay-prefix stats) may differ from the
+        per-step drive, but the surviving trajectory and quarantine set
+        are a pure function of the data stream — bit-identical in every
+        configuration (the documented invariant split)."""
+        bs = _batches(20, seed=6, nan_steps={6, 9, 10})
+
+        def data_fn(s):
+            return nd.array(bs[s][0]), nd.array(bs[s][1])
+
+        net1, tr1 = _build_drop(seed=31)
+        g1 = Guardian(str(tmp_path / "a"), max_skips=2,
+                      checkpoint_every=5, max_rollbacks=5)
+        g1.run(tr1, data_fn, 20)
+        net2, tr2 = _build_drop(seed=31)
+        g2 = Guardian(str(tmp_path / "b"), max_skips=2,
+                      checkpoint_every=5, max_rollbacks=5)
+        g2.run(tr2, data_fn, 20, window=4)
+        assert g1._quarantined_steps == g2._quarantined_steps
+        np.testing.assert_array_equal(_weights(net1), _weights(net2))
+
+    def test_streak_spanning_window_boundary(self, tmp_path):
+        """A skip streak crossing a WINDOW boundary (steps 7, 8 with
+        window=4) must carry the streak state across windows and
+        quarantine both steps, exactly like the per-step drive."""
+        bs = _batches(16, seed=8, nan_steps={7, 8})
+
+        def data_fn(s):
+            return nd.array(bs[s][0]), nd.array(bs[s][1])
+
+        net1, tr1 = _build_spmd(seed=23)
+        g1 = Guardian(str(tmp_path / "a"), max_skips=2,
+                      checkpoint_every=4)
+        st1 = g1.run(tr1, data_fn, 16)
+        net2, tr2 = _build_spmd(seed=23)
+        g2 = Guardian(str(tmp_path / "b"), max_skips=2,
+                      checkpoint_every=4)
+        st2 = g2.run(tr2, data_fn, 16, window=4)
+        assert st1 == st2 and st2["rollbacks"] == 1
+        assert g2._quarantined_steps == {7, 8}
+        np.testing.assert_array_equal(_weights(net1), _weights(net2))
+
+    def test_forced_divergence_windowed_replay_bit_exact(self, tmp_path):
+        """guardian.check fires per step index at window assembly; a
+        planned raise rolls back and the replayed run lands
+        bit-identical to the fault-free windowed run."""
+        bs = _batches(16, seed=5)
+
+        def data_fn(s):
+            return nd.array(bs[s][0]), nd.array(bs[s][1])
+
+        net1, tr1 = _build_drop(seed=29)
+        g1 = Guardian(str(tmp_path / "clean"), checkpoint_every=4)
+        g1.run(tr1, data_fn, 16, window=4)
+        net2, tr2 = _build_drop(seed=29)
+        g2 = Guardian(str(tmp_path / "faulted"), checkpoint_every=4)
+        with fault_plan("guardian.check@10:raise"):
+            st = g2.run(tr2, data_fn, 16, window=4)
+        assert st["rollbacks"] == 1
+        np.testing.assert_array_equal(_weights(net1), _weights(net2))
+
+    def test_spike_mid_window_quarantined(self, tmp_path):
+        bs = _batches(12, seed=8)
+        bs[6] = (bs[6][0] * 1e6, bs[6][1])
+
+        def data_fn(s):
+            return nd.array(bs[s][0]), nd.array(bs[s][1])
+
+        net1, tr1 = _build_spmd(seed=17)
+        g1 = Guardian(str(tmp_path / "a"), spike_factor=100.0,
+                      checkpoint_every=4, max_rollbacks=10)
+        st1 = g1.run(tr1, data_fn, 12)
+        net2, tr2 = _build_spmd(seed=17)
+        g2 = Guardian(str(tmp_path / "b"), spike_factor=100.0,
+                      checkpoint_every=4, max_rollbacks=10)
+        st2 = g2.run(tr2, data_fn, 12, window=4)
+        assert st1 == st2 and st2["spikes"] == 1
+        assert g2._quarantined_steps == {6}
+        np.testing.assert_array_equal(_weights(net1), _weights(net2))
+
+    def test_ragged_tail_and_env_default(self, tmp_path, monkeypatch):
+        """num_steps not a multiple of the window: the per-step loop
+        finishes the tail; MXTPU_TRAIN_WINDOW supplies the ambient
+        window."""
+        bs = _batches(14, seed=13)
+
+        def data_fn(s):
+            return nd.array(bs[s][0]), nd.array(bs[s][1])
+
+        net1, tr1 = _build_spmd(seed=41)
+        g1 = Guardian(str(tmp_path / "a"), checkpoint_every=4)
+        st1 = g1.run(tr1, data_fn, 14)
+        net2, tr2 = _build_spmd(seed=41)
+        monkeypatch.setenv("MXTPU_TRAIN_WINDOW", "4")
+        g2 = Guardian(str(tmp_path / "b"), checkpoint_every=4)
+        st2 = g2.run(tr2, data_fn, 14)
+        assert st1 == st2
+        np.testing.assert_array_equal(_weights(net1), _weights(net2))
+
+    def test_ledger_one_program_across_skip_rollback_replay(
+            self, tmp_path):
+        """The acceptance pin: a windowed guardian run that skips,
+        rolls back AND replays compiles exactly ONE step_multi program.
+        (Quarantining {9, 10} leaves 14 non-quarantined steps, so the
+        last 2 finish as the documented per-step ragged tail — at most
+        the ONE per-step program rides along, never a second window
+        program.)"""
+        led = get_ledger()
+        sites = ("spmd_trainer.step", "spmd_trainer.step_multi")
+        before = dict(led.miss_counts(sites))
+        bs = _batches(16, seed=4, nan_steps={9, 10})
+
+        def data_fn(s):
+            return nd.array(bs[s][0]), nd.array(bs[s][1])
+
+        net, tr = _build_spmd(seed=47)
+        g = Guardian(str(tmp_path / "g"), max_skips=2,
+                     checkpoint_every=4)
+        st = g.run(tr, data_fn, 16, window=4)
+        assert st["rollbacks"] == 1  # skip + rollback + replay all hit
+        after = led.miss_counts(sites)
+        assert (after.get("spmd_trainer.step_multi", 0)
+                - before.get("spmd_trainer.step_multi", 0)) == 1
+        assert (after.get("spmd_trainer.step", 0)
+                - before.get("spmd_trainer.step", 0)) <= 1
