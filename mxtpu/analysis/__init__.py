@@ -4,7 +4,7 @@ the op registry, sharding rules, and the compiled-program discipline
 as static analyses before execution; see PAPER.md §1 layer 6 and
 src/executor/graph_executor.cc in the reference).
 
-Eight shipped passes, each returning a :class:`Report` of located
+Nine shipped passes, each returning a :class:`Report` of located
 :class:`Diagnostic` records instead of silent Nones or deep-in-XLA
 failures:
 
@@ -32,6 +32,10 @@ failures:
   self-applied to the shipped ``ops/pallas`` kernels at their real
   serving/training geometries; ``kernel_vmem_estimate`` is the
   per-grid-step VMEM pricer beside the HBM model.
+- ``check_observability()`` — observability coverage (O0xx): every
+  declared fault site must resolve to a registered trace event type
+  and every CompileLedger site to a unified-metrics key, so telemetry
+  coverage is lost loudly (mirroring R005; docs/observability.md).
 
 CLI: ``python -m mxtpu.analysis`` (see docs/analysis.md).  Custom passes
 register via :func:`register_pass` and run via :func:`run_pass`.
@@ -52,6 +56,7 @@ from .memory_estimate import (MemoryEstimate, check_memory,
                               kernel_vmem_estimate, kv_cache_residency,
                               paged_kv_cache_residency, sublane_tile,
                               xla_memory_stats)
+from .obs_check import check_observability
 from .registry_audit import audit_fault_sites, audit_registry
 from .sharding_check import check_sharding
 from .trace_lint import lint_source, trace_lint
@@ -70,4 +75,5 @@ __all__ = [
     "check_donation", "check_trainer_donation",
     "KernelSpec", "BlockOperand", "ScratchOperand", "ScalarPrefetch",
     "check_kernels", "default_kernel_specs",
+    "check_observability",
 ]

@@ -18,6 +18,9 @@ Subcommands:
   kernels' KernelSpecs at their real TPU serving/training geometries
   (``--vmem-budget 16MiB`` to price a different ceiling)
 - ``sharding``           sharding-rule self-check on a reference rule set
+- ``obs``                observability coverage check: every declared
+  fault site resolves to a registered trace event type and every
+  compile-ledger site to a unified-metrics key (O001 on any loss)
 - ``all``                EVERY registered pass, each through its
   self-application probe (the repo self-lint; default).  A pass
   registered without a probe wired here gets a P001 ERROR — the gate
@@ -33,8 +36,8 @@ import argparse
 import sys
 
 from . import (Report, Severity, audit_registry, check_compiles,
-               check_kernels, check_memory, check_sharding, list_passes,
-               trace_lint, verify_graph)
+               check_kernels, check_memory, check_observability,
+               check_sharding, list_passes, trace_lint, verify_graph)
 from .diagnostics import Diagnostic
 
 
@@ -146,6 +149,13 @@ def _self_apply_kernels(vmem_budget=None) -> Report:
     return check_kernels(**kw)
 
 
+def _self_apply_obs() -> Report:
+    """Observability coverage over the live process state: every
+    declared fault site resolves to a trace event type, every ledger
+    site to a unified-metrics key (O001 on any loss)."""
+    return check_observability(include_summary=True)
+
+
 # Every registered pass needs a self-application probe here; `all` runs
 # each one and emits a P001 ERROR for any pass left unwired, so a new
 # pass cannot be silently skipped by the CI gate.
@@ -158,6 +168,7 @@ _SELF_APPLY = {
     "check_sharding": _self_apply_sharding,
     "donation_check": _self_apply_donation,
     "kernel_check": _self_apply_kernels,
+    "obs_check": _self_apply_obs,
 }
 
 
@@ -196,7 +207,7 @@ def main(argv=None) -> int:
     ap.add_argument("command", nargs="?", default="all",
                     choices=["all", "registry", "lint", "graph",
                              "memory", "compile", "donate", "kernel",
-                             "sharding"])
+                             "sharding", "obs"])
     ap.add_argument("paths", nargs="*",
                     help="lint: files/dirs; graph/memory: one "
                          "symbol.json; compile: one ledger dump")
@@ -258,6 +269,8 @@ def main(argv=None) -> int:
         report.extend(_self_apply_kernels(vmem_budget=args.vmem_budget))
     if args.command == "sharding":
         report.extend(_self_apply_sharding())
+    if args.command == "obs":
+        report.extend(_self_apply_obs())
 
     if args.json:
         print(report.to_json())

@@ -1,0 +1,109 @@
+"""Observability coverage check (O0xx): fault sites and ledger sites
+must resolve to registered telemetry.
+
+The observability layer (docs/observability.md) only earns its keep if
+its coverage cannot rot silently: a fault site added to
+``resilience.faults.SITES`` without a ``fault.<site>`` entry in the
+trace taxonomy would fire events that the tracer REJECTS (downgraded to
+``fault.unregistered``), and a CompileLedger site that no metrics
+source exposes would vanish from every dashboard.  Mirroring R005 (a
+declared fault site no test plan covers), this pass makes both losses
+loud:
+
+========  ========  ====================================================
+code      severity  meaning
+========  ========  ====================================================
+``O001``  ERROR     a declared fault site has no registered
+                    ``fault.<site>`` trace event type, or a recorded
+                    CompileLedger site does not resolve to a
+                    ``compile_ledger.<site>.programs`` metrics key (or
+                    the registry lost its ``compile_ledger`` source
+                    entirely) — observability coverage silently lost
+``O002``  INFO      per-run summary (sites checked, event types
+                    declared, metrics sources registered)
+========  ========  ====================================================
+
+Self-applied in tier-1 via ``python -m mxtpu.analysis all`` (the
+``obs`` subcommand runs it alone); red-team fixtures in
+tests/test_observability.py assert O001 fires for a site with no event
+type and for a registry stripped of its ledger source.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, Optional
+
+from .diagnostics import Diagnostic, Report, Severity, register_pass
+
+__all__ = ["check_observability"]
+
+_PASS = "obs_check"
+
+
+def check_observability(sites: Optional[Iterable[str]] = None,
+                        ledger=None, registry=None,
+                        include_summary: bool = False) -> Report:
+    """Cross-check the declared fault sites against the trace-event
+    taxonomy, and the compile ledger's recorded sites against the
+    metrics registry (module docstring).
+
+    sites: override ``resilience.faults.SITES`` (red-team fixtures).
+    ledger: a :class:`~mxtpu.analysis.compile_ledger.CompileLedger`
+    (default: the live process ledger).  registry: a
+    :class:`~mxtpu.observability.metrics.MetricsRegistry` (default: the
+    process registry)."""
+    from ..observability.trace import EVENT_TYPES
+
+    report = Report()
+    if sites is None:
+        from ..resilience.faults import SITES as sites
+    sites = tuple(sites)
+    for site in sites:
+        etype = "fault." + site
+        if etype not in EVENT_TYPES:
+            report.add(Diagnostic(
+                _PASS, "O001", Severity.ERROR, site,
+                "declared fault site %r has no registered trace event "
+                "type %r — a plan firing there would be downgraded to "
+                "fault.unregistered and its failure would be invisible "
+                "in traces and flight postmortems; add the type to "
+                "mxtpu.observability.trace.EVENT_TYPES (or retire the "
+                "site)" % (site, etype)))
+
+    if ledger is None:
+        from .compile_ledger import get_ledger
+        ledger = get_ledger()
+    if registry is None:
+        from ..observability.metrics import get_registry
+        registry = get_registry()
+    ledger_sites = ledger.sites()
+    if "compile_ledger" not in registry.sources():
+        report.add(Diagnostic(
+            _PASS, "O001", Severity.ERROR, "compile_ledger",
+            "the metrics registry has no 'compile_ledger' source — "
+            "every compiled-program count is invisible to snapshot()/"
+            "Prometheus exposition; re-register it (see "
+            "mxtpu.observability.metrics.default_registry)"))
+    else:
+        snap = registry.snapshot(sources=("compile_ledger",))
+        for site in ledger_sites:
+            key = "compile_ledger.%s.programs" % site
+            if key not in snap:
+                report.add(Diagnostic(
+                    _PASS, "O001", Severity.ERROR, site,
+                    "compile-ledger site %r does not resolve to the "
+                    "metrics key %r — its program count is lost to the "
+                    "unified registry (a filtering/replacement of the "
+                    "compile_ledger source dropped it)" % (site, key)))
+
+    if include_summary or len(report) == 0:
+        report.add(Diagnostic(
+            _PASS, "O002", Severity.INFO, "coverage",
+            "%d fault site(s) resolve to trace event types; %d ledger "
+            "site(s) resolve to metrics keys; %d metrics source(s) "
+            "registered" % (len(sites), len(ledger_sites),
+                            len(registry.sources()))))
+    return report
+
+
+register_pass(_PASS)(check_observability)

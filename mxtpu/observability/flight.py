@@ -1,0 +1,276 @@
+"""Failure flight recorder: bounded per-request ring buffers + postmortems.
+
+A production failure report must carry the failing request's WHOLE
+timeline — admitted where, routed where, which tier served its prefix,
+which fault fired — without paying unbounded trace memory on the happy
+path.  The flight recorder is the bounded always-on form of the tracer:
+it attaches to the :class:`~mxtpu.observability.trace.Tracer` as a sink
+(events flow even while full tracing is disabled), keeps only the last
+``buffer`` events per request id in a ring, and on any failure path —
+engine quarantine, load shed, replica death drain, guardian rollback,
+checkpoint corruption — snapshots a :class:`Postmortem` naming the
+implicated requests plus a resilience-counters DELTA (relative to the
+recorder's reset, so reruns of the same seed + fault plan serialize
+byte-identically; asserted in tests/test_observability.py).
+
+Timelines materialize at READ time (:meth:`FlightRecorder.postmortem_
+record` / :meth:`to_json`) from the live ring buffers: a replica-death
+postmortem dumped after the run therefore shows the drained requests'
+requeue ("reset") and re-dispatch events too, not just their history up
+to the death — the ring bound is the only truncation, and it is
+explicit (``MXTPU_FLIGHT_BUFFER`` events per request).
+
+Enable with ``MXTPU_FLIGHT_BUFFER=N`` (ambient, N > 0 events per
+request) or the :func:`flight_recording` context manager / ``get_
+flight().enable()``.  Determinism: ticks come from the tracer's counter
+clock; wall clocks never appear in a postmortem.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+from collections import deque
+from typing import Any, Dict, List, Optional, Tuple
+
+from .trace import TraceEvent, get_tracer
+
+__all__ = ["Postmortem", "FlightRecorder", "get_flight",
+           "flight_recording"]
+
+#: ring buffers kept for at most this many distinct request ids; the
+#: oldest-touched id is evicted past it (bounded-bookkeeping discipline)
+MAX_TRACKED_REQUESTS = 4096
+#: postmortem records kept (oldest evicted past it)
+MAX_POSTMORTEMS = 256
+
+
+def default_buffer() -> int:
+    """Ambient per-request ring size: ``MXTPU_FLIGHT_BUFFER`` (0 = the
+    recorder stays off)."""
+    try:
+        return max(0, int(os.environ.get("MXTPU_FLIGHT_BUFFER", "0")))
+    except ValueError:
+        return 0
+
+
+class Postmortem:
+    """One failure snapshot: the trigger (kind, tick, context, counters
+    delta) captured at failure time plus the implicated request ids
+    whose timelines materialize from the ring buffers at read time."""
+
+    __slots__ = ("kind", "tick", "rids", "context", "counters")
+
+    def __init__(self, kind: str, tick: int, rids: Tuple[str, ...],
+                 context: Dict[str, Any], counters: Dict[str, int]):
+        self.kind = kind
+        self.tick = tick
+        self.rids = rids
+        self.context = context
+        self.counters = counters
+
+    def __repr__(self):
+        return "<Postmortem %s tick=%d rids=%r>" % (
+            self.kind, self.tick, list(self.rids))
+
+
+class FlightRecorder:
+    """Bounded per-request event rings + failure postmortems (module
+    docstring)."""
+
+    def __init__(self, buffer: Optional[int] = None):
+        self._buffer = default_buffer() if buffer is None else int(buffer)
+        self._rings: Dict[str, deque] = {}
+        self._posts: List[Postmortem] = []
+        self._counter_base: Dict[str, int] = {}
+        self._attached = False
+        if self._buffer > 0:
+            self.enable(reset=True)
+
+    # -- lifecycle -------------------------------------------------------
+    @property
+    def active(self) -> bool:
+        return self._attached
+
+    @property
+    def buffer(self) -> int:
+        return self._buffer
+
+    def enable(self, buffer: Optional[int] = None,
+               reset: bool = True) -> "FlightRecorder":
+        if buffer is not None:
+            self._buffer = int(buffer)
+        if self._buffer <= 0:
+            raise ValueError(
+                "flight recorder needs a positive per-request buffer "
+                "(set MXTPU_FLIGHT_BUFFER or pass buffer=)")
+        if reset:
+            self.reset()
+        if not self._attached:
+            get_tracer().add_sink(self)
+            self._attached = True
+        return self
+
+    def disable(self) -> None:
+        if self._attached:
+            get_tracer().remove_sink(self)
+            self._attached = False
+
+    def reset(self) -> None:
+        """Clear rings and postmortems and re-baseline the counters
+        snapshot — the start-of-run point postmortem determinism is
+        relative to."""
+        self._rings = {}
+        self._posts = []
+        self._counter_base = self._counters_now()
+
+    # -- the tracer sink -------------------------------------------------
+    def observe(self, ev: TraceEvent) -> None:
+        """Called by the tracer for every emitted event (rid-less events
+        land in a shared ``_global`` ring so pool-level context —
+        replica deaths, spilled chains — survives into postmortems)."""
+        rid = ev.rid if ev.rid is not None else "_global"
+        ring = self._rings.get(rid)
+        if ring is None:
+            if len(self._rings) >= MAX_TRACKED_REQUESTS:
+                # evict the least-recently-touched id (insertion order
+                # approximates it; dict preserves insertion order and a
+                # touched ring is re-inserted below)
+                self._rings.pop(next(iter(self._rings)))
+            ring = deque(maxlen=self._buffer)
+        else:
+            del self._rings[rid]     # re-insert = touch
+        ring.append(ev)
+        self._rings[rid] = ring
+
+    # -- failure capture -------------------------------------------------
+    @staticmethod
+    def _counters_now() -> Dict[str, int]:
+        # Bootstrap guard: the ambient recorder is constructed at
+        # module import (MXTPU_FLIGHT_BUFFER), and importing
+        # mxtpu.resilience from here would circle back into this
+        # still-executing module (guardian imports it).  Only read
+        # counters from an ALREADY-imported module — before
+        # mxtpu.resilience.counters exists, every counter is zero
+        # (its module holds the only writers), so the empty baseline
+        # is exact, not approximate.
+        mod = sys.modules.get("mxtpu.resilience.counters")
+        if mod is None:
+            return {}
+        return mod.counters()
+
+    def failure(self, kind: str, rids=(), **context) -> Optional[Postmortem]:
+        """Record one postmortem (no-op while inactive).  ``rids`` are
+        correlation ids (resolved through the tracer's alias map);
+        ``context`` must be JSON-able, deterministic host data —
+        replica ids, site names, error TYPE names (never wall clocks or
+        memory addresses)."""
+        if not self._attached:
+            return None
+        tr = get_tracer()
+        now = self._counters_now()
+        delta = {k: now[k] - self._counter_base.get(k, 0)
+                 for k in sorted(now)
+                 if now[k] - self._counter_base.get(k, 0)}
+        pm = Postmortem(
+            kind=kind,
+            tick=tr.ticks,
+            rids=tuple(tr.resolve(r) for r in rids),
+            context=dict(context),
+            counters=delta)
+        if len(self._posts) >= MAX_POSTMORTEMS:
+            self._posts.pop(0)
+        self._posts.append(pm)
+        return pm
+
+    # -- reading ---------------------------------------------------------
+    @property
+    def postmortems(self) -> List[Postmortem]:
+        return list(self._posts)
+
+    def timeline(self, rid: str) -> List[TraceEvent]:
+        """The ring-buffered timeline of one request id (resolved
+        through the tracer alias map)."""
+        rid = get_tracer().resolve(rid)
+        return list(self._rings.get(rid, ()))
+
+    def postmortem_record(self, pm: Postmortem,
+                          include_noise: bool = False) -> Dict[str, Any]:
+        """Materialize one postmortem into a JSON-able record: trigger
+        context + counters delta + each implicated request's CURRENT
+        ring-buffered timeline (read-time materialization — see module
+        docstring)."""
+        return {
+            "kind": pm.kind,
+            "tick": pm.tick,
+            "context": pm.context,
+            "counters": pm.counters,
+            "requests": {
+                rid: [e.to_dict(include_noise=include_noise)
+                      for e in self.timeline(rid)]
+                for rid in pm.rids},
+        }
+
+    def stats(self) -> Dict[str, int]:
+        """Numeric summary (a MetricsRegistry source)."""
+        return {
+            "active": int(self._attached),
+            "buffer": self._buffer,
+            "tracked_requests": len(self._rings),
+            "postmortems": len(self._posts),
+        }
+
+    def to_json(self, include_noise: bool = False,
+                indent: Optional[int] = None) -> str:
+        """Deterministic JSON of every postmortem (byte-identical
+        across reruns of the same seed + fault plan after a reset —
+        the flight-recorder acceptance contract)."""
+        return json.dumps(
+            {"version": 1, "clock": "tick", "buffer": self._buffer,
+             "postmortems": [self.postmortem_record(
+                 pm, include_noise=include_noise)
+                 for pm in self._posts]},
+            sort_keys=True, separators=(",", ":"), indent=indent)
+
+
+class _FlightContext:
+    """``with flight_recording(N):`` — enable (resetting), restore the
+    prior attached state AND buffer size on exit, so a scoped recording
+    inside a process started with ambient ``MXTPU_FLIGHT_BUFFER`` does
+    not silently switch off (or resize) the always-on recorder (the
+    same restore discipline as ``tracing()``).  The enter-time reset is
+    not undone — the ambient recorder resumes with the events recorded
+    since."""
+
+    def __init__(self, buffer: int):
+        self._buffer = buffer
+        self._prev: Optional[Tuple[bool, int]] = None
+
+    def __enter__(self) -> FlightRecorder:
+        fl = get_flight()
+        self._prev = (fl.active, fl.buffer)
+        return fl.enable(buffer=self._buffer, reset=True)
+
+    def __exit__(self, *exc):
+        fl = get_flight()
+        prev_attached, prev_buffer = self._prev
+        fl.disable()
+        fl._buffer = prev_buffer
+        if prev_attached:
+            fl.enable(buffer=prev_buffer, reset=False)
+        return False
+
+
+def flight_recording(buffer: int = 256) -> _FlightContext:
+    """Scoped flight recording: ``with flight_recording(256) as fl:``."""
+    return _FlightContext(buffer)
+
+
+_FLIGHT = FlightRecorder()
+
+
+def get_flight() -> FlightRecorder:
+    """The process-wide flight recorder (attached at import when
+    ``MXTPU_FLIGHT_BUFFER`` > 0)."""
+    return _FLIGHT

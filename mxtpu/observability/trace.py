@@ -1,0 +1,548 @@
+"""Deterministic structured tracing: typed spans/events on a tick clock.
+
+The reference MXNet's engine-integrated profiler stamps every engine op
+with wall-clock timestamps and emits chrome://tracing JSON.  At serving
+scale the question a trace must answer — "which replica/tier/fault ate
+my latency?" — has to be answerable from telemetry that REPLAYS: this
+tracer therefore stamps every event with a process-wide COUNTER tick,
+never a wall clock, so the trace of a seeded run under a fault plan is
+bit-reproducible and assertable in tier-1 (the same discipline as
+``mxtpu.resilience.faults``).  Optional wall-clock annotations ride in
+a separate ``noise`` payload that is NOISE-labeled and excluded from
+the deterministic serialization.
+
+Off by default.  Enable with ``MXTPU_TRACE=1`` (ambient, read once at
+tracer construction) or the :func:`tracing` context manager.  When the
+:mod:`mxtpu.profiler` session is running (``profiler.start()``), every
+span additionally wraps itself in a ``jax.profiler.TraceAnnotation`` so
+host-side spans land inside the XLA trace.
+
+Event taxonomy (:data:`EVENT_TYPES`): every event carries a registered
+type — an unregistered type raises at the emit site, and the
+``obs_check`` analysis pass (O001, docs/analysis.md) cross-checks that
+every declared fault site in ``resilience.faults.SITES`` has its
+``fault.<site>`` type registered here, so observability coverage is
+lost loudly, never silently.
+
+Correlation ids: events carry an optional ``rid`` string threaded along
+the existing rid <-> tag maps — engines emit ``"<tag>:<rid>"`` (tag =
+``ledger_tag`` or ``"eng"``; replica pools stamp the replica id), the
+gateway emits ``"gw:<rid>"``, and the transport registers an ALIAS from
+the engine id to the gateway id at submit, so one request's events from
+every layer assemble into one :meth:`Tracer.timeline`.
+
+Determinism contract: with the tracer reset at the start of a run, the
+same seeds + the same ``MXTPU_FAULT_PLAN`` produce a byte-identical
+:meth:`Tracer.to_json` (asserted in tests/test_observability.py), and
+tracing compiles ZERO additional programs — every emit is host-side
+bookkeeping (asserted via the compile ledger).
+
+This module must stay import-light (no jax at import time): the serving
+and resilience hot paths import it unconditionally.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+from typing import Any, Dict, List, NamedTuple, Optional, Tuple
+
+__all__ = ["TraceEvent", "Tracer", "get_tracer", "tracing",
+           "gateway_rid", "EVENT_TYPES", "export_chrome_trace"]
+
+
+#: alias entries (engine-rid -> gateway-rid) kept for at most this many
+#: child ids; the oldest-registered is evicted past it.  One alias lands
+#: per submitted request, so the always-on serving posture (ambient
+#: MXTPU_FLIGHT_BUFFER, tracer never reset) would otherwise grow the
+#: map without bound — the same bounded-bookkeeping discipline as the
+#: flight recorder's request rings.
+MAX_ALIASES = 8192
+
+#: the registered span/event taxonomy: type -> one-line description
+#: (docs/observability.md mirrors this table).  ``fault.<site>`` types
+#: are declared EXPLICITLY (not derived from ``faults.SITES``) so the
+#: O001 cross-check can catch a site added without its event type.
+EVENT_TYPES: Dict[str, str] = {
+    # -- gateway (mxtpu.serving.gateway) --------------------------------
+    "gateway.admit": "request accepted into the gateway queue (QoS "
+                     "class, tenant); queue wait = dispatch tick delta",
+    "gateway.shed": "request shed (QoS overflow / quota / engine shed)",
+    "gateway.dispatch": "request dispatched to a replica (gen, replica, "
+                        "wait_ticks)",
+    "gateway.hedge": "hedged duplicate dispatch fired",
+    "gateway.requeue": "dispatch lost (replica death/stall) — stream "
+                       "reset, request requeued at class front",
+    "gateway.expired": "tick deadline passed; finished with partial "
+                       "stream",
+    "gateway.finish": "terminal gateway status (ok/failed)",
+    "gateway.pump": "one gateway service iteration (span)",
+    # -- router / transport ---------------------------------------------
+    "router.dispatch": "replica selected (locality score, chosen "
+                       "replica, load)",
+    "transport.submit": "spec handed to a replica engine (aliases the "
+                        "engine rid to the gateway rid)",
+    "replica.death": "supervisor declared a replica dead "
+                     "(drain-and-requeue)",
+    "replica.revive": "probation over — replica re-admitted",
+    # -- engines (mxtpu.parallel.serving) -------------------------------
+    "engine.iteration": "one engine scheduler iteration (span)",
+    "engine.admit": "admission started (prompt tokens)",
+    "engine.prefix_hit": "radix/host-tier prefix hit (tokens, pages "
+                         "shared — prefill skipped)",
+    "engine.cow": "copy-on-write page clone at the divergence point",
+    "engine.swap_in": "host-tier chain restored at admission (pages)",
+    "engine.swap_out": "pinned chain spilled to the host tier (pages; "
+                       "dropped=True when the copy was abandoned)",
+    "engine.defer": "admission deferred on transient page exhaustion",
+    "engine.prefill_chunk": "one chunked-prefill program ran for a "
+                            "prefilling slot",
+    "engine.decode": "slot emitted one token in the pooled decode step",
+    "engine.draft": "speculative proposal drafted for a slot",
+    "engine.verify": "slot scored in the pooled batched-verify call "
+                     "(drafted, accepted)",
+    "engine.finish": "request terminal in the engine "
+                     "(ok/failed/expired/cancelled)",
+    "engine.quarantine": "per-slot failure contained (site, error)",
+    "engine.requeue": "quarantined request re-queued (retries left)",
+    "engine.shed": "submission shed (typed LoadShedError)",
+    "engine.cancel": "request cancelled through the idempotent release "
+                     "path",
+    # -- guardian (mxtpu.resilience.guardian) ---------------------------
+    "guardian.skip": "non-finite step contained (update gated off)",
+    "guardian.spike": "finite loss spike detected -> rollback",
+    "guardian.rollback": "restored the last verified checkpoint",
+    "guardian.checkpoint": "verified checkpoint written",
+    "guardian.window": "one fused N-step window dispatched (the "
+                       "once-per-N host sync)",
+    # -- profiler parity API (mxtpu.profiler) ---------------------------
+    "profiler.counter": "profiler.Counter value change",
+    "profiler.marker": "profiler.Marker instant",
+    # -- automatic fault events (every resilience.faults site) ----------
+    # one type per DECLARED site; a plan firing at an undeclared
+    # (test-private) site emits fault.unregistered with a site field
+    "fault.serving.step": "injected fault fired at serving.step",
+    "fault.serving.admit": "injected fault fired at serving.admit",
+    "fault.serving.prefix_lookup":
+        "injected fault fired at serving.prefix_lookup",
+    "fault.serving.block_alloc":
+        "injected fault fired at serving.block_alloc",
+    "fault.serving.swap_out": "injected fault fired at serving.swap_out",
+    "fault.serving.swap_in": "injected fault fired at serving.swap_in",
+    "fault.serving.draft": "injected fault fired at serving.draft",
+    "fault.serving.verify": "injected fault fired at serving.verify",
+    "fault.gateway.admit": "injected fault fired at gateway.admit",
+    "fault.router.dispatch": "injected fault fired at router.dispatch",
+    "fault.replica.health": "injected fault fired at replica.health",
+    "fault.replica.stream": "injected fault fired at replica.stream",
+    "fault.kvstore.reduce": "injected fault fired at kvstore.reduce",
+    "fault.checkpoint.save": "injected fault fired at checkpoint.save",
+    "fault.engine.flush": "injected fault fired at engine.flush",
+    "fault.guardian.check": "injected fault fired at guardian.check",
+    "fault.ckpt.write": "injected fault fired at ckpt.write",
+    "fault.ckpt.verify": "injected fault fired at ckpt.verify",
+    "fault.unregistered": "injected fault fired at a site with no "
+                          "declared event type (site in fields)",
+}
+
+
+class TraceEvent(NamedTuple):
+    """One recorded event.  ``tick`` is the deterministic counter clock
+    (one tick per recorded event); ``phase`` is ``"I"`` (instant),
+    ``"B"``/``"E"`` (span begin/end); ``noise`` holds wall-clock or
+    otherwise non-deterministic annotations, excluded from the
+    deterministic serialization."""
+
+    tick: int
+    etype: str
+    rid: Optional[str]
+    phase: str
+    fields: Dict[str, Any]
+    noise: Dict[str, Any]
+
+    def to_dict(self, include_noise: bool = False) -> Dict[str, Any]:
+        d: Dict[str, Any] = {"tick": self.tick, "type": self.etype,
+                             "phase": self.phase}
+        if self.rid is not None:
+            d["rid"] = self.rid
+        if self.fields:
+            d["fields"] = self.fields
+        if include_noise and self.noise:
+            d["noise"] = self.noise
+        return d
+
+
+def gateway_rid(tag) -> str:
+    """Correlation id of a gateway request from its dispatch tag: the
+    gateway tags replica submissions ``(rid, dispatch_gen)`` — every
+    generation of one request shares ONE timeline."""
+    if isinstance(tag, tuple) and tag:
+        return "gw:%s" % (tag[0],)
+    return "gw:%s" % (tag,)
+
+
+class _Span:
+    """Begin/end event pair; on-profiler runs additionally wrap the
+    region in a ``jax.profiler.TraceAnnotation`` so the host span lands
+    inside the XLA trace."""
+
+    __slots__ = ("_tr", "_etype", "_rid", "_fields", "_ann", "_t0")
+
+    def __init__(self, tracer, etype, rid, fields):
+        self._tr = tracer
+        self._etype = etype
+        self._rid = rid
+        self._fields = fields
+        self._ann = None
+        self._t0 = None
+
+    def __enter__(self):
+        self._ann = _profiler_annotation(self._etype)
+        if self._ann is not None:
+            self._ann.__enter__()
+        self._tr.emit(self._etype, rid=self._rid, phase="B",
+                      **self._fields)
+        if self._tr.record_wall:
+            import time
+            self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        noise = None
+        if self._t0 is not None:
+            import time
+            noise = {"wall_s": time.perf_counter() - self._t0}
+        self._tr.emit(self._etype, rid=self._rid, phase="E",
+                      noise=noise)
+        if self._ann is not None:
+            self._ann.__exit__(None, None, None)
+        return False
+
+
+def _profiler_annotation(name):
+    """A jax TraceAnnotation when (and only when) a profiler session is
+    running — the only place this module touches jax, and only on an
+    already-active trace session."""
+    try:
+        from .. import profiler as _prof
+        if _prof.state() != "run":
+            return None
+        import jax
+        return jax.profiler.TraceAnnotation(name)
+    except Exception:  # noqa: BLE001 — tracing must never take the
+        return None    # serving path down over a profiler hiccup
+
+
+def _env_truthy(name: str) -> bool:
+    return os.environ.get(name, "").strip().lower() not in (
+        "", "0", "false", "off", "no")
+
+
+class Tracer:
+    """Process-wide structured tracer (module docstring).
+
+    ``max_events`` bounds the in-memory trace (further events are
+    counted in ``dropped_events``, never silently lost from the
+    counters); flight-recorder sinks observe every event regardless, so
+    their bounded ring buffers stay current past the cap.
+    """
+
+    def __init__(self, max_events: Optional[int] = None,
+                 enabled: Optional[bool] = None):
+        self._lock = threading.RLock()
+        self._enabled = (_env_truthy("MXTPU_TRACE") if enabled is None
+                         else bool(enabled))
+        if max_events is None:
+            try:
+                max_events = int(os.environ.get("MXTPU_TRACE_EVENTS",
+                                                200000))
+            except ValueError:
+                max_events = 200000
+        self._max_events = int(max_events)
+        self.record_wall = _env_truthy("MXTPU_TRACE_WALL")
+        self._events: List[TraceEvent] = []
+        self._profiler_events: List[Tuple[int, str, str, float]] = []
+        self._alias: Dict[str, str] = {}
+        self._tick = 0
+        self._dropped = 0
+        self._sinks: List[Any] = []   # flight recorders
+
+    # -- lifecycle -------------------------------------------------------
+    @property
+    def enabled(self) -> bool:
+        return self._enabled
+
+    @property
+    def active(self) -> bool:
+        """Whether emit() records anywhere (the tracer proper OR an
+        attached flight-recorder sink) — the cheap guard every
+        instrumented hot path checks first."""
+        return self._enabled or bool(self._sinks)
+
+    def enable(self, reset: bool = True) -> None:
+        with self._lock:
+            if reset:
+                self.reset()
+            self._enabled = True
+
+    def disable(self) -> None:
+        with self._lock:
+            self._enabled = False
+
+    def reset(self) -> None:
+        """Clear events, the tick clock, aliases, and the profiler
+        channel — the start-of-run point the determinism contract is
+        relative to."""
+        with self._lock:
+            self._events = []
+            self._profiler_events = []
+            self._alias = {}
+            self._tick = 0
+            self._dropped = 0
+
+    # -- sinks (flight recorder) -----------------------------------------
+    def add_sink(self, sink) -> None:
+        with self._lock:
+            if sink not in self._sinks:
+                self._sinks.append(sink)
+
+    def remove_sink(self, sink) -> None:
+        with self._lock:
+            if sink in self._sinks:
+                self._sinks.remove(sink)
+
+    # -- correlation -----------------------------------------------------
+    def alias(self, child: str, parent: str) -> None:
+        """Register ``child`` as another name of ``parent``'s timeline
+        (the transport's engine-rid -> gateway-rid mapping): events
+        emitted under ``child`` resolve to ``parent`` at record time."""
+        with self._lock:
+            if (child not in self._alias
+                    and len(self._alias) >= MAX_ALIASES):
+                self._alias.pop(next(iter(self._alias)))
+            self._alias[child] = parent
+
+    def resolve(self, rid: Optional[str]) -> Optional[str]:
+        if rid is None:
+            return None
+        return self._alias.get(rid, rid)
+
+    # -- recording -------------------------------------------------------
+    def emit(self, etype: str, rid: Optional[str] = None,
+             phase: str = "I", noise: Optional[dict] = None,
+             **fields) -> Optional[TraceEvent]:
+        """Record one typed event (no-op unless :attr:`active`).
+        ``etype`` must be registered in :data:`EVENT_TYPES` — a typo
+        here is a taxonomy bug and raises."""
+        if not (self._enabled or self._sinks):
+            return None
+        if etype not in EVENT_TYPES:
+            raise ValueError(
+                "unregistered trace event type %r — add it to "
+                "mxtpu.observability.trace.EVENT_TYPES (the obs_check "
+                "pass cross-checks the taxonomy)" % (etype,))
+        with self._lock:
+            rid = self._alias.get(rid, rid) if rid is not None else None
+            self._tick += 1
+            ev = TraceEvent(self._tick, etype, rid, phase,
+                            fields, noise or {})
+            if self._enabled:
+                if len(self._events) < self._max_events:
+                    self._events.append(ev)
+                else:
+                    self._dropped += 1
+            for sink in self._sinks:
+                sink.observe(ev)
+            return ev
+
+    def span(self, etype: str, rid: Optional[str] = None,
+             **fields) -> _Span:
+        """Context manager recording a begin/end event pair (and a
+        ``jax.profiler.TraceAnnotation`` when a profiler session is
+        running)."""
+        return _Span(self, etype, rid, fields)
+
+    # -- the profiler parity channel -------------------------------------
+    def profiler_event(self, name: str, wall_s: float = 0.0,
+                       kind: str = "scope") -> None:
+        """Record one explicit profiler-API event (Task/Frame/Event
+        scopes, Markers).  Unlike trace events this channel is ALWAYS
+        recorded — the user called the profiler API explicitly — but
+        its wall durations are NOISE by nature and excluded from the
+        deterministic trace serialization."""
+        with self._lock:
+            self._tick += 1
+            if len(self._profiler_events) < self._max_events:
+                self._profiler_events.append(
+                    (self._tick, kind, name, float(wall_s)))
+
+    def profiler_events(self) -> List[Tuple[int, str, str, float]]:
+        with self._lock:
+            return list(self._profiler_events)
+
+    def clear_profiler_events(self) -> None:
+        with self._lock:
+            self._profiler_events = []
+
+    # -- querying --------------------------------------------------------
+    def events(self, rid: Optional[str] = None,
+               types=None) -> List[TraceEvent]:
+        with self._lock:
+            out = list(self._events)
+        if rid is not None:
+            out = [e for e in out if e.rid == self.resolve(rid)]
+        if types is not None:
+            tset = {types} if isinstance(types, str) else set(types)
+            out = [e for e in out if e.etype in tset]
+        return out
+
+    def timeline(self, rid: str) -> List[TraceEvent]:
+        """Every recorded event of one request, tick order."""
+        return self.events(rid=rid)
+
+    def span_count(self) -> int:
+        """Completed spans (end events) recorded so far."""
+        with self._lock:
+            return sum(1 for e in self._events if e.phase == "E")
+
+    @property
+    def ticks(self) -> int:
+        """The current tick — cheap; ``stats()`` scans the whole event
+        list, which failure-path callers must not pay per postmortem."""
+        with self._lock:
+            return self._tick
+
+    @property
+    def dropped_events(self) -> int:
+        return self._dropped
+
+    def stats(self) -> Dict[str, int]:
+        """Numeric summary (a MetricsRegistry source)."""
+        with self._lock:
+            return {
+                "enabled": int(self._enabled),
+                "events": len(self._events),
+                "spans": sum(1 for e in self._events
+                             if e.phase == "E"),
+                "dropped_events": self._dropped,
+                "profiler_events": len(self._profiler_events),
+                "ticks": self._tick,
+                "aliases": len(self._alias),
+            }
+
+    # -- serialization ---------------------------------------------------
+    def to_json(self, include_noise: bool = False,
+                indent: Optional[int] = None) -> str:
+        """Deterministic JSON of the recorded trace: same seeds + same
+        fault plan (+ a reset at the start of the run) => byte-identical
+        output.  ``include_noise=True`` adds the NOISE-labeled
+        wall-clock annotations (then equality is no longer promised)."""
+        with self._lock:
+            events = [e.to_dict(include_noise=include_noise)
+                      for e in self._events]
+            dropped = self._dropped
+        return json.dumps({"version": 1, "clock": "tick",
+                           "dropped": dropped, "events": events},
+                          sort_keys=True, separators=(",", ":"),
+                          indent=indent)
+
+
+class _TracingContext:
+    """``with tracing():`` — enable (resetting by default), restore the
+    prior enabled state on exit."""
+
+    def __init__(self, reset: bool = True):
+        self._reset = reset
+        self._prev = None
+
+    def __enter__(self) -> Tracer:
+        tr = get_tracer()
+        self._prev = tr.enabled
+        tr.enable(reset=self._reset)
+        return tr
+
+    def __exit__(self, *exc):
+        if not self._prev:
+            get_tracer().disable()
+        return False
+
+
+def tracing(reset: bool = True) -> _TracingContext:
+    """Scoped tracing: ``with tracing() as tr: ... tr.to_json()``."""
+    return _TracingContext(reset=reset)
+
+
+_TRACER = Tracer()
+
+
+def get_tracer() -> Tracer:
+    """The process-wide tracer instance."""
+    return _TRACER
+
+
+# -- chrome trace-event export (one writer for both APIs) ----------------
+
+def export_chrome_trace(file=None, include_noise: bool = True,
+                        tracer: Optional[Tracer] = None) -> Optional[str]:
+    """Chrome trace-event JSON (chrome://tracing / Perfetto) serving
+    BOTH the tick-clock structured trace and the legacy
+    ``mxtpu.profiler`` Counter/Marker/scope events through one writer
+    (the reference profiler's output format, on the deterministic
+    clock: 1 tick is rendered as 1 us).  ``file`` may be a path or a
+    writable file object; with neither, the JSON string is returned."""
+    tr = tracer if tracer is not None else get_tracer()
+    tid_map: Dict[str, int] = {}
+
+    def _tid(rid):
+        if rid is None:
+            return 0
+        return tid_map.setdefault(rid, len(tid_map) + 1)
+
+    trace_events: List[dict] = []
+    for ev in tr.events():
+        ph = {"I": "i", "B": "B", "E": "E"}[ev.phase]
+        rec = {"name": ev.etype, "ph": ph, "ts": ev.tick, "pid": 0,
+               "tid": _tid(ev.rid), "cat": "mxtpu"}
+        if ph == "i":
+            rec["s"] = "t"
+        args = dict(ev.fields)
+        if ev.rid is not None:
+            args["rid"] = ev.rid
+        if include_noise and ev.noise:
+            args["NOISE"] = dict(ev.noise)
+        rec["args"] = args
+        trace_events.append(rec)
+    for (tick, kind, name, wall_s) in tr.profiler_events():
+        trace_events.append({
+            "name": name, "ph": "X", "ts": tick,
+            "dur": max(1, int(wall_s * 1e6)),
+            "pid": 0, "tid": 0,
+            "cat": "profiler,NOISE-wall-duration",
+            "args": {"kind": kind, "wall_s": wall_s},
+        })
+    # the profiler parity API's counters, as chrome counter samples
+    try:
+        from .. import profiler as _prof
+        now_tick = tr.ticks
+        for name, val in sorted(_prof.counter_values().items()):
+            if isinstance(val, (int, float)):
+                trace_events.append({
+                    "name": name, "ph": "C", "ts": now_tick,
+                    "pid": 0, "tid": 0, "cat": "profiler",
+                    "args": {"value": val}})
+    except Exception:  # noqa: BLE001 — export must not die on a
+        pass           # profiler import problem
+
+    doc = {"traceEvents": trace_events, "displayTimeUnit": "ms",
+           "otherData": {"clock": "mxtpu deterministic tick "
+                                  "(1 tick rendered as 1 us)"}}
+    text = json.dumps(doc, sort_keys=True, separators=(",", ":"))
+    if file is None:
+        return text
+    if hasattr(file, "write"):
+        file.write(text)
+        return None
+    with open(file, "w") as f:
+        f.write(text)
+    return None

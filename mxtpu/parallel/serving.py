@@ -94,6 +94,9 @@ from jax.sharding import PartitionSpec as P
 
 from .. import random as _random
 from ..ndarray import NDArray, array as nd_array
+from ..observability.flight import get_flight as _flight
+from ..observability.metrics import with_deprecated_aliases
+from ..observability.trace import get_tracer as _tracer
 from ..resilience import LoadShedError
 from ..resilience.counters import bump as _bump
 from ..resilience.faults import inject as _inject
@@ -105,6 +108,25 @@ from .sharding import ShardingRules
 
 __all__ = ["ContinuousBatchingEngine", "PagedContinuousBatchingEngine",
            "Request"]
+
+#: deprecated stats-key spellings kept for one release (old ->
+#: canonical; the canonical names follow the *_requests/*_tokens/
+#: *_blocks suffix convention — mapping table in docs/observability.md)
+_ENGINE_STATS_ALIASES = {
+    "tokens_generated": "generated_tokens",
+    "quarantined": "quarantined_requests",
+    "retries": "retried_requests",
+    "deadline_evictions": "expired_requests",
+    "shed": "shed_requests",
+}
+_PAGED_STATS_ALIASES = {
+    "prefix_hits": "prefix_hit_requests",
+    "cow_copies": "cow_copied_blocks",
+    "swap_ins": "swapped_in_blocks",
+    "swap_outs": "swapped_out_blocks",
+    "deferred_swap_ins": "deferred_swap_in_requests",
+    "session_hits": "session_hit_requests",
+}
 
 
 class Request:
@@ -317,6 +339,35 @@ class ContinuousBatchingEngine:
         self._slot_iterations = 0   # slot-participations in decode
         #                             calls: tokens/slot_iterations is
         #                             the per-cache-read multiplier
+        # -- observability (docs/observability.md) -----------------------
+        # correlation-id scope: replica pools stamp the replica id via
+        # InProcessReplica; standalone multi-engine tracing should pass
+        # distinct ledger_tag= so timelines never collide
+        self._trace_tag = ledger_tag or "eng"
+
+    # -- observability plumbing (docs/observability.md) ------------------
+    def _trace_key(self, rid) -> str:
+        """Correlation id of one engine request ("<tag>:<rid>"); the
+        transport aliases it onto the gateway id at submit so one
+        request's events assemble into one timeline."""
+        return "%s:%s" % (self._trace_tag, rid)
+
+    def _emit(self, etype, rid, **fields):
+        """Record one per-request trace event (no-op while tracing and
+        flight recording are both off — the instrumented paths stay
+        host-side bookkeeping and compile nothing)."""
+        tr = _tracer()
+        if tr.active:
+            tr.emit(etype,
+                    rid=None if rid is None else self._trace_key(rid),
+                    **fields)
+
+    def _flight_failure(self, kind, rid=None, **context):
+        fl = _flight()
+        if fl.active:
+            rids = () if rid is None else (self._trace_key(rid),)
+            fl.failure(kind, rids=rids, engine=self._trace_tag,
+                       **context)
 
     # -- introspection ---------------------------------------------------
     @property
@@ -337,21 +388,26 @@ class ContinuousBatchingEngine:
 
     @property
     def stats(self):
-        return {"steps": self._steps,
-                "tokens_generated": self._tokens_generated,
-                "quarantined": self._quarantined,
-                "retries": self._retries,
-                "deadline_evictions": self._deadline_evictions,
-                "shed": self._shed,
-                "drafted_tokens": self._drafted_tokens,
-                "accepted_tokens": self._accepted_tokens,
-                "slot_iterations": self._slot_iterations,
-                "draft_hit_rate": (
-                    self._accepted_tokens / self._drafted_tokens
-                    if self._drafted_tokens else 0.0),
-                "verify_calls": self._verify_calls,
-                "compiled_programs": sorted(
-                    k[0] for k in self._dec._jit_cache)}
+        # canonical key names use the *_requests/*_tokens/*_blocks
+        # suffix convention; the deprecated aliases (kept one release)
+        # are mapped in docs/observability.md
+        return with_deprecated_aliases({
+            "steps": self._steps,
+            "generated_tokens": self._tokens_generated,
+            "quarantined_requests": self._quarantined,
+            "retried_requests": self._retries,
+            "expired_requests": self._deadline_evictions,
+            "shed_requests": self._shed,
+            "drafted_tokens": self._drafted_tokens,
+            "accepted_tokens": self._accepted_tokens,
+            "slot_iterations": self._slot_iterations,
+            "draft_hit_rate": (
+                self._accepted_tokens / self._drafted_tokens
+                if self._drafted_tokens else 0.0),
+            "verify_calls": self._verify_calls,
+            "compiled_programs": sorted(
+                k[0] for k in self._dec._jit_cache),
+        }, _ENGINE_STATS_ALIASES)
 
     def status(self, rid) -> str:
         """Lifecycle status of one request: ``queued`` / ``active`` /
@@ -414,6 +470,11 @@ class ContinuousBatchingEngine:
                 len(self._queue) >= self._max_pending:
             self._shed += 1
             _bump("shed_requests")
+            self._emit("engine.shed", None,
+                       queue_depth=len(self._queue),
+                       limit=self._max_pending)
+            self._flight_failure("shed", queue_depth=len(self._queue),
+                                 limit=self._max_pending)
             raise LoadShedError(
                 "admission queue full (%d pending >= max_pending=%d): "
                 "request shed — back off and resubmit"
@@ -489,6 +550,8 @@ class ContinuousBatchingEngine:
         dt = self._prompt_dtype or onp.int32
         self._results[req.rid] = NDArray(out.astype(jnp.dtype(dt)))
         self._status[req.rid] = status
+        self._emit("engine.finish", req.rid, status=status,
+                   emitted=self._emitted_count(emitted))
         self._done.append(req.rid)
         if len(self._done) > self._history:
             evicted = self._done[:-self._history]
@@ -528,6 +591,8 @@ class ContinuousBatchingEngine:
             req.retries_left -= 1
             self._retries += 1
             _bump("retries")
+            self._emit("engine.requeue", req.rid,
+                       retries_left=req.retries_left, site=site)
             self._status[req.rid] = "queued"
             self._queue.append(req)
         else:
@@ -540,6 +605,10 @@ class ContinuousBatchingEngine:
         self._scrub_row(row)
         self._quarantined += 1
         _bump("quarantined_slots")
+        self._emit("engine.quarantine", req.rid, site=site,
+                   error=type(exc).__name__, step=self._steps)
+        self._flight_failure("quarantine", rid=req.rid, site=site,
+                             error=type(exc).__name__, step=self._steps)
         self._requeue_or_fail(req, exc, site, emitted=emitted, row=row)
 
     def _quarantine(self, slot_idx, exc, site):
@@ -590,6 +659,7 @@ class ContinuousBatchingEngine:
 
         _inject("serving.admit", key=req.rid)
         Tp = req.prompt.shape[1]
+        self._emit("engine.admit", req.rid, prompt_tokens=Tp)
         bucketing = (self._dec._bucket_prefill
                      and not self._dec._block_has_moe())
         raw = jnp.asarray(req.prompt, jnp.int32)
@@ -794,6 +864,11 @@ class ContinuousBatchingEngine:
         drafts = self._draft_phase(active)  # may quarantine members
         if not active:
             return
+        tr = _tracer()
+        if tr.active and drafts:
+            for i, d in sorted(drafts.items()):
+                self._emit("engine.draft", self._slots[i].req.rid,
+                           proposed=len(d))
         if drafts:
             self._decode_verify(active, drafts, sample_next_token)
         else:
@@ -815,11 +890,15 @@ class ContinuousBatchingEngine:
             toks = onp.asarray(jax.device_get(self._last_tokens))
             for i in hist_rows:
                 self._slots[i].history.append(int(toks[i]))
+        trace_on = _tracer().active
         for i in active:
             s = self._slots[i]
             s.pos += 1
             s.n_emitted += 1
             s.emitted.append(self._last_tokens)
+            if trace_on:
+                self._emit("engine.decode", s.req.rid, pos=s.pos,
+                           emitted=s.n_emitted)
             try:
                 done = self._slot_done(s)
             except Exception as exc:  # per-slot eos host read
@@ -889,6 +968,7 @@ class ContinuousBatchingEngine:
         self._verify_calls += 1
         self._drafted_tokens += nreal
         self._slot_iterations += len(active)
+        trace_on = _tracer().active
         for i in active:
             s = self._slots[i]
             m = int(counts_h[i])
@@ -898,6 +978,9 @@ class ContinuousBatchingEngine:
                 if hits.size:  # stop AT eos, exactly like sequential
                     m = int(hits[0]) + 1
                     toks = toks[:m]
+            if trace_on:
+                self._emit("engine.verify", s.req.rid,
+                           drafted=int(vl[i]) - 1, accepted=m - 1)
             self._accepted_tokens += m - 1
             self._tokens_generated += m
             s.pos += m
@@ -986,6 +1069,19 @@ class ContinuousBatchingEngine:
 
     # -- one scheduler iteration ----------------------------------------
     def step(self):
+        """One scheduler iteration (``_step_impl`` docstring has the
+        semantics).  With tracing active the iteration runs inside an
+        ``engine.iteration`` span (and, under a live ``jax.profiler``
+        session, a TraceAnnotation) — host-side only, zero compiled
+        programs either way."""
+        tr = _tracer()
+        if not tr.active:
+            return self._step_impl()
+        with tr.span("engine.iteration", tag=self._trace_tag,
+                     step=self._steps):
+            return self._step_impl()
+
+    def _step_impl(self):
         """One iteration: evict deadline-expired requests, admit queued
         requests into free slots, then run ONE pooled decode step — or,
         when speculation produced drafts, ONE batched verify call — for
@@ -1089,12 +1185,14 @@ class ContinuousBatchingEngine:
         for i, req in enumerate(self._queue):
             if req.rid == rid:
                 del self._queue[i]
+                self._emit("engine.cancel", rid)
                 self._finish(None, req, [], 0, status="cancelled")
                 return True
         for i, slot in enumerate(self._slots):
             if slot is not None and slot.req.rid == rid:
                 self._slots[i] = None
                 self._scrub_row(slot.row)
+                self._emit("engine.cancel", rid)
                 self._finish(None, slot.req, slot.emitted, slot.row,
                              status="cancelled")
                 return True
@@ -1331,13 +1429,13 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
     @property
     def stats(self):
         out = dict(super().stats)
-        out.update({
+        out.update(with_deprecated_aliases({
             "blocks_in_use": self._bp.in_use,
             "blocks_free": self._bp.free_count,
             "blocks_shared": self._bp.shared_count,
             "shared_extra_refs": self._bp.shared_extra_refs,
-            "prefix_hits": self._prefix_hits,
-            "cow_copies": self._cow_copies,
+            "prefix_hit_requests": self._prefix_hits,
+            "cow_copied_blocks": self._cow_copies,
             "block_size": self._bs,
             "num_blocks": self._bp.capacity,
             # hierarchical prefix cache (0s while disabled)
@@ -1345,13 +1443,13 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
                               if self._hc is not None else 0),
             "spilled_blocks": (self._hc.spilled_blocks
                                if self._hc is not None else 0),
-            "swap_ins": self._swap_ins,
-            "swap_outs": self._swap_outs,
-            "deferred_swap_ins": self._deferred_swap_ins,
-            "session_hits": self._session_hits,
+            "swapped_in_blocks": self._swap_ins,
+            "swapped_out_blocks": self._swap_outs,
+            "deferred_swap_in_requests": self._deferred_swap_ins,
+            "session_hit_requests": self._session_hits,
             "sessions_open": len(self._sessions),
             "prefill_tokens_avoided": self._prefill_tokens_avoided,
-        })
+        }, _PAGED_STATS_ALIASES))
         return out
 
     # -- paged pool plumbing ---------------------------------------------
@@ -1455,8 +1553,12 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
                 content = None
         if content is not None:
             self._hc.spill(chain, content)
+            self._emit("engine.swap_out", None,
+                       pages=len(chain.pages), dropped=False)
             self._swap_outs += len(chain.pages)
         else:
+            self._emit("engine.swap_out", None,
+                       pages=len(chain.pages), dropped=True)
             self._hc.drop_chain(chain)
 
     def _enforce_pin_budget(self):
@@ -1539,6 +1641,7 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
         finally:
             for bid in full:
                 self._bp.release(bid)
+        self._emit("engine.swap_in", req.rid, pages=len(fresh))
         self._swap_ins += len(fresh)
         return True
 
@@ -1736,6 +1839,10 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
             if need > self._bp.capacity:
                 self._shed += 1
                 _bump("shed_requests")
+                self._emit("engine.shed", None, pages_needed=need,
+                           pool_capacity=self._bp.capacity)
+                self._flight_failure("shed", pages_needed=need,
+                                     pool_capacity=self._bp.capacity)
                 raise LoadShedError(
                     "request needs %d page(s) > pool capacity %d "
                     "(block_size=%d): can never be admitted — shed"
@@ -1758,6 +1865,7 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
         admission in this iteration exactly like the slot engine."""
         _inject("serving.admit", key=req.rid)
         Tp = req.prompt.shape[1]
+        self._emit("engine.admit", req.rid, prompt_tokens=Tp)
         moe = self._dec._block_has_moe()
         bucketing = self._dec._bucket_prefill and not moe
         full, partial = [], None
@@ -1823,6 +1931,9 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
         # admission retries this whole path every iteration and must
         # not re-count the same hit (the bench's headline metric)
         if n_shared:
+            self._emit("engine.prefix_hit", req.rid, tokens=n_shared,
+                       pages=len(full),
+                       session=req.session is not None)
             self._prefill_tokens_avoided += n_shared
             if self._hc is not None:
                 self._hc.touch_prefix(req.prompt[0], Tp - 1)
@@ -1831,6 +1942,8 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
         cow = None
         if partial:
             cow = (partial[0], pages[len(full)])
+            self._emit("engine.cow", req.rid, src=int(partial[0]),
+                       dst=int(pages[len(full)]))
             self._cow_copies += 1
         slot = _PagedSlot(req, slot_idx, Tp, chunks, cow)
         self._slots[slot_idx] = slot
@@ -1855,6 +1968,8 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
         slot = self._slots[slot_idx]
         req = slot.req
         start, Tact, Tb = slot.chunks[slot.chunk_i]
+        self._emit("engine.prefill_chunk", req.rid, index=slot.chunk_i,
+                   start=start, tokens=Tact)
         raw = jnp.asarray(req.prompt[:, start:start + Tact], jnp.int32)
         if Tb > Tact:
             raw = jnp.pad(raw, ((0, 0), (0, Tb - Tact)))
@@ -1940,13 +2055,14 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
         return logits
 
     # -- one scheduler iteration ----------------------------------------
-    def step(self):
+    def _step_impl(self):
         """One iteration: deadline sweep, admissions (deferring at the
         queue head on transient page exhaustion), ONE prefill chunk per
         prefilling slot, then ONE pooled paged decode step — or batched
         verify call — over every DECODING slot.  Same per-slot failure
         containment as the slot engine; chunk-prefill faults quarantine
-        under the admission site."""
+        under the admission site.  (``step()`` wraps this in the
+        ``engine.iteration`` trace span — base class.)"""
         finished_before = set(self._results)
         self._evict_expired()
         # chunked prefill FIRST: slots already prefilling advance one
@@ -1976,6 +2092,8 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
                 except _AdmissionDeferred:
                     # FIFO preserved: the request stays at the head and
                     # no later request jumps it into the freed pages
+                    self._emit("engine.defer", req.rid,
+                               free_pages=self._bp.free_count)
                     self._queue.insert(0, req)
                     deferred = True
                 except Exception as exc:

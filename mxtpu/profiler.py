@@ -7,6 +7,16 @@ own per-op tracing lands in a TensorBoard/perfetto trace, and the user-scope
 API (Task/Frame/Event/Counter/Marker, set_config/start/stop/dump) maps onto
 jax.profiler trace sessions + TraceAnnotation. `dumps()` returns an
 aggregate text summary like the reference's aggregate_stats.
+
+Since the observability subsystem landed (docs/observability.md) this
+module is a thin parity veneer over it: scopes and Markers forward into
+the :mod:`mxtpu.observability.trace` tracer's profiler channel, Counter
+values are served back through the process
+:class:`~mxtpu.observability.metrics.MetricsRegistry` (source
+``profiler``), ``dumps()`` aggregates from those two surfaces instead
+of private module lists, and
+:func:`mxtpu.observability.trace.export_chrome_trace` is the ONE
+chrome-trace writer serving both this API and the structured tracer.
 """
 
 from __future__ import annotations
@@ -19,23 +29,44 @@ import jax
 
 __all__ = ["set_config", "start", "stop", "pause", "resume", "dump",
            "dumps", "set_state", "state", "Task", "Frame", "Event",
-           "Counter", "Marker", "scope"]
+           "Counter", "Marker", "scope", "counter_values"]
 
 _config = {"profile_all": False, "profile_symbolic": False,
            "profile_imperative": False, "profile_memory": False,
-           "profile_api": False,
+           "profile_api": False, "profile_process": "worker",
+           "continuous_dump": False, "dump_period": 1.0,
            "filename": "profile.json", "aggregate_stats": False}
 _state = "stop"
 _trace_dir = None
 _scope_stack = []
 _counters = {}
-_events = []
 
 
 def set_config(**kwargs):
     """Configure (parity: profiler.set_config). `filename` selects the
-    trace output directory (its dirname; jax traces are directories)."""
-    _config.update(kwargs)
+    trace output directory (its dirname; jax traces are directories).
+    Unknown keys warn instead of being silently absorbed — a typo like
+    ``profile_al=True`` used to configure nothing without a trace."""
+    unknown = sorted(set(kwargs) - set(_config))
+    if unknown:
+        import difflib
+        hints = []
+        for k in unknown:
+            close = difflib.get_close_matches(k, _config, n=1)
+            hints.append("%r%s" % (k, " (did you mean %r?)" % close[0]
+                                   if close else ""))
+        warnings.warn("profiler.set_config: unknown key(s) %s ignored "
+                      "(known: %s)" % (", ".join(hints),
+                                       ", ".join(sorted(_config))),
+                      stacklevel=2)
+    _config.update({k: v for k, v in kwargs.items() if k in _config})
+
+
+def counter_values() -> dict:
+    """Current Counter values — the backing data of the metrics
+    registry's ``profiler`` source (``dumps()`` and the registry read
+    the same numbers)."""
+    return dict(_counters)
 
 
 def state():
@@ -92,21 +123,32 @@ def dump(finished=True, profile_process="worker"):
 def dumps(reset=False, format="table", sort_by="total", ascending=False):
     """Aggregate stats summary (parity: profiler.dumps → AggregateStats).
     Returns a text table of user-scope events/counters recorded since
-    start; XLA per-op detail lives in the TensorBoard trace directory."""
+    start; XLA per-op detail lives in the TensorBoard trace directory.
+    Aggregates from the observability surfaces — scope/marker events
+    from the tracer's profiler channel, counters through the metrics
+    registry's ``profiler`` source — so this table, the registry
+    snapshot, and the chrome export all read the same numbers."""
+    from .observability.metrics import get_registry
+    from .observability.trace import get_tracer
+
+    tr = get_tracer()
     lines = ["Profile Statistics (user scopes; XLA op detail in %s)"
              % (_trace_dir or "<not started>"),
              "%-40s %12s %12s" % ("Name", "Count", "Total(ms)")]
     agg = {}
-    for name, dur in _events:
+    for _tick, kind, name, dur in tr.profiler_events():
         cnt, tot = agg.get(name, (0, 0.0))
         agg[name] = (cnt + 1, tot + dur)
     for name, (cnt, tot) in sorted(agg.items(),
                                    key=lambda kv: -kv[1][1]):
         lines.append("%-40s %12d %12.3f" % (name, cnt, tot * 1e3))
-    for name, val in _counters.items():
-        lines.append("%-40s %12s %12s" % (name, "counter", str(val)))
+    snap = get_registry().snapshot(sources=("profiler",))
+    for key in sorted(snap):
+        name = key.split(".", 1)[1] if "." in key else key
+        lines.append("%-40s %12s %12s" % (name, "counter",
+                                          str(snap[key])))
     if reset:
-        _events.clear()
+        tr.clear_profiler_events()
     return "\n".join(lines)
 
 
@@ -127,7 +169,9 @@ class _Scope:
 
     def stop(self):
         if self._ann is not None:
-            _events.append((self.name, time.perf_counter() - self._t0))
+            from .observability.trace import get_tracer
+            get_tracer().profiler_event(
+                self.name, time.perf_counter() - self._t0, kind="scope")
             self._ann.__exit__(None, None, None)
             self._ann = None
 
@@ -159,18 +203,30 @@ class Event(_Scope):
 
 
 class Counter:
-    """(parity: profiler.Counter)"""
+    """(parity: profiler.Counter).  Values live in the metrics
+    registry's ``profiler`` source; changes additionally forward into
+    the structured tracer (``profiler.counter`` events) when tracing is
+    active, so one export path serves both APIs."""
 
     def __init__(self, name, domain=None, value=None):
         self.name = name
         if value is not None:
-            _counters[name] = value
+            self.set_value(value)
+
+    @staticmethod
+    def _forward(name, value):
+        from .observability.trace import get_tracer
+        tr = get_tracer()
+        if tr.active:
+            tr.emit("profiler.counter", name=name, value=value)
 
     def set_value(self, value):
         _counters[self.name] = value
+        self._forward(self.name, value)
 
     def increment(self, delta=1):
         _counters[self.name] = _counters.get(self.name, 0) + delta
+        self._forward(self.name, _counters[self.name])
 
     def decrement(self, delta=1):
         self.increment(-delta)
@@ -185,13 +241,19 @@ class Counter:
 
 
 class Marker:
-    """Instant marker (parity: profiler.Marker)."""
+    """Instant marker (parity: profiler.Marker); forwards into the
+    tracer's profiler channel (and, with tracing active, the structured
+    trace) so the chrome export carries it."""
 
     def __init__(self, name, domain=None):
         self.name = name
 
     def mark(self, scope="process"):
-        _events.append((self.name, 0.0))
+        from .observability.trace import get_tracer
+        tr = get_tracer()
+        tr.profiler_event(self.name, 0.0, kind="marker")
+        if tr.active:
+            tr.emit("profiler.marker", name=self.name)
 
 
 def scope(name="<unk>:", append_mode=False):

@@ -71,6 +71,22 @@ class CorruptCheckpointError(MXTPUError):
         super().__init__(message + loc)
 
 
+def _flight_corruption(path: str, step, exc) -> None:
+    """Flight-recorder hook on a detected-and-survived corruption
+    (docs/observability.md): the postmortem names the damaged file and
+    generation.  Lazy import — this module is on the checkpoint hot
+    path and the recorder is usually off.  Path basenames only: a
+    postmortem must stay byte-identical across reruns in different
+    temp dirs."""
+    from ..observability.flight import get_flight
+    fl = get_flight()
+    if not fl.active:
+        return
+    fl.failure("ckpt_corruption", rids=("train",),
+               file=os.path.basename(path), step=int(step),
+               error=type(exc).__name__)
+
+
 def default_keep() -> int:
     """Checkpoints retained by rotation (``MXTPU_CKPT_KEEP``, default 3)."""
     try:
@@ -496,8 +512,9 @@ class CheckpointSet:
                 continue
             try:
                 verify(p, required=True, data=payload)
-            except CorruptCheckpointError:
+            except CorruptCheckpointError as exc:
                 bump("ckpt_corruptions")
+                _flight_corruption(p, s, exc)
                 fell_past = True
                 continue
             if fell_past:

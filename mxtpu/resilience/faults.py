@@ -285,6 +285,28 @@ class FaultRule:
             self.site, self.action, self.hits, self.fired)
 
 
+def _trace_fired(site: str, key: Optional[str], rule: "FaultRule",
+                 action: str) -> None:
+    """Automatic trace event for every PLAN FIRING (docs/
+    observability.md): a fired raise/delay lands in the structured
+    trace and the flight-recorder rings as ``fault.<site>`` — the
+    postmortem of a faulted run names exactly which rule hit where.
+    Sites outside the declared taxonomy (tests/diagnose use private
+    sites freely) emit ``fault.unregistered`` with the site in the
+    fields.  Imported lazily so this module stays import-light."""
+    from ..observability.trace import EVENT_TYPES, get_tracer
+    tr = get_tracer()
+    if not tr.active:
+        return
+    etype = "fault." + site
+    fields = {"site": site, "action": action, "hit": rule.hits}
+    if key is not None:
+        fields["key"] = str(key)
+    if etype not in EVENT_TYPES:
+        etype = "fault.unregistered"
+    tr.emit(etype, **fields)
+
+
 class FaultPlan:
     """A parsed set of rules plus the per-activation hit counters.
 
@@ -314,9 +336,11 @@ class FaultPlan:
             rule.fired += 1
             if rule.action == "delay":
                 bump("faults_delayed")
+                _trace_fired(site, key, rule, "delay")
                 self._sleep(rule.seconds)
                 continue
             bump("faults_injected")
+            _trace_fired(site, key, rule, "raise")
             raise rule.make_exc()
 
     # -- introspection ----------------------------------------------------

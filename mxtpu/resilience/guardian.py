@@ -41,9 +41,21 @@ import pickle
 from typing import Callable, Optional
 
 from ..base import MXTPUError
+from ..observability.flight import get_flight as _flight
+from ..observability.trace import get_tracer as _tracer
 from .checkpoint import CheckpointSet
 from .counters import bump
 from .faults import inject
+
+#: correlation id guardian events are recorded under — the training
+#: loop's timeline (docs/observability.md)
+_TRAIN_RID = "train"
+
+
+def _emit(etype, **fields):
+    tr = _tracer()
+    if tr.active:
+        tr.emit(etype, rid=_TRAIN_RID, **fields)
 
 __all__ = ["Guardian", "DivergenceError", "guard_enabled_default",
            "default_window"]
@@ -202,6 +214,7 @@ class Guardian:
             return False
         self.stats["checkpoints"] += 1
         self._rollbacks_since_ckpt = 0
+        _emit("guardian.checkpoint", step=int(step))
         return True
 
     def rollback(self, trainer) -> int:
@@ -227,6 +240,13 @@ class Guardian:
         bump("guardian_rollbacks")
         self._rollbacks_since_ckpt += 1
         self._loss_window.clear()
+        _emit("guardian.rollback", restored_step=int(restored))
+        fl = _flight()
+        if fl.active:
+            fl.failure("guardian_rollback", rids=(_TRAIN_RID,),
+                       restored_step=int(restored),
+                       rollbacks=self.stats["rollbacks"],
+                       skips=self.stats["skips"])
         logging.warning("guardian: rolled back to verified checkpoint at "
                         "step %d", restored)
         return restored
@@ -347,6 +367,7 @@ class Guardian:
                 # the batch is consumed, so move on — rollback only when
                 # skips persist (a stuck loss-scale/NaN regime)
                 self.stats["skips"] += 1
+                _emit("guardian.skip", step=step)
                 skip_window.append(step)
                 if len(skip_window) >= self.max_skips:
                     # quarantine the whole streak before rolling back:
@@ -372,6 +393,7 @@ class Guardian:
                     # quarantine this batch so the (bit-exact) replay
                     # does not walk into the same spike forever
                     self.stats["spikes"] += 1
+                    _emit("guardian.spike", step=step)
                     self._quarantined_steps.add(step)
                     step = self.rollback(trainer)
                     last_ckpt = step
@@ -445,6 +467,9 @@ class Guardian:
             res = trainer.step_window(onp.stack([_np(d) for d in datas]),
                                       onp.stack([_np(l) for l in labels]),
                                       count_skips=False)
+            # one fused window dispatched = the once-per-N host sync
+            _emit("guardian.window", steps=len(idxs),
+                  start=int(idxs[0]))
             loss_host = None
             rolled = False
             for i, s in enumerate(idxs):
@@ -452,6 +477,7 @@ class Guardian:
                 if not bool(res.ok[i]):
                     self.stats["skips"] += 1
                     bump("guardian_skips")
+                    _emit("guardian.skip", step=s)
                     skip_window.append(s)
                     if len(skip_window) >= self.max_skips:
                         self._quarantined_steps.update(skip_window)
@@ -467,6 +493,7 @@ class Guardian:
                         loss_host = res.losses.asnumpy()
                     if self._is_spike(float(loss_host[i])):
                         self.stats["spikes"] += 1
+                        _emit("guardian.spike", step=s)
                         self._quarantined_steps.add(s)
                         step = self.rollback(trainer)
                         last_ckpt = step

@@ -51,6 +51,9 @@ import numpy as onp
 
 from ..base import MXTPUError
 from ..ndarray import NDArray
+from ..observability.flight import get_flight as _flight
+from ..observability.metrics import with_deprecated_aliases
+from ..observability.trace import gateway_rid, get_tracer as _tracer
 from ..resilience import (EngineShedError, LoadShedError, QosShedError,
                           RetryPolicy)
 from ..resilience.counters import bump as _bump
@@ -61,6 +64,14 @@ from .transport import (InProcessReplica, ReplicaDownError,
                         ReplicaTransport, request_spec)
 
 __all__ = ["Gateway"]
+
+#: deprecated stats-key spellings kept for one release (old ->
+#: canonical — docs/observability.md "Stats key normalization")
+_GATEWAY_STATS_ALIASES = {
+    "qos_sheds": "qos_shed_requests",
+    "engine_sheds": "engine_shed_requests",
+    "hedges": "hedged_requests",
+}
 
 
 def _env_int(name, default):
@@ -239,19 +250,38 @@ class Gateway:
 
     @property
     def stats(self) -> dict:
-        return {
+        # canonical key names use the *_requests suffix convention;
+        # the deprecated aliases (kept one release) are mapped in
+        # docs/observability.md
+        return with_deprecated_aliases({
             "ticks": self._tick,
             "queued": len(self._queue),
             "outstanding": sum(1 for r in self._reqs.values()
                                if not r.terminal),
-            "qos_sheds": self._qos_sheds,
-            "engine_sheds": self._engine_sheds,
-            "hedges": self._hedges,
+            "qos_shed_requests": self._qos_sheds,
+            "engine_shed_requests": self._engine_sheds,
+            "hedged_requests": self._hedges,
             "requeued_requests": self._requeued,
             "ttft_ticks": dict(self._ttft),
             "supervisor": self._sup.stats,
             "router": self._router.stats,
-        }
+        }, _GATEWAY_STATS_ALIASES)
+
+    # -- observability plumbing (docs/observability.md) ------------------
+    @staticmethod
+    def _emit(etype, rid, **fields):
+        tr = _tracer()
+        if tr.active:
+            tr.emit(etype,
+                    rid=None if rid is None else gateway_rid(rid),
+                    **fields)
+
+    @staticmethod
+    def _flight_failure(kind, rid=None, **context):
+        fl = _flight()
+        if fl.active:
+            rids = () if rid is None else (gateway_rid(rid),)
+            fl.failure(kind, rids=rids, **context)
 
     # -- admission -------------------------------------------------------
     def _retry_after(self) -> int:
@@ -297,6 +327,10 @@ class Gateway:
                 self._tenant_out.get(tenant, 0) >= self._tenant_quota:
             self._qos_sheds += 1
             _bump("gateway_sheds")
+            self._emit("gateway.shed", None, reason="tenant_quota",
+                       tenant=str(tenant))
+            self._flight_failure("shed", reason="tenant_quota",
+                                 tenant=str(tenant))
             raise QosShedError(
                 "tenant %r has %d outstanding request(s) >= quota %d"
                 % (tenant, self._tenant_out.get(tenant, 0),
@@ -309,6 +343,10 @@ class Gateway:
             if victim is None:
                 self._qos_sheds += 1
                 _bump("gateway_sheds")
+                self._emit("gateway.shed", None, reason="queue_full",
+                           qos=qos)
+                self._flight_failure("shed", reason="queue_full",
+                                     qos=qos)
                 raise QosShedError(
                     "gateway queue full (%d >= max_pending=%d) and no "
                     "lower class to displace: request shed — back off "
@@ -324,6 +362,9 @@ class Gateway:
                          hedge, self._tick)
         self._reqs[rid] = req
         self._queue.append(rid)
+        self._emit("gateway.admit", rid, qos=qos,
+                   prompt_tokens=int(spec["prompt"].shape[1]),
+                   deadline_ticks=deadline_ticks)
         if tenant is not None:
             self._tenant_out[tenant] = self._tenant_out.get(tenant, 0) + 1
         return rid
@@ -352,6 +393,9 @@ class Gateway:
             % (req.qos, self._retry_after()),
             queue_depth=len(self._queue), limit=self._max_pending,
             retry_after_ticks=self._retry_after())
+        self._emit("gateway.shed", rid, reason="displaced", qos=req.qos)
+        self._flight_failure("shed", rid=rid, reason="displaced",
+                             qos=req.qos)
         self._finish_shed(req, exc)
         self._qos_sheds += 1
         _bump("gateway_sheds")
@@ -403,6 +447,10 @@ class Gateway:
                         str(exc), queue_depth=exc.queue_depth,
                         limit=exc.limit, retry_after_ticks=None,
                         permanent=True)
+                    self._emit("gateway.shed", rid,
+                               reason="engine_permanent")
+                    self._flight_failure("shed", rid=rid,
+                                         reason="engine_permanent")
                     self._finish_shed(req, mapped)
                     self._engine_sheds += 1
                     _bump("gateway_sheds")
@@ -419,12 +467,17 @@ class Gateway:
                              "error": str(exc), "tick": self._tick,
                              "site": "router.dispatch",
                              "exception": exc}
+                self._emit("gateway.finish", rid, status="failed",
+                           error=type(exc).__name__)
                 self._release_tenant(req)
                 self._mark_done(req)
                 ended.append(rid)
                 continue
             if replica is None:
                 break       # no capacity anywhere this tick
+            self._emit("gateway.dispatch", rid, gen=req.next_gen,
+                       replica=replica,
+                       wait_ticks=self._tick - req.submitted_tick)
             req.gens[req.next_gen] = replica
             req.buffers[req.next_gen] = []
             req.next_gen += 1
@@ -438,7 +491,15 @@ class Gateway:
         supervised pool (health → step → poll per replica), ingest
         token/finish events, requeue drained tags, then run the hedge
         and deadline sweeps.  Returns the rids that went terminal this
-        pump."""
+        pump.  With tracing active the iteration runs inside a
+        ``gateway.pump`` span."""
+        tr = _tracer()
+        if not tr.active:
+            return self._pump_impl()
+        with tr.span("gateway.pump", tick=self._tick + 1):
+            return self._pump_impl()
+
+    def _pump_impl(self) -> List[int]:
         self._tick += 1
         done: List[int] = []
         done.extend(self._dispatch_queued())
@@ -478,6 +539,8 @@ class Gateway:
                 req.result = result
                 if eng_err is not None:
                     req.error = dict(eng_err)
+                self._emit("gateway.finish", rid, status="failed",
+                           gen=gen)
                 self._release_tenant(req)
                 self._mark_done(req)
                 done.append(rid)
@@ -493,6 +556,10 @@ class Gateway:
             req.requeues += 1
             self._requeued += 1
             _bump("gateway_requeues")
+            # the stream-reset event: everything streamed on the lost
+            # dispatch is void; the re-dispatch restarts from the seed
+            self._emit("gateway.requeue", rid, gen=gen,
+                       resets=req.resets)
             req.status = "queued"
             self._queue.append(rid)
         self._hedge_sweep()
@@ -503,6 +570,9 @@ class Gateway:
         req.status = "ok"
         req.result = result
         req.winner_gen = winner_gen
+        self._emit("gateway.finish", req.rid, status="ok",
+                   gen=winner_gen,
+                   ticks=self._tick - req.submitted_tick)
         self._release_tenant(req)
         self._mark_done(req)
         # retire hedge losers through the engines' idempotent release
@@ -534,6 +604,8 @@ class Gateway:
                 continue    # no spare capacity: skip, retry next pump
             if replica is None:
                 continue
+            self._emit("gateway.hedge", req.rid, gen=req.next_gen,
+                       replica=replica)
             req.gens[req.next_gen] = replica
             req.buffers[req.next_gen] = []
             req.next_gen += 1
@@ -559,6 +631,8 @@ class Gateway:
                 self._queue.remove(req.rid)
             req.status = "expired"
             req.result = self._partial_result(req)
+            self._emit("gateway.expired", req.rid,
+                       deadline_ticks=req.deadline_ticks)
             self._release_tenant(req)
             self._mark_done(req)
             done.append(req.rid)

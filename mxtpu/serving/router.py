@@ -27,6 +27,7 @@ from __future__ import annotations
 
 from typing import Any, Dict, List, Optional, Sequence, Set
 
+from ..observability.trace import gateway_rid, get_tracer as _tracer
 from ..resilience import RetryPolicy
 from ..resilience.faults import inject as _inject
 from .supervisor import ReplicaSupervisor
@@ -168,6 +169,14 @@ class Router:
                 tried.add(rep.replica_id)
                 raise
             self._dispatches += 1
+            tr = _tracer()
+            if tr.active:
+                # the placement decision, with its locality evidence:
+                # score = prefix_hit_tokens - load_weight * held
+                tr.emit("router.dispatch", rid=gateway_rid(tag),
+                        replica=rep.replica_id,
+                        prefix_hit_tokens=int(hit_tokens),
+                        load=int(rep.load), policy=self._policy)
             if hit_tokens > 0:
                 self._locality_hits += 1
                 self._locality_tokens += hit_tokens

@@ -33,6 +33,8 @@ from __future__ import annotations
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from ..base import MXTPUError
+from ..observability.flight import get_flight as _flight
+from ..observability.trace import gateway_rid, get_tracer as _tracer
 from ..resilience.counters import bump as _bump
 from .transport import ReplicaTransport
 
@@ -140,6 +142,24 @@ class ReplicaSupervisor:
             self._last_errors[rep.replica_id]["drain_error"] = \
                 "%s: %s" % (type(drain_exc).__name__, drain_exc)
         self._requeued += len(tags)
+        tr = _tracer()
+        if tr.active:
+            tr.emit("replica.death", replica=rep.replica_id,
+                    reason=reason,
+                    error=(type(exc).__name__ if exc is not None
+                           else None),
+                    tick=self.tick_count, requeued=len(tags))
+        fl = _flight()
+        if fl.active:
+            # the postmortem names the dead replica and every drained
+            # request; their timelines (read-time materialized) carry
+            # the requeue/re-dispatch events that follow
+            fl.failure("replica_death",
+                       rids=[gateway_rid(t) for t in tags],
+                       replica=rep.replica_id, reason=reason,
+                       tick=self.tick_count,
+                       error=(type(exc).__name__ if exc is not None
+                              else None))
         if self._on_death is not None:
             self._on_death(rep, tags, reason)
         return tags
@@ -157,6 +177,10 @@ class ReplicaSupervisor:
         self._last_progress.pop(replica_id, None)
         self._death_tick.pop(replica_id, None)
         self._revivals += 1
+        tr = _tracer()
+        if tr.active:
+            tr.emit("replica.revive", replica=replica_id,
+                    tick=self.tick_count)
 
     def _fail(self, rep: ReplicaTransport, reason: str,
               exc: BaseException) -> Optional[List[Any]]:

@@ -29,6 +29,7 @@ import numpy as onp
 
 from ..base import MXTPUError
 from ..ndarray import NDArray, array as nd_array
+from ..observability.trace import gateway_rid, get_tracer as _tracer
 from ..parallel.serving import _SpecTokens
 from ..resilience.faults import inject as _inject
 
@@ -174,6 +175,11 @@ class InProcessReplica(ReplicaTransport):
         self.alive = True
         self._tags: Dict[int, Any] = {}        # engine rid -> tag
         self._cursor: Dict[int, List[int]] = {}  # rid -> [entries, toks]
+        # correlation-id scope (docs/observability.md): an engine left
+        # on the default "eng" tag takes this replica's id, so pooled
+        # replicas' timelines never collide
+        if getattr(engine, "_trace_tag", None) in (None, "eng"):
+            engine._trace_tag = self.replica_id
 
     @property
     def engine(self):
@@ -206,6 +212,15 @@ class InProcessReplica(ReplicaTransport):
         kw = {k: spec[k] for k in SPEC_KEYS if k in spec}
         rid = self._eng.submit(nd_array(spec["prompt"]),
                                kw.pop("max_new_tokens"), **kw)
+        tr = _tracer()
+        if tr.active and hasattr(self._eng, "_trace_key"):
+            # thread the correlation id along the rid<->tag map: every
+            # engine event of this request resolves onto the gateway
+            # request's timeline from here on
+            gw = gateway_rid(tag)
+            tr.alias(self._eng._trace_key(rid), gw)
+            tr.emit("transport.submit", rid=gw,
+                    replica=self.replica_id, engine_rid=str(rid))
         self._tags[rid] = tag
         # [emitted entries consumed, tokens streamed, prompt length,
         #  the slot object last streamed from] — the slot reference is
@@ -303,8 +318,8 @@ class InProcessReplica(ReplicaTransport):
         st = self._eng.stats
         chunks = sum(getattr(s, "chunk_i", 0)
                      for s in self._eng._slots if s is not None)
-        return (st["steps"], st["tokens_generated"], st["quarantined"],
-                len(self._eng._done), chunks)
+        return (st["steps"], st["generated_tokens"],
+                st["quarantined_requests"], len(self._eng._done), chunks)
 
     def cancel(self, tag) -> bool:
         rid = next((r for r, t in self._tags.items() if t == tag), None)

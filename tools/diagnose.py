@@ -164,13 +164,15 @@ def check_serving():
                  st["blocks_in_use"], st["blocks_free"]))
         print("sharing      : %d prefix hit(s), %d page(s) shared now, "
               "%d COW cop%s"
-              % (st["prefix_hits"], st["blocks_shared"],
-                 st["cow_copies"],
-                 "y" if st["cow_copies"] == 1 else "ies"))
+              % (st["prefix_hit_requests"], st["blocks_shared"],
+                 st["cow_copied_blocks"],
+                 "y" if st["cow_copied_blocks"] == 1 else "ies"))
         print("traffic      : %d step(s), %d token(s), %d quarantined, "
-              "%d shed" % (st["steps"], st["tokens_generated"],
-                           st["quarantined"], st["shed"]))
-        healthy = (st["prefix_hits"] >= 1 and st["cow_copies"] >= 1
+              "%d shed" % (st["steps"], st["generated_tokens"],
+                           st["quarantined_requests"],
+                           st["shed_requests"]))
+        healthy = (st["prefix_hit_requests"] >= 1
+                   and st["cow_copied_blocks"] >= 1
                    and st["blocks_in_use"] == 0)
         print("probe        :", "ok (prefix hit + COW + clean drain)"
               if healthy else "UNEXPECTED counters %r" % (st,))
@@ -211,7 +213,7 @@ def check_speculative():
                             dtype="int32"), 12)
         eng.run()
         st = eng.stats
-        rate = (st["tokens_generated"] / st["slot_iterations"]
+        rate = (st["generated_tokens"] / st["slot_iterations"]
                 if st["slot_iterations"] else 0.0)
         print("drafting     : %d drafted, %d accepted (hit rate %.2f), "
               "%d verify call(s)"
@@ -336,12 +338,13 @@ def check_hierarchical():
               "%d prefill token(s) avoided on the re-hit"
               % (pinned, avoided))
         print("host tier    : %d page(s) spilled, %d swapped out / "
-              "%d swapped in" % (spilled, st["swap_outs"],
-                                 st["swap_ins"]))
+              "%d swapped in" % (spilled, st["swapped_out_blocks"],
+                                 st["swapped_in_blocks"]))
         eng._hc.pin_blocks = 0    # release the cache and check drain
         eng._enforce_pin_budget()
         clean = eng.stats["blocks_in_use"] == 0
-        healthy = (pinned > 0 and avoided > 0 and st["swap_ins"] > 0
+        healthy = (pinned > 0 and avoided > 0
+                   and st["swapped_in_blocks"] > 0
                    and hit_ok and swap_ok and clean)
         print("probe        :", "ok (pin -> lull -> re-hit -> swap "
               "round trip, streams bit-exact, clean drain)"
@@ -398,7 +401,7 @@ def check_router():
         loc = gw.router.stats
         ok_loc = (bool(np.array_equal(res[r2].asnumpy(), want))
                   and loc["locality_hits"] >= 1
-                  and gw.stats["hedges"] >= 1)
+                  and gw.stats["hedged_requests"] >= 1)
         r3 = gw.submit(p, 6)
         with fault_plan("replica.health#r0@2x2:raise="
                         "OSError(probe-kill)"):
@@ -413,7 +416,7 @@ def check_router():
         print("routing      : %d dispatch(es), %d locality hit(s), "
               "hit rate %.2f, %d hedge(s)"
               % (loc["dispatches"], loc["locality_hits"],
-                 loc["prefix_hit_rate"], gw.stats["hedges"]))
+                 loc["prefix_hit_rate"], gw.stats["hedged_requests"]))
         print("supervision  : %d death(s), %d request(s) requeued, "
               "%d alive of %d" % (sup["deaths"],
                                   sup["requeued_requests"],
@@ -440,23 +443,29 @@ def check_resilience():
         print("fault sites  :", ", ".join(faults.SITES))
         print("env plan     :",
               os.environ.get("MXTPU_FAULT_PLAN") or "none")
-        # session counters FIRST — the probe below must not pollute (and
-        # must never reset) what this process actually experienced
-        c = resilience.counters()
+        # session counters FIRST (through the unified registry — the
+        # same keys Prometheus exposition serves) — the probe below
+        # must not pollute (and must never reset) what this process
+        # actually experienced
+        from mxtpu.observability import get_registry
+        c = get_registry().snapshot(sources=("resilience",))
         print("counters     : %d retries / %d exhaustions / "
               "%d quarantines / %d deadline evictions / %d sheds"
-              % (c["retries"], c["retry_exhaustions"],
-                 c["quarantined_slots"], c["deadline_evictions"],
-                 c["shed_requests"]))
+              % (c["resilience.retries"],
+                 c["resilience.retry_exhaustions"],
+                 c["resilience.quarantined_slots"],
+                 c["resilience.deadline_evictions"],
+                 c["resilience.shed_requests"]))
         sleeps = []
         pol = RetryPolicy(max_attempts=3, base_delay=0.01,
                           sleep=sleeps.append)
         with fault_plan("diagnose.probe@1:raise=OSError(probe)"):
             pol.call(faults.inject, "diagnose.probe")
-        d = resilience.counters()
+        d = get_registry().delta(c, get_registry().snapshot(
+            sources=("resilience",)))
         print("probe        : ok (%d injected fault, %d retry, no real "
-              "sleep)" % (d["faults_injected"] - c["faults_injected"],
-                          d["retries"] - c["retries"]))
+              "sleep)" % (d.get("resilience.faults_injected", 0),
+                          d.get("resilience.retries", 0)))
     except Exception as e:
         print("resilience   : FAILED (%s: %s)" % (type(e).__name__, e))
 
@@ -480,13 +489,17 @@ def check_guardian():
         print("ckpt keep    : %d (MXTPU_CKPT_KEEP=%s)"
               % (ckpt.default_keep(),
                  os.environ.get("MXTPU_CKPT_KEEP") or "unset"))
-        # session counters FIRST — the probe must not pollute the report
-        c = resilience.counters()
+        # session counters FIRST (unified-registry keys) — the probe
+        # must not pollute the report
+        from mxtpu.observability import get_registry
+        c = get_registry().snapshot(sources=("resilience",))
         print("counters     : %d skips / %d rollbacks / %d ckpt writes / "
               "%d corruptions / %d fallbacks"
-              % (c["guardian_skips"], c["guardian_rollbacks"],
-                 c["ckpt_writes"], c["ckpt_corruptions"],
-                 c["ckpt_fallbacks"]))
+              % (c["resilience.guardian_skips"],
+                 c["resilience.guardian_rollbacks"],
+                 c["resilience.ckpt_writes"],
+                 c["resilience.ckpt_corruptions"],
+                 c["resilience.ckpt_fallbacks"]))
         with tempfile.TemporaryDirectory() as d:
             cs = ckpt.CheckpointSet(d, keep=3)
             cs.save(0, b"probe-0")
@@ -502,6 +515,89 @@ def check_guardian():
             print("probe        : UNEXPECTED result %r" % (got,))
     except Exception as e:
         print("guardian     : FAILED (%s: %s)" % (type(e).__name__, e))
+
+
+def check_observability():
+    """Exercise the unified observability layer once (docs/
+    observability.md): a traced + flight-recorded micro-engine run
+    under a deterministic fault plan — a healthy install records
+    tick-clock spans along the full request path, an automatic
+    ``fault.<site>`` event, a quarantine postmortem naming the request,
+    a valid chrome-trace export, and Prometheus exposition of the
+    unified registry (with ZERO extra compiled programs from tracing)."""
+    print("----------Observability----------")
+    try:
+        import json
+
+        import numpy as np
+
+        import mxtpu as mx
+        from mxtpu import nd
+        from mxtpu.analysis import get_ledger
+        from mxtpu.models.transformer import (
+            TransformerLM, transformer_lm_sharding_rules)
+        from mxtpu.observability import (export_chrome_trace,
+                                         flight_recording, get_registry,
+                                         tracing)
+        from mxtpu.parallel import PagedContinuousBatchingEngine
+        from mxtpu.parallel.mesh import DeviceMesh
+        from mxtpu.resilience import fault_plan
+
+        print("ambient      : MXTPU_TRACE=%s MXTPU_FLIGHT_BUFFER=%s"
+              % (os.environ.get("MXTPU_TRACE") or "unset",
+                 os.environ.get("MXTPU_FLIGHT_BUFFER") or "unset"))
+        mx.random.seed(7)
+        lm = TransformerLM(32, units=16, hidden_size=32, num_layers=1,
+                           num_heads=2, num_kv_heads=2)
+        lm.initialize()
+        eng = PagedContinuousBatchingEngine(
+            lm, DeviceMesh(dp=1), transformer_lm_sharding_rules(),
+            num_slots=2, max_length=32, block_size=8, prefill_chunk=8)
+        rng = np.random.RandomState(0)
+        prompt = nd.array(rng.randint(0, 32, (1, 9)), dtype="int32")
+        led = get_ledger()
+        eng.submit(prompt, 3)
+        eng.run()                       # compile everything UNTRACED
+        seq = led.sequence()
+        with tracing() as tr, flight_recording(64) as fl:
+            with fault_plan("serving.step@2:raise=RuntimeError(probe)"):
+                eng.submit(prompt, 3, seed=5, temperature=0.7)
+                eng.run()
+            types = sorted({e.etype for e in tr.events()})
+            spans, events = tr.span_count(), len(tr.events())
+            pm = fl.postmortems
+            record = (fl.postmortem_record(pm[0]) if pm else {})
+        extra = len(led.misses_after(seq, sites=("serving.*",)))
+        chrome = json.loads(export_chrome_trace())
+        reg = get_registry()
+        reg.register_stats("diag_engine", eng)
+        try:
+            prom = reg.to_prometheus()
+        finally:
+            reg.unregister("diag_engine")
+        print("trace        : %d event(s) / %d span(s), types: %s"
+              % (events, spans, ", ".join(
+                  t for t in types if not t.startswith("engine.") )
+                 or "(engine-only)"))
+        print("flight       : %d postmortem(s)%s"
+              % (len(pm), " — %r over %d timeline event(s)"
+                 % (pm[0].kind, sum(len(v) for v in
+                                    record.get("requests", {}).values()))
+                 if pm else ""))
+        print("exports      : chrome traceEvents=%d, prometheus "
+              "lines=%d" % (len(chrome.get("traceEvents", ())),
+                            len(prom.splitlines())))
+        healthy = (events > 0 and spans > 0
+                   and "fault.serving.step" in types
+                   and pm and pm[0].kind == "quarantine"
+                   and extra == 0
+                   and "mxtpu_resilience_faults_injected" in prom)
+        print("probe        :", "ok (traced faulted run + postmortem + "
+              "exports, 0 extra compiled programs)" if healthy
+              else "UNEXPECTED (types=%r postmortems=%r extra=%d)"
+              % (types, [p.kind for p in pm], extra))
+    except Exception as e:
+        print("observability: FAILED (%s: %s)" % (type(e).__name__, e))
 
 
 def check_multistep_trainer():
@@ -658,6 +754,7 @@ def main():
     check_serving()
     check_resilience()
     check_guardian()
+    check_observability()
     check_multistep_trainer()
     check_analysis(full=full)
     check_devices()
