@@ -731,6 +731,91 @@ def _bench_paged_decode():
     print(json.dumps(rec), flush=True)
 
 
+def _bench_kernel_traffic():
+    """Serving-kernel memory accounting (round-16 tentpole): the
+    deterministic perf evidence for the kernel-default fast path while
+    the TPU tunnel stays wedged.  ``kernel_hbm_traffic`` sweeps the
+    REAL scalar-prefetch index maps over the full grid (exact host
+    math, no compile, no wall clock anywhere in this record):
+
+    - decode: page-pool fetches are O(valid pages) — one DMA per
+      table-live page per kv-head walk — vs one fetch per grid step
+      on the gather path;
+    - prefill: per-grid-step VMEM residency of the chunked kernel vs
+      the ~2 MiB/row the XLA path materializes at T=2048 fp32."""
+    import numpy as np
+    import jax
+    from mxtpu.analysis import kernel_hbm_traffic, kernel_vmem_estimate
+    from mxtpu.ops.pallas import paged_attention as pa
+    from mxtpu.ops.pallas import prefill_attention as pf
+
+    platform = jax.devices()[0].platform
+    B, KV, rep, D, bs, L = 16, 8, 4, 128, 16, 2048
+    M = L // bs
+    R = np.random.RandomState(0)
+    pos = R.randint(1, L, B).astype(np.int32)
+    nv = np.minimum(pos // bs + 1, M).astype(np.int32)
+    tables = np.zeros((B, M), np.int32)
+    perm = R.permutation(np.arange(1, B * M + 1)).astype(np.int32)
+    off = 0
+    for b in range(B):
+        tables[b, :nv[b]] = perm[off:off + nv[b]]
+        off += nv[b]
+    spec = pa.kernel_spec(B=B, KV=KV, rep=rep, W=1, D=D, block_size=bs,
+                          max_length=L, num_blocks=B * M + 1,
+                          tables=tables, pos=pos)
+    tr = kernel_hbm_traffic(spec)
+    pool = {n: tr["per_operand"][n] for n in ("pool_k", "pool_v")}
+    valid = int(nv.sum())
+    grid = tr["grid_points"]
+    fetches = sum(p["fetches"] for p in pool.values())
+    rec = {
+        "metric": "decode_pool_fetches_vs_grid_steps",
+        "value": fetches,
+        "unit": "page DMAs (K+V)",
+        "vs_baseline": 2 * grid,   # gather path: every step refetches
+        "platform": platform,
+        "valid_pages": valid,
+        "grid_points": grid,
+        "traffic_ratio_vs_gather": round(fetches / (2 * grid), 4),
+        "pool_bytes": sum(p["bytes"] for p in pool.values()),
+        "config": {"B": B, "KV": KV, "rep": rep, "D": D,
+                   "block_size": bs, "max_length": L,
+                   "fill": "uniform(1, max_length) seeded"},
+        "baseline_note": "DETERMINISTIC: exact index-map sweep "
+                         "(analysis.kernel_hbm_traffic), bit-stable "
+                         "across reruns; baseline is one pool fetch "
+                         "per grid step x2 operands (the gather "
+                         "path's traffic at the same geometry)",
+    }
+    assert fetches <= 2 * (KV * valid + B * KV), "O(valid pages) broken"
+    print(json.dumps(rec), flush=True)
+
+    pspec = pf.kernel_spec(T=128, KV=KV, rep=rep, D=D, block_size=bs,
+                           max_length=L, start_pos=L - 128)
+    est = kernel_vmem_estimate(pspec)
+    xla_row = 2 * L * D * 4                    # K+V rows, fp32
+    rec = {
+        "metric": "prefill_chunk_tile_vmem_bytes",
+        "value": est["total_bytes"],
+        "unit": "bytes/grid-step",
+        "vs_baseline": xla_row,
+        "platform": platform,
+        "residency_gain_vs_xla_rows": round(xla_row / est["total_bytes"],
+                                            2),
+        "config": {"T": 128, "KV": KV, "rep": rep, "D": D,
+                   "block_size": bs, "max_length": L,
+                   "start_pos": L - 128, "cache_dtype": "float32"},
+        "baseline_note": "DETERMINISTIC: kernel_vmem_estimate cost "
+                         "model (double-buffered tiles + scratch) vs "
+                         "the full fp32 K+V rows the XLA gather arm "
+                         "materializes per (slot, kv-head) at T=2048; "
+                         "tier-1 pins the >=4x floor",
+    }
+    assert xla_row >= 4 * est["total_bytes"]
+    print(json.dumps(rec), flush=True)
+
+
 def _bench_hierarchical_cache():
     """Hierarchical prefix cache (round-15 tentpole): persistent HBM
     pinning + host-RAM tiering + multi-turn sessions vs the overlap-
@@ -1728,6 +1813,7 @@ def _child_main():
     _bench_continuous_decode()
     _bench_trace_overhead()
     _bench_paged_decode()
+    _bench_kernel_traffic()
     _bench_speculative_decode()
     _bench_quantized_decode()
     _bench_hierarchical_cache()

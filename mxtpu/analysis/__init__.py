@@ -53,7 +53,8 @@ from .kernel_check import (BlockOperand, KernelSpec, ScalarPrefetch,
                            default_kernel_specs)
 from .memory_estimate import (MemoryEstimate, check_memory,
                               estimate_graph_memory, estimate_jit_memory,
-                              kernel_vmem_estimate, kv_cache_residency,
+                              kernel_hbm_traffic, kernel_vmem_estimate,
+                              kv_cache_residency,
                               paged_kv_cache_residency, sublane_tile,
                               xla_memory_stats)
 from .obs_check import check_observability
@@ -71,7 +72,7 @@ __all__ = [
     "MemoryEstimate", "check_memory", "estimate_graph_memory",
     "estimate_jit_memory", "kv_cache_residency",
     "paged_kv_cache_residency", "xla_memory_stats",
-    "kernel_vmem_estimate", "sublane_tile",
+    "kernel_vmem_estimate", "kernel_hbm_traffic", "sublane_tile",
     "check_donation", "check_trainer_donation",
     "KernelSpec", "BlockOperand", "ScratchOperand", "ScalarPrefetch",
     "check_kernels", "default_kernel_specs",

@@ -52,8 +52,14 @@ K007        INFO      geometry is interpret-mode-only: the spec was
 K008        INFO      the K004 index-map sweep SAMPLED an oversized grid
                       (small axes full, large axes at edges+midpoint) —
                       the clean verdict is partial, never silent
+K009        ERROR     mesh-axis/cache_spec mismatch: the spec declares a
+                      shard_map partitioning (``mesh_axis``) whose shard
+                      count does not divide the global sharded-axis
+                      extent — GSPMD would pad or gather around the
+                      kernel instead of running it per-device
 M007        INFO      per-grid-step VMEM pricing breakdown (always
-                      emitted per spec)
+                      emitted per spec; PER-SHARD when the spec carries
+                      a ``mesh_axis``)
 ==========  ========  =====================================================
 
 Severity contract: K001–K004 are definite Mosaic-lowering/correctness
@@ -168,29 +174,46 @@ class ScalarPrefetch:
 class KernelSpec:
     """Statically-checkable descriptor of ONE pallas_call: grid,
     windowed operands, VMEM scratch, scalar-prefetch operands, and
-    whether the call is interpret-mode-only (CPU tests)."""
+    whether the call is interpret-mode-only (CPU tests).
+
+    ``mesh_axis`` describes a shard_map-partitioned call (the serving
+    kernels under a tp-sharded cache): a
+    ``(axis_name, shards, global_extent)`` triple — mesh axis name, its
+    shard count, and the GLOBAL extent of the sharded operand axis the
+    per-shard geometry was derived from (kv heads for the paged
+    kernels).  The spec's grid/operands then describe ONE shard, so
+    K003 prices the per-device VMEM; a shard count that does not divide
+    the global extent is a K009 ERROR."""
 
     __slots__ = ("name", "grid", "operands", "scratch", "prefetch",
-                 "interpret")
+                 "interpret", "mesh_axis")
 
     def __init__(self, name: str, grid: Sequence[int],
                  operands: Sequence[BlockOperand],
                  scratch: Sequence[ScratchOperand] = (),
                  prefetch: Sequence[ScalarPrefetch] = (),
-                 interpret: bool = False):
+                 interpret: bool = False,
+                 mesh_axis: Optional[Tuple] = None):
         self.name = name
         self.grid = tuple(int(g) for g in grid)
         self.operands = list(operands)
         self.scratch = list(scratch)
         self.prefetch = list(prefetch)
         self.interpret = bool(interpret)
+        if mesh_axis is not None:
+            axis, shards = mesh_axis[0], int(mesh_axis[1])
+            extent = int(mesh_axis[2]) if len(mesh_axis) > 2 else None
+            mesh_axis = (str(axis), shards, extent)
+        self.mesh_axis = mesh_axis
 
     def __repr__(self):
         return ("<KernelSpec %s grid=%r %d operand(s) %d scratch "
-                "%d prefetch%s>"
+                "%d prefetch%s%s>"
                 % (self.name, self.grid, len(self.operands),
                    len(self.scratch), len(self.prefetch),
-                   " interpret" if self.interpret else ""))
+                   " interpret" if self.interpret else "",
+                   " mesh_axis=%r" % (self.mesh_axis,)
+                   if self.mesh_axis else ""))
 
 
 # -- geometry rules (K001/K002) -------------------------------------------
@@ -483,6 +506,24 @@ def check_kernels(specs: Optional[Sequence[KernelSpec]] = None,
     for spec in specs:
         deferred: List[Tuple[str, str, str]] = []
 
+        # K009 — mesh-axis/cache_spec divisibility (ERROR everywhere:
+        # a partitioning the mesh cannot honor is wrong in interpret
+        # mode too — GSPMD would pad or gather around the kernel)
+        if spec.mesh_axis is not None:
+            axis, shards, extent = spec.mesh_axis
+            if shards < 1 or (extent is not None
+                              and extent % max(shards, 1) != 0):
+                report.add(Diagnostic(
+                    _PASS, "K009", Severity.ERROR, spec.name,
+                    "mesh-axis mismatch: cache_spec shards axis %r "
+                    "over %d device(s) but the global sharded-axis "
+                    "extent %s does not divide — shard_map cannot "
+                    "place whole kv heads per device; fix the mesh "
+                    "size or the cache_spec heads axis"
+                    % (axis, shards, extent),
+                    details={"axis": axis, "shards": shards,
+                             "global_extent": extent}))
+
         # K001/K002 — tile geometry
         for code, opname, msg in _geometry_violations(spec):
             if spec.interpret:
@@ -566,7 +607,13 @@ def default_kernel_specs() -> List[KernelSpec]:
       (56x56x64, fp32);
     - paged_attention decode (W=1) and W-wide speculative verify (W=8),
       fp32 cache at block_size 16 and int8 cache at block_size 32 (the
-      int8 sublane floor), GQA rep 4, D=128, ragged model tables.
+      int8 sublane floor), GQA rep 4, D=128, ragged model tables — plus
+      the shard_map-partitioned (``mesh_axis=("tp", 4)``) per-shard
+      variants of the decode and int8-verify geometries, the default
+      fast path under a tp-sharded cache;
+    - paged_prefill chunked-prefill at the serving chunk (T=128, GQA
+      rep 4, D=128), fp32 cache at block_size 16 and int8 at 32, plus
+      the tp=4 per-shard variant.
     """
     import importlib
 
@@ -576,6 +623,8 @@ def default_kernel_specs() -> List[KernelSpec]:
     # module's name; import the module itself for its spec builder
     flash_attention = importlib.import_module(
         "mxtpu.ops.pallas.flash_attention")
+    prefill_attention = importlib.import_module(
+        "mxtpu.ops.pallas.prefill_attention")
 
     specs: List[KernelSpec] = []
     for dtype in ("float32", "bfloat16"):
@@ -588,6 +637,22 @@ def default_kernel_specs() -> List[KernelSpec]:
             specs.append(paged_attention.kernel_spec(
                 B=16, KV=8, rep=4, W=W, D=128, block_size=block_size,
                 max_length=512, cache_dtype=cache_dtype))
+    # the GSPMD-partitioned serving path: per-shard (tp=4 over 8 global
+    # kv heads -> 2 per device) decode and int8-verify geometries
+    specs.append(paged_attention.kernel_spec(
+        B=16, KV=8, rep=4, W=1, D=128, block_size=16, max_length=512,
+        cache_dtype="float32", mesh_axis=("tp", 4)))
+    specs.append(paged_attention.kernel_spec(
+        B=16, KV=8, rep=4, W=8, D=128, block_size=32, max_length=512,
+        cache_dtype="int8", mesh_axis=("tp", 4)))
+    # chunked-prefill kernel at the serving chunk geometry
+    for cache_dtype, block_size in (("float32", 16), ("int8", 32)):
+        specs.append(prefill_attention.kernel_spec(
+            T=128, KV=8, rep=4, D=128, block_size=block_size,
+            max_length=2048, start_pos=512, cache_dtype=cache_dtype))
+    specs.append(prefill_attention.kernel_spec(
+        T=128, KV=8, rep=4, D=128, block_size=16, max_length=2048,
+        start_pos=512, cache_dtype="float32", mesh_axis=("tp", 4)))
     return specs
 
 

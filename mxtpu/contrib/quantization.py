@@ -140,6 +140,35 @@ def wq_matmul_i8(x, qweight, wscale, bias=None, flatten=False,
     return out.astype(x.dtype)
 
 
+@register_op("wq_matmul_i8_q8", differentiable=False, num_outputs=2)
+def wq_matmul_i8_q8(x, qweight, wscale, bias=None, head_dim=0,
+                    flatten=False, no_bias=False):
+    """Weight-only int8 matmul with a FUSED int8-quantize epilogue —
+    the int8-weights × int8-KV fast path's projection op: the
+    ``wq_matmul_i8`` product is quantized per ``head_dim`` group of the
+    output axis straight into cache form, returning ((…, O) int8
+    payload, (…, O/head_dim) float32 scales) for a pre-quantized paged
+    write (``_paged_cache_write_rows_pre_q8``).  Between the int8
+    weights and the int8 cache nothing float-typed crosses an op
+    boundary.
+
+    Bit-exactness contract: the epilogue applies the SAME math, in the
+    same order, as the quantize-on-write path — ``wq_matmul_i8``'s fp32
+    accumulate + scale (+ bias) + x.dtype cast, then ops.tensor's
+    ``_q8_quantize`` per head vector — so the stored cache bits are
+    identical to projecting float and quantizing at the write
+    (tests/test_quantized_serving.py asserts it)."""
+    from ..ops.tensor import _q8_quantize
+
+    y = wq_matmul_i8(x, qweight, wscale, bias, flatten=flatten,
+                     no_bias=no_bias)
+    O = qweight.shape[0]
+    hd = int(head_dim) or O
+    lead = y.shape[:-1]
+    q, s = _q8_quantize(y.reshape(lead + (O // hd, hd)))
+    return q.reshape(lead + (O,)), s
+
+
 @register_op("wq_matmul_i4", differentiable=False)
 def wq_matmul_i4(x, qweight, wscale, bias=None, flatten=False,
                  no_bias=False, group_size=0, in_units=0):
@@ -177,7 +206,7 @@ def _bind_namespaces():
     from .. import ndarray as _ndm
     from .. import symbol as _symm
 
-    for _n in ("wq_matmul_i8", "wq_matmul_i4"):
+    for _n in ("wq_matmul_i8", "wq_matmul_i8_q8", "wq_matmul_i4"):
         if not hasattr(_ndm, _n):
             setattr(_ndm, _n, _ndm._make_op_fn(_n))
         if not hasattr(_symm, _n):

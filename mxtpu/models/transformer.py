@@ -127,8 +127,9 @@ def _page_copy(leaf, src, dst):
 
 def _paged_kernel_attention(q, pool_k, pool_v, tables, pos):
     """Route the paged cache read through the ragged Pallas kernel
-    (ops/pallas/paged_attention — gated MXTPU_PALLAS_PAGED_ATTN); q is
-    (B, H, W, D) post-rope, returns (B, H, W, D)."""
+    (ops/pallas/paged_attention — tri-state MXTPU_PALLAS_PAGED_ATTN,
+    default on where the geometry guard passes); q is (B, H, W, D)
+    post-rope, returns (B, H, W, D)."""
     if _q8cache(pool_k):
         return nd.paged_decode_attention(
             q, pool_k[0], pool_v[0], tables, pos,
@@ -136,9 +137,39 @@ def _paged_kernel_attention(q, pool_k, pool_v, tables, pos):
     return nd.paged_decode_attention(q, pool_k, pool_v, tables, pos)
 
 
-def _paged_attn_on():
+def _paged_prefill_kernel(q, pool_k, pool_v, table, start_pos):
+    """Route chunked prefill through the Pallas chunked-prefill kernel
+    (ops/pallas/prefill_attention); q is (1, H, T, D) post-rope,
+    returns (1, H, T, D) without gathering the full K/V rows."""
+    if _q8cache(pool_k):
+        return nd.paged_prefill_attention(
+            q, pool_k[0], pool_v[0], table, start_pos,
+            k_scales=pool_k[1], v_scales=pool_v[1])
+    return nd.paged_prefill_attention(q, pool_k, pool_v, table, start_pos)
+
+
+def _leaf_geometry(pool_k):
+    """(D, block_size, pool_dtype) of a paged cache leaf for the kernel
+    gates — geometry is static, so the gate verdict is trace-stable."""
+    p = _payload(pool_k)
+    dt = "int8" if _q8cache(pool_k) else str(p.dtype)
+    return int(p.shape[-1]), int(p.shape[-2]), dt
+
+
+def _paged_attn_on(pool_k=None):
     from ..ops.pallas.paged_attention import paged_attention_enabled
-    return paged_attention_enabled()
+    if pool_k is None:
+        return paged_attention_enabled()
+    D, bs, dt = _leaf_geometry(pool_k)
+    return paged_attention_enabled(D=D, block_size=bs, pool_dtype=dt)
+
+
+def _paged_prefill_on(pool_k, T, rep, q_dtype):
+    from ..ops.pallas.prefill_attention import paged_prefill_enabled
+    D, bs, dt = _leaf_geometry(pool_k)
+    return paged_prefill_enabled(D=D, block_size=bs, pool_dtype=dt,
+                                 T=int(T), rep=int(rep),
+                                 q_dtype=str(q_dtype))
 
 
 class RMSNorm(HybridBlock):
@@ -373,6 +404,46 @@ class MultiHeadAttention(HybridBlock):
             (0, 3, 1, 2, 4)).reshape(B, W, H * D)
         return self.out_proj(out), cache_k, cache_v
 
+    def _fused_q8_epilogue_on(self, pool_v):
+        """int8-weights × int8-KV fused-epilogue eligibility: an int8
+        QuantizedDense qkv projection feeding an int8 paged cache with
+        the Pallas read on.  When eligible, the V projection emits
+        quantized rows directly (wq_matmul_i8_q8) and the kernel
+        dequantizes them in VMEM — neither a float weight copy nor a
+        dequantized cache row materializes between projection and
+        attention."""
+        if not _q8cache(pool_v) or not _paged_attn_on(pool_v):
+            return False
+        try:
+            from ..contrib.quantization import QuantizedDense
+        except ImportError:  # pragma: no cover - contrib always ships
+            return False
+        return (isinstance(self.qkv, QuantizedDense)
+                and getattr(self.qkv, "_bits", 0) == 8)
+
+    def _project_qkv_fused_q8(self, x):
+        """Split the fused int8 qkv projection at the V boundary: q/k
+        rows come out float (rope still applies to them), V rows come
+        out as an (int8 payload, scales) pair straight from the matmul
+        epilogue.  Bit-identical to the unfused wq_matmul_i8 +
+        quantize-on-write path because each output row's contraction
+        and _q8_quantize math are unchanged by the row split."""
+        H, KV, D = self._heads, self._kv_heads, self._head_dim
+        cut = (H + KV) * D
+        w = self.qkv.weight.data()
+        s = self.qkv.wscale.data()
+        b = None if self.qkv.bias is None else self.qkv.bias.data()
+        qk = nd.wq_matmul_i8(x, w[:cut], s[:cut],
+                             None if b is None else b[:cut],
+                             flatten=self.qkv._flatten,
+                             no_bias=b is None)
+        vq, vs = nd.wq_matmul_i8_q8(x, w[cut:], s[cut:],
+                                    None if b is None else b[cut:],
+                                    head_dim=D,
+                                    flatten=self.qkv._flatten,
+                                    no_bias=b is None)
+        return qk, vq, vs
+
     def verify_pages(self, x, pool_k, pool_v, tables, pos, valid_len):
         """Batched speculative verification over the BLOCK-PAGED pool —
         verify_slots() with the cache read/write routed through the
@@ -384,18 +455,34 @@ class MultiHeadAttention(HybridBlock):
         B, W, _ = x.shape
         H, KV, D = self._heads, self._kv_heads, self._head_dim
         Tmax = tables.shape[1] * _payload(pool_k).shape[2]
-        qkv = self.qkv(x)
-        q = qkv[:, :, :H * D].reshape(B, W, H, D).transpose((0, 2, 1, 3))
-        k = qkv[:, :, H * D:(H + KV) * D].reshape(
-            B, W, KV, D).transpose((0, 2, 1, 3))
-        v = qkv[:, :, (H + KV) * D:].reshape(
-            B, W, KV, D).transpose((0, 2, 1, 3))
+        fused = self._fused_q8_epilogue_on(pool_v)
+        if fused:
+            qk, vq, vs = self._project_qkv_fused_q8(x)
+            q = qk[:, :, :H * D].reshape(
+                B, W, H, D).transpose((0, 2, 1, 3))
+            k = qk[:, :, H * D:].reshape(
+                B, W, KV, D).transpose((0, 2, 1, 3))
+        else:
+            qkv = self.qkv(x)
+            q = qkv[:, :, :H * D].reshape(
+                B, W, H, D).transpose((0, 2, 1, 3))
+            k = qkv[:, :, H * D:(H + KV) * D].reshape(
+                B, W, KV, D).transpose((0, 2, 1, 3))
+            v = qkv[:, :, (H + KV) * D:].reshape(
+                B, W, KV, D).transpose((0, 2, 1, 3))
         if self._rotary:
             q = nd.rope(q, offset=pos)
             k = nd.rope(k, offset=pos)
         pool_k = _paged_write_span(pool_k, k, tables, pos, valid_len)
-        pool_v = _paged_write_span(pool_v, v, tables, pos, valid_len)
-        if _paged_attn_on():
+        if fused:
+            # V rows land pre-quantized — no float V tensor exists
+            pool_v = tuple(nd._paged_cache_write_span_pre_q8(
+                pool_v[0], pool_v[1],
+                vq.reshape(B, W, KV, D).transpose((0, 2, 1, 3)),
+                vs.transpose((0, 2, 1)), tables, pos, valid_len))
+        else:
+            pool_v = _paged_write_span(pool_v, v, tables, pos, valid_len)
+        if _paged_attn_on(pool_k):
             # ragged Pallas kernel: walk each row's block table, read
             # only valid rows, per-lane causal extent pos[b]+w
             out = _paged_kernel_attention(q, pool_k, pool_v, tables,
@@ -451,18 +538,34 @@ class MultiHeadAttention(HybridBlock):
         B = x.shape[0]
         H, KV, D = self._heads, self._kv_heads, self._head_dim
         Tmax = tables.shape[1] * _payload(pool_k).shape[2]
-        qkv = self.qkv(x)  # (B, 1, (H+2KV)*D)
-        q = qkv[:, :, :H * D].reshape(B, 1, H, D).transpose((0, 2, 1, 3))
-        k = qkv[:, :, H * D:(H + KV) * D].reshape(
-            B, 1, KV, D).transpose((0, 2, 1, 3))
-        v = qkv[:, :, (H + KV) * D:].reshape(
-            B, 1, KV, D).transpose((0, 2, 1, 3))
+        fused = self._fused_q8_epilogue_on(pool_v)
+        if fused:
+            qk, vq, vs = self._project_qkv_fused_q8(x)
+            q = qk[:, :, :H * D].reshape(
+                B, 1, H, D).transpose((0, 2, 1, 3))
+            k = qk[:, :, H * D:].reshape(
+                B, 1, KV, D).transpose((0, 2, 1, 3))
+        else:
+            qkv = self.qkv(x)  # (B, 1, (H+2KV)*D)
+            q = qkv[:, :, :H * D].reshape(
+                B, 1, H, D).transpose((0, 2, 1, 3))
+            k = qkv[:, :, H * D:(H + KV) * D].reshape(
+                B, 1, KV, D).transpose((0, 2, 1, 3))
+            v = qkv[:, :, (H + KV) * D:].reshape(
+                B, 1, KV, D).transpose((0, 2, 1, 3))
         if self._rotary:
             q = nd.rope(q, offset=pos)  # (B,) offset: per-row rotation
             k = nd.rope(k, offset=pos)
         pool_k = _paged_write_rows(pool_k, k, tables, pos)
-        pool_v = _paged_write_rows(pool_v, v, tables, pos)
-        if _paged_attn_on():
+        if fused:
+            # V rows land pre-quantized — no float V tensor exists
+            pool_v = tuple(nd._paged_cache_write_rows_pre_q8(
+                pool_v[0], pool_v[1],
+                vq.reshape(B, 1, KV, D).transpose((0, 2, 1, 3)),
+                vs.transpose((0, 2, 1)), tables, pos))
+        else:
+            pool_v = _paged_write_rows(pool_v, v, tables, pos)
+        if _paged_attn_on(pool_k):
             # ragged Pallas kernel replaces the gather+softmax read:
             # each (slot, kv-head) walks its own block-table chain and
             # touches only rows <= pos[b] (docs/inference.md)
@@ -511,11 +614,19 @@ class MultiHeadAttention(HybridBlock):
             k = nd.rope(k, offset=start_pos)
         pool_k = _paged_write(pool_k, k, table, start_pos=start_pos)
         pool_v = _paged_write(pool_v, v, table, start_pos=start_pos)
+        rep = H // KV
+        if _paged_prefill_on(pool_k, T, rep, q.dtype):
+            # Pallas chunked-prefill kernel: scalar-prefetched block-
+            # table walk with online-softmax carry across chunk tiles —
+            # the full (Tmax, D) K/V rows are never materialized
+            out = _paged_prefill_kernel(q, pool_k, pool_v, table,
+                                        start_pos)          # (B,H,T,D)
+            out = out.transpose((0, 2, 1, 3)).reshape(B, T, H * D)
+            return self.out_proj(out), pool_k, pool_v
         keys = _paged_gather(pool_k, table).reshape(
             B * KV, Tmax, D)
         values = _paged_gather(pool_v, table).reshape(
             B * KV, Tmax, D)
-        rep = H // KV
         q_r = q.reshape(B * KV, rep * T, D)
         scores = nd.batch_dot(q_r, keys,
                               transpose_b=True) / math.sqrt(D)

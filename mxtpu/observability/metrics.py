@@ -28,6 +28,9 @@ source              pulls
 ``tracer``          :meth:`~mxtpu.observability.trace.Tracer.stats`
 ``flight``          :meth:`~mxtpu.observability.flight.FlightRecorder
                     .stats`
+``kernel_invocations``  :func:`mxtpu.ops.pallas.counters.counts` —
+                    trace-time Pallas kernel invocation counters
+                    (``kernel_invocations.<kernel_name>``)
 ==================  ====================================================
 
 Live objects (engines, gateways, supervisors, routers) register with
@@ -223,6 +226,16 @@ def _src_flight() -> dict:
     return get_flight().stats()
 
 
+def _src_kernel_invocations() -> dict:
+    """Pallas kernel trace-time invocation counters: one bump per
+    pallas_call traced into a compiled program, keyed by kernel name
+    (``kernel_invocations.paged_attention`` etc.) — the counter that
+    proves the fast path is actually riding the kernel, not the XLA
+    fallback (ops/pallas/counters.py)."""
+    from ..ops.pallas import counters
+    return counters.counts()
+
+
 def default_registry() -> MetricsRegistry:
     """A fresh registry pre-loaded with the built-in process-wide
     sources (module docstring table)."""
@@ -233,6 +246,7 @@ def default_registry() -> MetricsRegistry:
     reg.register_source("profiler", _src_profiler)
     reg.register_source("tracer", _src_tracer)
     reg.register_source("flight", _src_flight)
+    reg.register_source("kernel_invocations", _src_kernel_invocations)
     return reg
 
 

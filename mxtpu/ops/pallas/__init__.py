@@ -2,5 +2,7 @@
 src/operator/contrib/transformer.cc fused attention + fusion/fused_op RTC —
 where the reference hand-wrote CUDA, mxtpu hand-writes Pallas)."""
 
+from . import counters
 from .flash_attention import flash_attention
 from .paged_attention import paged_decode_attention
+from .prefill_attention import paged_prefill_attention

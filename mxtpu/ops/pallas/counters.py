@@ -1,0 +1,46 @@
+"""Named trace-time invocation counters for the Pallas kernels.
+
+One bump per *traced* pallas_call (not per execution): jit caching means
+a kernel that rode the fast path traces once per program family, so a
+moving counter is proof the compiled program contains the kernel — the
+"default path actually rode the kernel" claim becomes a counter
+assertion instead of an env-var inference (ISSUE 16 satellite).
+
+The counters surface two ways:
+
+- ``kernel_invocations.<name>`` in the unified MetricsRegistry
+  (observability/metrics.py — registered as a lazy source in
+  ``default_registry``), and
+- the ``tools/diagnose.py`` Pallas kernel section.
+
+Host-side Python ints mutated at trace time — never inside traced code,
+so they are jit/shard_map-safe by construction (the bump happens while
+the trace runs on the host, exactly like the old module-local
+``_invocations`` int this generalizes).
+"""
+
+from __future__ import annotations
+
+__all__ = ["bump", "count", "counts", "reset"]
+
+_COUNTS: dict = {}
+
+
+def bump(name, n=1):
+    """Record one traced pallas_call of kernel ``name``."""
+    _COUNTS[name] = _COUNTS.get(name, 0) + int(n)
+
+
+def count(name):
+    """Traced-call count for one kernel (0 if never traced)."""
+    return _COUNTS.get(name, 0)
+
+
+def counts():
+    """Snapshot of all counters — the MetricsRegistry source payload."""
+    return dict(_COUNTS)
+
+
+def reset():
+    """Zero every counter (tests only)."""
+    _COUNTS.clear()

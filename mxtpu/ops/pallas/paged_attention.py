@@ -21,11 +21,23 @@ payloads and the per-head-per-position scales dequantize INSIDE the
 kernel — the cache crosses HBM at one byte per element and never
 materializes a float copy.
 
-Gating mirrors the training kernels: ``MXTPU_PALLAS_PAGED_ATTN=1``
-routes ``TransformerLM.step_pages`` / ``verify_pages`` through this
-kernel (default off — the XLA gather path is the bit-exact parity
-reference for the serving engines); interpret mode on CPU, verified
-against the XLA path in tests/test_paged_attention_pallas.py.
+Gating is tri-state (``MXTPU_PALLAS_PAGED_ATTN`` = ``auto``/``1``/``0``,
+default ``auto``): on a real accelerator backend the kernel IS the
+default execution path wherever :func:`validate_call_geometry` accepts
+the call geometry; on interpret-only CPU hosts ``auto`` resolves off
+(the K007 rule — interpret mode accepts geometry hardware wouldn't) and
+the XLA gather path runs, which stays the bit-exact parity reference
+everywhere.  ``1`` forces the kernel (CPU tests run it in interpret
+mode), ``0`` forces the XLA path.  The resolved decision is baked into
+the serving jit keys so ledger program families stay pinned.
+
+Under a tp-sharded cache (``cache_spec`` heads axis, shard count > 1)
+the pallas_call is wrapped in ``shard_map`` over that axis — q/out and
+the page pools split on their heads axis, tables/pos replicate, and
+each device runs the kernel on its per-device KV heads (see
+ops/pallas/partition.py; the decoder opens the scope around its traced
+bodies).  Verified against the XLA path in
+tests/test_paged_attention_pallas.py.
 
 Geometry contract: ``mxtpu.analysis.kernel_check`` is the source of
 truth (docs/analysis.md K0xx) — :func:`kernel_spec` describes this
@@ -42,33 +54,64 @@ from __future__ import annotations
 
 import functools
 import math
+import os
 
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from ...base import env_bool, register_op
+from ...base import register_op
+from . import counters
+from .partition import current_head_sharding, head_shard_map
 
 __all__ = ["paged_decode_attention", "paged_attention_enabled",
-           "kernel_spec", "validate_call_geometry"]
+           "paged_attention_mode", "kernel_spec",
+           "validate_call_geometry"]
 
 _NEG_INF = -1e30
 
-# trace-time invocation counter: tests assert step_pages/verify_pages
-# actually ride the kernel when the gate is on (one bump per traced
-# pallas_call, not per execution)
-_invocations = 0
+KERNEL_NAME = "paged_attention"
 
 
-def paged_attention_enabled() -> bool:
-    """True when MXTPU_PALLAS_PAGED_ATTN routes the paged engines' cache
-    read through this kernel (docs/inference.md "Quantized serving")."""
-    return env_bool("MXTPU_PALLAS_PAGED_ATTN", False)
+def paged_attention_mode() -> str:
+    """The raw tri-state gate: ``"auto"`` (default), ``"1"`` (force the
+    kernel, interpret mode on CPU) or ``"0"`` (force the XLA gather
+    path).  Unrecognized values read as ``auto``."""
+    v = os.environ.get("MXTPU_PALLAS_PAGED_ATTN", "auto").strip().lower()
+    if v in ("0", "false", "off"):
+        return "0"
+    if v in ("1", "true", "on"):
+        return "1"
+    return "auto"
 
 
-def invocation_count() -> int:
-    return _invocations
+def paged_attention_enabled(D=None, block_size=None,
+                            pool_dtype=None) -> bool:
+    """Resolve the tri-state gate for one call site (docs/inference.md
+    "Serving Pallas kernels").  ``auto`` = on where the backend is a
+    real accelerator AND :func:`validate_call_geometry` accepts the
+    geometry (when the caller supplies it); off on interpret-only CPU
+    hosts — the K007 rule: interpret mode accepts geometry hardware
+    would reject, so CPU hosts stay on the bit-exact XLA path unless
+    forced with ``1``."""
+    mode = paged_attention_mode()
+    if mode == "0":
+        return False
+    if mode == "1":
+        return True
+    if jax.default_backend() == "cpu":
+        return False
+    if D is not None and validate_call_geometry(
+            D, block_size, pool_dtype):
+        return False
+    return True
+
+
+def invocation_count(name=KERNEL_NAME) -> int:
+    """Traced-call count (ops/pallas/counters; one bump per traced
+    pallas_call, not per execution)."""
+    return counters.count(name)
 
 
 def _kernel(tbl_ref, pos_ref, nv_ref, q_ref, k_ref, *rest,
@@ -155,12 +198,21 @@ def _model_tables(B, M, n_pages, block_size, W, max_length):
 
 def kernel_spec(B, KV, rep, W, D, block_size, max_length,
                 q_dtype="bfloat16", cache_dtype="float32",
-                num_blocks=None, tables=None, pos=None, interpret=False):
+                num_blocks=None, tables=None, pos=None, interpret=False,
+                mesh_axis=None):
     """KernelSpec descriptor (mxtpu.analysis.kernel_check) for one
     paged_decode_attention call — the REAL index maps (_page_index /
     _scale_index, block-table walk and null-page-0 routing included)
     over model scalar-prefetch tables, so the static pass evaluates the
-    same functions the pallas_call traces."""
+    same functions the pallas_call traces.
+
+    ``mesh_axis=(axis_name, shards)`` describes the shard_map-partitioned
+    call: ``KV`` stays the GLOBAL kv-head count and the spec's operand
+    geometry becomes PER-SHARD (KV//shards heads per device), so K003
+    prices the per-device VMEM the partitioned kernel actually uses.  A
+    shard count that does not divide KV is recorded as-is — the static
+    pass locates it as a K009 mesh-axis mismatch ERROR instead of this
+    builder raising."""
     import numpy as np
 
     from ...analysis.kernel_check import (BlockOperand, KernelSpec,
@@ -168,6 +220,13 @@ def kernel_spec(B, KV, rep, W, D, block_size, max_length,
 
     bs = int(block_size)
     M = math.ceil(max_length / bs)
+    name_sfx = ""
+    if mesh_axis is not None:
+        axis_name, shards = mesh_axis[0], int(mesh_axis[1])
+        mesh_axis = (axis_name, shards, int(KV))
+        if shards > 1 and KV % shards == 0:
+            KV = KV // shards
+        name_sfx = ",%s=%d" % (axis_name, shards)
     N = int(num_blocks) if num_blocks is not None else B * M + 1
     quant = str(cache_dtype) == "int8"
     # caller overrides apply INDEPENDENTLY (auditing a real engine's
@@ -210,7 +269,8 @@ def kernel_spec(B, KV, rep, W, D, block_size, max_length,
         "o", "out", (1, 1, lanes, D), (B, KV, lanes, D), q_dtype, q_im,
         strict_dims=(-1,)))
     return KernelSpec(
-        "paged_attention[%s,W=%d,bs=%d,D=%d]" % (pool_dtype, W, bs, D),
+        "paged_attention[%s,W=%d,bs=%d,D=%d%s]" % (pool_dtype, W, bs, D,
+                                                   name_sfx),
         grid=(B, KV, M),
         operands=operands,
         scratch=[ScratchOperand("m", (lanes, 1), "float32"),
@@ -220,7 +280,8 @@ def kernel_spec(B, KV, rep, W, D, block_size, max_length,
                   ScalarPrefetch("pos", pos,
                                  valid_range=(0, max_length)),
                   ScalarPrefetch("nv", nv, valid_range=(1, M + 1))],
-        interpret=interpret)
+        interpret=interpret,
+        mesh_axis=mesh_axis)
 
 
 def validate_call_geometry(D, block_size, pool_dtype):
@@ -254,36 +315,17 @@ def _scale_index(b, kv, j, tbl, pos, nv):
     return (jnp.where(j < nv[b], tbl[b, j], 0), kv, 0)
 
 
-def paged_decode_attention(q, pool_k, pool_v, tables, pos,
-                           k_scales=None, v_scales=None, scale=None):
-    """Ragged paged attention over block tables.
-
-    q : (B, H, W, D) queries — W = 1 for the plain decode step, > 1 for
-        a speculative verify window (lane w attends <= pos[b] + w).
-    pool_k / pool_v : (N, KV, bs, D) page pools (float, or int8 payload
-        when ``k_scales``/``v_scales`` (N, KV, bs) are given).
-    tables : (B, M) int32 block tables (page 0 = reserved null page).
-    pos : (B,) int32 per-slot positions (the last written position of
-        window lane 0).
-
-    Returns (B, H, W, D) in q's dtype.  H = KV * rep, kv-major (head
-    h = kv*rep + r — the models' GQA fold).
-    """
-    global _invocations
-    B, H, W, D = q.shape
-    N, KV, bs, _ = pool_k.shape
+def _call_local(qr, pool_k, pool_v, tables, pos, k_scales=None,
+                v_scales=None, *, sm_scale, W, interpret):
+    """The unpartitioned pallas_call on (possibly per-shard) operands:
+    qr is the kv-major (B, KV, rep*W, D) fold — under shard_map KV here
+    is the PER-DEVICE kv-head count."""
+    B, KV, lanes, D = qr.shape
+    N, _, bs, _ = pool_k.shape
     M = tables.shape[-1]
-    rep = H // KV
-    sm_scale = float(scale if scale is not None else 1.0 / math.sqrt(D))
     quant = k_scales is not None
-
-    qr = q.reshape(B, KV, rep * W, D)
-    tables = tables.astype(jnp.int32)
-    pos = jnp.asarray(pos, jnp.int32).reshape(-1)
     nv = _num_valid_pages(pos, W, bs, M)
 
-    lanes = rep * W
-    grid = (B, KV, M)
     in_specs = [
         pl.BlockSpec((1, 1, lanes, D),
                      lambda b, kv, j, tbl, pos, nv: (b, kv, 0, 0)),
@@ -303,7 +345,7 @@ def paged_decode_attention(q, pool_k, pool_v, tables, pos,
                                W=W, n_pages=M, quant=quant)
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=3,
-        grid=grid,
+        grid=(B, KV, M),
         in_specs=in_specs,
         out_specs=pl.BlockSpec(
             (1, 1, lanes, D),
@@ -314,6 +356,41 @@ def paged_decode_attention(q, pool_k, pool_v, tables, pos,
             pltpu.VMEM((lanes, D), jnp.float32),
         ],
     )
+    return pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((B, KV, lanes, D), qr.dtype),
+        grid_spec=grid_spec,
+        interpret=interpret,
+    )(tables, pos, nv, *args)
+
+
+def paged_decode_attention(q, pool_k, pool_v, tables, pos,
+                           k_scales=None, v_scales=None, scale=None):
+    """Ragged paged attention over block tables.
+
+    q : (B, H, W, D) queries — W = 1 for the plain decode step, > 1 for
+        a speculative verify window (lane w attends <= pos[b] + w).
+    pool_k / pool_v : (N, KV, bs, D) page pools (float, or int8 payload
+        when ``k_scales``/``v_scales`` (N, KV, bs) are given).
+    tables : (B, M) int32 block tables (page 0 = reserved null page).
+    pos : (B,) int32 per-slot positions (the last written position of
+        window lane 0).
+
+    Returns (B, H, W, D) in q's dtype.  H = KV * rep, kv-major (head
+    h = kv*rep + r — the models' GQA fold).  Inside an active
+    ``head_sharding_scope`` (the decoder's tp-sharded cache) the call is
+    shard_map-partitioned over the heads axis.
+    """
+    B, H, W, D = q.shape
+    N, KV, bs, _ = pool_k.shape
+    rep = H // KV
+    sm_scale = float(scale if scale is not None else 1.0 / math.sqrt(D))
+    quant = k_scales is not None
+
+    qr = q.reshape(B, KV, rep * W, D)
+    tables = tables.astype(jnp.int32)
+    pos = jnp.asarray(pos, jnp.int32).reshape(-1)
+
     interpret = jax.default_backend() == "cpu"
     if not interpret:
         # runtime mirror of the static kernel_check pass: TPU-illegal
@@ -329,13 +406,34 @@ def paged_decode_attention(q, pool_k, pool_v, tables, pos,
                 "`python -m mxtpu.analysis kernel` for the full static "
                 "verdict); interpret-mode CPU tests accept this "
                 "geometry, hardware does not.")
-    _invocations += 1
-    out = pl.pallas_call(
-        kernel,
-        out_shape=jax.ShapeDtypeStruct((B, KV, lanes, D), q.dtype),
-        grid_spec=grid_spec,
-        interpret=interpret,
-    )(tables, pos, nv, *args)
+    counters.bump(KERNEL_NAME)
+    call = functools.partial(_call_local, sm_scale=sm_scale, W=W,
+                             interpret=interpret)
+
+    shard = current_head_sharding()
+    if shard is not None and KV % shard[2] == 0:
+        from jax.sharding import PartitionSpec as P
+
+        jm, axes, _ = shard
+        ax = axes[0] if len(axes) == 1 else tuple(axes)
+        heads4 = P(None, ax, None, None)   # qr/out and page pools
+        heads3 = P(None, ax, None)         # int8 scale planes
+        repl = P()                         # tables / pos
+        if quant:
+            fn = lambda a, b_, c, d, e, f, g: call(  # noqa: E731
+                a, b_, c, d, e, f, g)
+            in_specs = (heads4, heads4, heads4, repl, repl,
+                        heads3, heads3)
+            mapped = head_shard_map(fn, jm, in_specs, heads4)
+            out = mapped(qr, pool_k, pool_v, tables, pos,
+                         k_scales, v_scales)
+        else:
+            fn = lambda a, b_, c, d, e: call(a, b_, c, d, e)  # noqa: E731
+            in_specs = (heads4, heads4, heads4, repl, repl)
+            mapped = head_shard_map(fn, jm, in_specs, heads4)
+            out = mapped(qr, pool_k, pool_v, tables, pos)
+    else:
+        out = call(qr, pool_k, pool_v, tables, pos, k_scales, v_scales)
     return out.reshape(B, KV, rep, W, D).reshape(B, H, W, D)
 
 

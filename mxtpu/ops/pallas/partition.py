@@ -1,0 +1,80 @@
+"""GSPMD partitioning scope for the serving Pallas kernels.
+
+The paged-attention / paged-prefill pallas_calls are traced deep inside
+``TransformerLM.step_pages``-family bodies, but the information needed
+to partition them — the device mesh and which mesh axes shard the
+KV-heads axis of the paged cache (``cache_spec[1]``, ``"tp"`` by
+default) — lives on the ``ShardedDecoder`` that builds the jitted
+programs.  Rather than thread a mesh argument through every leaf-form
+helper, the decoder opens :func:`head_sharding_scope` around its traced
+bodies and the kernels read :func:`current_head_sharding` at trace time.
+
+When the scope reports more than one shard, the kernels wrap their
+pallas_call in ``shard_map`` over the heads axis: q/out (B, H, W, D) and
+the page pools (N, KV, bs, D) split on their head axis, block tables /
+positions replicate, and each device runs the identical kernel on its
+per-device KV heads — the per-shard geometry ``kernel_check`` verdicts
+via ``KernelSpec.mesh_axis``.  The GQA fold keeps q heads kv-major
+(h = kv*rep + r), so an H-axis split lands every query head on the same
+device as its KV head and the kernel body needs no cross-device
+communication at all.
+
+Trace-time host state (a plain stack), same discipline as the
+invocation counters: never read inside traced code, only while the
+trace runs.
+"""
+
+from __future__ import annotations
+
+import contextlib
+
+try:  # jax >= 0.8
+    from jax import shard_map
+except ImportError:  # pragma: no cover — older jax
+    from jax.experimental.shard_map import shard_map
+
+__all__ = ["head_sharding_scope", "current_head_sharding",
+           "head_shard_map"]
+
+_SCOPE = []
+
+
+@contextlib.contextmanager
+def head_sharding_scope(mesh, axes):
+    """Declare, for the duration of a traced serving body, that the
+    paged cache's KV-heads axis is sharded over mesh ``axes`` (the
+    engine's ``cache_spec[1]``, e.g. ``"tp"``).  ``mesh`` is the
+    DeviceMesh (or anything with ``jax_mesh``/``axis_sizes``); a scope
+    that resolves to one shard is recorded as inactive."""
+    axes = (axes,) if isinstance(axes, str) else tuple(axes or ())
+    shards = 1
+    sizes = getattr(mesh, "axis_sizes", None) or {}
+    for a in axes:
+        shards *= int(sizes.get(a, 1))
+    entry = None
+    if axes and shards > 1:
+        entry = (getattr(mesh, "jax_mesh", mesh), axes, shards)
+    _SCOPE.append(entry)
+    try:
+        yield entry
+    finally:
+        _SCOPE.pop()
+
+
+def current_head_sharding():
+    """(jax_mesh, axes, shards) of the innermost active scope, or None
+    when unscoped / single-shard — kernels fall back to the unpartitioned
+    call."""
+    return _SCOPE[-1] if _SCOPE else None
+
+
+def head_shard_map(fn, mesh, in_specs, out_specs):
+    """shard_map with the repo's jax-version shim (ring_attention
+    idiom): replication checking off because the kernels' outputs are
+    genuinely sharded and the block tables genuinely replicated."""
+    try:  # jax >= 0.8 renamed check_rep -> check_vma
+        return shard_map(fn, mesh=mesh, in_specs=in_specs,
+                         out_specs=out_specs, check_vma=False)
+    except TypeError:  # pragma: no cover — older jax
+        return shard_map(fn, mesh=mesh, in_specs=in_specs,
+                         out_specs=out_specs, check_rep=False)

@@ -1410,6 +1410,55 @@ def _paged_cache_write_span_q8(pool, scales, new, tables, pos,
     return pool, scales
 
 
+@register_op("_paged_cache_write_rows_pre_q8", differentiable=False,
+             num_outputs=2)
+def _paged_cache_write_rows_pre_q8(pool, scales, new_q, new_s, tables,
+                                   pos):
+    """PRE-quantized twin of _paged_cache_write_rows_q8 — the fused
+    int8 epilogue's landing op: ``new_q`` (B, KV, 1, D) int8 payload
+    and ``new_s`` (B, KV, 1) float32 scales arrive already quantized
+    (``wq_matmul_i8_q8``'s projection epilogue produced them), so no
+    float cache row materializes between projection and write.  Same
+    index math, no requantization — the stored bits are identical to
+    the quantize-on-write path by the shared _q8_quantize contract."""
+    t = tables.astype(jnp.int32)
+    bs = pool.shape[2]
+    p = jnp.asarray(pos, jnp.int32).reshape(-1)
+    rows = jnp.arange(t.shape[0])
+    blk, off = t[rows, p // bs], p % bs
+    pool = pool.at[blk, :, off, :].set(
+        new_q[:, :, 0, :].astype(pool.dtype))
+    scales = scales.at[blk, :, off].set(
+        new_s[:, :, 0].astype(scales.dtype))
+    return pool, scales
+
+
+@register_op("_paged_cache_write_span_pre_q8", differentiable=False,
+             num_outputs=2)
+def _paged_cache_write_span_pre_q8(pool, scales, new_q, new_s, tables,
+                                   pos, valid_len):
+    """PRE-quantized twin of _paged_cache_write_span_q8 (the
+    speculative-window variant of the fused-epilogue landing op):
+    payload (B, KV, W, D) int8 + scales (B, KV, W) scatter with the
+    same null-page-0 routing for invalid lanes, no requantization."""
+    t = tables.astype(jnp.int32)                             # (B, M)
+    bs = pool.shape[2]
+    M = t.shape[1]
+    W = new_q.shape[2]
+    p = (jnp.asarray(pos, jnp.int32).reshape(-1, 1)
+         + jnp.arange(W, dtype=jnp.int32)[None, :])          # (B, W)
+    valid = (jnp.arange(W, dtype=jnp.int32)[None, :]
+             < jnp.asarray(valid_len, jnp.int32).reshape(-1, 1))
+    blk = jnp.take_along_axis(t, jnp.clip(p // bs, 0, M - 1), axis=1)
+    blk = jnp.where(valid & (p // bs < M), blk, 0)
+    off = p % bs
+    qv = new_q.transpose(0, 2, 1, 3).astype(pool.dtype)      # (B, W, KV, D)
+    sv = new_s.transpose(0, 2, 1).astype(scales.dtype)       # (B, W, KV)
+    pool = pool.at[blk, :, off, :].set(qv)
+    scales = scales.at[blk, :, off].set(sv)
+    return pool, scales
+
+
 # ---------------------------------------------------------------------------
 # upstream mx.np internal op names (python/mxnet/numpy calls lower to
 # `_npi_*`-registered kernels in the reference — src/operator/numpy/**).

@@ -89,6 +89,14 @@ def _paged_attn_gate():
     return bool(paged_attention_enabled())
 
 
+def _paged_prefill_gate():
+    """Prefill twin of _paged_attn_gate: the chunked-prefill kernel
+    choice is likewise baked into the compiled program, so the
+    page_prefill jit key carries the resolved tri-state verdict."""
+    from ..ops.pallas.prefill_attention import paged_prefill_enabled
+    return bool(paged_prefill_enabled())
+
+
 def resolve_cache_dtype(cache_dtype):
     """None → the ambient default: MXTPU_CACHE_DTYPE (e.g. "int8" to
     run every engine/generate quantized without touching call sites),
@@ -247,15 +255,27 @@ class ShardedDecoder:
         """
         block = self._block
         params = self._params
+        mesh = self._mesh
+        spec = tuple(self._cache_spec)
+        heads_axes = ()
+        if len(spec) > 1 and spec[1] is not None:
+            heads_axes = (spec[1] if isinstance(spec[1], tuple)
+                          else (spec[1],))
 
         def program(param_leaves, cache_leaves, *extra):
+            # the cache_spec heads axes scope the trace: any Pallas
+            # paged-attention call inside body() shard_maps itself over
+            # them, so tp>1 configurations ride the kernel per-shard
+            # instead of falling back (ops/pallas/partition.py)
+            from ..ops.pallas.partition import head_sharding_scope
             saved = []
             for p, leaf in zip(params, param_leaves):
                 holder = p.data()
                 saved.append((holder, holder._data))
                 holder._data = leaf
             try:
-                with autograd.pause(train_mode=False):
+                with autograd.pause(train_mode=False), \
+                        head_sharding_scope(mesh, heads_axes):
                     caches = [(_wrap_leaf(ck), _wrap_leaf(cv))
                               for ck, cv in cache_leaves]
                     logits, new_caches = body(block, caches, *extra)
@@ -526,7 +546,7 @@ class ShardedDecoder:
         key = ("page_prefill",
                _cache_shapes(cache_leaves),
                _cache_dt(cache_leaves), tokens.shape, tokens.dtype,
-               table.shape, total_len)
+               table.shape, total_len, _paged_prefill_gate())
         hit = key in self._jit_cache
         self._ledger_report("page_prefill", cache_leaves, (tokens,), hit)
         if not hit:

@@ -460,3 +460,71 @@ def test_unwired_pass_fails_the_all_gate(monkeypatch):
     assert not rep.ok
     assert [d.code for d in rep.errors] == ["P001"]
     assert rep.errors[0].subject == "zz_new_pass"
+
+
+# ------------------------------------- sharded (mesh-axis) geometry
+
+
+def test_sharded_specs_verdict_per_shard_geometry():
+    """A spec carrying ``mesh_axis=(axis, shards)`` prices the
+    PER-DEVICE slice: the KV grid axis shrinks to KV/shards and the
+    shard count is part of the spec name (so K diagnostics locate the
+    sharded variant, not the global one)."""
+    g = pa.kernel_spec(B=4, KV=8, rep=2, W=1, D=128, block_size=16,
+                      max_length=256, cache_dtype="float32")
+    s = pa.kernel_spec(B=4, KV=8, rep=2, W=1, D=128, block_size=16,
+                      max_length=256, cache_dtype="float32",
+                      mesh_axis=("tp", 4))
+    assert s.grid[1] == g.grid[1] // 4
+    assert "tp=4" in s.name
+    assert check_kernels([s]).ok
+
+
+def test_k003_per_shard_over_budget_fires_located_error():
+    """Red team (ISSUE 16): the K003 budget applies to the PER-SHARD
+    geometry — a sharded verify-window spec over a tightened budget
+    fires a located ERROR whose subject names the tp-sharded spec."""
+    spec = pa.kernel_spec(B=4, KV=8, rep=4, W=8, D=128, block_size=32,
+                          max_length=512, cache_dtype="int8",
+                          mesh_axis=("tp", 4))
+    rep = check_kernels([spec], vmem_budget="64KiB")
+    hit = rep.filter(code="K003")
+    assert len(hit) == 1 and not rep.ok
+    d = hit.diagnostics[0]
+    assert d.severity is Severity.ERROR
+    assert "tp=4" in d.subject
+    assert d.details["budget_bytes"] == 64 * 1024
+    # the same per-shard geometry is fine under the real 16MiB budget
+    assert check_kernels([spec]).ok
+
+
+def test_k009_mesh_axis_mismatch_fires_located_error():
+    """Red team (ISSUE 16): a shard count that does not divide the
+    global KV-head extent is a partitioning error — K009 ERROR locating
+    the sharded spec, fired even for interpret-mode specs (it is a
+    mesh/cache_spec mismatch, not a TPU tile rule)."""
+    for interp in (False, True):
+        spec = pa.kernel_spec(B=4, KV=6, rep=2, W=1, D=128,
+                              block_size=32, max_length=256,
+                              cache_dtype="float32",
+                              mesh_axis=("tp", 4), interpret=interp)
+        rep = check_kernels([spec])
+        hit = rep.filter(code="K009")
+        assert len(hit) == 1 and not rep.ok
+        d = hit.diagnostics[0]
+        assert d.severity is Severity.ERROR
+        assert "tp" in d.message and "4" in d.message
+        assert d.details["global_extent"] == 6
+        assert d.details["shards"] == 4
+
+
+def test_prefill_specs_in_the_merge_gate():
+    """The chunked-prefill kernel lands behind the same gate: its
+    specs (fp32 + int8 cache, incl. a tp-sharded variant) are part of
+    default_kernel_specs() and verdict clean."""
+    names = " ".join(s.name for s in default_kernel_specs())
+    assert "paged_prefill[float32" in names
+    assert "paged_prefill[int8" in names
+    assert "paged_attention[float32,W=1,bs=16,D=128,tp=4" in names \
+        or ("paged_attention" in names and "tp=4" in names)
+    assert "paged_prefill" in names and "tp=4" in names

@@ -415,6 +415,10 @@ SKIP = {
     "paged_decode_attention": "ragged Pallas kernel; covered by "
                               "tests/test_paged_attention_pallas.py "
                               "(XLA-path parity matrix incl. int8)",
+    "paged_prefill_attention": "chunked-prefill Pallas kernel; covered "
+                               "by tests/test_prefill_attention_pallas"
+                               ".py (XLA-path parity matrix incl. "
+                               "int8/bf16 + engine integration)",
     "ring_attention": "needs a device mesh; covered by "
                       "tests/test_parallel.py exact-vs-dense test",
     "ROIAlign": "covered by detection-op usage; numeric grad unstable at "
@@ -560,6 +564,19 @@ CASES.update({
     "wq_matmul_i4": C(
         lambda: (A(3, 4), I8(5, 2), SCL(5, 2)),
         {"group_size": 2, "in_units": 4}, grad=False),
+    "wq_matmul_i8_q8": C(
+        lambda: (A(3, 4), I8(6, 4), SCL(6)),
+        {"head_dim": 2}, grad=False, bf16=False),
+    # pre-quantized paged landings (fused int8 epilogue, ISSUE 16):
+    # rows/scales arrive already int8 so the write is a pure scatter
+    "_paged_cache_write_rows_pre_q8": C(
+        lambda: (I8(5, 3, 4, 2), SCL(5, 3, 4), I8(2, 3, 1, 2),
+                 SCL(2, 3, 1), IDX(2, 3, n=5), jnp.asarray([5, 2])),
+        grad=False, bf16=False),
+    "_paged_cache_write_span_pre_q8": C(
+        lambda: (I8(5, 3, 4, 2), SCL(5, 3, 4), I8(2, 3, 4, 2),
+                 SCL(2, 3, 4), IDX(2, 3, n=5), jnp.asarray([3, 2]),
+                 jnp.asarray([4, 2])), grad=False, bf16=False),
     "_npi_einsum": C(lambda: (A(2, 3), A(3, 4)),
                      {"subscripts": "ij,jk->ik"}),
     "gradientmultiplier": C(lambda: (A(3, 4),), {"scalar": 1.0}),

@@ -164,3 +164,53 @@ def test_verify_pages_rides_kernel_when_gated(monkeypatch):
     assert st["accepted_tokens"] > 0
     for w, g in zip(want, got):
         assert np.array_equal(w, g)
+
+
+# ------------------------------------------------- tri-state gating
+
+
+def test_tri_state_mode_parsing(monkeypatch):
+    """MXTPU_PALLAS_PAGED_ATTN is a tri-state: 0/off/false, 1/on/true,
+    everything else (incl. unset) resolves to auto."""
+    for v, want in [("0", "0"), ("off", "0"), ("FALSE", "0"),
+                    ("1", "1"), ("on", "1"), ("True", "1"),
+                    ("auto", "auto"), ("", "auto"), ("bogus", "auto")]:
+        monkeypatch.setenv("MXTPU_PALLAS_PAGED_ATTN", v)
+        assert pa.paged_attention_mode() == want
+    monkeypatch.delenv("MXTPU_PALLAS_PAGED_ATTN", raising=False)
+    assert pa.paged_attention_mode() == "auto"
+
+
+def test_auto_resolves_off_on_interpret_only_cpu_host(monkeypatch):
+    """The K007 rule applied at runtime: on a CPU backend the default
+    `auto` keeps the XLA gather path (no interpret-mode overhead);
+    `1` forces the kernels (the parity arm), `0` forces XLA.  Both
+    kernels share one resolution."""
+    from mxtpu.ops.pallas import prefill_attention as pf
+
+    monkeypatch.delenv("MXTPU_PALLAS_PAGED_ATTN", raising=False)
+    assert pa.paged_attention_enabled() is False
+    assert pf.paged_prefill_enabled() is False
+    monkeypatch.setenv("MXTPU_PALLAS_PAGED_ATTN", "1")
+    assert pa.paged_attention_enabled() is True
+    assert pf.paged_prefill_enabled(D=16, block_size=8,
+                                    pool_dtype="float32", T=8,
+                                    rep=2) is True
+    monkeypatch.setenv("MXTPU_PALLAS_PAGED_ATTN", "0")
+    assert pa.paged_attention_enabled(D=128, block_size=32,
+                                      pool_dtype="int8") is False
+    assert pf.paged_prefill_enabled() is False
+
+
+def test_auto_default_keeps_xla_arm_on_cpu(monkeypatch):
+    """Honest default flip: on this interpret-only host the engine's
+    default-auto run never traces a kernel (counter-asserted), so the
+    existing CPU parity suites keep testing the XLA reference arm."""
+    from mxtpu.ops.pallas import counters
+
+    monkeypatch.delenv("MXTPU_PALLAS_PAGED_ATTN", raising=False)
+    before = dict(counters.counts())
+    _drive("float32")
+    after = counters.counts()
+    for name in ("paged_attention", "paged_prefill"):
+        assert after.get(name, 0) == before.get(name, 0)

@@ -1,0 +1,176 @@
+"""Interpret-mode parity matrix for the Pallas chunked-prefill kernel
+(ops/pallas/prefill_attention) vs the XLA gather path — the decode
+kernel's established pattern (test_paged_attention_pallas.py): tier-1
+keeps fast bit-exact anchors on every variant axis (block size, ragged
+start_pos, GQA fold, int8 dequant-in-kernel, bf16 cache) and the full
+grid rides the slow marker; plus the integration claim: with the gate
+forced on, the paged engine's chunked prefill traces through the
+kernel (prefill invocation counter moves) and the token streams match
+the XLA arm bit-for-bit."""
+
+import numpy as np
+import pytest
+import jax.numpy as jnp
+
+import mxtpu as mx
+from mxtpu import nd
+from mxtpu.ops.pallas import prefill_attention as pf
+from mxtpu.ops.pallas.prefill_attention import (paged_prefill_attention,
+                                                xla_reference)
+
+R = np.random.RandomState(0)
+
+
+def _setup(KV=2, rep=2, T=8, D=16, bs=8, M=4, N=9, start=0,
+           quant=False, q_dtype="float32", cache_dtype="float32"):
+    """A slot mid-prefill: positions [0, start+T) live behind a 1-based
+    table; the chunk's own K/V rows are already written (the engine
+    writes before it attends)."""
+    H = KV * rep
+    q = jnp.asarray(R.randn(1, H, T, D).astype(q_dtype))
+    need = (start + T + bs - 1) // bs
+    assert need <= M <= N - 1
+    pages = R.permutation(np.arange(1, N))[:M].astype(np.int32)
+    table = np.zeros(M, np.int32)
+    table[:need] = pages[:need]
+    table = jnp.asarray(table)
+    if quant:
+        pk = jnp.asarray(R.randint(-127, 128, (N, KV, bs, D)).astype(
+            np.int8))
+        pv = jnp.asarray(R.randint(-127, 128, (N, KV, bs, D)).astype(
+            np.int8))
+        ks = jnp.asarray((R.rand(N, KV, bs) * 0.1 + 1e-3).astype(
+            np.float32))
+        vs = jnp.asarray((R.rand(N, KV, bs) * 0.1 + 1e-3).astype(
+            np.float32))
+        return q, pk, pv, table, start, dict(k_scales=ks, v_scales=vs)
+    pk = jnp.asarray(R.randn(N, KV, bs, D).astype(cache_dtype))
+    pv = jnp.asarray(R.randn(N, KV, bs, D).astype(cache_dtype))
+    return q, pk, pv, table, start, {}
+
+
+def _check(q, pk, pv, table, start, kw, rtol=1e-4, atol=1e-5):
+    out = paged_prefill_attention(q, pk, pv, table, start, **kw)
+    ref = xla_reference(q, pk, pv, table, start, **kw)
+    np.testing.assert_allclose(np.asarray(out, dtype="float32"),
+                               np.asarray(ref, dtype="float32"),
+                               rtol=rtol, atol=atol)
+
+
+# --------------------------------------------- tier-1 fast anchors
+
+
+def test_first_chunk_matches_xla():
+    """start_pos=0: causal masking within the chunk alone."""
+    _check(*_setup())
+
+
+def test_later_chunk_ragged_start_matches_xla():
+    """A mid-prompt chunk whose start is NOT block-aligned: earlier
+    chunks' pages replay through the online-softmax walk and the
+    chunk's causal frontier crosses a page boundary."""
+    _check(*_setup(start=13, T=8, M=4))
+
+
+def test_gqa_fold_and_128_lane_tiling():
+    """rep*T = 4*32 = 128 exercises the exact one-q-tile boundary;
+    rep*T = 4*64 subdivides into two 128-lane tiles."""
+    _check(*_setup(rep=4, T=32, M=8, N=12, start=5))
+    _check(*_setup(rep=4, T=64, M=12, N=16, start=17))
+
+
+def test_int8_cache_dequant_in_kernel():
+    _check(*_setup(quant=True, start=5), rtol=1e-3, atol=1e-3)
+
+
+def test_bf16_cache_and_queries():
+    _check(*_setup(q_dtype="bfloat16", cache_dtype="bfloat16"),
+           rtol=2e-2, atol=2e-2)
+
+
+def test_null_page_walk_is_finite():
+    """Table entries past the chunk's extent hold null page 0; the
+    padded walk steps must not poison the finalized output."""
+    q, pk, pv, table, start, kw = _setup(T=8, M=6, N=9, start=0)
+    out = np.asarray(paged_prefill_attention(q, pk, pv, table, start,
+                                             **kw))
+    assert np.isfinite(out).all()
+
+
+# --------------------------------------------------- slow full grid
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("bs", [4, 8, 16])
+@pytest.mark.parametrize("start", [0, 5, 13])
+@pytest.mark.parametrize("rep", [1, 2, 4])
+def test_full_grid_block_sizes_starts_gqa(bs, start, rep):
+    M = (start + 8 + bs - 1) // bs + 2
+    _check(*_setup(rep=rep, T=8, bs=bs, M=M, N=M + 3, start=start))
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("start", [0, 13])
+@pytest.mark.parametrize("T", [8, 16, 32])
+def test_full_grid_int8_chunks(T, start):
+    M = (start + T + 7) // 8 + 1
+    _check(*_setup(T=T, M=M, N=M + 3, start=start, quant=True),
+           rtol=1e-3, atol=1e-3)
+
+
+# ------------------------------------------------ geometry guard
+
+
+def test_geometry_guard_names_the_rules():
+    """validate_call_geometry mirrors the static K rules for this
+    kernel: non-lane-aligned D (K001), int8 sublane floor (K002), and
+    the q-tile sublane rule for a fold that does not subdivide."""
+    assert pf.validate_call_geometry(128, 32, "int8", T=64, rep=2) == []
+    errs = pf.validate_call_geometry(96, 16, "int8", T=3, rep=1,
+                                     q_dtype="bfloat16")
+    joined = " ".join(errs)
+    assert "K001" in joined        # D=96 not 128-aligned
+    assert "K002" in joined        # int8 bs=16 < sublane 32
+    assert any("q tile" in e for e in errs)   # 3 lanes vs bf16 tile 16
+
+
+# ------------------------------------------------- engine integration
+
+
+def _drive(cache_dtype):
+    from mxtpu.models.transformer import (TransformerLM,
+                                          transformer_lm_sharding_rules)
+    from mxtpu.parallel import PagedContinuousBatchingEngine
+    from mxtpu.parallel.mesh import DeviceMesh
+
+    mx.random.seed(1)
+    lm = TransformerLM(20, units=32, hidden_size=64, num_layers=1,
+                       num_heads=4, num_kv_heads=2)
+    lm.initialize()
+    eng = PagedContinuousBatchingEngine(
+        lm, DeviceMesh(dp=1), transformer_lm_sharding_rules(),
+        num_slots=2, max_length=64, block_size=8, prefill_chunk=8,
+        cache_dtype=cache_dtype)
+    rng = np.random.RandomState(0)
+    r1 = eng.submit(nd.array(rng.randint(0, 20, (1, 12)),
+                             dtype="int32"), 6)
+    r2 = eng.submit(nd.array(rng.randint(0, 20, (1, 9)),
+                             dtype="int32"), 6)
+    res = eng.run()
+    return res[r1].asnumpy(), res[r2].asnumpy()
+
+
+@pytest.mark.parametrize("cache_dtype", ["float32", "int8"])
+def test_chunked_prefill_rides_kernel_when_forced(cache_dtype,
+                                                  monkeypatch):
+    """ISSUE-16 acceptance: with the tri-state forced on, the engine's
+    chunked prefill traces through the prefill kernel (ITS counter
+    moves, not just decode's) and streams match the XLA arm."""
+    monkeypatch.setenv("MXTPU_PALLAS_PAGED_ATTN", "0")
+    want = _drive(cache_dtype)
+    monkeypatch.setenv("MXTPU_PALLAS_PAGED_ATTN", "1")
+    before = pf.invocation_count()
+    got = _drive(cache_dtype)
+    assert pf.invocation_count() > before, "prefill kernel never traced"
+    for w, g in zip(want, got):
+        assert np.array_equal(w, g)

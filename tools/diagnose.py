@@ -725,13 +725,22 @@ def check_kernel_geometry():
         specs = default_kernel_specs()
         rep = check_kernels(specs)
         print("kernel specs :", len(specs), "pallas_call geometrie(s) "
-              "(flash fwd/bwd, conv_bwd, paged fp32+int8 W=1/8)")
+              "(flash fwd/bwd, conv_bwd, paged decode+prefill "
+              "fp32/int8 incl. tp-sharded)")
         print("verdict      :", rep.summary())
         for d in rep.errors:
             print("  ", d)
         for d in rep.filter(code="M007"):
             print("  %-42s %s" % (d.subject[:42],
                                   d.message.split(", smem")[0]))
+        from mxtpu.ops.pallas import counters
+        counts = counters.counts()
+        if counts:
+            print("invocations  :",
+                  ", ".join("%s=%d" % kv for kv in sorted(counts.items())))
+        else:
+            print("invocations  : none this process "
+                  "(kernel_invocations.* in the metrics registry)")
     except Exception as e:
         print("kernel check : FAILED (%s: %s)" % (type(e).__name__, e))
 
