@@ -4,7 +4,7 @@ the op registry, sharding rules, and the compiled-program discipline
 as static analyses before execution; see PAPER.md §1 layer 6 and
 src/executor/graph_executor.cc in the reference).
 
-Nine shipped passes, each returning a :class:`Report` of located
+Ten shipped passes, each returning a :class:`Report` of located
 :class:`Diagnostic` records instead of silent Nones or deep-in-XLA
 failures:
 
@@ -36,6 +36,15 @@ failures:
   declared fault site must resolve to a registered trace event type
   and every CompileLedger site to a unified-metrics key, so telemetry
   coverage is lost loudly (mirroring R005; docs/observability.md).
+- ``lifecycle_check(paths)`` — serving-lifecycle sanitizer (V0xx): an
+  opt-in shadow page-accounting state machine over BlockPool /
+  HierarchicalCache (double-free, use-after-free, COW violations,
+  pin leaks, host-tier orphans — V001–V005), an AST release-path lint
+  proving every terminal path in both engines reaches the idempotent
+  release helper (V006), and a small-scope model checker that
+  exhaustively drives the gateway/supervisor/router stack over bounded
+  configs and fault plans (V007/V008); ``page_sanitizing()`` arms the
+  sanitizer per-scope, ``MXTPU_PAGE_SANITIZER=1`` process-wide.
 
 CLI: ``python -m mxtpu.analysis`` (see docs/analysis.md).  Custom passes
 register via :func:`register_pass` and run via :func:`run_pass`.
@@ -51,6 +60,10 @@ from .graph_verify import verify_graph
 from .kernel_check import (BlockOperand, KernelSpec, ScalarPrefetch,
                            ScratchOperand, check_kernels,
                            default_kernel_specs)
+from .lifecycle_check import (PageLifecycleError, PageSanitizer,
+                              check_protocol, conformance,
+                              get_sanitizer, lifecycle_check,
+                              page_sanitizing, release_path_lint)
 from .memory_estimate import (MemoryEstimate, check_memory,
                               estimate_graph_memory, estimate_jit_memory,
                               kernel_hbm_traffic, kernel_vmem_estimate,
@@ -77,4 +90,7 @@ __all__ = [
     "KernelSpec", "BlockOperand", "ScratchOperand", "ScalarPrefetch",
     "check_kernels", "default_kernel_specs",
     "check_observability",
+    "PageLifecycleError", "PageSanitizer", "page_sanitizing",
+    "get_sanitizer", "lifecycle_check", "release_path_lint",
+    "check_protocol", "conformance",
 ]

@@ -21,6 +21,11 @@ Subcommands:
 - ``obs``                observability coverage check: every declared
   fault site resolves to a registered trace event type and every
   compile-ledger site to a unified-metrics key (O001 on any loss)
+- ``lifecycle``          serving-lifecycle sanitizer: release-path lint
+  over both engines + the serving package (V006), ReplicaTransport
+  conformance and a bounded model-check of the gateway/supervisor/
+  router stack (V007/V008), and an armed page-sanitizer self-drive
+  (V001–V005)
 - ``all``                EVERY registered pass, each through its
   self-application probe (the repo self-lint; default).  A pass
   registered without a probe wired here gets a P001 ERROR — the gate
@@ -156,6 +161,15 @@ def _self_apply_obs() -> Report:
     return check_observability(include_summary=True)
 
 
+def _self_apply_lifecycle() -> Report:
+    """Serving-lifecycle sanitizer self-application: release-path lint
+    over the in-repo engines (V006), transport conformance + bounded
+    model check of the real service stack (V007/V008), and the armed
+    page-sanitizer self-drive (V001–V005).  All host-side."""
+    from .lifecycle_check import lifecycle_check
+    return lifecycle_check()
+
+
 # Every registered pass needs a self-application probe here; `all` runs
 # each one and emits a P001 ERROR for any pass left unwired, so a new
 # pass cannot be silently skipped by the CI gate.
@@ -169,6 +183,7 @@ _SELF_APPLY = {
     "donation_check": _self_apply_donation,
     "kernel_check": _self_apply_kernels,
     "obs_check": _self_apply_obs,
+    "lifecycle_check": _self_apply_lifecycle,
 }
 
 
@@ -207,7 +222,7 @@ def main(argv=None) -> int:
     ap.add_argument("command", nargs="?", default="all",
                     choices=["all", "registry", "lint", "graph",
                              "memory", "compile", "donate", "kernel",
-                             "sharding", "obs"])
+                             "sharding", "obs", "lifecycle"])
     ap.add_argument("paths", nargs="*",
                     help="lint: files/dirs; graph/memory: one "
                          "symbol.json; compile: one ledger dump")
@@ -271,6 +286,8 @@ def main(argv=None) -> int:
         report.extend(_self_apply_sharding())
     if args.command == "obs":
         report.extend(_self_apply_obs())
+    if args.command == "lifecycle":
+        report.extend(_self_apply_lifecycle())
 
     if args.json:
         print(report.to_json())

@@ -1,0 +1,940 @@
+"""lifecycle_check — serving-lifecycle sanitizer (V0xx diagnostics).
+
+ROADMAP item 2 moves replicas out of process, where today's implicit
+invariants — every terminal path releases its pages, a COW-shared page
+is never written, a drained replica keeps nothing — become remote-state
+bugs no single-process test can catch.  This pass family is the
+analysis-side counterpart of the engine lifecycle state machine, three
+layers deep:
+
+1. **PageSanitizer** — an opt-in shadow state machine
+   (``MXTPU_PAGE_SANITIZER=1`` or the :func:`page_sanitizing` context)
+   hooked into :class:`~mxtpu.parallel.paging.BlockPool` /
+   :class:`~mxtpu.parallel.paging.PrefixIndex` /
+   :class:`~mxtpu.parallel.paging.HierarchicalCache` through the
+   existing ``on_free`` seam plus alloc/share/pin/spill/restore hooks.
+   Every page id is tracked through
+   ``free → owned → shared → pinned → spilled → restored → free``;
+   an illegal transition raises a typed :class:`PageLifecycleError`
+   at the faulting call site carrying the page's full event history
+   from a flight-recorder-style ring (counter clock — byte-reproducible
+   across reruns).  Unarmed, every hook is a single ``None`` check.
+2. **Release-path lint** (:func:`release_path_lint`) — an AST pass
+   proving every terminal path in both engines
+   (quarantine/expired/failed/cancel/shed/finish/drain) reaches the
+   one idempotent release helper; V006 ERROR on a terminal branch that
+   does not.  Self-applied over ``mxtpu/parallel/serving.py`` +
+   ``mxtpu/serving/`` in tier-1.
+3. **Small-scope model checker** (:func:`check_protocol`) —
+   exhaustively explores the deterministic gateway/supervisor/router
+   state space over bounded configs (≤2 replicas, ≤4 requests, ≤3 QoS
+   classes; fault plans from the existing grammar enumerated as
+   transition choices), asserting on every trajectory: no request
+   stranded, ``blocks_in_use == 0`` ∧ ``pinned_blocks == 0`` after
+   drain, no tag dispatched to a dead replica, QoS displacement order.
+   V007/V008 ERRORs carry the exact config + fault-plan string, so a
+   violation replays bit-identically.
+
+Codes::
+
+    V001  double-free (release of an already-free tracked page)
+    V002  use-after-free (gather/write/COW-source naming a freed page)
+    V003  write to a shared or pinned page (COW violation)
+    V004  pin leak at drain (pinned pages survive a replica drain)
+    V005  host-tier orphan (page recycled while its index entry lives)
+    V006  terminal path missing the idempotent release helper (lint)
+    V007  liveness/accounting violation in the replica-pool model
+    V008  protocol violation (dead-replica dispatch, QoS displacement
+          order, ReplicaTransport conformance)
+
+See docs/analysis.md "lifecycle_check" and docs/serving.md.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from collections import deque
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from ..base import MXTPUError
+from ..parallel import paging as _paging
+from ..parallel.paging import (BlockPool, HierarchicalCache, NULL_PAGE,
+                               PrefixIndex)
+from ..resilience.counters import bump as _bump
+from .diagnostics import Diagnostic, Report, Severity, register_pass
+
+__all__ = ["PageLifecycleError", "PageSanitizer", "get_sanitizer",
+           "page_sanitizing", "release_path_lint", "conformance",
+           "check_protocol", "lifecycle_check"]
+
+_PASS = "lifecycle_check"
+
+#: event-ring depth per tracked page (deep enough for a full
+#: alloc→share→pin→spill→restore→free story plus slack)
+RING_DEPTH = 16
+
+
+class PageLifecycleError(MXTPUError):
+    """An illegal page-lifecycle transition caught by the armed
+    :class:`PageSanitizer` — raised at the faulting call site with the
+    page's full event history (counter-clock ring, byte-reproducible).
+    """
+
+    def __init__(self, code: str, pool_uid: int, bid: int, message: str,
+                 history: Tuple[Tuple[int, str, str], ...]):
+        self.code = code
+        self.pool_uid = pool_uid
+        self.bid = bid
+        self.history = history
+        tail = "".join("\n    #%d %s %s" % ev for ev in history)
+        super().__init__(
+            "%s: page %d (pool %d): %s — event history (seq op info):%s"
+            % (code, bid, pool_uid, message, tail or "\n    (empty)"))
+
+
+class PageSanitizer:
+    """Shadow page-accounting state machine (module docstring).
+
+    One process-wide instance is installed into
+    ``mxtpu.parallel.paging._SAN`` when this module imports; the pool
+    and index hooks are no-ops until :attr:`armed`.  Shadow state is
+    keyed ``(pool_uid, page_id)`` where ``pool_uid`` is assigned lazily
+    per pool from the sanitizer's own deterministic counter; page 0
+    (the NULL page) and pages allocated before arming are exempt from
+    every check, which makes per-test arming safe around module-scoped
+    engines.
+    """
+
+    def __init__(self):
+        self._depth = 0
+        self._env = os.environ.get(
+            "MXTPU_PAGE_SANITIZER", "") not in ("", "0")
+        self._next_uid = 0
+        # (pool_uid, bid) -> {"refs": int, "pins": int}; refs == 0 is
+        # the tracked-FREE state (what distinguishes a double free from
+        # a page this sanitizer never saw allocated)
+        self._state: Dict[Tuple[int, int], Dict[str, int]] = {}
+        self._rings: Dict[Tuple[int, int], deque] = {}
+        # id(index) -> set of page ids it currently references
+        self._indexed: Dict[int, set] = {}
+        self._seq = 0
+        self.transitions = 0
+        self.violations = 0          # process-lifetime, never cleared
+
+    # -- arming ----------------------------------------------------------
+    @property
+    def armed(self) -> bool:
+        return self._depth > 0 or self._env
+
+    def enable(self) -> None:
+        self._depth += 1
+
+    def disable(self) -> None:
+        self._depth = max(0, self._depth - 1)
+        if self._depth == 0 and not self._env:
+            # full disarm clears shadow state so pages tracked in one
+            # test can never false-positive in the next
+            self._state.clear()
+            self._rings.clear()
+            self._indexed.clear()
+
+    def reload_env(self) -> bool:
+        """Re-read ``MXTPU_PAGE_SANITIZER`` (parsed once at import)."""
+        self._env = os.environ.get(
+            "MXTPU_PAGE_SANITIZER", "") not in ("", "0")
+        return self._env
+
+    # -- bookkeeping -----------------------------------------------------
+    def _uid(self, pool) -> int:
+        uid = getattr(pool, "_san_uid", None)
+        if uid is None:
+            uid = self._next_uid
+            self._next_uid += 1
+            pool._san_uid = uid
+        return uid
+
+    def _event(self, key: Tuple[int, int], op: str, info: str = ""
+               ) -> None:
+        ring = self._rings.get(key)
+        if ring is None:
+            ring = self._rings[key] = deque(maxlen=RING_DEPTH)
+        self._seq += 1
+        ring.append((self._seq, op, info))
+        self.transitions += 1
+
+    def _violate(self, code: str, key: Tuple[int, int], msg: str):
+        self.violations += 1
+        _bump("lifecycle_violations")
+        raise PageLifecycleError(
+            code, key[0], key[1], msg,
+            tuple(self._rings.get(key, ())))
+
+    def history(self, pool, bid: int) -> Tuple[Tuple[int, str, str], ...]:
+        return tuple(self._rings.get((self._uid(pool), int(bid)), ()))
+
+    def stats(self) -> Dict[str, int]:
+        """Numeric snapshot (the ``lifecycle.*`` metrics source)."""
+        return {
+            "armed": int(self.armed),
+            "pages_tracked": len(self._state),
+            "rings": len(self._rings),
+            "transitions": self.transitions,
+            "violations_ever": self.violations,
+            "indexed_pages": sum(len(s) for s in self._indexed.values()),
+        }
+
+    # -- BlockPool hooks -------------------------------------------------
+    def note_alloc(self, pool, bids: Sequence[int]) -> None:
+        uid = self._uid(pool)
+        for bid in bids:
+            if bid == NULL_PAGE:
+                continue
+            key = (uid, int(bid))
+            self._state[key] = {"refs": 1, "pins": 0}
+            self._event(key, "alloc")
+
+    def note_retain(self, pool, bid: int) -> None:
+        key = (self._uid(pool), int(bid))
+        st = self._state.get(key)
+        if st is None or bid == NULL_PAGE:
+            return
+        st["refs"] += 1
+        self._event(key, "retain", "refs=%d" % st["refs"])
+
+    def note_pin(self, pool, bid: int) -> None:
+        key = (self._uid(pool), int(bid))
+        st = self._state.get(key)
+        if st is None or bid == NULL_PAGE:
+            return
+        st["refs"] += 1
+        st["pins"] += 1
+        self._event(key, "pin", "pins=%d" % st["pins"])
+
+    def note_unpin(self, pool, bid: int) -> None:
+        key = (self._uid(pool), int(bid))
+        st = self._state.get(key)
+        if st is None or bid == NULL_PAGE:
+            return
+        st["pins"] = max(0, st["pins"] - 1)
+        self._event(key, "unpin", "pins=%d" % st["pins"])
+
+    def check_release(self, pool, bid: int) -> None:
+        """V001 gate at the top of ``BlockPool.release`` — fires BEFORE
+        the pool mutates, so the faulting frame is the double-freeing
+        caller."""
+        if bid == NULL_PAGE:
+            return
+        key = (self._uid(pool), int(bid))
+        st = self._state.get(key)
+        if st is None:          # allocated before arming: exempt
+            return
+        if st["refs"] <= 0:
+            self._event(key, "release", "double-free")
+            self._violate(
+                "V001", key,
+                "double free: release() of a page already returned to "
+                "the free list")
+
+    def note_release(self, pool, bid: int, freed: bool) -> None:
+        if bid == NULL_PAGE:
+            return
+        key = (self._uid(pool), int(bid))
+        st = self._state.get(key)
+        if st is None:
+            return
+        st["refs"] = max(0, st["refs"] - 1)
+        self._event(key, "free" if freed else "release",
+                    "refs=%d" % st["refs"])
+        if freed:
+            st["refs"] = 0
+            st["pins"] = 0
+            self._check_recycled(pool, key)
+
+    def _check_recycled(self, pool, key: Tuple[int, int]) -> None:
+        """V005: the pool's own index (its ``on_free`` hook target)
+        still references this just-recycled page — the erase the
+        ``on_free`` seam exists to guarantee did not happen."""
+        owner = getattr(getattr(pool, "_on_free", None), "__self__", None)
+        if isinstance(owner, PrefixIndex):
+            entries = self._indexed.get(id(owner))
+            if entries and key[1] in entries:
+                self._violate(
+                    "V005", key,
+                    "host-tier orphan: page recycled while its prefix-"
+                    "index entry survives (index erase skipped)")
+
+    def check_use(self, pool, bid: int, write: bool = False) -> None:
+        """V002 (any use of a freed page) / V003 (write to a shared or
+        pinned page) — the engine's ``_read_page`` / ``_write_page``
+        gate."""
+        if bid == NULL_PAGE:
+            return
+        key = (self._uid(pool), int(bid))
+        st = self._state.get(key)
+        if st is None:
+            return
+        op = "write" if write else "gather"
+        if st["refs"] <= 0:
+            self._event(key, op, "use-after-free")
+            self._violate(
+                "V002", key,
+                "use after free: %s names a recycled page" % op)
+        if write and (st["refs"] > 1 or st["pins"] > 0):
+            self._event(key, op, "refs=%d pins=%d"
+                        % (st["refs"], st["pins"]))
+            self._violate(
+                "V003", key,
+                "write to a shared/pinned page (refs=%d, pins=%d) — "
+                "copy-on-write violation" % (st["refs"], st["pins"]))
+        self._event(key, op)
+
+    def note_cow(self, pool, src: int, dst: int) -> None:
+        """COW gate at the paged engine's clone: the donor must still be
+        allocated (V002) and the clone target solely owned (V003)."""
+        if src != NULL_PAGE:
+            skey = (self._uid(pool), int(src))
+            st = self._state.get(skey)
+            if st is not None and st["refs"] <= 0:
+                self._event(skey, "cow-src", "use-after-free")
+                self._violate(
+                    "V002", skey,
+                    "use after free: COW donor page was recycled")
+            if st is not None:
+                self._event(skey, "cow-src", "dst=%d" % dst)
+        if dst != NULL_PAGE:
+            dkey = (self._uid(pool), int(dst))
+            st = self._state.get(dkey)
+            if st is not None:
+                if st["refs"] != 1 or st["pins"] > 0:
+                    self._event(dkey, "cow-dst", "refs=%d pins=%d"
+                                % (st["refs"], st["pins"]))
+                    self._violate(
+                        "V003", dkey,
+                        "COW clone into a page that is not solely "
+                        "owned (refs=%d, pins=%d)"
+                        % (st["refs"], st["pins"]))
+                self._event(dkey, "cow-dst", "src=%d" % src)
+
+    def note_spill(self, pool, bids: Sequence[int]) -> None:
+        uid = self._uid(pool)
+        for bid in bids:
+            key = (uid, int(bid))
+            if key in self._state:
+                self._event(key, "spill")
+
+    def note_restore(self, pool, bids: Sequence[int]) -> None:
+        uid = self._uid(pool)
+        for bid in bids:
+            key = (uid, int(bid))
+            if key in self._state:
+                self._event(key, "restore")
+
+    def check_drain(self, pool) -> None:
+        """V004: a replica drain left pinned pages behind — after drain
+        a replica may hold zero pages (the transport contract)."""
+        uid = self._uid(pool)
+        leaked = sorted(bid for (u, bid), st in self._state.items()
+                        if u == uid and st["pins"] > 0)
+        if leaked:
+            key = (uid, leaked[0])
+            self._event(key, "drain", "pin-leak x%d" % len(leaked))
+            self._violate(
+                "V004", key,
+                "pin leak at drain: %d page(s) still pinned after the "
+                "replica drained (%r)" % (len(leaked), leaked))
+
+    # -- PrefixIndex hooks -----------------------------------------------
+    def note_register(self, index, bid: int) -> None:
+        self._indexed.setdefault(id(index), set()).add(int(bid))
+
+    def note_evict(self, index, bid: int) -> None:
+        entries = self._indexed.get(id(index))
+        if entries is not None:
+            entries.discard(int(bid))
+
+
+#: the process-wide sanitizer, installed into the paging module's
+#: ``_SAN`` hook point (paging imports nothing from analysis, so this
+#: direction is cycle-free)
+_SANITIZER = PageSanitizer()
+_paging._SAN = _SANITIZER
+
+
+def get_sanitizer() -> PageSanitizer:
+    return _SANITIZER
+
+
+class page_sanitizing:
+    """Context manager arming the page sanitizer::
+
+        with page_sanitizing():
+            engine.run()   # any lifecycle bug raises PageLifecycleError
+
+    Re-entrant; restores the prior armed state on exit, and a full
+    disarm clears all shadow state (cross-test hygiene)."""
+
+    def __enter__(self) -> PageSanitizer:
+        _SANITIZER.enable()
+        return _SANITIZER
+
+    def __exit__(self, exc_type, exc, tb):
+        _SANITIZER.disable()
+        return False
+
+
+# =====================================================================
+# Layer 2: release-path lint (V006)
+# =====================================================================
+
+#: calls that count as reaching the idempotent release path after a
+#: slot is abandoned (``self._slots[i] = None``)
+_RELEASE_FOLLOWERS = frozenset({
+    "_scrub_row", "_release_row", "_finish", "_quarantine_request",
+    "_requeue_or_fail"})
+
+#: terminal status literals whose assignment must be paired with the
+#: gateway's bounded terminal bookkeeping
+_TERMINAL_STATUSES = frozenset({"ok", "failed", "expired", "shed"})
+
+#: calls that count as terminal bookkeeping for a ``.status`` assign
+_DONE_FOLLOWERS = frozenset({"_mark_done", "_finish_shed", "_resolve"})
+
+
+def _calls_in(node: ast.AST) -> set:
+    """Attribute/function names called anywhere under ``node``."""
+    out = set()
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Call):
+            f = sub.func
+            if isinstance(f, ast.Attribute):
+                out.add(f.attr)
+            elif isinstance(f, ast.Name):
+                out.add(f.id)
+    return out
+
+
+def _is_slot_clear(stmt: ast.stmt) -> Optional[ast.Assign]:
+    """``self._slots[...] = None`` (or ``x._slots[...] = None``)."""
+    if not isinstance(stmt, ast.Assign):
+        return None
+    if not (isinstance(stmt.value, ast.Constant)
+            and stmt.value.value is None):
+        return None
+    for tgt in stmt.targets:
+        if (isinstance(tgt, ast.Subscript)
+                and isinstance(tgt.value, ast.Attribute)
+                and tgt.value.attr == "_slots"):
+            return stmt
+    return None
+
+
+def _blocks(node: ast.AST):
+    """Yield every statement list under ``node`` (bodies, orelse,
+    finally, handlers) — the unit rule (b) checks followers within."""
+    for sub in ast.walk(node):
+        for field in ("body", "orelse", "finalbody"):
+            block = getattr(sub, field, None)
+            if isinstance(block, list) and block and \
+                    isinstance(block[0], ast.stmt):
+                yield block
+
+
+def _lint_release_paths(tree: ast.AST, filename: str, report: Report
+                        ) -> None:
+    for cls in [n for n in ast.walk(tree) if isinstance(n, ast.ClassDef)]:
+        methods = {m.name: m for m in cls.body
+                   if isinstance(m, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef))}
+        # (a) engines with a dedicated release helper must reach it
+        # from both scrub and finish
+        if "_release_row" in methods:
+            for name in ("_scrub_row", "_finish"):
+                m = methods.get(name)
+                if m is not None and \
+                        "_release_row" not in _calls_in(m):
+                    report.add(
+                        _PASS, "V006", Severity.ERROR,
+                        "%s.%s" % (cls.name, name),
+                        "terminal path does not reach the idempotent "
+                        "release helper _release_row()",
+                        location="%s:%d" % (filename, m.lineno))
+        # (c) a transport implementation's drain must drop both cache
+        # tiers (stub bodies — docstring + raise — are the protocol)
+        if "drain" in methods and "cancel" in methods:
+            m = methods["drain"]
+            real = [s for s in m.body
+                    if not (isinstance(s, ast.Expr)
+                            and isinstance(s.value, ast.Constant))]
+            if real and not all(isinstance(s, ast.Raise) for s in real) \
+                    and "drop_cache" not in _calls_in(m):
+                report.add(
+                    _PASS, "V006", Severity.ERROR,
+                    "%s.drain" % cls.name,
+                    "transport drain() does not drop the engine cache "
+                    "tiers (drop_cache) — a drained replica must hold "
+                    "zero pages",
+                    location="%s:%d" % (filename, m.lineno))
+        for mname, m in methods.items():
+            # (b) an abandoned slot must reach a release follower (or
+            # re-raise; _finish IS the follower for its own tail)
+            if mname not in ("_finish",):
+                for block in _blocks(m):
+                    for i, stmt in enumerate(block):
+                        if _is_slot_clear(stmt) is None:
+                            continue
+                        rest = block[i + 1:]
+                        ok = any(isinstance(s, ast.Raise) for s in rest)
+                        for s in rest:
+                            if _calls_in(s) & _RELEASE_FOLLOWERS:
+                                ok = True
+                                break
+                        if not ok:
+                            report.add(
+                                _PASS, "V006", Severity.ERROR,
+                                "%s.%s" % (cls.name, mname),
+                                "slot abandoned (self._slots[...] = "
+                                "None) with no release call on the "
+                                "path (%s)"
+                                % ", ".join(sorted(_RELEASE_FOLLOWERS)),
+                                location="%s:%d"
+                                % (filename, stmt.lineno))
+            # (d) a terminal status assignment needs the bounded
+            # terminal bookkeeping in the same method
+            hits = [
+                s for s in ast.walk(m)
+                if isinstance(s, ast.Assign)
+                and isinstance(s.value, ast.Constant)
+                and s.value.value in _TERMINAL_STATUSES
+                and any(isinstance(t, ast.Attribute)
+                        and t.attr == "status" for t in s.targets)]
+            if hits and not (_calls_in(m) & _DONE_FOLLOWERS):
+                report.add(
+                    _PASS, "V006", Severity.ERROR,
+                    "%s.%s" % (cls.name, mname),
+                    "terminal status %r assigned without terminal "
+                    "bookkeeping (%s)"
+                    % (hits[0].value.value,
+                       ", ".join(sorted(_DONE_FOLLOWERS))),
+                    location="%s:%d" % (filename, hits[0].lineno))
+
+
+def _default_lint_paths() -> List[str]:
+    pkg = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    paths = [os.path.join(pkg, "parallel", "serving.py")]
+    sdir = os.path.join(pkg, "serving")
+    if os.path.isdir(sdir):
+        paths.extend(sorted(
+            os.path.join(sdir, f) for f in os.listdir(sdir)
+            if f.endswith(".py")))
+    return paths
+
+
+def release_path_lint(paths: Optional[Sequence[str]] = None,
+                      source: Optional[str] = None,
+                      filename: str = "<source>") -> Report:
+    """V006: prove every terminal path reaches the idempotent release
+    helper.  ``source`` lints one in-memory module (the red-team
+    fixtures); otherwise ``paths`` (default: both engines and the
+    serving package)."""
+    report = Report()
+    if source is not None:
+        _lint_release_paths(ast.parse(source, filename), filename, report)
+        return report
+    for path in (paths if paths is not None else _default_lint_paths()):
+        try:
+            with open(path, "r", encoding="utf-8") as fh:
+                text = fh.read()
+            tree = ast.parse(text, path)
+        except (OSError, SyntaxError) as exc:
+            report.add(_PASS, "V006", Severity.WARNING, path,
+                       "cannot lint: %s" % exc, location=path)
+            continue
+        _lint_release_paths(tree, os.path.basename(path), report)
+    return report
+
+
+# =====================================================================
+# Layer 3: small-scope model checking (V007/V008) + conformance
+# =====================================================================
+
+#: the ReplicaTransport surface a conforming transport must implement
+PROTOCOL_SURFACE = ("capacity", "load", "free_slots", "prefix_probe",
+                    "submit", "step", "poll", "health", "progress",
+                    "cancel", "drain")
+
+
+def conformance(cls, report: Optional[Report] = None) -> Report:
+    """Structural ReplicaTransport conformance: every protocol member
+    must be overridden from the raising base stubs (V008)."""
+    from ..serving.transport import ReplicaTransport
+    report = report if report is not None else Report()
+    missing = [name for name in PROTOCOL_SURFACE
+               if getattr(cls, name, None)
+               is getattr(ReplicaTransport, name)]
+    if missing:
+        report.add(
+            _PASS, "V008", Severity.ERROR, cls.__name__,
+            "ReplicaTransport conformance: %d protocol member(s) not "
+            "implemented: %s" % (len(missing), ", ".join(missing)),
+            details={"missing": missing})
+    return report
+
+
+def _make_model_replica():
+    """Define the pure-host bounded-state replica lazily (keeps module
+    import free of the serving package until a checker runs)."""
+    from ..resilience.faults import inject as _inject
+    from ..serving.transport import ReplicaDownError, ReplicaTransport
+
+    class _ModelReplica(ReplicaTransport):
+        """Small-scope model of one replica: decodes one token per
+        request per step, page-accounts with a real BlockPool, honors
+        the ``replica.*`` fault sites — and compiles NOTHING.  The
+        checker's whole state space is host counters."""
+
+        def __init__(self, replica_id: str = "r0", capacity: int = 2,
+                     pool_pages: int = 8, block_size: int = 4):
+            self.replica_id = str(replica_id)
+            self.alive = True
+            self._cap = int(capacity)
+            self._bp = BlockPool(pool_pages, block_size)
+            self._live: Dict[Any, Dict[str, Any]] = {}
+            self._order: List[Any] = []
+            self._steps = 0
+            self._out = 0
+            self._done = 0
+            #: V008 evidence: tags submitted while ``alive`` was False
+            self.dead_submits: List[Any] = []
+
+        @property
+        def capacity(self) -> int:
+            return self._cap
+
+        @property
+        def load(self) -> int:
+            return len(self._live)
+
+        @property
+        def free_slots(self) -> int:
+            return max(0, self._cap - len(self._live))
+
+        def prefix_probe(self, prompt) -> int:
+            return 0
+
+        def submit(self, spec: dict, tag) -> Any:
+            if not self.alive:
+                self.dead_submits.append(tag)
+                raise ReplicaDownError(
+                    "model replica %s is down" % self.replica_id)
+            pages = self._bp.alloc(1)
+            self._live[tag] = {
+                "pages": pages,
+                "left": int(spec["max_new_tokens"]),
+                "n": 0, "new": []}
+            self._order.append(tag)
+            return tag
+
+        def step(self) -> None:
+            if not self._live:
+                return
+            self._steps += 1
+            for st in self._live.values():
+                if st["left"] > 0:
+                    st["left"] -= 1
+                    st["new"].append((st["n"] * 3 + 1) % 7)
+                    st["n"] += 1
+                    self._out += 1
+
+        def _retire(self, tag) -> None:
+            st = self._live.pop(tag, None)
+            if st is None:
+                return
+            for bid in st["pages"]:
+                self._bp.release(bid)
+            self._order.remove(tag)
+            self._done += 1
+
+        def poll(self):
+            _inject("replica.stream", key=self.replica_id)
+            tokens: Dict[Any, List[int]] = {}
+            finished: List[Tuple[Any, str, Any, Any]] = []
+            for tag in list(self._order):
+                st = self._live[tag]
+                if st["new"]:
+                    tokens[tag] = st["new"]
+                    st["new"] = []
+                if st["left"] <= 0:
+                    finished.append((tag, "ok", None, None))
+                    self._retire(tag)
+            return tokens, finished, []
+
+        def health(self) -> None:
+            _inject("replica.health", key=self.replica_id)
+
+        def progress(self) -> tuple:
+            return (self._steps, self._out, self._done)
+
+        def cancel(self, tag) -> bool:
+            if tag in self._live:
+                self._retire(tag)
+                return True
+            return False
+
+        def drain(self) -> List[Any]:
+            tags = list(self._order)
+            for tag in tags:
+                self._retire(tag)
+            return tags
+
+    return _ModelReplica
+
+
+_MODEL_REPLICA = None
+
+
+def model_replica_cls():
+    """The checker's pure-host replica class (lazily defined)."""
+    global _MODEL_REPLICA
+    if _MODEL_REPLICA is None:
+        _MODEL_REPLICA = _make_model_replica()
+    return _MODEL_REPLICA
+
+
+def _shed_observer(gateway_cls):
+    """Subclass ``gateway_cls`` recording every displacement decision
+    with its queue snapshot — pure observation, behavior unchanged."""
+
+    class _Observed(gateway_cls):
+        def __init__(self, *args, **kwargs):
+            super().__init__(*args, **kwargs)
+            self.shed_log: List[Tuple[Any, int, List[Tuple[int, int]]]] \
+                = []
+
+        def _pick_shed_victim(self, incoming_qos):
+            victim = super()._pick_shed_victim(incoming_qos)
+            snapshot = [(self._reqs[r].qos, r) for r in self._queue]
+            self.shed_log.append((victim, incoming_qos, snapshot))
+            return victim
+
+    _Observed.__name__ = "_Observed" + gateway_cls.__name__
+    return _Observed
+
+
+#: the bounded fault plans the checker enumerates as transition
+#: choices — every plan is bit-replayable by the grammar's contract
+DEFAULT_FAULT_PLANS = (
+    "",
+    "replica.health#r0@1x3:raise",
+    "replica.stream#r0@2x3:raise",
+    "router.dispatch@1x1:raise",
+    "gateway.admit#1@1:raise",
+)
+
+
+def check_protocol(replica_factory=None, gateway_cls=None,
+                   fault_plans: Optional[Sequence[str]] = None,
+                   replica_counts: Sequence[int] = (1, 2),
+                   qos_classes: Sequence[int] = (1, 3),
+                   n_requests: int = 4,
+                   max_pending: int = 2,
+                   max_new_tokens: int = 3) -> Report:
+    """Small-scope model check of the gateway/supervisor/router stack
+    (module docstring).  Bounded configs × fault plans are enumerated
+    as deterministic trajectories; every violation diagnostic carries
+    the exact ``(config, fault_plan)`` coordinates, so re-running the
+    same call replays it bit-identically.
+
+    ``replica_factory(replica_id) -> ReplicaTransport`` and
+    ``gateway_cls`` let the red-team fixtures inject defective
+    implementations; the defaults model-check the REAL service layer
+    over the pure-host :func:`model_replica_cls`.
+    """
+    import numpy as onp
+
+    from ..resilience import QosShedError
+    from ..resilience.faults import InjectedFault, fault_plan
+    from ..serving.gateway import Gateway
+
+    report = Report()
+    factory = replica_factory if replica_factory is not None \
+        else model_replica_cls()
+    observed_cls = _shed_observer(
+        gateway_cls if gateway_cls is not None else Gateway)
+    plans = tuple(fault_plans) if fault_plans is not None \
+        else DEFAULT_FAULT_PLANS
+    n_requests = min(int(n_requests), 4)
+    prompt = onp.asarray([[1, 2, 3, 4]], dtype=onp.int32)
+
+    def _fail(code, subject, msg, cfg, plan, **details):
+        report.add(_PASS, code, Severity.ERROR, subject, msg,
+                   details=dict(details, config=cfg, fault_plan=plan))
+
+    for n_rep in replica_counts:
+        for qos_n in qos_classes:
+            for plan in plans:
+                cfg = {"replicas": int(n_rep), "qos_classes": int(qos_n),
+                       "requests": n_requests,
+                       "max_pending": int(max_pending)}
+                label = ("replicas=%d qos=%d plan=%r"
+                         % (n_rep, qos_n, plan))
+                reps = [factory("r%d" % i) for i in range(int(n_rep))]
+                gw = observed_cls(
+                    reps, qos_classes=int(qos_n),
+                    max_pending=int(max_pending),
+                    hedge_fraction=None, fail_threshold=3,
+                    stall_ticks=None, revive_after_ticks=2)
+                rids: List[int] = []
+                with fault_plan(plan, sleep=lambda s: None):
+                    for i in range(n_requests):
+                        try:
+                            rids.append(gw.submit(
+                                prompt, max_new_tokens,
+                                qos=i % int(qos_n)))
+                        except (QosShedError, InjectedFault):
+                            continue   # sheds/poisoned admits are
+                            #            legal terminal outcomes
+                    stranded: Optional[str] = None
+                    outages = 0
+                    while True:
+                        try:
+                            gw.run()
+                            break
+                        except MXTPUError as exc:   # before RuntimeError
+                            #                         (its base class)
+                            # pool-wide outage: the gateway's typed
+                            # signal to revive or rebuild.  Model the
+                            # operator revival (bounded) — liveness
+                            # then demands the requeued work completes.
+                            outages += 1
+                            if outages > 3:
+                                stranded = "MXTPUError: %s" % exc
+                                break
+                            for rep in gw.supervisor.replicas:
+                                if not rep.alive:
+                                    gw.supervisor.revive(rep.replica_id)
+                        except RuntimeError as exc:
+                            stranded = "RuntimeError: %s" % exc
+                            break
+                # -- liveness: every admitted request went terminal ---
+                if stranded is not None:
+                    _fail("V007", label,
+                          "liveness: gateway.run() did not converge "
+                          "(%s)" % stranded, cfg, plan)
+                else:
+                    hung = [r for r in rids
+                            if not gw._reqs[r].terminal]
+                    if hung:
+                        _fail("V007", label,
+                              "liveness: request(s) %r stranded "
+                              "non-terminal after run()" % hung,
+                              cfg, plan, stranded_rids=hung)
+                # -- page accounting: drain leaves nothing ------------
+                for rep in reps:
+                    rep.drain()
+                    pool = getattr(rep, "_bp", None)
+                    if pool is None:
+                        continue
+                    if pool.in_use != 0 or pool.pinned_count != 0:
+                        _fail("V007",
+                              "%s %s" % (label, rep.replica_id),
+                              "page accounting after drain: "
+                              "blocks_in_use=%d pinned_blocks=%d "
+                              "(both must be 0)"
+                              % (pool.in_use, pool.pinned_count),
+                              cfg, plan, replica=rep.replica_id,
+                              in_use=pool.in_use,
+                              pinned=pool.pinned_count)
+                # -- no tag dispatched to a dead replica ---------------
+                for rep in reps:
+                    dead = getattr(rep, "dead_submits", None)
+                    # a ReplicaDownError-raising refusal is the
+                    # transport contract; observing MANY of them means
+                    # the router kept targeting a known-dead replica
+                    if dead and len(dead) > len(rids):
+                        _fail("V008",
+                              "%s %s" % (label, rep.replica_id),
+                              "%d submit(s) reached replica %s while "
+                              "it was declared dead"
+                              % (len(dead), rep.replica_id),
+                              cfg, plan, replica=rep.replica_id,
+                              dead_submits=len(dead))
+                # -- QoS displacement order ---------------------------
+                for victim, incoming, snapshot in gw.shed_log:
+                    eligible = [(q, r) for q, r in snapshot
+                                if q > incoming]
+                    want = max(eligible)[1] if eligible else None
+                    if victim != want:
+                        _fail("V008", label,
+                              "QoS displacement order: shed victim %r, "
+                              "expected %r (newest request of the "
+                              "lowest class below the incoming one)"
+                              % (victim, want),
+                              cfg, plan, victim=victim, expected=want,
+                              queue=[list(t) for t in snapshot])
+    return report
+
+
+# =====================================================================
+# The registered pass
+# =====================================================================
+
+def _sanitizer_self_drive(report: Report) -> None:
+    """Drive a pure-host pool/index/cache through the full lifecycle
+    under arming; a PageLifecycleError here is a V0xx ERROR against the
+    in-repo paging layer itself."""
+    try:
+        with page_sanitizing() as san:
+            idx = PrefixIndex(4)
+            pool = BlockPool(8, 4, on_free=idx.evict)
+            hc = HierarchicalCache(pool, idx, pin_blocks=4,
+                                   host_blocks=4)
+            toks = tuple(range(8))
+            pages = pool.alloc(2)
+            idx.register(toks, pages)
+            chain = hc.pin_chain(toks, pages)
+            for bid in pages:
+                pool.release(bid)       # table drops; pins hold
+            pool.retain(pages[0])       # a share
+            pool.release(pages[0])
+            hc.spill(chain, ["p0", "p1"])   # device → host tier
+            restored = pool.alloc(2)        # host → device restore
+            san.note_restore(pool, restored)
+            idx.register(toks, restored)
+            chain2 = hc.pin_chain(toks, restored)
+            for bid in restored:
+                pool.release(bid)
+            host = hc.host_match(toks, 8)
+            if host is not None:
+                hc.drop_host(host[0])
+            hc.drop_chain(chain2)           # drain
+            san.check_drain(pool)
+            if pool.in_use != 0:
+                report.add(_PASS, "V007", Severity.ERROR,
+                           "sanitizer-self-drive",
+                           "self-drive left %d page(s) allocated"
+                           % pool.in_use)
+    except PageLifecycleError as exc:
+        report.add(_PASS, exc.code, Severity.ERROR,
+                   "sanitizer-self-drive", str(exc))
+
+
+@register_pass(_PASS)
+def lifecycle_check(paths: Optional[Sequence[str]] = None) -> Report:
+    """The registered pass: release-path lint over the engines and the
+    serving package (V006), ReplicaTransport conformance + a bounded
+    model-check sweep of the real service stack (V007/V008), and an
+    armed sanitizer self-drive over the paging layer (V001–V005).
+    Entirely host-side — compiles nothing."""
+    report = release_path_lint(paths)
+    _sanitizer_self_drive(report)
+    try:
+        from ..serving.transport import InProcessReplica
+        conformance(InProcessReplica, report)
+        conformance(model_replica_cls(), report)
+        report.extend(check_protocol(
+            replica_counts=(1, 2), qos_classes=(1, 3)))
+    except ImportError as exc:   # serving stack unavailable: degrade
+        report.add(_PASS, "V008", Severity.WARNING, "serving",
+                   "model check skipped: %s" % exc)
+    return report

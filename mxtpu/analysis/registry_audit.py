@@ -25,7 +25,9 @@ R005        WARNING   a declared fault-injection site
 The R005 cross-check (``audit_fault_sites``) scans the STRING LITERALS
 of the tests/ tree for PLAN-shaped mentions of each declared site: the
 site name followed by a ``:raise``/``:delay`` action in the same
-literal.  Bare mentions (comments, docstrings, assertion messages —
+literal (split literals — f-strings, adjacent strings, and ``"a" +
+"b"`` concatenation chains — are rejoined before matching, so a plan a
+formatter wrapped across fragments keeps its coverage credit).  Bare mentions (comments, docstrings, assertion messages —
 and this audit's own fixtures) never count, and the injector-level
 fault matrix (tests/test_resilience.py) is parametrized over ``SITES``
 with ``"%s@..."`` literals and so proves only the injector; what R005
@@ -204,9 +206,32 @@ def _default_test_dir() -> Optional[str]:
     return cand if os.path.isdir(cand) else None
 
 
+def _literal_fragments(node):
+    """Constant-string fragments of a literal, an f-string, or a
+    ``"a" + "b"`` concatenation chain, in source order.  Non-literal
+    pieces (formatted values, names) contribute nothing — the same hole
+    an f-string leaves.  (Adjacent string literals, ``"a" "b"``, are
+    already merged into one Constant by the parser.)"""
+    import ast
+
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        yield node.value
+    elif isinstance(node, ast.JoinedStr):
+        for v in node.values:
+            yield from _literal_fragments(v)
+    elif isinstance(node, ast.BinOp) and isinstance(node.op, ast.Add):
+        yield from _literal_fragments(node.left)
+        yield from _literal_fragments(node.right)
+
+
 def _string_literals(paths) -> frozenset:
     """Every str constant in the given python files/dirs (AST-level, so
-    comments never count as coverage)."""
+    comments never count as coverage).  Split plan literals — f-strings
+    (``f"site@{i}:raise"``), parenthesized adjacent strings, and
+    ``"site" + "@1:raise"`` BinOp concatenations — are rejoined first so
+    a plan token that the source splits across fragments still lands in
+    ONE scanned literal (a split plan is real coverage; losing it to
+    formatting was the R005 false-positive this guards against)."""
     import ast
     import os
 
@@ -231,17 +256,12 @@ def _string_literals(paths) -> frozenset:
         except (OSError, SyntaxError):
             continue
         for node in ast.walk(tree):
-            if isinstance(node, ast.Constant) and isinstance(node.value,
-                                                             str):
-                lits.add(node.value)
-            elif isinstance(node, ast.JoinedStr):
-                # an f-string plan (f"site@{i}:raise") splits into
-                # fragments; rejoin its constant parts so the
-                # site + action still land in ONE scanned literal
-                lits.add("".join(
-                    v.value for v in node.values
-                    if isinstance(v, ast.Constant)
-                    and isinstance(v.value, str)))
+            if isinstance(node, (ast.Constant, ast.JoinedStr)) or (
+                    isinstance(node, ast.BinOp)
+                    and isinstance(node.op, ast.Add)):
+                joined = "".join(_literal_fragments(node))
+                if joined:
+                    lits.add(joined)
     result = frozenset(lits)
     _LITERAL_CACHE[key] = result
     return result

@@ -49,6 +49,14 @@ L007        INFO      dead ``# trace-ok`` suppression: the comment is
                       present but no diagnostic was suppressed on that
                       line — the hazard it excused is gone; delete the
                       comment so stale suppressions don't accumulate
+L008        WARNING   direct mutation of BlockPool internals (an
+                      assignment / augmented assignment / delete
+                      targeting a ``._refs`` / ``._pins`` / ``._free``
+                      attribute) outside ``mxtpu/parallel/paging.py`` —
+                      bypasses the refcount invariants AND the
+                      lifecycle sanitizer's shadow accounting
+                      (``analysis/lifecycle_check.py``); go through
+                      alloc/retain/pin/unpin/release
 ==========  ========  =====================================================
 
 The L005 rule lints ``with ... bulk(...):`` bodies rather than traced
@@ -489,6 +497,77 @@ class _HostHazardLinter(ast.NodeVisitor):
         self.generic_visit(node)
 
 
+# BlockPool internals owned by mxtpu/parallel/paging.py (L008)
+_POOL_INTERNALS = {"_refs", "_pins", "_free"}
+
+
+def _paging_exempt(filename: str) -> bool:
+    """L008 exemption: paging.py itself owns the pool internals."""
+    norm = filename.replace("\\", "/")
+    return norm.split("/")[-1] == "paging.py"
+
+
+class _PoolInternalsLinter(ast.NodeVisitor):
+    """L008: module-wide scan for statements that mutate BlockPool
+    internals directly — ``pool._refs[bid] = 2``, ``pool._pins = {}``,
+    ``del pool._free[0]``, ``pool._refs[bid] += 1``.  Like L006 this is
+    not scoped to traced regions: an out-of-band refcount write anywhere
+    silently desynchronizes both the pool invariants and the lifecycle
+    sanitizer's shadow accounting."""
+
+    def __init__(self, fname: str, lines: List[str], report: Report,
+                 used: Optional[Set[int]] = None):
+        self.fname = fname
+        self.lines = lines
+        self.report = report
+        self.used = used
+
+    @staticmethod
+    def _internal_attr(target) -> Optional[str]:
+        """The ``_refs``/``_pins``/``_free`` attr a write target reaches
+        (``x._refs``, ``x._refs[i]``, ``x._free[a:b]``), else None."""
+        node = target
+        while isinstance(node, ast.Subscript):
+            node = node.value
+        if isinstance(node, ast.Attribute) and \
+                node.attr in _POOL_INTERNALS:
+            return node.attr
+        return None
+
+    def _check(self, stmt, targets):
+        for t in targets:
+            attr = self._internal_attr(t)
+            if attr is None:
+                continue
+            if _trace_ok_suppressed(self.lines, stmt, used=self.used):
+                continue
+            self.report.add(Diagnostic(
+                _PASS, "L008", Severity.WARNING, attr,
+                "direct mutation of BlockPool internals (.%s) outside "
+                "mxtpu/parallel/paging.py bypasses the refcount "
+                "invariants and the lifecycle sanitizer's shadow "
+                "accounting — go through alloc/retain/pin/unpin/release "
+                "(or suppress a deliberate red-team write with "
+                "`# trace-ok`)" % attr,
+                location="%s:%d" % (self.fname, stmt.lineno)))
+
+    def visit_Assign(self, node):
+        self._check(node, node.targets)
+        self.generic_visit(node)
+
+    def visit_AnnAssign(self, node):
+        self._check(node, [node.target])
+        self.generic_visit(node)
+
+    def visit_AugAssign(self, node):
+        self._check(node, [node.target])
+        self.generic_visit(node)
+
+    def visit_Delete(self, node):
+        self._check(node, node.targets)
+        self.generic_visit(node)
+
+
 def lint_source(source: str, filename: str = "<string>") -> Report:
     """Lint one Python source string; returns a Report."""
     report = Report()
@@ -527,6 +606,9 @@ def lint_source(source: str, filename: str = "<string>") -> Report:
     _BulkRegionLinter(filename, lines, report, used=used).visit(tree)
     if not _resilience_exempt(filename):
         _HostHazardLinter(filename, lines, report, used=used).visit(tree)
+    if not _paging_exempt(filename):
+        _PoolInternalsLinter(filename, lines, report,
+                             used=used).visit(tree)
 
     # L007: suppressions present but never consulted by a firing rule —
     # the hazard they excused is gone, so the comment is stale

@@ -31,6 +31,11 @@ source              pulls
 ``kernel_invocations``  :func:`mxtpu.ops.pallas.counters.counts` —
                     trace-time Pallas kernel invocation counters
                     (``kernel_invocations.<kernel_name>``)
+``lifecycle``       page-sanitizer shadow-accounting stats from the
+                    serving-lifecycle pass (``lifecycle.armed``,
+                    ``lifecycle.pages_tracked``,
+                    ``lifecycle.violations_ever`` — see
+                    ``analysis/lifecycle_check.py``)
 ==================  ====================================================
 
 Live objects (engines, gateways, supervisors, routers) register with
@@ -226,6 +231,16 @@ def _src_flight() -> dict:
     return get_flight().stats()
 
 
+def _src_lifecycle() -> dict:
+    """Page-sanitizer shadow-accounting stats from the lifecycle pass
+    (``lifecycle.armed``, ``lifecycle.pages_tracked``,
+    ``lifecycle.violations_ever`` ...) — all plain host ints, so a
+    scrape never arms or perturbs the sanitizer
+    (analysis/lifecycle_check.py)."""
+    from ..analysis.lifecycle_check import get_sanitizer
+    return get_sanitizer().stats()
+
+
 def _src_kernel_invocations() -> dict:
     """Pallas kernel trace-time invocation counters: one bump per
     pallas_call traced into a compiled program, keyed by kernel name
@@ -247,6 +262,7 @@ def default_registry() -> MetricsRegistry:
     reg.register_source("tracer", _src_tracer)
     reg.register_source("flight", _src_flight)
     reg.register_source("kernel_invocations", _src_kernel_invocations)
+    reg.register_source("lifecycle", _src_lifecycle)
     return reg
 
 

@@ -53,6 +53,7 @@ admissions always beat cached prefixes), and release on
 
 from __future__ import annotations
 
+import os
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 from ..base import MXTPUError
@@ -63,6 +64,18 @@ __all__ = ["BlockPool", "BlockPoolExhausted", "NULL_PAGE",
 
 #: the reserved garbage-absorbing page id (module docstring)
 NULL_PAGE = 0
+
+#: hook point for the opt-in page-lifecycle sanitizer
+#: (mxtpu.analysis.lifecycle_check installs its PageSanitizer here at
+#: import — paging imports nothing back, so the seam is cycle-free).
+#: Unarmed, every hook below is a single None/armed check.
+_SAN = None
+
+
+def _sanitizer():
+    """The armed sanitizer, or None (the fast path)."""
+    san = _SAN
+    return san if san is not None and san.armed else None
 
 
 class BlockPoolExhausted(MXTPUError):
@@ -87,6 +100,10 @@ class BlockPool:
         self.capacity = int(capacity)
         self.block_size = int(block_size)
         self._on_free = on_free
+        if _SAN is None and os.environ.get(
+                "MXTPU_PAGE_SANITIZER", "") not in ("", "0"):
+            # env-driven arming: the import installs the sanitizer
+            from ..analysis import lifecycle_check  # noqa: F401
         # ordered free list: alloc pops lowest ids first, frees re-sort
         # lazily — deterministic assignment for bit-exact replays
         self._free: List[int] = list(range(1, self.capacity + 1))
@@ -125,6 +142,9 @@ class BlockPool:
         got, self._free = self._free[:n], self._free[n:]
         for bid in got:
             self._refs[bid] = 1
+        san = _sanitizer()
+        if san is not None:
+            san.note_alloc(self, got)
         return got
 
     def retain(self, bid: int) -> None:
@@ -132,6 +152,9 @@ class BlockPool:
         if bid not in self._refs:
             raise MXTPUError("retain() of unallocated page %d" % bid)
         self._refs[bid] += 1
+        san = _sanitizer()
+        if san is not None:
+            san.note_retain(self, bid)
 
     # -- pinning (hierarchical cache) -----------------------------------
     @property
@@ -151,6 +174,9 @@ class BlockPool:
             raise MXTPUError("pin() of unallocated page %d" % bid)
         self._refs[bid] += 1
         self._pins[bid] = self._pins.get(bid, 0) + 1
+        san = _sanitizer()
+        if san is not None:
+            san.note_pin(self, bid)
 
     def unpin(self, bid: int) -> None:
         """Drop one pin (and the reference it holds); the last overall
@@ -162,6 +188,9 @@ class BlockPool:
             del self._pins[bid]
         else:
             self._pins[bid] = count - 1
+        san = _sanitizer()
+        if san is not None:
+            san.note_unpin(self, bid)
         self.release(bid)
 
     def release(self, bid: int) -> None:
@@ -169,6 +198,9 @@ class BlockPool:
         fires ``on_free`` so index entries cannot dangle.  A release
         that would dip into the references pins hold is a refcounting
         bug and raises instead of recycling the pinned page."""
+        san = _sanitizer()
+        if san is not None:
+            san.check_release(self, bid)   # V001 before any mutation
         count = self._refs.get(bid)
         if count is None:
             raise MXTPUError("release() of unallocated page %d" % bid)
@@ -179,6 +211,8 @@ class BlockPool:
                 % (bid, count, self._pins.get(bid, 0)))
         if count > 1:
             self._refs[bid] = count - 1
+            if san is not None:
+                san.note_release(self, bid, freed=False)
             return
         del self._refs[bid]
         # insertion keeps the list sorted (freed pages are reused
@@ -193,6 +227,10 @@ class BlockPool:
         self._free.insert(lo, bid)
         if self._on_free is not None:
             self._on_free(bid)
+        if san is not None:
+            # after on_free: a correct index erased its entry by now,
+            # which is exactly what the V005 check verifies
+            san.note_release(self, bid, freed=True)
 
     def refcount(self, bid: int) -> int:
         return self._refs.get(bid, 0)
@@ -301,6 +339,9 @@ class PrefixIndex:
                 node.children[chunk] = child
                 self._nodes[int(bid)] = child
                 self._parents[int(bid)] = (node, chunk)
+                san = _sanitizer()
+                if san is not None:
+                    san.note_register(self, int(bid))
             node = child
 
     def evict(self, bid: int) -> None:
@@ -312,6 +353,9 @@ class PrefixIndex:
         node = self._nodes.pop(int(bid), None)
         if node is None:
             return
+        san = _sanitizer()
+        if san is not None:
+            san.note_evict(self, int(bid))
         parent, chunk = self._parents.pop(int(bid))
         if parent.children.get(chunk) is node:
             del parent.children[chunk]
@@ -322,6 +366,8 @@ class PrefixIndex:
             if sub.bid is not None:
                 self._nodes.pop(sub.bid, None)
                 self._parents.pop(sub.bid, None)
+                if san is not None:
+                    san.note_evict(self, sub.bid)
             stack.extend(sub.children.values())
 
 
@@ -528,6 +574,9 @@ class HierarchicalCache:
         page content, unpin the device pages, and evict the OLDEST host
         chains past the ``host_blocks`` budget (a chain bigger than the
         whole budget is dropped, not stored)."""
+        san = _sanitizer()
+        if san is not None:
+            san.note_spill(self._bp, chain.pages)
         self.unpin_chain(chain)
         if len(content) != len(chain.pages) or \
                 len(content) > self.host_blocks:

@@ -103,7 +103,7 @@ from ..resilience.faults import inject as _inject
 from .decode import ShardedDecoder, _bucket, resolve_cache_dtype
 from .mesh import DeviceMesh
 from .paging import (NULL_PAGE, BlockPool, HierarchicalCache,
-                     PrefixIndex)
+                     PrefixIndex, _sanitizer)
 from .sharding import ShardingRules
 
 __all__ = ["ContinuousBatchingEngine", "PagedContinuousBatchingEngine",
@@ -1527,6 +1527,9 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
         """Device→host copy of one page through the bounded copy
         program (the swap tier's ONLY compiled program; ledger site
         ``serving.swap``); returns a host pytree of numpy arrays."""
+        san = _sanitizer()
+        if san is not None:
+            san.check_use(self._bp, bid)           # V002 gate
         content, self._pool = self._dec._swap_page_jitted(
             self._pool, self._swap_template(), bid, 0)
         return jax.tree_util.tree_map(
@@ -1534,6 +1537,9 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
 
     def _write_page(self, bid, content):
         """Host→device restore of one page (same program, write=1)."""
+        san = _sanitizer()
+        if san is not None:
+            san.check_use(self._bp, bid, write=True)  # V002/V003 gate
         _, self._pool = self._dec._swap_page_jitted(
             self._pool, content, bid, 1)
 
@@ -1623,6 +1629,9 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
                 for bid in fresh:
                     self._bp.release(bid)
                 raise
+            san = _sanitizer()
+            if san is not None:
+                san.note_restore(self._bp, fresh)
             tokens = chain.tokens[:npages * self._bs]
             self._prefix.register(tokens, list(full) + fresh)
             pages, _ = self._prefix.lookup(tokens, limit=len(tokens))
@@ -1973,6 +1982,10 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
         raw = jnp.asarray(req.prompt[:, start:start + Tact], jnp.int32)
         if Tb > Tact:
             raw = jnp.pad(raw, ((0, 0), (0, Tb - Tact)))
+        if slot.cow is not None:
+            san = _sanitizer()
+            if san is not None:              # V002/V003 COW gate
+                san.note_cow(self._bp, slot.cow[0], slot.cow[1])
         src, dst = slot.cow if slot.cow is not None else (0, 0)
         slot.cow = None                      # COW runs exactly once
         moe = self._dec._block_has_moe()

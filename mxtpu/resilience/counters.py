@@ -33,6 +33,8 @@ _COUNTERS = {
     "ckpt_writes": 0,          # verified checkpoint payloads written
     "ckpt_corruptions": 0,     # checkpoints that failed verification
     "ckpt_fallbacks": 0,       # restores that fell back past a bad checkpoint
+    "lifecycle_violations": 0,  # V0xx raised by the armed page sanitizer
+                               # (analysis/lifecycle_check.py)
 }
 
 

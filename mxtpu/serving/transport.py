@@ -359,4 +359,9 @@ class InProcessReplica(ReplicaTransport):
             self._eng.drop_cache()
         except Exception:  # noqa: BLE001
             pass
+        from ..parallel.paging import _sanitizer
+        san = _sanitizer()
+        pool = getattr(self._eng, "_bp", None)
+        if san is not None and pool is not None:
+            san.check_drain(pool)           # V004: zero pins post-drain
         return tags

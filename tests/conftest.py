@@ -33,6 +33,22 @@ def pytest_configure(config):
         "'not slow' budget run")
 
 
+@pytest.fixture(autouse=True)
+def _arm_page_sanitizer(request):
+    """Arm the serving-lifecycle page sanitizer for every test in the
+    serving/speculative suites (ISSUE 17 acceptance: the parity suites
+    run sanitizer-armed).  The sanitizer is pure host bookkeeping — zero
+    extra compiled programs, streams bit-identical — and pages allocated
+    before arming are exempt, so module-scoped engines stay legal."""
+    mod = getattr(request.module, "__name__", "")
+    if not ("serving" in mod or "speculative" in mod):
+        yield
+        return
+    from mxtpu.analysis.lifecycle_check import page_sanitizing
+    with page_sanitizing():
+        yield
+
+
 @pytest.fixture
 def rnd_seed():
     """Parity: tests/python/unittest/common.py with_seed() — deterministic

@@ -313,6 +313,40 @@ def test_trace_lint_untraced_function_is_ignored():
     assert len(lint_source(src, "s.py")) == 0
 
 
+def test_trace_lint_pool_internals_mutation_is_L008():
+    """Direct writes to BlockPool internals (._refs/._pins/._free)
+    outside paging.py bypass both the refcount invariants and the
+    lifecycle sanitizer's shadow accounting — each mutating statement
+    form draws one L008 WARNING with its line."""
+    src = (
+        "def hack(pool, bid):\n"
+        "    pool._refs[bid] = 2\n"
+        "    pool._pins = {}\n"
+        "    del pool._free[0]\n"
+        "    pool._refs[bid] += 1\n"
+        "    n = len(pool._free)\n"       # read-only: no finding
+        "    return n\n")
+    rep = lint_source(src, "mxtpu/serving/evil.py")
+    hits = rep.filter(code="L008")
+    assert [d.location for d in hits] == [
+        "mxtpu/serving/evil.py:2", "mxtpu/serving/evil.py:3",
+        "mxtpu/serving/evil.py:4", "mxtpu/serving/evil.py:5"]
+    assert {d.subject for d in hits} == {"_refs", "_pins", "_free"}
+    assert all(d.severity == Severity.WARNING for d in hits)
+
+
+def test_trace_lint_L008_exempts_paging_and_honors_trace_ok():
+    """paging.py owns the internals (no finding there), and a
+    deliberate red-team write suppresses with ``# trace-ok``."""
+    src = "def f(pool):\n    pool._refs[1] = 9\n"
+    assert len(lint_source(src, "mxtpu/parallel/paging.py")
+               .filter(code="L008")) == 0
+    ok = ("def f(pool):\n"
+          "    pool._refs[1] = 9  # trace-ok: seeded double-free\n")
+    assert len(lint_source(ok, "tests/test_x.py")
+               .filter(code="L008")) == 0
+
+
 # -- satellites: get_op suggestions, pass registry, CachedOp.verify ----
 
 def test_get_op_suggests_close_matches():
@@ -469,6 +503,26 @@ def test_fault_site_audit_counts_fstring_plans(tmp_path):
     rep = audit_fault_sites(test_paths=[str(tmp_path)],
                             sites=("serving.swap_in",))
     assert len(rep.filter(code="R005")) == 0
+
+
+def test_fault_site_audit_rejoins_binop_concatenations(tmp_path):
+    """A plan split with explicit ``"a" + "b"`` concatenation (black
+    wrapping a long literal, or a shared-prefix constant) is rejoined
+    before matching — the R005 false-positive the split-literal fix
+    guards against.  Non-literal operands are holes, like an f-string's
+    formatted values, and earn no credit on their own."""
+    from mxtpu.analysis import audit_fault_sites
+
+    (tmp_path / "test_fake.py").write_text(
+        "def test_a(n):\n"
+        "    plan = ('serving.swap_in#2' + '@1:raise=OSError(dma)')\n"
+        "    p2 = 'serving.swap' + '_out@' + str(n) + ':raise'\n"
+        "    p3 = 'serving.st' + 'ep'\n")  # no action: not a plan
+    rep = audit_fault_sites(
+        test_paths=[str(tmp_path)],
+        sites=("serving.swap_in", "serving.swap_out", "serving.step"))
+    assert [d.subject for d in rep.filter(code="R005")] == \
+        ["serving.step"]
 
 
 def test_full_registry_audit_includes_fault_site_check():

@@ -1,0 +1,398 @@
+"""Red-team fixture matrix for the serving-lifecycle sanitizer
+(ISSUE 17 acceptance): one seeded defect per V code, each asserting the
+diagnostic fires EXACTLY where expected — plus model-checker replay
+determinism and armed-engine parity (bit-identical streams, zero extra
+compiled programs)."""
+
+import numpy as np
+import pytest
+
+from mxtpu.analysis import Severity
+from mxtpu.analysis.lifecycle_check import (
+    DEFAULT_FAULT_PLANS, PageLifecycleError, check_protocol, conformance,
+    get_sanitizer, lifecycle_check, model_replica_cls, page_sanitizing,
+    release_path_lint)
+from mxtpu.parallel.paging import BlockPool, PrefixIndex
+
+
+def _expect(code):
+    """pytest.raises wrapper asserting the typed error's code AND that
+    it carries a non-empty event history (the replay evidence)."""
+    class _Ctx:
+        def __enter__(self):
+            self._raises = pytest.raises(PageLifecycleError)
+            self.excinfo = self._raises.__enter__()
+            return self.excinfo
+
+        def __exit__(self, *exc):
+            out = self._raises.__exit__(*exc)
+            if out:   # the error fired: check its anatomy
+                err = self.excinfo.value
+                assert err.code == code
+                assert err.history, "V code without event history"
+                assert code in str(err)
+            return out
+    return _Ctx()
+
+
+# -- V001–V005: the shadow state machine -------------------------------
+
+def test_v001_double_free_fires_at_second_release():
+    with page_sanitizing():
+        pool = BlockPool(4, 8)
+        (bid,) = pool.alloc(1)
+        pool.release(bid)            # legal: page returns to free
+        with _expect("V001"):
+            pool.release(bid)        # the seeded double free
+
+
+def test_v002_use_after_free():
+    with page_sanitizing() as san:
+        pool = BlockPool(4, 8)
+        (bid,) = pool.alloc(1)
+        san.check_use(pool, bid)     # legal while owned
+        pool.release(bid)
+        with _expect("V002"):
+            san.check_use(pool, bid)
+
+
+def test_v002_cow_donor_recycled():
+    with page_sanitizing() as san:
+        pool = BlockPool(4, 8)
+        src, dst = pool.alloc(2)
+        pool.release(src)
+        with _expect("V002"):
+            san.note_cow(pool, src, dst)
+
+
+def test_v003_write_to_shared_page():
+    with page_sanitizing() as san:
+        pool = BlockPool(4, 8)
+        (bid,) = pool.alloc(1)
+        pool.retain(bid)             # refs=2: shared
+        san.check_use(pool, bid)     # reads of shared pages are legal
+        with _expect("V003"):
+            san.check_use(pool, bid, write=True)
+
+
+def test_v003_cow_into_non_exclusive_target():
+    with page_sanitizing() as san:
+        pool = BlockPool(4, 8)
+        src, dst = pool.alloc(2)
+        pool.retain(dst)             # clone target not solely owned
+        with _expect("V003"):
+            san.note_cow(pool, src, dst)
+
+
+def test_v004_pin_leak_at_drain():
+    with page_sanitizing() as san:
+        pool = BlockPool(4, 8)
+        (bid,) = pool.alloc(1)
+        pool.pin(bid)
+        with _expect("V004"):
+            san.check_drain(pool)
+        pool.unpin(bid)              # release the pin: drain is clean
+        san.check_drain(pool)
+        pool.release(bid)
+
+
+def test_v005_index_entry_survives_recycle():
+    class LeakyIndex(PrefixIndex):
+        def evict(self, bid):        # the seeded defect: erase skipped
+            pass
+
+    with page_sanitizing():
+        idx = LeakyIndex(4)
+        pool = BlockPool(4, 8, on_free=idx.evict)
+        pages = pool.alloc(1)
+        idx.register(tuple(range(8)), pages)
+        with _expect("V005"):
+            pool.release(pages[0])
+
+
+def test_sanitizer_exempts_pages_allocated_before_arming():
+    """Per-test arming around module-scoped engines: pre-armed pages
+    are invisible, so their releases can never false-positive."""
+    pool = BlockPool(4, 8)
+    (bid,) = pool.alloc(1)
+    pool.release(bid)
+    with page_sanitizing() as san:
+        san.check_use(pool, bid)     # untracked: exempt, no V002
+    # disarm cleared shadow state; violations counter is process-wide
+    assert san.stats()["pages_tracked"] == 0
+
+
+def test_sanitizer_history_is_counter_clocked_and_bounded():
+    with page_sanitizing() as san:
+        pool = BlockPool(4, 8)
+        (bid,) = pool.alloc(1)
+        for _ in range(40):          # overflow the ring
+            pool.retain(bid)
+            pool.release(bid)
+        hist = san.history(pool, bid)
+        from mxtpu.analysis.lifecycle_check import RING_DEPTH
+        assert len(hist) == RING_DEPTH
+        seqs = [ev[0] for ev in hist]
+        assert seqs == sorted(seqs)  # monotone counter clock, no wall
+        assert all(isinstance(s, int) for s in seqs)
+
+
+# -- V006: release-path lint -------------------------------------------
+
+def test_v006_abandoned_slot_without_release():
+    rep = release_path_lint(source=(
+        "class Engine:\n"
+        "    def abandon(self, i):\n"
+        "        self._slots[i] = None\n"), filename="seeded.py")
+    bad = rep.filter(code="V006")
+    assert [d.subject for d in bad] == ["Engine.abandon"]
+    assert bad.diagnostics[0].severity == Severity.ERROR
+    assert bad.diagnostics[0].location == "seeded.py:3"
+
+
+def test_v006_slot_clear_followed_by_release_is_clean():
+    rep = release_path_lint(source=(
+        "class Engine:\n"
+        "    def evict(self, i):\n"
+        "        slot = self._slots[i]\n"
+        "        self._slots[i] = None\n"
+        "        self._release_row(slot)\n"
+        "    def reject(self, i):\n"
+        "        self._slots[i] = None\n"
+        "        raise RuntimeError('requeue upstream')\n"))
+    assert len(rep.filter(code="V006")) == 0
+
+
+def test_v006_scrub_must_reach_release_helper():
+    rep = release_path_lint(source=(
+        "class Engine:\n"
+        "    def _release_row(self, i):\n"
+        "        pass\n"
+        "    def _scrub_row(self, i):\n"
+        "        self.log(i)\n"        # the seeded defect
+        "    def _finish(self, i):\n"
+        "        self._release_row(i)\n"))
+    assert [d.subject for d in rep.filter(code="V006")] == \
+        ["Engine._scrub_row"]
+
+
+def test_v006_transport_drain_must_drop_cache():
+    rep = release_path_lint(source=(
+        "class Replica:\n"
+        "    def cancel(self, tag):\n"
+        "        return True\n"
+        "    def drain(self):\n"
+        "        return list(self._tags)\n"))   # no drop_cache
+    assert [d.subject for d in rep.filter(code="V006")] == \
+        ["Replica.drain"]
+    # the protocol's raising stub is NOT a defect
+    stub = release_path_lint(source=(
+        "class Transport:\n"
+        "    def cancel(self, tag):\n"
+        "        raise NotImplementedError\n"
+        "    def drain(self):\n"
+        "        '''contract'''\n"
+        "        raise NotImplementedError\n"))
+    assert len(stub.filter(code="V006")) == 0
+
+
+def test_v006_terminal_status_needs_bookkeeping():
+    rep = release_path_lint(source=(
+        "class Gateway:\n"
+        "    def expire(self, req):\n"
+        "        req.status = 'expired'\n"))    # no _mark_done
+    assert [d.subject for d in rep.filter(code="V006")] == \
+        ["Gateway.expire"]
+
+
+def test_v006_self_application_over_real_engines_is_clean():
+    """The shipped engines + serving package pass their own lint —
+    the tier-1 gate this pass adds."""
+    rep = release_path_lint()
+    assert rep.ok, str(rep)
+
+
+# -- V007/V008: conformance + the model checker ------------------------
+
+def test_v008_conformance_names_missing_members():
+    from mxtpu.serving.transport import ReplicaTransport
+
+    class Partial(ReplicaTransport):
+        def submit(self, spec, tag):
+            return tag
+
+        def drain(self):
+            return []
+
+    rep = conformance(Partial)
+    bad = rep.filter(code="V008")
+    assert len(bad) == 1
+    missing = bad.diagnostics[0].details["missing"]
+    assert "poll" in missing and "health" in missing
+    assert "submit" not in missing and "drain" not in missing
+    # both shipped transports conform
+    from mxtpu.serving.transport import InProcessReplica
+    assert conformance(InProcessReplica).ok
+    assert conformance(model_replica_cls()).ok
+
+
+def test_model_check_of_real_stack_is_clean():
+    rep = check_protocol()
+    assert rep.ok, str(rep)
+
+
+def test_v007_page_leak_across_drain_is_caught():
+    Base = model_replica_cls()
+
+    class LeakyReplica(Base):
+        def _retire(self, tag):      # the seeded defect: pages kept
+            st = self._live.pop(tag, None)
+            if st is None:
+                return
+            self._order.remove(tag)
+            self._done += 1
+
+    rep = check_protocol(replica_factory=LeakyReplica,
+                         fault_plans=("",), replica_counts=(1,),
+                         qos_classes=(1,))
+    bad = rep.filter(code="V007")
+    assert bad, "leak not caught"
+    d = bad.diagnostics[0]
+    assert "page accounting after drain" in d.message
+    assert d.details["in_use"] > 0
+    assert d.details["fault_plan"] == ""
+    assert d.details["config"]["replicas"] == 1
+
+
+def test_v008_defective_qos_displacement_is_caught():
+    from mxtpu.serving.gateway import Gateway
+
+    class DefectiveGateway(Gateway):
+        def _pick_shed_victim(self, incoming_qos):
+            return None              # the seeded defect: never displace
+
+    rep = check_protocol(gateway_cls=DefectiveGateway,
+                         fault_plans=("",), replica_counts=(1,),
+                         qos_classes=(3,))
+    bad = [d for d in rep.filter(code="V008")
+           if "QoS displacement" in d.message]
+    assert bad, str(rep)
+    d = bad[0]
+    assert d.details["victim"] is None
+    assert d.details["expected"] is not None
+    assert d.details["queue"]    # the snapshot that proves the verdict
+
+
+def test_model_check_replays_bit_identically():
+    """Two runs of the same bounded sweep produce byte-identical JSON —
+    counter clocks only, no wall time anywhere in the trajectory."""
+    a = check_protocol().to_json()
+    b = check_protocol().to_json()
+    assert a == b
+
+
+def test_v007_replay_coordinates_reproduce_the_violation():
+    """A violation's (config, fault_plan) details are sufficient to
+    replay exactly that trajectory and re-raise the same diagnostic."""
+    Base = model_replica_cls()
+
+    class LeakyReplica(Base):
+        def _retire(self, tag):
+            st = self._live.pop(tag, None)
+            if st is None:
+                return
+            self._order.remove(tag)
+            self._done += 1
+
+    full = check_protocol(replica_factory=LeakyReplica)
+    d = full.filter(code="V007").diagnostics[0]
+    cfg, plan = d.details["config"], d.details["fault_plan"]
+    replay = check_protocol(
+        replica_factory=LeakyReplica, fault_plans=(plan,),
+        replica_counts=(cfg["replicas"],),
+        qos_classes=(cfg["qos_classes"],))
+    again = [x for x in replay.filter(code="V007")
+             if x.details["config"] == cfg
+             and x.details["fault_plan"] == plan
+             and x.message == d.message]
+    assert again, str(replay)
+
+
+def test_default_fault_plans_exercise_every_layer():
+    """The bounded plan set names each service layer's site family —
+    trimming a layer out of the sweep should fail loudly here."""
+    joined = " ".join(DEFAULT_FAULT_PLANS)
+    for fam in ("replica.health", "replica.stream", "router.dispatch",
+                "gateway.admit"):
+        assert fam in joined
+
+
+# -- the registered pass + CLI wiring ----------------------------------
+
+def test_registered_pass_self_applies_clean():
+    rep = lifecycle_check()
+    assert rep.ok, str(rep)
+
+
+def test_pass_is_wired_into_cli_all():
+    """The P001 gate: lifecycle_check must have a self-application
+    probe in `python -m mxtpu.analysis all`."""
+    from mxtpu.analysis.__main__ import _SELF_APPLY
+    from mxtpu.analysis import list_passes
+    assert "lifecycle_check" in list_passes()
+    assert "lifecycle_check" in _SELF_APPLY
+
+
+def test_violations_bump_resilience_counter():
+    from mxtpu.resilience.counters import counters
+    before = counters()["lifecycle_violations"]
+    with page_sanitizing():
+        pool = BlockPool(4, 8)
+        (bid,) = pool.alloc(1)
+        pool.release(bid)
+        with pytest.raises(PageLifecycleError):
+            pool.release(bid)
+    after = counters()["lifecycle_violations"]
+    assert after == before + 1
+    snap = get_sanitizer().stats()
+    assert snap["violations_ever"] >= 1
+    assert snap["armed"] == 0        # context exited
+
+
+# -- armed-engine parity: streams + compile ledger ---------------------
+
+def test_armed_engine_stream_is_bit_identical_with_zero_compiles():
+    """Arming the sanitizer around the paged engine changes NOTHING the
+    device sees: the second (armed) run of the same prompt is
+    bit-identical to the unarmed run and compiles zero new programs —
+    the sanitizer is pure host bookkeeping."""
+    import mxtpu as mx
+    from mxtpu import nd
+    from mxtpu.analysis import get_ledger
+    from mxtpu.models.transformer import (
+        TransformerLM, transformer_lm_sharding_rules)
+    from mxtpu.parallel import PagedContinuousBatchingEngine
+    from mxtpu.parallel.mesh import DeviceMesh
+
+    mx.random.seed(7)
+    lm = TransformerLM(32, units=16, hidden_size=32, num_layers=1,
+                       num_heads=2, num_kv_heads=2)
+    lm.initialize()
+    eng = PagedContinuousBatchingEngine(
+        lm, DeviceMesh(dp=1), transformer_lm_sharding_rules(),
+        num_slots=2, max_length=32, block_size=8, prefill_chunk=8)
+    rng = np.random.RandomState(0)
+    prompt = nd.array(rng.randint(0, 32, (1, 9)), dtype="int32")
+    rid = eng.submit(prompt, 4)
+    want = eng.run()[rid].asnumpy()          # unarmed: compiles here
+    assert eng.stats["blocks_in_use"] == 0
+    led = get_ledger()
+    seq = led.sequence()
+    with page_sanitizing() as san:
+        rid = eng.submit(prompt, 4)
+        got = eng.run()[rid].asnumpy()       # armed rerun
+        assert san.stats()["pages_tracked"] > 0
+        assert san.stats()["transitions"] > 0
+    np.testing.assert_array_equal(got, want)
+    assert led.misses_after(seq) == [], \
+        "the armed run compiled new programs"

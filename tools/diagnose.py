@@ -428,6 +428,90 @@ def check_router():
               % (ok_loc, ok_death, sup))
     except Exception as e:
         print("router       : FAILED (%s: %s)" % (type(e).__name__, e))
+    check_lifecycle()
+
+
+def check_lifecycle():
+    """Exercise the serving-lifecycle page sanitizer once (docs/
+    analysis.md "lifecycle_check"): an ARMED micro-engine driven
+    through the full page lifecycle — prefix share, copy-on-write,
+    host-tier spill, swap-in restore, clean drain — a healthy install
+    raises ZERO V0xx violations while the shadow accounting tracks
+    every page, and the ``lifecycle.*`` metrics source reports the
+    same stats through the unified registry."""
+    print("----------Serving (lifecycle sanitizer)----------")
+    try:
+        import numpy as np
+
+        import mxtpu as mx
+        from mxtpu import nd
+        from mxtpu.analysis.lifecycle_check import (RING_DEPTH,
+                                                    get_sanitizer,
+                                                    page_sanitizing)
+        from mxtpu.models.transformer import (
+            TransformerLM, transformer_lm_sharding_rules)
+        from mxtpu.parallel import PagedContinuousBatchingEngine
+        from mxtpu.parallel.mesh import DeviceMesh
+
+        print("ambient      : MXTPU_PAGE_SANITIZER=%s"
+              % (os.environ.get("MXTPU_PAGE_SANITIZER") or "unset"))
+        mx.random.seed(7)
+        lm = TransformerLM(32, units=16, hidden_size=32, num_layers=1,
+                           num_heads=2, num_kv_heads=2)
+        lm.initialize()
+        viol_before = get_sanitizer().stats()["violations_ever"]
+        with page_sanitizing():
+            eng = PagedContinuousBatchingEngine(
+                lm, DeviceMesh(dp=1), transformer_lm_sharding_rules(),
+                num_slots=2, max_length=32, block_size=8,
+                prefill_chunk=8, pin_bytes="64KiB",
+                host_cache_bytes="64KiB")
+            rng = np.random.RandomState(0)
+            shared = rng.randint(0, 32, (1, 11))
+            pa = np.concatenate([shared, rng.randint(0, 32, (1, 6))],
+                                axis=1)
+            pb = np.concatenate([shared, rng.randint(0, 32, (1, 4))],
+                                axis=1)
+            eng.submit(nd.array(pa, dtype="int32"), 3)
+            for _ in range(3):
+                eng.step()      # drive A's chunked prefill to register
+            eng.submit(nd.array(pb, dtype="int32"), 3)
+            eng.run()           # prefix SHARE + COW under the sanitizer
+            for chain in list(eng._hc._chains.values()):
+                eng._spill_chain(chain)     # host-tier SPILL
+            eng.submit(nd.array(pa, dtype="int32"), 3)
+            eng.run()           # swap-in RESTORE
+            eng._hc.pin_blocks = 0
+            eng._enforce_pin_budget()       # release pins -> clean drain
+            st = eng.stats
+            san = get_sanitizer().stats()
+            from mxtpu.observability import get_registry
+            m = get_registry().snapshot(sources=("lifecycle",))
+        new_viol = san["violations_ever"] - viol_before
+        print("shadow state : %d page(s) tracked, %d event ring(s) "
+              "(depth %d), %d transition(s) recorded"
+              % (san["pages_tracked"], san["rings"], RING_DEPTH,
+                 san["transitions"]))
+        print("lifecycle    : %d COW cop%s, %d spilled / %d swapped "
+              "in, %d in use after drain"
+              % (st["cow_copied_blocks"],
+                 "y" if st["cow_copied_blocks"] == 1 else "ies",
+                 st["spilled_blocks"], st["swapped_in_blocks"],
+                 st["blocks_in_use"]))
+        print("metrics      : lifecycle.armed=%d "
+              "lifecycle.violations_ever=%d (unified registry)"
+              % (m["lifecycle.armed"], m["lifecycle.violations_ever"]))
+        healthy = (st["cow_copied_blocks"] >= 1
+                   and st["spilled_blocks"] >= 1
+                   and st["swapped_in_blocks"] >= 1
+                   and st["blocks_in_use"] == 0
+                   and san["pages_tracked"] > 0
+                   and new_viol == 0)
+        print("probe        :", "ok (armed share -> COW -> spill -> "
+              "restore -> drain, zero V0xx violations)" if healthy
+              else "UNEXPECTED (viol=%d stats=%r)" % (new_viol, st))
+    except Exception as e:
+        print("lifecycle    : FAILED (%s: %s)" % (type(e).__name__, e))
 
 
 def check_resilience():
