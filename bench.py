@@ -1447,6 +1447,141 @@ def _bench_speculative_decode():
     print(json.dumps(rec), flush=True)
 
 
+def _bench_tree_speculative():
+    """Tree speculative decoding (round-18 tentpole): multi-branch
+    draft trees verified in ONE pooled ancestor-masked cache read vs
+    LINEAR windows of the same node budget, on a BRANCHY workload —
+    histories whose trailing n-grams recur with different continuations,
+    the regime where a linear window bets everything on one continuation
+    and loses the whole draft at the first fork taken the other way.
+
+    ``accepted_tokens_per_step_tree``: emitted tokens per slot-iteration
+    under tree drafting, with the linear engine's number on the
+    identical workload alongside — both DETERMINISTIC host-side
+    counters (timer-free, honest on any platform); wall clock is
+    recorded NOISE-labeled only."""
+    import numpy as np
+    import jax
+    import mxtpu as mx
+    from mxtpu import nd
+    from mxtpu.models import transformer
+    from mxtpu.models.transformer import TransformerLM
+    from mxtpu.parallel import ContinuousBatchingEngine, make_mesh
+
+    platform = jax.devices()[0].platform
+    cpu = platform == "cpu"
+    if cpu:
+        mx.random.seed(1)   # the pinned cycling micro model
+        lm = TransformerLM(20, units=32, hidden_size=64, num_layers=1,
+                           num_heads=4, num_kv_heads=2)
+        slots, n_req, max_len, vocab = 4, 8, 96, 20
+        glo, ghi = 24, 40
+    else:
+        mx.random.seed(1)
+        lm = transformer.llama_3_8b(vocab_size=32000, width_factor=0.25,
+                                    depth_factor=0.25)
+        slots, n_req, max_len, vocab = 8, 16, 256, 32000
+        glo, ghi = 24, 64
+    nodes, branch = 7, 2
+    lm.initialize()
+    mesh = make_mesh(dp=1)
+    rules = transformer.transformer_lm_sharding_rules()
+
+    R = np.random.RandomState(0)
+    # branchy prompts: a short pattern tiled, but with the token after
+    # one pattern occurrence PERTURBED — the trailing n-gram now recurs
+    # with two different continuations, so the most-recent-occurrence
+    # bet a linear window makes is wrong whenever the model continues
+    # the other way; the tree drafts BOTH
+    prompts = []
+    for _ in range(n_req):
+        w = int(R.randint(3, 6))
+        pat = R.randint(0, vocab, (1, w))
+        tiled = np.tile(pat, 6)[:, :max_len // 2 - 1]
+        k = int(R.randint(1, w + 1))     # perturb inside tile 2
+        tiled[0, w + k - 1] = int(R.randint(0, vocab))
+        prompts.append(nd.array(tiled.astype(np.int32)))
+    news = R.randint(glo, ghi + 1, n_req).tolist()
+    useful = float(sum(news))
+
+    from mxtpu.analysis import get_ledger
+    _led = get_ledger()
+    _sites = ("serving.verify_tree_slots", "serving.fixup_slots")
+    _tree_before = sum(_led.miss_counts(_sites).values())
+
+    tree = ContinuousBatchingEngine(lm, mesh, rules, num_slots=slots,
+                                    max_length=max_len,
+                                    spec_tree=(nodes, branch))
+    # the linear comparator gets the SAME node budget: spec_k drafts
+    # one chain as long as the tree's deepest path
+    linear = ContinuousBatchingEngine(lm, mesh, rules, num_slots=slots,
+                                      max_length=max_len, spec_k=nodes)
+
+    def drive(eng):
+        t0 = time.perf_counter()
+        for p, n in zip(prompts, news):
+            eng.submit(p, n)
+        eng.run()
+        return time.perf_counter() - t0
+
+    drive(tree)                    # compile warmup
+    t0s = tree.stats
+    tree_dt = drive(tree)
+    t1s = tree.stats
+    drive(linear)                  # compile warmup
+    linear_dt = drive(linear)
+    l1s = linear.stats
+
+    def rate(a, b=None):
+        it = a["slot_iterations"] - (b["slot_iterations"] if b else 0)
+        tk = a["generated_tokens"] - (b["generated_tokens"] if b else 0)
+        return tk / max(it, 1)
+
+    drafted = t1s["tree_nodes_drafted"] - t0s["tree_nodes_drafted"]
+    paths = t1s["tree_paths"] - t0s["tree_paths"]
+    accepted = t1s["accepted_tokens"] - t0s["accepted_tokens"]
+    cfg = {"num_slots": slots, "requests": n_req,
+           "spec_tree": [nodes, branch], "linear_spec_k": nodes,
+           "new_tokens": [glo, ghi], "max_length": max_len,
+           "workload": "tiled 3-5 token patterns with one perturbed "
+                       "continuation (branchy)"}
+    rec = {
+        "metric": "accepted_tokens_per_step_tree",
+        "value": round(rate(t1s, t0s), 3),
+        "unit": "tokens/slot-iteration",
+        # linear speculation at the SAME node budget on the SAME
+        # branchy workload — the number the ancestor-masked tree beats
+        "vs_baseline": round(rate(l1s), 3),
+        "platform": platform,
+        "tree_nodes_drafted": drafted,
+        "tree_paths": paths,
+        "accepted_tokens": accepted,
+        "node_hit_rate": round(accepted / drafted, 3) if drafted
+        else 0.0,
+        # verify-tree + fixup program family compiled over warmup+timed:
+        # bounded by the pow2 window ladder, never per tree shape
+        "compiled_program_count": sum(
+            _led.miss_counts(_sites).values()) - _tree_before,
+        "wall_clock_note": "NOISE-DOMINATED CPU wall clock, recorded "
+                           "for completeness only: tree %.2fs vs "
+                           "linear %.2fs for %d useful tokens"
+                           % (tree_dt, linear_dt, int(useful)),
+        "config": cfg,
+        "baseline_note": "comparison column is this repo's own LINEAR "
+                         "speculative engine (spec_k = tree max_nodes) "
+                         "on the identical branchy workload; both "
+                         "values are deterministic host-side counters "
+                         "(timer-free) and every stream on both "
+                         "engines stays bit-identical to "
+                         "non-speculative decode",
+    }
+    if cpu:
+        rec["config_note"] = ("CPU fallback runs the LABELED pinned "
+                              "cycling micro model — acceptance "
+                              "evidence, NOT a TPU serving number")
+    print(json.dumps(rec), flush=True)
+
+
 def _bench_analysis():
     """Static-analysis wall time (round-11 tentpole: compile-discipline
     and device-memory static analysis).  Times every pass the repo
@@ -1910,6 +2045,7 @@ def _child_main():
     _bench_paged_decode()
     _bench_kernel_traffic()
     _bench_speculative_decode()
+    _bench_tree_speculative()
     _bench_quantized_decode()
     _bench_hierarchical_cache()
     _bench_router()

@@ -611,6 +611,10 @@ def default_kernel_specs() -> List[KernelSpec]:
       the shard_map-partitioned (``mesh_axis=("tp", 4)``) per-shard
       variants of the decode and int8-verify geometries, the default
       fast path under a tp-sharded cache;
+    - paged_attention TREE verify (``tree=True``: ancestor-bitmask
+      lane masking over a model binary tree) at W=4 and W=8, fp32 and
+      int8 caches, plus the tp=2 per-shard int8 tree geometry — the
+      serving engines' spec_tree fast path;
     - paged_prefill chunked-prefill at the serving chunk (T=128, GQA
       rep 4, D=128), fp32 cache at block_size 16 and int8 at 32, plus
       the tp=4 per-shard variant.
@@ -645,6 +649,17 @@ def default_kernel_specs() -> List[KernelSpec]:
     specs.append(paged_attention.kernel_spec(
         B=16, KV=8, rep=4, W=8, D=128, block_size=32, max_length=512,
         cache_dtype="int8", mesh_axis=("tp", 4)))
+    # tree-speculative verify: per-lane ancestor bitmasks over a model
+    # binary tree (the engines' spec_tree path), fp32 + int8, and the
+    # tp-sharded int8 variant
+    for cache_dtype, block_size in (("float32", 16), ("int8", 32)):
+        for W in (4, 8):
+            specs.append(paged_attention.kernel_spec(
+                B=16, KV=8, rep=4, W=W, D=128, block_size=block_size,
+                max_length=512, cache_dtype=cache_dtype, tree=True))
+    specs.append(paged_attention.kernel_spec(
+        B=16, KV=8, rep=4, W=8, D=128, block_size=32, max_length=512,
+        cache_dtype="int8", tree=True, mesh_axis=("tp", 2)))
     # chunked-prefill kernel at the serving chunk geometry
     for cache_dtype, block_size in (("float32", 16), ("int8", 32)):
         specs.append(prefill_attention.kernel_spec(

@@ -183,28 +183,33 @@ class MoEDecoderLayer(HybridBlock):
         return x + self.moe.decode_forward(self.ffn_norm(x)), \
             cache_k, cache_v
 
-    def verify_slots(self, x, cache_k, cache_v, pos, valid_len):
+    def verify_slots(self, x, cache_k, cache_v, pos, valid_len,
+                     tree=None):
         """Speculative verification window (W candidate tokens per row;
         see Attention.verify_slots).  The routed FFN runs
         capacity-unbounded like step_slots — BUT the unbounded capacity
         NUMBER is a function of the window batch (S = B*W tokens), so a
         W-token window is not guaranteed to route bit-identically to W
         sequential one-token steps.  The serving engines therefore opt
-        MoE blocks OUT of speculation automatically (the same caveat
-        class as prefix sharing / prefill bucketing); this method exists
-        for parity experiments and future capacity-pinned routing."""
+        MoE blocks OUT of speculation automatically — linear AND tree
+        windows alike (the same caveat class as prefix sharing /
+        prefill bucketing); this method exists for parity experiments
+        and future capacity-pinned routing."""
         h, cache_k, cache_v = self.attn.verify_slots(
-            self.attn_norm(x), cache_k, cache_v, pos, valid_len)
+            self.attn_norm(x), cache_k, cache_v, pos, valid_len,
+            tree=tree)
         x = x + h
         return x + self.moe.decode_forward(self.ffn_norm(x)), \
             cache_k, cache_v
 
-    def verify_pages(self, x, pool_k, pool_v, tables, pos, valid_len):
+    def verify_pages(self, x, pool_k, pool_v, tables, pos, valid_len,
+                     tree=None):
         """Block-paged speculative verification window (see
         verify_slots for the MoE routing caveat — the serving engines
-        opt MoE blocks out of speculation)."""
+        opt MoE blocks out of speculation, tree windows included)."""
         h, pool_k, pool_v = self.attn.verify_pages(
-            self.attn_norm(x), pool_k, pool_v, tables, pos, valid_len)
+            self.attn_norm(x), pool_k, pool_v, tables, pos, valid_len,
+            tree=tree)
         x = x + h
         return x + self.moe.decode_forward(self.ffn_norm(x)), \
             pool_k, pool_v

@@ -17,7 +17,7 @@ from .. import ndarray as nd
 from ..ndarray import NDArray
 
 __all__ = ["BeamSearchSampler", "NGramDrafter", "SequenceSampler",
-           "beam_search", "sample_next_token"]
+           "TreeDrafter", "beam_search", "sample_next_token"]
 
 _NEG_INF = -1e30
 
@@ -178,6 +178,110 @@ class NGramDrafter:
                 if H[i:i + n] == pat:
                     return H[i + n:i + n + k]
         return []
+
+
+class TreeDrafter:
+    """Multi-branch host-side self-drafter for TREE speculative
+    decoding: where :class:`NGramDrafter` proposes ONE chain from the
+    most recent occurrence of the longest trailing n-gram, this drafts
+    a small TREE — the primary chain plus alternate continuations from
+    the next-most-recent occurrences, branching at the first token
+    where an alternate diverges from the tree built so far.  A single
+    pooled verify call then scores every branch in one cache read, so
+    an early primary-chain mismatch no longer discards the whole
+    window: the longest accepted root-to-leaf path wins.
+
+    Tree grammar (window-lane encoding the verify path consumes): the
+    proposal is three equal-length lists ``(tokens, parent, depth)``
+    over DRAFT nodes; node j occupies window lane ``j + 1`` (lane 0 is
+    the committed root token the engine prepends), ``parent[j]`` is the
+    window lane of its parent (0 = root, always < j + 1 — lane order is
+    topological), and ``depth[j] >= 1`` its tree depth.  Sibling
+    tokens under one parent are UNIQUE by construction (alternates that
+    agree with an existing node follow it instead of duplicating), so
+    at most one root-to-leaf path can match the per-position target
+    draws — acceptance is unambiguous.
+
+    Fully DETERMINISTIC: a pure function of (history, budgets) with
+    most-recent-first occurrence order, like the linear drafter — fault
+    replays and seeded reruns reproduce trees bit-for-bit.  ``branch``
+    caps the children of any single node (the per-divergence-point
+    fanout); the CALLER clamps node/depth budgets to its cache extent.
+    """
+
+    def __init__(self, max_nodes=8, branch=2, max_ngram=3, min_ngram=1):
+        if min_ngram < 1 or max_ngram < min_ngram:
+            raise ValueError(
+                "TreeDrafter needs 1 <= min_ngram <= max_ngram, got "
+                "min=%d max=%d" % (min_ngram, max_ngram))
+        if max_nodes < 1 or branch < 1:
+            raise ValueError(
+                "TreeDrafter needs max_nodes >= 1 and branch >= 1, "
+                "got nodes=%d branch=%d" % (max_nodes, branch))
+        self._nodes = int(max_nodes)
+        self._branch = int(branch)
+        self._max = int(max_ngram)
+        self._min = int(min_ngram)
+
+    @property
+    def max_nodes(self):
+        return self._nodes
+
+    @property
+    def branch(self):
+        return self._branch
+
+    def propose_tree(self, history, max_nodes, max_depth):
+        """Draft a tree continuing ``history``: returns ``(tokens,
+        parent, depth)`` lists (possibly empty) with ``len <=
+        min(max_nodes, self.max_nodes)`` nodes and depths ``<=
+        max_depth``.  Longest trailing n-gram wins; its occurrences are
+        walked most-recent-first — the first builds the primary chain,
+        later ones graft alternate branches at their divergence
+        points until the node budget or per-node ``branch`` cap stops
+        them."""
+        max_nodes = min(int(max_nodes), self._nodes)
+        max_depth = int(max_depth)
+        H = [int(t) for t in history]
+        L = len(H)
+        if max_nodes <= 0 or max_depth <= 0 or L < 2:
+            return [], [], []
+        starts = []
+        for n in range(min(self._max, L - 1), self._min - 1, -1):
+            pat = H[L - n:]
+            starts = [i + n for i in range(L - n - 1, -1, -1)
+                      if H[i:i + n] == pat]
+            if starts:
+                break
+        if not starts:
+            return [], [], []
+
+        toks, parents, depths = [], [], []
+        children = {0: {}}            # window lane -> {token: child lane}
+
+        def _insert(chain):
+            lane, d = 0, 0
+            for tok in chain:
+                if d >= max_depth:
+                    return
+                kids = children.setdefault(lane, {})
+                if tok in kids:       # sibling dedup: follow, don't fork
+                    lane = kids[tok]
+                    d += 1
+                    continue
+                if len(kids) >= self._branch or len(toks) >= max_nodes:
+                    return
+                toks.append(tok)
+                parents.append(lane)
+                depths.append(d + 1)
+                lane = kids[tok] = len(toks)   # window lane (root = 0)
+                d += 1
+
+        for s in starts:
+            if len(toks) >= max_nodes:
+                break
+            _insert(H[s:s + max_depth])
+        return toks, parents, depths
 
 
 class BeamSearchSampler:

@@ -125,16 +125,18 @@ def _page_copy(leaf, src, dst):
     return nd._paged_block_copy(leaf, src=src, dst=dst)
 
 
-def _paged_kernel_attention(q, pool_k, pool_v, tables, pos):
+def _paged_kernel_attention(q, pool_k, pool_v, tables, pos, anc=None):
     """Route the paged cache read through the ragged Pallas kernel
     (ops/pallas/paged_attention — tri-state MXTPU_PALLAS_PAGED_ATTN,
     default on where the geometry guard passes); q is (B, H, W, D)
-    post-rope, returns (B, H, W, D)."""
+    post-rope, returns (B, H, W, D).  ``anc`` (B, W) int32 swaps the
+    triangular W-window mask for the tree ancestor bitmask."""
     if _q8cache(pool_k):
         return nd.paged_decode_attention(
             q, pool_k[0], pool_v[0], tables, pos,
-            k_scales=pool_k[1], v_scales=pool_v[1])
-    return nd.paged_decode_attention(q, pool_k, pool_v, tables, pos)
+            k_scales=pool_k[1], v_scales=pool_v[1], anc=anc)
+    return nd.paged_decode_attention(q, pool_k, pool_v, tables, pos,
+                                     anc=anc)
 
 
 def _paged_prefill_kernel(q, pool_k, pool_v, table, start_pos):
@@ -355,7 +357,8 @@ class MultiHeadAttention(HybridBlock):
         out = out.reshape(B, 1, H * D)
         return self.out_proj(out), cache_k, cache_v
 
-    def verify_slots(self, x, cache_k, cache_v, pos, valid_len):
+    def verify_slots(self, x, cache_k, cache_v, pos, valid_len,
+                     tree=None):
         """Batched speculative verification: x (B, W, C) is a window of
         W candidate tokens per row — the last sampled token followed by
         W-1 drafts — with row b's window starting at its own cache
@@ -370,7 +373,19 @@ class MultiHeadAttention(HybridBlock):
         (probe-verified on this XLA build; asserted stream-level in
         tests/test_speculative.py).  Rejected lanes simply roll the
         host position back: their writes sit beyond every validity
-        mask until sequential re-writes overtake them."""
+        mask until sequential re-writes overtake them.
+
+        ``tree=(perm, depth)`` generalizes the window from a chain to a
+        draft TREE (TreeDrafter): lane w sits at tree depth
+        ``depth[b, w]`` with ancestor-lane chain ``perm[b, w, :]`` (pad
+        = w), its K/V still lands at cache position pos[b]+w (lane
+        order) but ropes at pos[b]+depth[b, w], and the attention read
+        permutes each lane's window columns into its own path order so
+        the masked softmax + contraction see exactly the sequential
+        step's arrangement — a per-lane ANCESTOR mask in one pooled
+        cache read (see _internal_tree_verify_attn).  A linear chain
+        (perm[b, w, i] = min(i, w), depth = arange) reproduces this
+        method's chain form exactly."""
         B, W, _ = x.shape
         H, KV, D = self._heads, self._kv_heads, self._head_dim
         Tmax = _payload(cache_k).shape[2]
@@ -381,8 +396,15 @@ class MultiHeadAttention(HybridBlock):
         v = qkv[:, :, (H + KV) * D:].reshape(
             B, W, KV, D).transpose((0, 2, 1, 3))
         if self._rotary:
-            q = nd.rope(q, offset=pos)  # (B,) offset + intra-window arange
-            k = nd.rope(k, offset=pos)
+            if tree is not None:
+                # absolute per-lane positions: lane w rotates at its
+                # TREE depth, not its window index
+                off = pos.reshape((B, 1)) + tree[1]          # (B, W)
+                q = nd.rope(q, offset=off)
+                k = nd.rope(k, offset=off)
+            else:
+                q = nd.rope(q, offset=pos)  # (B,) offset + window arange
+                k = nd.rope(k, offset=pos)
         cache_k = _cache_write_span(cache_k, k, pos, valid_len)
         cache_v = _cache_write_span(cache_v, v, pos, valid_len)
         # the step_slots GQA fold with W queries; validity is per-row
@@ -393,6 +415,10 @@ class MultiHeadAttention(HybridBlock):
         values = _cache_fp(cache_v).reshape(B * KV, Tmax, D)
         scores = nd.batch_dot(q_r, keys,
                               transpose_b=True) / math.sqrt(D)
+        if tree is not None:
+            out = nd._internal_tree_verify_attn(
+                scores, values, pos, tree[0], tree[1], rep=rep)
+            return self.out_proj(out), cache_k, cache_v
         valid = (nd.arange(0, Tmax).reshape((1, 1, Tmax))
                  <= (pos.reshape((B, 1)) + nd.arange(0, W).reshape(
                      (1, W))).reshape((B, W, 1)))  # (B, W, Tmax)
@@ -444,14 +470,24 @@ class MultiHeadAttention(HybridBlock):
                                     no_bias=b is None)
         return qk, vq, vs
 
-    def verify_pages(self, x, pool_k, pool_v, tables, pos, valid_len):
+    def verify_pages(self, x, pool_k, pool_v, tables, pos, valid_len,
+                     tree=None):
         """Batched speculative verification over the BLOCK-PAGED pool —
         verify_slots() with the cache read/write routed through the
         per-row block tables (gather into sequence order, then exactly
         the same math on the same shapes).  Invalid window lanes write
         the null page; rejected lanes need only a host position
         roll-back, never a page operation (every page the window can
-        touch was allocated at admission)."""
+        touch was allocated at admission).
+
+        ``tree=(perm, depth, anc)`` is the draft-TREE window (see
+        verify_slots): the XLA path permutes window columns per lane
+        through ``perm``/``depth``; the Pallas kernel path instead
+        consumes ``anc`` (B, W) int32 — bit j of anc[b, w] marks window
+        lane j an ancestor-or-self of lane w — via scalar prefetch,
+        swapping its triangular W-window mask for the ancestor bitmask
+        while the block-table walk (and its O(valid pages) HBM
+        traffic) stays untouched."""
         B, W, _ = x.shape
         H, KV, D = self._heads, self._kv_heads, self._head_dim
         Tmax = tables.shape[1] * _payload(pool_k).shape[2]
@@ -471,8 +507,13 @@ class MultiHeadAttention(HybridBlock):
             v = qkv[:, :, (H + KV) * D:].reshape(
                 B, W, KV, D).transpose((0, 2, 1, 3))
         if self._rotary:
-            q = nd.rope(q, offset=pos)
-            k = nd.rope(k, offset=pos)
+            if tree is not None:
+                off = pos.reshape((B, 1)) + tree[1]          # (B, W)
+                q = nd.rope(q, offset=off)
+                k = nd.rope(k, offset=off)
+            else:
+                q = nd.rope(q, offset=pos)
+                k = nd.rope(k, offset=pos)
         pool_k = _paged_write_span(pool_k, k, tables, pos, valid_len)
         if fused:
             # V rows land pre-quantized — no float V tensor exists
@@ -484,9 +525,11 @@ class MultiHeadAttention(HybridBlock):
             pool_v = _paged_write_span(pool_v, v, tables, pos, valid_len)
         if _paged_attn_on(pool_k):
             # ragged Pallas kernel: walk each row's block table, read
-            # only valid rows, per-lane causal extent pos[b]+w
-            out = _paged_kernel_attention(q, pool_k, pool_v, tables,
-                                          pos)                # (B,H,W,D)
+            # only valid rows; per-lane causal extent pos[b]+w, or the
+            # ancestor bitmask for tree windows
+            out = _paged_kernel_attention(
+                q, pool_k, pool_v, tables, pos,
+                anc=None if tree is None else tree[2])        # (B,H,W,D)
             out = out.transpose((0, 2, 1, 3)).reshape(B, W, H * D)
             return self.out_proj(out), pool_k, pool_v
         keys = _paged_gather(pool_k, tables).reshape(
@@ -497,6 +540,10 @@ class MultiHeadAttention(HybridBlock):
         q_r = q.reshape(B * KV, rep * W, D)
         scores = nd.batch_dot(q_r, keys,
                               transpose_b=True) / math.sqrt(D)
+        if tree is not None:
+            out = nd._internal_tree_verify_attn(
+                scores, values, pos, tree[0], tree[1], rep=rep)
+            return self.out_proj(out), pool_k, pool_v
         valid = (nd.arange(0, Tmax).reshape((1, 1, Tmax))
                  <= (pos.reshape((B, 1)) + nd.arange(0, W).reshape(
                      (1, W))).reshape((B, W, 1)))  # (B, W, Tmax)
@@ -852,23 +899,27 @@ class LlamaDecoderLayer(HybridBlock):
         h = self.down_proj(nd.swish(self.gate_proj(h)) * self.up_proj(h))
         return x + h, cache_k, cache_v
 
-    def verify_slots(self, x, cache_k, cache_v, pos, valid_len):
+    def verify_slots(self, x, cache_k, cache_v, pos, valid_len,
+                     tree=None):
         """Speculative verification window through this layer (W
         candidate tokens per row at per-row positions; see
-        Attention.verify_slots).  The FFN is per-token, so the window
-        batch changes nothing."""
+        Attention.verify_slots — ``tree`` is the draft-tree form).  The
+        FFN is per-token, so the window batch changes nothing."""
         h, cache_k, cache_v = self.attn.verify_slots(
-            self.attn_norm(x), cache_k, cache_v, pos, valid_len)
+            self.attn_norm(x), cache_k, cache_v, pos, valid_len,
+            tree=tree)
         x = x + h
         h = self.ffn_norm(x)
         h = self.down_proj(nd.swish(self.gate_proj(h)) * self.up_proj(h))
         return x + h, cache_k, cache_v
 
-    def verify_pages(self, x, pool_k, pool_v, tables, pos, valid_len):
+    def verify_pages(self, x, pool_k, pool_v, tables, pos, valid_len,
+                     tree=None):
         """Speculative verification window through the block-paged pool
         (see Attention.verify_pages)."""
         h, pool_k, pool_v = self.attn.verify_pages(
-            self.attn_norm(x), pool_k, pool_v, tables, pos, valid_len)
+            self.attn_norm(x), pool_k, pool_v, tables, pos, valid_len,
+            tree=tree)
         x = x + h
         h = self.ffn_norm(x)
         h = self.down_proj(nd.swish(self.gate_proj(h)) * self.up_proj(h))
@@ -996,7 +1047,7 @@ class TransformerLM(HybridBlock):
             new_caches.append((ck, cv))
         return self._logits(x), new_caches
 
-    def verify_slots(self, token_ids, caches, pos, valid_len):
+    def verify_slots(self, token_ids, caches, pos, valid_len, tree=None):
         """Score a speculative window of W candidate tokens per slot in
         ONE forward: token_ids (B, W) — row b holds its last sampled
         token followed by up to W-1 drafted tokens, starting at cache
@@ -1007,27 +1058,63 @@ class TransformerLM(HybridBlock):
         read and keep per-stream output bit-exact (speculative
         decoding).  ``valid_len`` (B,) masks each row's real window
         extent; lanes past it (padding, inactive slots at 0) write
-        nothing.  Same functional-cache contract as step_slots()."""
+        nothing.  Same functional-cache contract as step_slots().
+
+        ``tree=(perm, depth)`` scores a draft TREE instead of a chain
+        (TreeDrafter windows; see Attention.verify_slots): the logits
+        at lane w are then bit-identical to the sequential steps along
+        lane w's root-to-w ancestor path."""
         x = self.embed(token_ids)
         new_caches = []
         for layer, (ck, cv) in zip(self.layers, caches):
-            x, ck, cv = layer.verify_slots(x, ck, cv, pos, valid_len)
+            x, ck, cv = layer.verify_slots(x, ck, cv, pos, valid_len,
+                                           tree=tree)
             new_caches.append((ck, cv))
         return self._logits(x), new_caches
 
-    def verify_pages(self, token_ids, pools, tables, pos, valid_len):
+    def verify_pages(self, token_ids, pools, tables, pos, valid_len,
+                     tree=None):
         """Speculative-window scoring through the block-paged pool:
         verify_slots() with the cache traffic routed through ``tables``
         (B, M) — see Attention.verify_pages.  Rollback on rejection is a
         host position fix-up only: every page a window can touch was
-        allocated at admission and stays with the slot."""
+        allocated at admission and stays with the slot.  ``tree=(perm,
+        depth, anc)`` is the draft-tree form (anc feeds the Pallas
+        kernel's ancestor bitmask)."""
         x = self.embed(token_ids)
         new_pools = []
         for layer, (pk, pv) in zip(self.layers, pools):
             x, pk, pv = layer.verify_pages(x, pk, pv, tables, pos,
-                                           valid_len)
+                                           valid_len, tree=tree)
             new_pools.append((pk, pv))
         return self._logits(x), new_pools
+
+    def permute_cache_span(self, caches, pos, src_lane):
+        """Post-acceptance tree fix-up over every layer's static cache:
+        row b's window entry at position pos[b]+src_lane[b, j] moves to
+        pos[b]+j, landing the accepted root-to-leaf path in depth order
+        — exactly where sequential decode would have written it (see
+        _internal_cache_permute_span; lanes marked -1 stay untouched).
+        Functional like write_cache_slot; the serving engines skip the
+        dispatch entirely when every row is the identity."""
+        def _permute(leaf):
+            if _q8cache(leaf):
+                return tuple(nd._internal_cache_permute_span_q8(
+                    leaf[0], leaf[1], pos, src_lane))
+            return nd._internal_cache_permute_span(leaf, pos, src_lane)
+        return [(_permute(ck), _permute(cv)) for ck, cv in caches]
+
+    def permute_pool_span(self, pools, tables, pos, src_lane):
+        """Paged twin of permute_cache_span: the accepted path moves
+        through the block tables — rollback and fix-up stay position
+        bookkeeping, never an allocator op."""
+        def _permute(leaf):
+            if _q8cache(leaf):
+                return tuple(nd._paged_cache_permute_span_q8(
+                    leaf[0], leaf[1], tables, pos, src_lane))
+            return nd._paged_cache_permute_span(leaf, tables, pos,
+                                                src_lane)
+        return [(_permute(pk), _permute(pv)) for pk, pv in pools]
 
     def prefill(self, token_ids, caches, start_pos=0, total_len=None):
         """Ingest the whole prompt in ONE forward: token_ids (B, T) →

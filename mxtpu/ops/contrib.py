@@ -186,16 +186,25 @@ def rope(data, base=10000.0, offset=0, scale=1.0):
     (B, T, D). Pairs are (x[..., :D/2], x[..., D/2:]) — the Llama layout.
 
     ``offset`` may be a scalar (python int or traced — every row sits at
-    the same position) or a (B,) vector: row b's positions start at
+    the same position), a (B,) vector: row b's positions start at
     offset[b] (continuous-batching decode, where each cache slot is at
-    its own depth)."""
+    its own depth), or a (B, T) matrix of ABSOLUTE positions: element
+    (b, t) is rotated at offset[b, t] (tree-speculative verify, where
+    window lane t sits at its own tree depth rather than at t)."""
     dt = data.dtype
     x = data.astype(jnp.float32)
     D = x.shape[-1]
     T = x.shape[-2]
     half = D // 2
     freqs = base ** (-jnp.arange(0, half, dtype=jnp.float32) / half)
-    if getattr(offset, "ndim", 0) >= 1:
+    if getattr(offset, "ndim", 0) >= 2:
+        # int->fp32 is exact below 2^24, so wherever
+        # offset[b, t] == offset[b] + t this path is bit-identical to
+        # the (B,) branch (and hence to the sequential decode step)
+        pos = jnp.asarray(offset, jnp.float32) * scale       # (B, T)
+        ang = pos[..., None] * freqs                         # (B, T, D/2)
+        shape = (x.shape[0],) + (1,) * (x.ndim - 3) + (T, half)
+    elif getattr(offset, "ndim", 0) >= 1:
         off = jnp.asarray(offset, jnp.float32).reshape(-1)   # (B,)
         pos = (jnp.arange(T, dtype=jnp.float32)[None, :]
                + off[:, None]) * scale                       # (B, T)

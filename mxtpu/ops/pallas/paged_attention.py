@@ -114,13 +114,20 @@ def invocation_count(name=KERNEL_NAME) -> int:
     return counters.count(name)
 
 
-def _kernel(tbl_ref, pos_ref, nv_ref, q_ref, k_ref, *rest,
-            sm_scale, bs, W, n_pages, quant):
+def _kernel(tbl_ref, pos_ref, nv_ref, *rest,
+            sm_scale, bs, W, n_pages, quant, tree):
     """One (slot b, kv head) pair walks its block-table chain; carries
     online-softmax state in VMEM scratch across the page walk.  With
     ``quant`` the pools are int8 payloads and ``rest`` carries their
     scale refs — the page dequantizes (payload × per-head-per-position
-    scale) inside the kernel, then the identical online softmax."""
+    scale) inside the kernel, then the identical online softmax.  With
+    ``tree`` a fourth scalar-prefetch operand carries the (B, W) int32
+    ancestor bitmask and the triangular W-window mask is swapped for
+    the per-lane tree mask (see paged_decode_attention)."""
+    if tree:
+        anc_ref, q_ref, k_ref, *rest = rest
+    else:
+        anc_ref, (q_ref, k_ref, *rest) = None, rest
     if quant:
         ks_ref, v_ref, vs_ref, o_ref, m_ref, l_ref, acc_ref = rest
     else:
@@ -149,7 +156,20 @@ def _kernel(tbl_ref, pos_ref, nv_ref, q_ref, k_ref, *rest,
         k_pos = j * bs + jax.lax.broadcasted_iota(
             jnp.int32, (lanes, bs), 1)
         w = jax.lax.broadcasted_iota(jnp.int32, (lanes, bs), 0) % W
-        s = jnp.where(k_pos <= pos_ref[b] + w, s, _NEG_INF)
+        if tree:
+            # tree verify: cache rows pos[b]..pos[b]+W-1 hold the
+            # window tokens in LANE order; lane w attends committed
+            # history (rel < 0), itself (rel == w), and exactly its
+            # strict tree ancestors (bit rel of anc[b, w])
+            rel = k_pos - pos_ref[b]
+            bits = jnp.stack([anc_ref[b, i] for i in range(W)])
+            bits = jnp.tile(bits, lanes // W)[:, None]   # (lanes, 1)
+            bit = (bits >> jnp.clip(rel, 0, 31)) & 1
+            ok = (rel < 0) | (rel == w) | ((rel >= 0) & (rel < W)
+                                           & (bit == 1))
+            s = jnp.where(ok, s, _NEG_INF)
+        else:
+            s = jnp.where(k_pos <= pos_ref[b] + w, s, _NEG_INF)
         m_prev = m_ref[...]
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
         p = jnp.exp(s - m_new)
@@ -196,10 +216,80 @@ def _model_tables(B, M, n_pages, block_size, W, max_length):
     return tables, pos
 
 
+def _model_anc(B, W, branch=2):
+    """Representative (B, W) ancestor bitmask for the static checker: a
+    ``branch``-ary draft tree in window-lane order (lane 0 = root, lane
+    w's parent = (w-1)//branch — topological, so every ancestor bit is
+    < w), the same strict-ancestors-only convention the engines emit."""
+    import numpy as np
+
+    anc = np.zeros((W,), np.int32)
+    for w in range(1, W):
+        p = (w - 1) // max(int(branch), 1)
+        anc[w] = anc[p] | np.int32(1 << p)
+    return np.broadcast_to(anc, (B, W)).copy()
+
+
+def _check_anc_model(anc, W):
+    """Semantic validation of a model ancestor table — evaluated by the
+    kernel_check index-map sweep (NUMPY values; the traced runtime maps
+    never see concrete bits), so a malformed table surfaces as a
+    located K004 ERROR on the tree spec instead of silently modeling a
+    mask the kernel would never run."""
+    import numpy as np
+
+    a = np.asarray(anc)
+    if a.ndim != 2 or a.shape[-1] != W:
+        raise ValueError(
+            "malformed ancestor table: shape %r, expected (B, W=%d)"
+            % (a.shape, W))
+    if W > 32:
+        raise ValueError(
+            "malformed ancestor table: W=%d exceeds the 32-lane int32 "
+            "bitmask" % W)
+    a = a.astype(np.int64)
+    if (a[:, 0] != 0).any():
+        raise ValueError(
+            "malformed ancestor table: lane 0 is the shared root and "
+            "has no ancestors (anc[:, 0] must be 0)")
+    for w in range(1, W):
+        col = a[:, w]
+        if ((col < 0) | (col >= (1 << w))).any():
+            raise ValueError(
+                "malformed ancestor table: lane %d carries an ancestor "
+                "bit >= its own lane — parents must precede children "
+                "in window-lane order" % w)
+        if (col & 1 == 0).any():
+            raise ValueError(
+                "malformed ancestor table: lane %d does not descend "
+                "from the root (bit 0 unset)" % w)
+        for j in range(1, w):
+            on = (col >> j) & 1 == 1
+            if (on & ((a[:, j] & ~col) != 0)).any():
+                raise ValueError(
+                    "malformed ancestor table: lane %d lists lane %d "
+                    "as an ancestor but not lane %d's own ancestors — "
+                    "ancestor sets must be transitively closed"
+                    % (w, j, j))
+
+
+def _page_index_tree_model(b, kv, j, tbl, pos, nv, anc):
+    """kernel_check-side tree table walk: identical page selection,
+    plus semantic validation of the ancestor table (concrete values are
+    only available here — see _check_anc_model)."""
+    _check_anc_model(anc, anc.shape[-1])
+    return _page_index_tree(b, kv, j, tbl, pos, nv, anc)
+
+
+def _scale_index_tree_model(b, kv, j, tbl, pos, nv, anc):
+    _check_anc_model(anc, anc.shape[-1])
+    return _scale_index_tree(b, kv, j, tbl, pos, nv, anc)
+
+
 def kernel_spec(B, KV, rep, W, D, block_size, max_length,
                 q_dtype="bfloat16", cache_dtype="float32",
                 num_blocks=None, tables=None, pos=None, interpret=False,
-                mesh_axis=None):
+                mesh_axis=None, tree=False, anc=None):
     """KernelSpec descriptor (mxtpu.analysis.kernel_check) for one
     paged_decode_attention call — the REAL index maps (_page_index /
     _scale_index, block-table walk and null-page-0 routing included)
@@ -212,6 +302,14 @@ def kernel_spec(B, KV, rep, W, D, block_size, max_length,
     prices the per-device VMEM the partitioned kernel actually uses.  A
     shard count that does not divide KV is recorded as-is — the static
     pass locates it as a K009 mesh-axis mismatch ERROR instead of this
+    builder raising.
+
+    ``tree=True`` (or an explicit ``anc`` table) describes the
+    tree-verify variant: a fourth scalar-prefetch operand carries the
+    (B, W) int32 ancestor bitmask and the spec's index maps validate
+    its semantics (strict ancestors < w, rooted, transitively closed —
+    _check_anc_model) during the K004 sweep, so a malformed table a
+    caller audits is a located ERROR, recorded as-is rather than this
     builder raising."""
     import numpy as np
 
@@ -240,8 +338,18 @@ def kernel_spec(B, KV, rep, W, D, block_size, max_length,
     pos = model_pos if pos is None \
         else np.asarray(pos).astype(np.int32)
     nv = np.asarray(_num_valid_pages(pos, W, bs, M))
+    tree = tree or anc is not None
+    if tree:
+        anc = _model_anc(B, W) if anc is None \
+            else np.asarray(anc).astype(np.int32)
     lanes = rep * W
-    q_im = lambda b, kv, j, tbl, pos, nv: (b, kv, 0, 0)  # noqa: E731
+    if tree:
+        q_im = lambda b, kv, j, tbl, pos, nv, anc: (  # noqa: E731
+            b, kv, 0, 0)
+        page_im, scale_im = _page_index_tree_model, _scale_index_tree_model
+    else:
+        q_im = lambda b, kv, j, tbl, pos, nv: (b, kv, 0, 0)  # noqa: E731
+        page_im, scale_im = _page_index, _scale_index
     pool_dtype = "int8" if quant else cache_dtype
     # strict_dims: D (head_dim) and bs (block_size) are engine-chosen
     # tile parameters — the full-axis exemption must not absolve a
@@ -252,44 +360,53 @@ def kernel_spec(B, KV, rep, W, D, block_size, max_length,
         BlockOperand("q", "in", (1, 1, lanes, D), (B, KV, lanes, D),
                      q_dtype, q_im, strict_dims=(-1,)),
         BlockOperand("pool_k", "in", (1, 1, bs, D), (N, KV, bs, D),
-                     pool_dtype, _page_index, strict_dims=(-1, -2)),
+                     pool_dtype, page_im, strict_dims=(-1, -2)),
     ]
     if quant:
         operands.append(BlockOperand(
             "k_scales", "in", (1, 1, bs), (N, KV, bs), "float32",
-            _scale_index))
+            scale_im))
     operands.append(BlockOperand(
         "pool_v", "in", (1, 1, bs, D), (N, KV, bs, D), pool_dtype,
-        _page_index, strict_dims=(-1, -2)))
+        page_im, strict_dims=(-1, -2)))
     if quant:
         operands.append(BlockOperand(
             "v_scales", "in", (1, 1, bs), (N, KV, bs), "float32",
-            _scale_index))
+            scale_im))
     operands.append(BlockOperand(
         "o", "out", (1, 1, lanes, D), (B, KV, lanes, D), q_dtype, q_im,
         strict_dims=(-1,)))
+    prefetch = [ScalarPrefetch("tables", tables, valid_range=(0, N)),
+                ScalarPrefetch("pos", pos, valid_range=(0, max_length)),
+                ScalarPrefetch("nv", nv, valid_range=(1, M + 1))]
+    if tree:
+        # strict-ancestor bits are all < w <= W-1, so a well-formed
+        # table stays below 2**(W-1)
+        prefetch.append(ScalarPrefetch(
+            "anc", anc, valid_range=(0, 1 << max(W - 1, 1))))
     return KernelSpec(
-        "paged_attention[%s,W=%d,bs=%d,D=%d%s]" % (pool_dtype, W, bs, D,
-                                                   name_sfx),
+        "paged_attention[%s,W=%d,bs=%d,D=%d%s%s]"
+        % (pool_dtype, W, bs, D, ",tree" if tree else "", name_sfx),
         grid=(B, KV, M),
         operands=operands,
         scratch=[ScratchOperand("m", (lanes, 1), "float32"),
                  ScratchOperand("l", (lanes, 1), "float32"),
                  ScratchOperand("acc", (lanes, D), "float32")],
-        prefetch=[ScalarPrefetch("tables", tables, valid_range=(0, N)),
-                  ScalarPrefetch("pos", pos,
-                                 valid_range=(0, max_length)),
-                  ScalarPrefetch("nv", nv, valid_range=(1, M + 1))],
+        prefetch=prefetch,
         interpret=interpret,
         mesh_axis=mesh_axis)
 
 
-def validate_call_geometry(D, block_size, pool_dtype):
+def validate_call_geometry(D, block_size, pool_dtype, W=None):
     """The runtime mirror of the kernel_check static rules for THIS
     kernel: returns the list of violated-rule messages (empty = TPU
     legal).  K001 — head_dim must be lane-aligned (multiple of 128);
     K002 — block_size must be a multiple of the cache dtype's sublane
-    tile (8 fp32 / 16 bf16 / 32 int8)."""
+    tile (8 fp32 / 16 bf16 / 32 int8).  ``W`` (tree-verify calls only)
+    adds the tree-mask table rule: the per-lane ancestor set rides an
+    int32 bitmask whose bits are strict-ancestor lanes < w, so the
+    window must fit W <= 32 lanes (31 draft nodes + root — the engine
+    cap on ``spec_tree`` nodes)."""
     from ...analysis.memory_estimate import LANE, sublane_tile
 
     errs = []
@@ -301,6 +418,10 @@ def validate_call_geometry(D, block_size, pool_dtype):
         errs.append("K002: block_size=%d is not a multiple of the %s "
                     "sublane tile %d (8 fp32 / 16 bf16 / 32 int8)"
                     % (block_size, pool_dtype, sub))
+    if W is not None and W > 32:
+        errs.append("K004: tree verify window W=%d exceeds the 32-lane "
+                    "int32 ancestor bitmask — cap spec_tree at 31 "
+                    "draft nodes (+ root)" % W)
     return errs
 
 
@@ -315,57 +436,77 @@ def _scale_index(b, kv, j, tbl, pos, nv):
     return (jnp.where(j < nv[b], tbl[b, j], 0), kv, 0)
 
 
+def _page_index_tree(b, kv, j, tbl, pos, nv, anc):
+    """Tree-verify variant: identical table walk, but the grid spec
+    carries a fourth scalar-prefetch operand (the ancestor bitmask),
+    so every index map takes it — the walk itself never reads it."""
+    return (jnp.where(j < nv[b], tbl[b, j], 0), kv, 0, 0)
+
+
+def _scale_index_tree(b, kv, j, tbl, pos, nv, anc):
+    return (jnp.where(j < nv[b], tbl[b, j], 0), kv, 0)
+
+
 def _call_local(qr, pool_k, pool_v, tables, pos, k_scales=None,
-                v_scales=None, *, sm_scale, W, interpret):
+                v_scales=None, anc=None, *, sm_scale, W, interpret):
     """The unpartitioned pallas_call on (possibly per-shard) operands:
     qr is the kv-major (B, KV, rep*W, D) fold — under shard_map KV here
-    is the PER-DEVICE kv-head count."""
+    is the PER-DEVICE kv-head count.  ``anc`` (B, W) int32 selects the
+    tree-mask kernel variant (fourth scalar-prefetch operand)."""
     B, KV, lanes, D = qr.shape
     N, _, bs, _ = pool_k.shape
     M = tables.shape[-1]
     quant = k_scales is not None
+    tree = anc is not None
     nv = _num_valid_pages(pos, W, bs, M)
 
+    page_index = _page_index_tree if tree else _page_index
+    scale_index = _scale_index_tree if tree else _scale_index
+    if tree:
+        q_im = lambda b, kv, j, tbl, pos, nv, anc: (  # noqa: E731
+            b, kv, 0, 0)
+    else:
+        q_im = lambda b, kv, j, tbl, pos, nv: (b, kv, 0, 0)  # noqa: E731
+
     in_specs = [
-        pl.BlockSpec((1, 1, lanes, D),
-                     lambda b, kv, j, tbl, pos, nv: (b, kv, 0, 0)),
-        pl.BlockSpec((1, 1, bs, D), _page_index),
+        pl.BlockSpec((1, 1, lanes, D), q_im),
+        pl.BlockSpec((1, 1, bs, D), page_index),
     ]
     args = [qr, pool_k]
     if quant:
-        in_specs.append(pl.BlockSpec((1, 1, bs), _scale_index))
+        in_specs.append(pl.BlockSpec((1, 1, bs), scale_index))
         args.append(k_scales)
-    in_specs.append(pl.BlockSpec((1, 1, bs, D), _page_index))
+    in_specs.append(pl.BlockSpec((1, 1, bs, D), page_index))
     args.append(pool_v)
     if quant:
-        in_specs.append(pl.BlockSpec((1, 1, bs), _scale_index))
+        in_specs.append(pl.BlockSpec((1, 1, bs), scale_index))
         args.append(v_scales)
 
     kernel = functools.partial(_kernel, sm_scale=sm_scale, bs=bs,
-                               W=W, n_pages=M, quant=quant)
+                               W=W, n_pages=M, quant=quant, tree=tree)
     grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=3,
+        num_scalar_prefetch=4 if tree else 3,
         grid=(B, KV, M),
         in_specs=in_specs,
-        out_specs=pl.BlockSpec(
-            (1, 1, lanes, D),
-            lambda b, kv, j, tbl, pos, nv: (b, kv, 0, 0)),
+        out_specs=pl.BlockSpec((1, 1, lanes, D), q_im),
         scratch_shapes=[
             pltpu.VMEM((lanes, 1), jnp.float32),
             pltpu.VMEM((lanes, 1), jnp.float32),
             pltpu.VMEM((lanes, D), jnp.float32),
         ],
     )
+    prefetch = (tables, pos, nv, anc) if tree else (tables, pos, nv)
     return pl.pallas_call(
         kernel,
         out_shape=jax.ShapeDtypeStruct((B, KV, lanes, D), qr.dtype),
         grid_spec=grid_spec,
         interpret=interpret,
-    )(tables, pos, nv, *args)
+    )(*prefetch, *args)
 
 
 def paged_decode_attention(q, pool_k, pool_v, tables, pos,
-                           k_scales=None, v_scales=None, scale=None):
+                           k_scales=None, v_scales=None, scale=None,
+                           anc=None):
     """Ragged paged attention over block tables.
 
     q : (B, H, W, D) queries — W = 1 for the plain decode step, > 1 for
@@ -375,37 +516,58 @@ def paged_decode_attention(q, pool_k, pool_v, tables, pos,
     tables : (B, M) int32 block tables (page 0 = reserved null page).
     pos : (B,) int32 per-slot positions (the last written position of
         window lane 0).
+    anc : optional (B, W) int32 ancestor bitmask — tree-speculative
+        verify.  The cache rows pos[b]..pos[b]+W-1 hold the window
+        tokens in LANE order; bit j of ``anc[b, w]`` marks window lane
+        j a STRICT tree ancestor of lane w (so bit 0, the shared root,
+        is set for every lane w >= 1 and ``anc[b, 0] == 0``; bits are
+        always < w, keeping the mask inside 31 bits for any W <= 32).
+        Lane w then attends committed history (< pos[b]), itself, and
+        exactly its ancestors — a degenerate chain
+        ``anc[b, w] = (1 << w) - 1`` reproduces the triangular
+        <= pos[b] + w window mask bit for bit.  The page walk is
+        UNCHANGED: HBM traffic stays O(valid pages) for the whole tree.
 
     Returns (B, H, W, D) in q's dtype.  H = KV * rep, kv-major (head
     h = kv*rep + r — the models' GQA fold).  Inside an active
     ``head_sharding_scope`` (the decoder's tp-sharded cache) the call is
-    shard_map-partitioned over the heads axis.
+    shard_map-partitioned over the heads axis (``anc`` replicates like
+    tables/pos).
     """
     B, H, W, D = q.shape
     N, KV, bs, _ = pool_k.shape
     rep = H // KV
     sm_scale = float(scale if scale is not None else 1.0 / math.sqrt(D))
     quant = k_scales is not None
+    tree = anc is not None
 
     qr = q.reshape(B, KV, rep * W, D)
     tables = tables.astype(jnp.int32)
     pos = jnp.asarray(pos, jnp.int32).reshape(-1)
+    if tree:
+        anc = jnp.asarray(anc, jnp.int32).reshape(B, W)
 
     interpret = jax.default_backend() == "cpu"
-    if not interpret:
+    errs = validate_call_geometry(
+        D, bs, "int8" if quant else str(pool_k.dtype),
+        W=W if tree else None)
+    if tree and any("K004" in e for e in errs):
+        # the tree-mask width rule is a correctness bound, not a TPU
+        # lowering rule — it holds in interpret mode too
+        raise ValueError(
+            "paged_decode_attention: "
+            + "; ".join(e for e in errs if "K004" in e))
+    if not interpret and errs:
         # runtime mirror of the static kernel_check pass: TPU-illegal
         # geometry fails HERE with the violated K-rule named instead of
         # deferring to an opaque Mosaic lowering error mid-compile
-        errs = validate_call_geometry(
-            D, bs, "int8" if quant else str(pool_k.dtype))
-        if errs:
-            raise ValueError(
-                "paged_decode_attention: TPU-illegal call geometry — "
-                + "; ".join(errs)
-                + ". Fix the engine's block_size/head_dim (or run "
-                "`python -m mxtpu.analysis kernel` for the full static "
-                "verdict); interpret-mode CPU tests accept this "
-                "geometry, hardware does not.")
+        raise ValueError(
+            "paged_decode_attention: TPU-illegal call geometry — "
+            + "; ".join(errs)
+            + ". Fix the engine's block_size/head_dim (or run "
+            "`python -m mxtpu.analysis kernel` for the full static "
+            "verdict); interpret-mode CPU tests accept this "
+            "geometry, hardware does not.")
     counters.bump(KERNEL_NAME)
     call = functools.partial(_call_local, sm_scale=sm_scale, W=W,
                              interpret=interpret)
@@ -418,8 +580,16 @@ def paged_decode_attention(q, pool_k, pool_v, tables, pos,
         ax = axes[0] if len(axes) == 1 else tuple(axes)
         heads4 = P(None, ax, None, None)   # qr/out and page pools
         heads3 = P(None, ax, None)         # int8 scale planes
-        repl = P()                         # tables / pos
-        if quant:
+        repl = P()                         # tables / pos / anc
+        if quant and tree:
+            fn = lambda a, b_, c, d, e, f, g, h: call(  # noqa: E731
+                a, b_, c, d, e, f, g, h)
+            in_specs = (heads4, heads4, heads4, repl, repl,
+                        heads3, heads3, repl)
+            mapped = head_shard_map(fn, jm, in_specs, heads4)
+            out = mapped(qr, pool_k, pool_v, tables, pos,
+                         k_scales, v_scales, anc)
+        elif quant:
             fn = lambda a, b_, c, d, e, f, g: call(  # noqa: E731
                 a, b_, c, d, e, f, g)
             in_specs = (heads4, heads4, heads4, repl, repl,
@@ -427,21 +597,29 @@ def paged_decode_attention(q, pool_k, pool_v, tables, pos,
             mapped = head_shard_map(fn, jm, in_specs, heads4)
             out = mapped(qr, pool_k, pool_v, tables, pos,
                          k_scales, v_scales)
+        elif tree:
+            fn = lambda a, b_, c, d, e, h: call(  # noqa: E731
+                a, b_, c, d, e, None, None, h)
+            in_specs = (heads4, heads4, heads4, repl, repl, repl)
+            mapped = head_shard_map(fn, jm, in_specs, heads4)
+            out = mapped(qr, pool_k, pool_v, tables, pos, anc)
         else:
             fn = lambda a, b_, c, d, e: call(a, b_, c, d, e)  # noqa: E731
             in_specs = (heads4, heads4, heads4, repl, repl)
             mapped = head_shard_map(fn, jm, in_specs, heads4)
             out = mapped(qr, pool_k, pool_v, tables, pos)
     else:
-        out = call(qr, pool_k, pool_v, tables, pos, k_scales, v_scales)
+        out = call(qr, pool_k, pool_v, tables, pos, k_scales, v_scales,
+                   anc)
     return out.reshape(B, KV, rep, W, D).reshape(B, H, W, D)
 
 
 def xla_reference(q, pool_k, pool_v, tables, pos, k_scales=None,
-                  v_scales=None, scale=None):
+                  v_scales=None, scale=None, anc=None):
     """The XLA gather path on raw arrays — the reference the kernel is
     verified against (the same math the models' step_pages/verify_pages
-    run when the gate is off)."""
+    run when the gate is off).  ``anc`` (B, W) int32 applies the tree
+    ancestor mask (see paged_decode_attention)."""
     B, H, W, D = q.shape
     N, KV, bs, _ = pool_k.shape
     M = tables.shape[-1]
@@ -463,8 +641,15 @@ def xla_reference(q, pool_k, pool_v, tables, pos, k_scales=None,
                    preferred_element_type=jnp.float32)
     k_pos = jnp.arange(M * bs, dtype=jnp.int32)
     w = jnp.arange(rep * W, dtype=jnp.int32) % W
-    valid = (k_pos[None, None, :]
-             <= pos[:, None, None] + w[None, :, None])     # (B, l, t)
+    if anc is not None:
+        bits = jnp.asarray(anc, jnp.int32).reshape(B, W)[:, w]
+        rel = k_pos[None, None, :] - pos[:, None, None]    # (B, 1, t)
+        bit = (bits[:, :, None] >> jnp.clip(rel, 0, 31)) & 1
+        valid = ((rel < 0) | (rel == w[None, :, None])
+                 | ((rel >= 0) & (rel < W) & (bit == 1)))  # (B, l, t)
+    else:
+        valid = (k_pos[None, None, :]
+                 <= pos[:, None, None] + w[None, :, None])  # (B, l, t)
     s = jnp.where(valid[:, None], s, _NEG_INF)
     p = jax.nn.softmax(s, axis=-1)
     o = jnp.einsum("bklt,bktd->bkld", p, values)
@@ -474,7 +659,8 @@ def xla_reference(q, pool_k, pool_v, tables, pos, k_scales=None,
 
 @register_op("paged_decode_attention", differentiable=False)
 def paged_decode_attention_op(q, pool_k, pool_v, tables, pos,
-                              k_scales=None, v_scales=None, scale=None):
+                              k_scales=None, v_scales=None, scale=None,
+                              anc=None):
     return paged_decode_attention(q, pool_k, pool_v, tables, pos,
                                   k_scales=k_scales, v_scales=v_scales,
-                                  scale=scale)
+                                  scale=scale, anc=anc)

@@ -1460,6 +1460,174 @@ def _paged_cache_write_span_pre_q8(pool, scales, new_q, new_s, tables,
 
 
 # ---------------------------------------------------------------------------
+# tree-speculative verify (TreeDrafter windows): the W window lanes hold
+# a TREE of candidate continuations — lane 0 the committed root token,
+# lane w a draft at tree depth depth[w] whose ancestor chain is
+# perm[w, 0..depth[w]] (perm[w, i] = ancestor lane at depth i;
+# perm[w, depth[w]] = w; entries PAST depth[w] pad with w itself).
+# Node w's K/V sits at cache position pos + w (lane order) but is roped
+# at pos + depth[w] (its tree position).  The ops below are the pooled
+# verify attention over such windows and the post-acceptance fix-up
+# that moves the accepted root-to-leaf path into depth order — both
+# built so every surviving element is BIT-identical to the sequential
+# (non-speculative) decode step arrangement.
+# ---------------------------------------------------------------------------
+
+
+@register_op("_internal_tree_verify_attn", differentiable=False)
+def _internal_tree_verify_attn(scores, values, pos, perm, depth, rep=1):
+    """Tree-window verify attention from precomputed scores.
+
+    ``scores`` (B*KV, rep*W, Tmax) are the raw q·kᵀ/√D scores of the
+    window lanes against the FULL cache row (the same batch_dot the
+    linear verify path computes — window score columns arrive in LANE
+    arrangement).  ``values`` (B*KV, Tmax, D) is the float cache-value
+    view.  ``perm`` (B, W, W) / ``depth`` (B, W) describe the trees.
+
+    Per lane w the window score/value columns are PERMUTED into the
+    lane's own root-to-w path order (src[t] = pos + perm[w, t-pos] for
+    window positions, identity elsewhere) — pure data movement, so
+    every element equals the score the sequential decode step at
+    position pos+depth[w] would have computed at that column.  The mask
+    is then the sequential one, t <= pos + depth[w], and the softmax +
+    value contraction run on the SAME primitives (fp32 softmax,
+    matmul at matmul_precision) over the SAME per-row shapes
+    ((rep, Tmax) x (Tmax, D)) as the sequential step — which is what
+    makes accepted-path outputs bit-identical to non-speculative
+    decode.  Masked columns contribute exact-zero products (attn is
+    exactly 0 there), so the garbage they gather is inert.
+
+    Returns (B, W, KV*rep*D) attention output in h = kv*rep + r head
+    order, ready for the output projection."""
+    B, W = perm.shape[0], perm.shape[1]
+    BKV, RW, Tmax = scores.shape
+    KV = BKV // B
+    D = values.shape[-1]
+    p = jnp.asarray(pos, jnp.int32).reshape(-1)              # (B,)
+    t = jnp.arange(Tmax, dtype=jnp.int32)
+    rel = t[None, None, :] - p[:, None, None]                # (B, 1, Tmax)
+    rel = jnp.broadcast_to(rel, (B, W, Tmax))
+    anc = jnp.take_along_axis(jnp.asarray(perm, jnp.int32),
+                              jnp.clip(rel, 0, W - 1), axis=2)
+    inside = (rel >= 0) & (rel < W)
+    src = jnp.where(inside, p[:, None, None] + anc,
+                    t[None, None, :])                        # (B, W, Tmax)
+    src = jnp.clip(src, 0, Tmax - 1)
+    s5 = scores.reshape(B, KV, rep, W, Tmax)
+    s5 = jnp.take_along_axis(s5, src[:, None, None], axis=-1)
+    valid = (t[None, None, :]
+             <= p[:, None, None] + jnp.asarray(depth, jnp.int32)[:, :, None])
+    # inline masked_softmax (contrib) body: fp32, bool mask, cast back
+    x = jnp.where(valid[:, None, None], s5.astype(jnp.float32), -jnp.inf)
+    attn = jax.nn.softmax(x, axis=-1).astype(s5.dtype)
+    v5 = values.reshape(B, KV, 1, Tmax, D)
+    v_seq = jnp.take_along_axis(v5, src[:, None, :, :, None], axis=3)
+    a = attn.transpose(0, 1, 3, 2, 4).reshape(B * KV * W, rep, Tmax)
+    v = v_seq.reshape(B * KV * W, Tmax, D)
+    out = jnp.matmul(a, v, precision=matmul_precision(a, v))
+    return out.reshape(B, KV, W, rep, D).transpose(
+        0, 2, 1, 3, 4).reshape(B, W, KV * rep * D)
+
+
+@register_op("_internal_cache_permute_span", differentiable=False)
+def _internal_cache_permute_span(cache, pos, src_lane):
+    """Post-acceptance tree fix-up: cache row b's entry at position
+    ``pos[b] + src_lane[b, j]`` moves to position ``pos[b] + j`` — the
+    accepted root-to-leaf path (stored in lane order by the verify
+    write) lands in depth order, exactly where sequential decode would
+    have written it.  Gather-before-scatter (functional), so
+    overlapping source/destination windows are safe; ``src_lane[b, j]
+    == j`` rewrites identical bits (exact no-op — the host skips the
+    dispatch entirely when every row is identity); ``src_lane[b, j] <
+    0`` marks lanes to leave untouched (routed to the dropped OOB
+    position)."""
+    B = cache.shape[0]
+    Tmax = cache.shape[2]
+    W = src_lane.shape[1]
+    sl = jnp.asarray(src_lane, jnp.int32)                    # (B, W)
+    p = jnp.asarray(pos, jnp.int32).reshape(-1, 1)           # (B, 1)
+    src = jnp.clip(p + jnp.clip(sl, 0, W - 1), 0, Tmax - 1)
+    rows = jnp.arange(B)[:, None]
+    vals = cache[rows, :, src, :]                            # (B, W, KV, D)
+    dst = p + jnp.arange(W, dtype=jnp.int32)[None, :]
+    dst = jnp.where(sl >= 0, dst, Tmax)   # OOB scatter indices drop
+    return cache.at[rows, :, dst, :].set(vals)
+
+
+@register_op("_internal_cache_permute_span_q8", differentiable=False,
+             num_outputs=2)
+def _internal_cache_permute_span_q8(cache, scales, pos, src_lane):
+    """Quantized twin of _internal_cache_permute_span: payload AND
+    scales move with the same indices — no requantization, so the moved
+    rows keep bit-identical stored content."""
+    B = cache.shape[0]
+    Tmax = cache.shape[2]
+    W = src_lane.shape[1]
+    sl = jnp.asarray(src_lane, jnp.int32)
+    p = jnp.asarray(pos, jnp.int32).reshape(-1, 1)
+    src = jnp.clip(p + jnp.clip(sl, 0, W - 1), 0, Tmax - 1)
+    rows = jnp.arange(B)[:, None]
+    vals = cache[rows, :, src, :]
+    svals = scales[rows, :, src]                             # (B, W, KV)
+    dst = p + jnp.arange(W, dtype=jnp.int32)[None, :]
+    dst = jnp.where(sl >= 0, dst, Tmax)
+    cache = cache.at[rows, :, dst, :].set(vals)
+    scales = scales.at[rows, :, dst].set(svals)
+    return cache, scales
+
+
+@register_op("_paged_cache_permute_span", differentiable=False)
+def _paged_cache_permute_span(pool, tables, pos, src_lane):
+    """Paged twin of _internal_cache_permute_span: the accepted path
+    moves through the block tables (logical position pos[b]+src_lane →
+    pos[b]+j).  Untouched (-1) and off-table lanes route their WRITE to
+    the reserved null page 0, which absorbs garbage by design; reads
+    are clamped on-table (their value is discarded with the write).
+    Distinct live slots own disjoint pages, so the scatter is
+    conflict-free where it matters."""
+    t = jnp.asarray(tables, jnp.int32)                       # (B, M)
+    bs = pool.shape[2]
+    M = t.shape[1]
+    W = src_lane.shape[1]
+    sl = jnp.asarray(src_lane, jnp.int32)
+    p = jnp.asarray(pos, jnp.int32).reshape(-1, 1)
+    src = p + jnp.clip(sl, 0, W - 1)                         # (B, W)
+    src_blk = jnp.take_along_axis(t, jnp.clip(src // bs, 0, M - 1),
+                                  axis=1)
+    vals = pool[src_blk, :, src % bs, :]                     # (B, W, KV, D)
+    dst = p + jnp.arange(W, dtype=jnp.int32)[None, :]
+    dst_blk = jnp.take_along_axis(t, jnp.clip(dst // bs, 0, M - 1),
+                                  axis=1)
+    dst_blk = jnp.where((sl >= 0) & (dst // bs < M), dst_blk, 0)
+    return pool.at[dst_blk, :, dst % bs, :].set(vals)
+
+
+@register_op("_paged_cache_permute_span_q8", differentiable=False,
+             num_outputs=2)
+def _paged_cache_permute_span_q8(pool, scales, tables, pos, src_lane):
+    """Quantized twin of _paged_cache_permute_span: payload + scale
+    pages move with the same indices, no requantization."""
+    t = jnp.asarray(tables, jnp.int32)
+    bs = pool.shape[2]
+    M = t.shape[1]
+    W = src_lane.shape[1]
+    sl = jnp.asarray(src_lane, jnp.int32)
+    p = jnp.asarray(pos, jnp.int32).reshape(-1, 1)
+    src = p + jnp.clip(sl, 0, W - 1)
+    src_blk = jnp.take_along_axis(t, jnp.clip(src // bs, 0, M - 1),
+                                  axis=1)
+    vals = pool[src_blk, :, src % bs, :]
+    svals = scales[src_blk, :, src % bs]                     # (B, W, KV)
+    dst = p + jnp.arange(W, dtype=jnp.int32)[None, :]
+    dst_blk = jnp.take_along_axis(t, jnp.clip(dst // bs, 0, M - 1),
+                                  axis=1)
+    dst_blk = jnp.where((sl >= 0) & (dst // bs < M), dst_blk, 0)
+    pool = pool.at[dst_blk, :, dst % bs, :].set(vals)
+    scales = scales.at[dst_blk, :, dst % bs].set(svals)
+    return pool, scales
+
+
+# ---------------------------------------------------------------------------
 # upstream mx.np internal op names (python/mxnet/numpy calls lower to
 # `_npi_*`-registered kernels in the reference — src/operator/numpy/**).
 # Aliased here ONLY where our canonical op already has exact numpy

@@ -347,6 +347,54 @@ class ShardedDecoder:
                                   NDArray(valid_len))
 
     @staticmethod
+    def _verify_tree_slots_body(block, caches, tokens, pos, valid_len,
+                                perm, depth):
+        """Tree-speculative verification over the slot pool: ``tokens``
+        (B, W) holds a draft TREE in window-lane order (lane 0 = root)
+        and ``perm``/``depth`` carry each lane's root-to-self ancestor
+        chain — one pooled cache read scores every branch (see
+        MultiHeadAttention.verify_slots).  A degenerate chain
+        (perm[b, w, i] = min(i, w), depth[b, w] = w) reproduces the
+        linear verify bit for bit, which is how mixed linear/tree pools
+        share this program."""
+        return block.verify_slots(NDArray(tokens), caches, NDArray(pos),
+                                  NDArray(valid_len),
+                                  tree=(NDArray(perm), NDArray(depth)))
+
+    @staticmethod
+    def _verify_tree_pages_body(block, caches, tokens, tables, pos,
+                                valid_len, perm, depth, anc):
+        """Block-paged tree verification: ``anc`` additionally carries
+        the (B, W) int32 strict-ancestor bitmask the Pallas kernel's
+        tree mask reads via scalar prefetch (see
+        ops/pallas/paged_attention.py)."""
+        return block.verify_pages(NDArray(tokens), caches,
+                                  NDArray(tables), NDArray(pos),
+                                  NDArray(valid_len),
+                                  tree=(NDArray(perm), NDArray(depth),
+                                        NDArray(anc)))
+
+    @staticmethod
+    def _fixup_slots_body(block, caches, pos, src_lane):
+        """Post-acceptance cache fix-up: rewrite rows pos[b]+j from the
+        accepted path's window lanes (``src_lane`` (B, W), -1 beyond
+        the accepted count) so the surviving K/V land in SEQUENTIAL
+        arrangement — a host position fix-up expressed as one in-place
+        gather/scatter, never an allocator op.  src_lane[b, j] >= j
+        always (parents precede children in lane order), so the
+        gather-before-scatter inside the op reads pre-permute rows."""
+        return NDArray(pos), block.permute_cache_span(
+            caches, NDArray(pos), NDArray(src_lane))
+
+    @staticmethod
+    def _fixup_pages_body(block, caches, tables, pos, src_lane):
+        """Paged twin of _fixup_slots_body: the same span permute
+        routed through the block tables (out-of-range destinations fall
+        on the reserved null page 0)."""
+        return NDArray(pos), block.permute_pool_span(
+            caches, NDArray(tables), NDArray(pos), NDArray(src_lane))
+
+    @staticmethod
     def _step_pages_body(block, caches, token, tables, pos):
         """Block-paged pool decode step: ``tables`` (B, M) block tables
         and ``pos`` (B,) positions are both traced — ONE compiled
@@ -523,6 +571,78 @@ class ShardedDecoder:
         param_leaves = tuple(p.data()._data for p in self._params)
         return self._jit_cache[key](param_leaves, cache_leaves, tokens,
                                     tables, pos, valid_len)
+
+    def _verify_tree_slots_jitted(self, cache_leaves, tokens, pos,
+                                  valid_len, perm, depth):
+        """Tree verify over the slot pool: W rides the same power-of-two
+        node ladder as the linear verify, and perm/depth shapes are
+        functions of (B, W) — so this site compiles at most |ladder|
+        programs (the compile_budget bound), shared by every tree SHAPE
+        in the bucket including degenerate linear chains."""
+        key = ("verify_tree_slots",
+               _cache_shapes(cache_leaves),
+               _cache_dt(cache_leaves), tokens.shape, tokens.dtype)
+        hit = key in self._jit_cache
+        self._ledger_report("verify_tree_slots", cache_leaves, (tokens,),
+                            hit)
+        if not hit:
+            self._jit_cache[key] = self._build_program(
+                self._verify_tree_slots_body, cache_leaves,
+                n_extra_inputs=5)
+        param_leaves = tuple(p.data()._data for p in self._params)
+        return self._jit_cache[key](param_leaves, cache_leaves, tokens,
+                                    pos, valid_len, perm, depth)
+
+    def _verify_tree_pages_jitted(self, cache_leaves, tokens, tables,
+                                  pos, valid_len, perm, depth, anc):
+        """Block-paged tree verify (same bounded window-ladder family
+        as _verify_tree_slots_jitted)."""
+        key = ("verify_tree_pages",
+               _cache_shapes(cache_leaves),
+               _cache_dt(cache_leaves), tokens.shape, tokens.dtype,
+               tables.shape, _paged_attn_gate())
+        hit = key in self._jit_cache
+        self._ledger_report("verify_tree_pages", cache_leaves, (tokens,),
+                            hit)
+        if not hit:
+            self._jit_cache[key] = self._build_program(
+                self._verify_tree_pages_body, cache_leaves,
+                n_extra_inputs=7)
+        param_leaves = tuple(p.data()._data for p in self._params)
+        return self._jit_cache[key](param_leaves, cache_leaves, tokens,
+                                    tables, pos, valid_len, perm, depth,
+                                    anc)
+
+    def _fixup_slots_jitted(self, cache_leaves, pos, src_lane):
+        """Accepted-path cache permute over the slot pool (tree verify
+        rollback; one program per (pool shape, W) pair)."""
+        key = ("fixup_slots", _cache_shapes(cache_leaves),
+               _cache_dt(cache_leaves), src_lane.shape)
+        hit = key in self._jit_cache
+        self._ledger_report("fixup_slots", cache_leaves, (src_lane,),
+                            hit)
+        if not hit:
+            self._jit_cache[key] = self._build_program(
+                self._fixup_slots_body, cache_leaves, n_extra_inputs=2)
+        param_leaves = tuple(p.data()._data for p in self._params)
+        _, caches = self._jit_cache[key](param_leaves, cache_leaves,
+                                         pos, src_lane)
+        return caches
+
+    def _fixup_pages_jitted(self, cache_leaves, tables, pos, src_lane):
+        """Paged accepted-path cache permute (see _fixup_slots_jitted)."""
+        key = ("fixup_pages", _cache_shapes(cache_leaves),
+               _cache_dt(cache_leaves), src_lane.shape, tables.shape)
+        hit = key in self._jit_cache
+        self._ledger_report("fixup_pages", cache_leaves, (src_lane,),
+                            hit)
+        if not hit:
+            self._jit_cache[key] = self._build_program(
+                self._fixup_pages_body, cache_leaves, n_extra_inputs=3)
+        param_leaves = tuple(p.data()._data for p in self._params)
+        _, caches = self._jit_cache[key](param_leaves, cache_leaves,
+                                         tables, pos, src_lane)
+        return caches
 
     def _step_pages_jitted(self, cache_leaves, token, tables, pos):
         key = ("step_pages", _cache_shapes(cache_leaves),

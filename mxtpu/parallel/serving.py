@@ -84,6 +84,7 @@ are where per-request failures actually arise.
 
 from __future__ import annotations
 
+import os
 import time
 from typing import Any, Dict, List, Optional
 
@@ -129,18 +130,54 @@ _PAGED_STATS_ALIASES = {
 }
 
 
+def _parse_spec_tree(value):
+    """Normalize a tree-speculation config to ``(max_nodes, branch)``
+    ints: 1 <= max_nodes <= 31 (the 32-lane int32 ancestor-bitmask cap
+    of the paged tree kernel — root lane + 31 draft nodes) and
+    branch >= 1.  Accepts a tuple/list, a bare int (branch defaults to
+    2), or a ``"nodes,branch"`` string (the MXTPU_SPEC_TREE form)."""
+    if isinstance(value, str):
+        parts = [p for p in value.replace(",", " ").split() if p]
+        value = tuple(parts)
+    if isinstance(value, int):
+        value = (value, 2)
+    try:
+        nodes = int(value[0])
+        branch = int(value[1]) if len(value) > 1 else 2
+    except (TypeError, ValueError, IndexError):
+        raise ValueError(
+            "spec_tree must be (max_nodes, branch), a bare node count, "
+            "or a 'nodes,branch' string — got %r" % (value,))
+    if not 1 <= nodes <= 31:
+        raise ValueError(
+            "spec_tree max_nodes must be in [1, 31] (root + 31 draft "
+            "nodes fill the verify kernel's 32-lane int32 ancestor "
+            "bitmask), got %d" % nodes)
+    if branch < 1:
+        raise ValueError(
+            "spec_tree branch must be >= 1, got %d" % branch)
+    return nodes, branch
+
+
+def _ambient_spec_tree():
+    """Engine-default tree config from MXTPU_SPEC_TREE ("nodes,branch";
+    unset/empty = tree speculation off)."""
+    v = os.environ.get("MXTPU_SPEC_TREE", "").strip()
+    return _parse_spec_tree(v) if v else None
+
+
 class Request:
     """One generation request (host-side record)."""
 
     __slots__ = ("rid", "prompt", "max_new_tokens", "temperature",
                  "top_k", "top_p", "repetition_penalty", "seed",
                  "eos_id", "deadline_at", "retries_left", "speculative",
-                 "session")
+                 "session", "spec_tree")
 
     def __init__(self, rid, prompt, max_new_tokens, temperature=0.0,
                  top_k=0, top_p=0.0, repetition_penalty=1.0, seed=None,
                  eos_id=None, deadline_at=None, retries=0,
-                 speculative=None, session=None):
+                 speculative=None, session=None, spec_tree=None):
         self.rid = rid
         self.prompt = prompt            # (1, Tp) int32 numpy
         self.max_new_tokens = int(max_new_tokens)
@@ -154,6 +191,8 @@ class Request:
         self.retries_left = int(retries)
         self.speculative = speculative  # None = engine default
         self.session = session          # paged engine only
+        self.spec_tree = spec_tree      # None = engine default;
+        #                                 False = force linear drafting
 
     @property
     def sampled(self):
@@ -189,6 +228,33 @@ class _SpecTokens:
 
     def __init__(self, toks):
         self.toks = toks
+
+
+class _TreeDraft:
+    """One slot's proposed draft TREE for one verify iteration (host
+    ints; docs/inference.md "Tree speculation").  ``parent[j]`` is the
+    WINDOW LANE of node j's parent (lane 0 carries the committed root
+    token; node j itself rides window lane ``j + 1``), so topological
+    order is ``parent[j] <= j``.  A linear draft [t1..tk] is the
+    degenerate chain parent = [0, 1, .., k-1]."""
+
+    __slots__ = ("toks", "parent")
+
+    def __init__(self, toks, parent):
+        self.toks = [int(t) for t in toks]
+        self.parent = [int(p) for p in parent]
+        if len(self.parent) != len(self.toks):
+            raise ValueError(
+                "tree draft needs one parent lane per node: %d nodes "
+                "vs %d parents" % (len(self.toks), len(self.parent)))
+        for j, p in enumerate(self.parent):
+            if not 0 <= p <= j:
+                raise ValueError(
+                    "tree draft is not topological: node %d (window "
+                    "lane %d) names parent lane %d" % (j, j + 1, p))
+
+    def __len__(self):
+        return len(self.toks)
 
 
 class _Slot:
@@ -243,6 +309,18 @@ class ContinuousBatchingEngine:
         pool instead of the n-gram lookup (the verify side is
         identical).  Requires spec_k >= 1.
     draft_rules : ShardingRules for the draft model (default: ``rules``).
+    spec_tree : optional ``(max_nodes, branch)`` — draft multi-branch
+        TREES instead of single chains and verify every branch in ONE
+        pooled cache read (per-lane ancestor masks; docs/inference.md
+        "Tree speculation").  ``max_nodes`` <= 31 caps the tree (root +
+        31 draft lanes fill the paged kernel's 32-lane int32 ancestor
+        bitmask), ``branch`` caps any node's children.  None reads
+        ``MXTPU_SPEC_TREE`` ("nodes,branch"; unset = off).  Requests
+        opt out per-submit with ``spec_tree=False`` (linear drafting)
+        or override with their own tuple; mixed pools share one verify
+        program — linear windows ride it as degenerate chains.
+        Self-drafting only (exclusive with draft_block); MoE blocks
+        opt out of speculation entirely, tree included.
     ledger_tag : optional per-replica compile-ledger label
         (``serving.step@TAG`` — see ShardedDecoder); a multi-replica
         pool (``mxtpu.serving``) tags each replica so per-replica
@@ -259,7 +337,7 @@ class ContinuousBatchingEngine:
                  history: int = 1024, spec_k: int = 0,
                  spec_ngram: int = 3, draft_block=None,
                  draft_rules: Optional[ShardingRules] = None,
-                 ledger_tag: Optional[str] = None):
+                 ledger_tag: Optional[str] = None, spec_tree=None):
         self._dec = ShardedDecoder(block, mesh, rules, cache_spec,
                                    bucket_prefill,
                                    ledger_tag=ledger_tag)
@@ -298,11 +376,26 @@ class ContinuousBatchingEngine:
         if spec_k < 0:
             raise ValueError("spec_k must be >= 0, got %d" % spec_k)
         self._spec_k = int(spec_k)
+        self._spec_ngram = int(spec_ngram)
+        # -- tree speculation (docs/inference.md "Tree speculation") -----
+        if spec_tree is None:
+            spec_tree = _ambient_spec_tree()
+        self._spec_tree = (None if spec_tree is None
+                           else _parse_spec_tree(spec_tree))
+        if self._spec_tree is not None and draft_block is not None:
+            raise ValueError(
+                "spec_tree drafting is self-drafted (n-gram tree "
+                "lookup) — it cannot be combined with draft_block; "
+                "pick one proposal source")
         # MoE decode routing capacity is a function of the window batch,
         # so a W-token window is not routing-parity-safe — same opt-out
-        # class as prefix sharing / prefill bucketing
-        self._spec_on = self._spec_k > 0 and not self._dec._block_has_moe()
+        # class as prefix sharing / prefill bucketing (linear AND tree)
+        self._spec_on = ((self._spec_k > 0
+                          or self._spec_tree is not None)
+                         and not self._dec._block_has_moe())
         self._drafter = None
+        self._tree_drafters: Dict[Any, Any] = {}  # (nodes, branch) ->
+        #                                           TreeDrafter
         if self._spec_on and draft_block is None:
             from ..models.sampler import NGramDrafter
             self._drafter = NGramDrafter(max_ngram=spec_ngram)
@@ -335,6 +428,8 @@ class ContinuousBatchingEngine:
             self._draft_dec = ddec
         self._drafted_tokens = 0
         self._accepted_tokens = 0
+        self._tree_nodes_drafted = 0   # draft nodes proposed as trees
+        self._tree_paths = 0           # root-to-leaf paths proposed
         self._verify_calls = 0
         self._slot_iterations = 0   # slot-participations in decode
         #                             calls: tokens/slot_iterations is
@@ -400,6 +495,8 @@ class ContinuousBatchingEngine:
             "shed_requests": self._shed,
             "drafted_tokens": self._drafted_tokens,
             "accepted_tokens": self._accepted_tokens,
+            "tree_nodes_drafted": self._tree_nodes_drafted,
+            "tree_paths": self._tree_paths,
             "slot_iterations": self._slot_iterations,
             "draft_hit_rate": (
                 self._accepted_tokens / self._drafted_tokens
@@ -430,7 +527,7 @@ class ContinuousBatchingEngine:
     def submit(self, prompt_ids, max_new_tokens, temperature=0.0,
                top_k=0, top_p=0.0, repetition_penalty=1.0, seed=None,
                eos_id=None, deadline_s=None, retries=0,
-               speculative=None, session=None) -> int:
+               speculative=None, session=None, spec_tree=None) -> int:
         """Queue one request; returns its id.  Sampling knobs follow the
         ``generate`` contract (temperature=0 greedy; seed reproduces).
 
@@ -449,7 +546,19 @@ class ContinuousBatchingEngine:
         "Hierarchical prefix cache") — the finished request's page
         chain stays pinned so the NEXT turn's prompt (this transcript
         plus the new message) prefills only the new suffix; release
-        with ``close_session``."""
+        with ``close_session``.  ``spec_tree``: per-request TREE
+        drafting config — None rides the engine default, False forces
+        linear (single-chain) drafting, a ``(max_nodes, branch)`` tuple
+        overrides; output is bit-identical in every mode
+        (docs/inference.md "Tree speculation")."""
+        if spec_tree is not None and spec_tree is not False:
+            spec_tree = _parse_spec_tree(spec_tree)
+            if not self._spec_on or self._drafter is None:
+                raise ValueError(
+                    "submit(spec_tree=...) needs a self-drafting "
+                    "speculation-enabled engine (spec_k > 0 or "
+                    "spec_tree= at construction, a dense non-MoE "
+                    "block, and no draft_block)")
         if session is not None and not self._supports_sessions:
             raise ValueError(
                 "submit(session=...) needs the paged engine's "
@@ -495,7 +604,8 @@ class ContinuousBatchingEngine:
         self._queue.append(Request(
             rid, prompt, max_new_tokens, temperature, top_k, top_p,
             repetition_penalty, seed, eos_id, deadline_at=deadline_at,
-            retries=retries, speculative=speculative, session=session))
+            retries=retries, speculative=speculative, session=session,
+            spec_tree=spec_tree))
         self._status[rid] = "queued"
         return rid
 
@@ -760,6 +870,40 @@ class ContinuousBatchingEngine:
                    slot.req.max_new_tokens - slot.n_emitted - 1,
                    self._spec_extent(slot) - 1 - slot.pos)
 
+    # -- tree speculation (docs/inference.md "Tree speculation") ---------
+    def _tree_cfg_for(self, req):
+        """Resolved (max_nodes, branch) tree config of one request, or
+        None for linear drafting.  Per-request False opts out; a
+        per-request tuple overrides the engine default; draft-model
+        engines never tree-draft (proposals come from the model)."""
+        if not self._spec_on or self._draft_dec is not None:
+            return None
+        if req.spec_tree is False:
+            return None
+        if req.spec_tree is not None:
+            return req.spec_tree        # validated at submit
+        return self._spec_tree
+
+    def _tree_drafter_for(self, cfg):
+        """The TreeDrafter for one (max_nodes, branch) config (cached —
+        drafters are stateless, one per distinct config ever seen)."""
+        d = self._tree_drafters.get(cfg)
+        if d is None:
+            from ..models.sampler import TreeDrafter
+            d = TreeDrafter(max_nodes=cfg[0], branch=cfg[1],
+                            max_ngram=self._spec_ngram)
+            self._tree_drafters[cfg] = d
+        return d
+
+    def _tree_budget(self, slot, nodes):
+        """Per-slot tree NODE budget this iteration: the same remaining-
+        tokens / cache-extent clamps as _spec_budget (the deepest
+        accepted path emits at most depth+1 <= nodes+1 tokens, and the
+        widest window lane writes at pos + nodes)."""
+        return min(nodes,
+                   slot.req.max_new_tokens - slot.n_emitted - 1,
+                   self._spec_extent(slot) - 1 - slot.pos)
+
     def _draft_phase(self, active):
         """Collect draft proposals for every speculating active slot
         ({row: [tokens]}).  The ``serving.draft`` fault site fires per
@@ -787,6 +931,21 @@ class ContinuousBatchingEngine:
         for i in list(spec_rows):
             s = self._slots[i]
             try:
+                cfg = self._tree_cfg_for(s.req)
+                if cfg is not None:
+                    n = self._tree_budget(s, cfg[0])
+                    toks, par = [], []
+                    if n > 0:
+                        toks, par, _ = self._tree_drafter_for(
+                            cfg).propose_tree(s.history, n, n)
+                    if toks:
+                        d = _TreeDraft(toks, par)
+                        self._tree_nodes_drafted += len(toks)
+                        # leaves = nodes no other node names as parent
+                        self._tree_paths += len(toks) - len(
+                            {p for p in d.parent if p > 0})
+                        out[i] = d
+                    continue
                 k = self._spec_budget(s)
                 d = self._drafter.propose(s.history, k) if k > 0 else []
             except Exception as exc:
@@ -869,10 +1028,14 @@ class ContinuousBatchingEngine:
             for i, d in sorted(drafts.items()):
                 self._emit("engine.draft", self._slots[i].req.rid,
                            proposed=len(d))
-        if drafts:
-            self._decode_verify(active, drafts, sample_next_token)
-        else:
+        if not drafts:
             self._decode_plain(active, sample_next_token)
+        elif any(isinstance(d, _TreeDraft) for d in drafts.values()):
+            # one TREE verify serves the whole pool: linear windows
+            # ride the same program as degenerate chains
+            self._decode_verify_tree(active, drafts, sample_next_token)
+        else:
+            self._decode_verify(active, drafts, sample_next_token)
 
     def _decode_plain(self, active, sample_next_token):
         """The non-speculative pooled step (the original decode tail);
@@ -994,6 +1157,245 @@ class ContinuousBatchingEngine:
                     or (s.req.eos_id is not None
                         and int(toks[-1]) == s.req.eos_id)):
                 self._finish(i, s.req, s.emitted, s.row)
+
+    def _decode_verify_tree(self, active, drafts, sample_next_token):
+        """TREE-speculative iteration: ONE compiled verify call scores
+        every row's candidate tree — the committed root token on window
+        lane 0, draft node j on lane j+1, each lane attending only its
+        own root-to-node path (per-lane ancestor sets; the paged kernel
+        consumes them as an int32 bitmask).  Candidate draws use
+        EXACTLY the key / penalty state sequential decode would use at
+        the lane's DEPTH along its own path, and each row advances by
+        its deepest fully matched root path + 1.  Sibling tokens are
+        distinct (TreeDrafter dedups them), so at most one child of any
+        node can match its parent's candidate draw — the accepted lanes
+        form a single chain and every stream stays bit-identical to
+        non-speculative decode (docs/inference.md "Tree speculation").
+        A row whose accepted path took a side branch re-packs those
+        lanes' K/V into sequential cache positions with ONE compiled
+        gather/scatter fix-up; rejection rollback stays a host position
+        fix-up exactly like linear speculation.  Linear drafts ride the
+        same call as degenerate chains, so mixed pools share one verify
+        program per window bucket.  The ``serving.verify`` fault site
+        fires per participating slot (keyed by rid) before the pooled
+        call."""
+        B = self._num_slots
+        for i in list(active):
+            try:
+                _inject("serving.verify", key=self._slots[i].req.rid)
+            except Exception as exc:
+                self._quarantine(i, exc, "serving.verify")
+                active.remove(i)
+                drafts.pop(i, None)
+        if not active:
+            return
+        jmax = max((len(d) for d in drafts.values()), default=0)
+        if jmax == 0:
+            self._decode_plain(active, sample_next_token)
+            return
+        # window width from the same power-of-two ladder as the linear
+        # verify: the tree program family stays <= |ladder| too
+        W = _bucket(jmax + 1, base=2)
+        state = self._decode_state(active)
+        dr = onp.zeros((B, W - 1), onp.int32)
+        vl = onp.zeros((B,), onp.int32)
+        # degenerate-chain defaults: padding lanes continue a chain off
+        # the previous lane, so every row's table is topologically
+        # well-formed however few nodes it drafted (invalid lanes are
+        # forced unmatched below and their writes sit behind valid_len)
+        parent = onp.maximum(
+            onp.arange(W, dtype=onp.int32) - 1, 0) * onp.ones(
+            (B, 1), onp.int32)
+        nreal = 0
+        for i in active:
+            s = self._slots[i]
+            d = drafts.get(i)
+            if d is None:
+                vl[i] = 1
+                continue
+            if isinstance(d, _TreeDraft):
+                toks, par = d.toks, d.parent
+            else:  # linear draft -> degenerate chain
+                toks, par = list(d), list(range(len(d)))
+            n = min(len(toks), W - 1)
+            vl[i] = 1 + n
+            dr[i, :n] = toks[:n]
+            parent[i, 1:n + 1] = par[:n]
+            nreal += n
+        # per-lane path tables from the parent lanes (host, W <= 32):
+        # depth[b,w] = |root path| - 1, anc[b,w] = strict-ancestor lane
+        # bitmask (the paged kernel's scalar-prefetch operand), and
+        # perm[b,w] = the root path in depth order padded with w itself
+        # (so gathering window tokens at perm[w] yields "ancestors and
+        # self" — idempotent repeats, exactly what the per-lane penalty
+        # masks and acceptance test want)
+        depth = onp.zeros((B, W), onp.int32)
+        anc = onp.zeros((B, W), onp.int32)
+        perm = onp.zeros((B, W, W), onp.int32)
+        for b in range(B):
+            pb, db, ab, qb = parent[b], depth[b], anc[b], perm[b]
+            for w in range(1, W):
+                p = int(pb[w])
+                dw = int(db[p]) + 1
+                db[w] = dw
+                ab[w] = ab[p] | (1 << p)
+                qb[w, :dw] = qb[p, :dw]
+                qb[w, dw:] = w
+        window = jnp.concatenate(
+            [self._last_tokens.reshape(-1, 1).astype(jnp.int32),
+             jnp.asarray(dr)], axis=1)                # (B, W)
+        logits = self._run_verify_tree(state, window, vl, perm, depth,
+                                       anc)           # (B, W, V)
+        M = self._sample_window_tree(logits, active, window, W, perm,
+                                     depth, sample_next_token)
+        # acceptance: lane w matches when its token equals the draw at
+        # its PARENT lane; a lane is accepted when its whole root path
+        # (ancestors and itself) matched.  perm gathers exactly that
+        # set, and path_lane[j] recovers the accepted chain's lane at
+        # emit position j (one accepted lane per depth — sibling
+        # uniqueness makes the sum a selection, never a collision).
+        par_d = jnp.asarray(parent)
+        dep_d = jnp.asarray(depth)
+        vld = jnp.asarray(vl)
+        lane = jnp.arange(W)
+        matched = ((window == jnp.take_along_axis(M, par_d, axis=1))
+                   & (lane[None, :] < vld[:, None])).at[:, 0].set(True)
+        accepted = jnp.all(jnp.take_along_axis(
+            matched, jnp.asarray(perm).reshape(B, -1),
+            axis=1).reshape(B, W, W), axis=2)         # (B, W)
+        counts = jnp.max((dep_d + 1) * accepted.astype(jnp.int32),
+                         axis=1)                      # (B,) emitted
+        path_lane = jnp.sum(
+            ((dep_d[:, :, None] == lane[None, None, :])
+             & accepted[:, :, None]) * lane[None, :, None],
+            axis=1).astype(jnp.int32)                 # (B, W)
+        path_M = jnp.take_along_axis(M, path_lane, axis=1)
+        self._last_tokens = jnp.take_along_axis(
+            path_M, jnp.clip(counts - 1, 0, W - 1)[:, None],
+            axis=1)[:, 0].astype(jnp.int32)
+        self._update_seen_window(active, path_M, counts, W)
+        # ONE pooled host sync: accept counts + the emitted path tokens
+        # AND the lanes they came from (the fix-up source map)
+        counts_h, pathM_h, lane_h = (
+            onp.asarray(x) for x in jax.device_get(
+                (counts, path_M, path_lane)))
+        self._steps += 1
+        self._verify_calls += 1
+        self._drafted_tokens += nreal
+        self._slot_iterations += len(active)
+        trace_on = _tracer().active
+        src = onp.full((B, W), -1, onp.int32)
+        need_fix = False
+        finish = []
+        for i in active:
+            s = self._slots[i]
+            m = int(counts_h[i])
+            toks = pathM_h[i, :m]
+            if s.req.eos_id is not None:
+                hits = onp.nonzero(toks == s.req.eos_id)[0]
+                if hits.size:  # stop AT eos, exactly like sequential
+                    m = int(hits[0]) + 1
+                    toks = toks[:m]
+            if trace_on:
+                self._emit("engine.verify", s.req.rid,
+                           drafted=int(vl[i]) - 1, accepted=m - 1,
+                           tree=isinstance(drafts.get(i), _TreeDraft))
+            self._accepted_tokens += m - 1
+            self._tokens_generated += m
+            s.pos += m
+            s.n_emitted += m
+            if s.keys is not None:
+                s.keys.advance(m)  # commit exactly the emitted draws
+            if s.history is not None:
+                s.history.extend(int(t) for t in toks)
+            s.emitted.append(_SpecTokens(toks.copy()))
+            if (s.n_emitted >= s.req.max_new_tokens
+                    or (s.req.eos_id is not None
+                        and int(toks[-1]) == s.req.eos_id)):
+                finish.append(i)
+            elif any(int(lane_h[i, j]) != j for j in range(m)):
+                # the accepted path took a side branch: cache position
+                # pos+j must hold lane path[j]'s K/V before the next
+                # step reads it (finished rows skip the re-pack — their
+                # rows/pages are released either way)
+                src[i, :m] = lane_h[i, :m]
+                need_fix = True
+        if need_fix:
+            self._run_fixup(state, src)
+        for i in finish:
+            s = self._slots[i]
+            self._finish(i, s.req, s.emitted, s.row)
+
+    def _run_verify_tree(self, state, window, valid_len, perm, depth,
+                         anc):
+        logits, self._pool = self._dec._verify_tree_slots_jitted(
+            self._pool, window, jnp.asarray(state),
+            jnp.asarray(valid_len), jnp.asarray(perm),
+            jnp.asarray(depth))
+        return logits
+
+    def _run_fixup(self, state, src_lane):
+        self._pool = self._dec._fixup_slots_jitted(
+            self._pool, jnp.asarray(state), jnp.asarray(src_lane))
+
+    def _sample_window_tree(self, logits, active, window, W, perm,
+                            depth, sample_next_token):
+        """Candidate draws for every TREE lane: lane w of row b samples
+        from logits[b, w] with EXACTLY the key / penalty state
+        sequential decode would use after emitting the lane's root
+        path — key = the slot's depth[b,w]-th future draw; penalty mask
+        = base seen + the path's window tokens (gathered at perm[b,w],
+        self included — the tree form of "window drafts 1..w"; the root
+        token is already in the base mask, so its repeat is
+        idempotent).  Degenerate chains reproduce _sample_window's
+        masks and keys value-for-value, which is what lets mixed pools
+        share this call bit-identically."""
+        B = self._num_slots
+        V = logits.shape[-1]
+        self._ensure_seen(V)
+        groups: Dict[Any, List[int]] = {}
+        for i in active:
+            groups.setdefault(self._slots[i].req.sample_config,
+                              []).append(i)
+        pen = [i for i in active if self._slots[i].req.penalized]
+        seen_w = [self._seen] * W
+        if pen:
+            pr = onp.zeros((B,), bool)
+            pr[pen] = True
+            pr = jnp.asarray(pr)
+            rows = jnp.arange(B)[:, None]
+            perm_d = jnp.asarray(perm)
+            seen_w = []
+            for w in range(W):
+                toks_w = jnp.take_along_axis(window, perm_d[:, w, :],
+                                             axis=1)       # (B, W)
+                upd = self._seen.at[rows, toks_w].set(True)
+                seen_w.append(jnp.where(pr[:, None], upd, self._seen))
+        cols: List[Any] = [None] * W
+        for (temp, top_k, top_p, rep), members in groups.items():
+            mask = onp.zeros((B,), bool)
+            mask[members] = True
+            mask = jnp.asarray(mask)
+            keys_w = None
+            if temp > 0.0:
+                dummy = jax.random.key(0)
+                keys_w = []
+                for w in range(W):
+                    per_row = [
+                        self._slots[i].keys.peek_key(int(depth[i, w]))
+                        if i in members and self._slots[i].keys
+                        else dummy for i in range(B)]
+                    keys_w.append(jax.random.wrap_key_data(jnp.stack(
+                        [jax.random.key_data(k) for k in per_row])))
+            for w in range(W):
+                out = sample_next_token(
+                    logits[:, w], keys_w[w] if keys_w else None,
+                    temp, top_k, top_p, rep,
+                    seen_mask=seen_w[w] if rep != 1.0 else None,
+                    active_mask=mask)
+                cols[w] = out if cols[w] is None \
+                    else jnp.where(mask, out, cols[w])
+        return jnp.stack(cols, axis=1).astype(jnp.int32)
 
     def _sample_window(self, logits, active, window, W,
                        sample_next_token):
@@ -1376,12 +1778,12 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
                  draft_rules: Optional[ShardingRules] = None,
                  pin_bytes=None, host_cache_bytes=None,
                  overlap_swaps: bool = False,
-                 ledger_tag: Optional[str] = None):
+                 ledger_tag: Optional[str] = None, spec_tree=None):
         super().__init__(block, mesh, rules, num_slots, max_length,
                          cache_dtype, cache_spec, bucket_prefill,
                          max_pending, clock, history, spec_k,
                          spec_ngram, draft_block, draft_rules,
-                         ledger_tag=ledger_tag)
+                         ledger_tag=ledger_tag, spec_tree=spec_tree)
         bs = int(block_size)
         chunk = int(prefill_chunk)
         if bs < 1:
@@ -1820,7 +2222,7 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
     def submit(self, prompt_ids, max_new_tokens, temperature=0.0,
                top_k=0, top_p=0.0, repetition_penalty=1.0, seed=None,
                eos_id=None, deadline_s=None, retries=0,
-               speculative=None, session=None) -> int:
+               speculative=None, session=None, spec_tree=None) -> int:
         """Same contract as the slot engine's submit(); additionally a
         request whose worst-case page need exceeds the WHOLE pool can
         never be admitted and sheds immediately with LoadShedError
@@ -1861,7 +2263,7 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
         rid = super().submit(pids, max_new_tokens, temperature, top_k,
                              top_p, repetition_penalty, seed, eos_id,
                              deadline_s, retries, speculative,
-                             session=session)
+                             session=session, spec_tree=spec_tree)
         if session is not None:
             self._sessions[session] = \
                 self._sessions.get(session, 0) + 1
@@ -2066,6 +2468,21 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
             self._pool, window, jnp.asarray(tables), jnp.asarray(pos),
             jnp.asarray(valid_len))
         return logits
+
+    def _run_verify_tree(self, state, window, valid_len, perm, depth,
+                         anc):
+        pos, tables = state
+        logits, self._pool = self._dec._verify_tree_pages_jitted(
+            self._pool, window, jnp.asarray(tables), jnp.asarray(pos),
+            jnp.asarray(valid_len), jnp.asarray(perm),
+            jnp.asarray(depth), jnp.asarray(anc))
+        return logits
+
+    def _run_fixup(self, state, src_lane):
+        pos, tables = state
+        self._pool = self._dec._fixup_pages_jitted(
+            self._pool, jnp.asarray(tables), jnp.asarray(pos),
+            jnp.asarray(src_lane))
 
     # -- one scheduler iteration ----------------------------------------
     def _step_impl(self):

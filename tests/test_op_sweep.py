@@ -447,6 +447,28 @@ SKIP = {
                 "contract (grad_scale, incoming cotangent ignored): "
                 "autodiff deliberately diverges from the numeric "
                 "jacobian; semantics in tests/test_legacy_vision_ops.py",
+    "_internal_tree_verify_attn": "tree-verify attention over a pooled "
+                                  "slot cache with per-lane ancestor "
+                                  "bitmasks; bit-exact stream parity + "
+                                  "kernel parity covered by tests/"
+                                  "test_tree_speculative.py and tests/"
+                                  "test_paged_attention_pallas.py",
+    "_internal_cache_permute_span": "side-branch cache fix-up (permute "
+                                    "accepted lanes into place) for the "
+                                    "slot engine; covered by tests/"
+                                    "test_tree_speculative.py bit-exact "
+                                    "parity + fixup program counts",
+    "_internal_cache_permute_span_q8": "int8 variant of the slot fix-up; "
+                                       "same coverage (cache_dtype grid "
+                                       "in tests/test_tree_speculative"
+                                       ".py)",
+    "_paged_cache_permute_span": "side-branch cache fix-up for the paged "
+                                 "engine; covered by tests/"
+                                 "test_tree_speculative.py paged parity "
+                                 "+ fixup program counts",
+    "_paged_cache_permute_span_q8": "int8 variant of the paged fix-up; "
+                                    "same coverage (cache_dtype grid in "
+                                    "tests/test_tree_speculative.py)",
 }
 
 

@@ -107,9 +107,63 @@ def test_kernel_bf16_queries():
     _check(*_setup(dtype="bfloat16"), rtol=2e-2, atol=2e-2)
 
 
+# ------------------------------------------------- tree ancestor masks
+
+def _chain_anc(B, W):
+    """Degenerate linear chain: lane w's strict ancestors are lanes
+    0..w-1 -> bitmask (1 << w) - 1."""
+    return np.tile(((1 << np.arange(W)) - 1).astype(np.int32), (B, 1))
+
+
+@pytest.mark.parametrize("quant", [False, True])
+def test_kernel_tree_ancestor_mask_matches_xla(quant):
+    """Tree verify: lane w attends its own root path only (committed
+    positions + ancestor lanes + itself, via the per-lane strict-
+    ancestor bitmask), including windows crossing page boundaries."""
+    q, pk, pv, tables, pos, kw = _setup(
+        W=4, B=4, pos=np.array([0, 6, 7, 20]), quant=quant)
+    anc = jnp.asarray(pa._model_anc(4, 4, branch=2))
+    tol = dict(rtol=1e-3, atol=1e-3) if quant else {}
+    out = paged_decode_attention(q, pk, pv, tables, pos, anc=anc, **kw)
+    ref = xla_reference(q, pk, pv, tables, pos, anc=anc, **kw)
+    np.testing.assert_allclose(np.asarray(out, dtype="float32"),
+                               np.asarray(ref, dtype="float32"),
+                               **(tol or dict(rtol=1e-4, atol=1e-5)))
+
+
+def test_kernel_tree_degenerate_chain_is_bitwise_linear():
+    """A chain ancestor table reproduces the triangular <= pos + w
+    window mask BIT-FOR-BIT on the kernel AND the XLA reference — the
+    identity that lets mixed linear/tree pools share one verify
+    program."""
+    q, pk, pv, tables, pos, kw = _setup(W=4, B=4,
+                                        pos=np.array([0, 6, 7, 20]))
+    anc = jnp.asarray(_chain_anc(4, 4))
+    np.testing.assert_array_equal(
+        np.asarray(paged_decode_attention(q, pk, pv, tables, pos,
+                                          anc=anc, **kw)),
+        np.asarray(paged_decode_attention(q, pk, pv, tables, pos,
+                                          **kw)))
+    np.testing.assert_array_equal(
+        np.asarray(xla_reference(q, pk, pv, tables, pos, anc=anc,
+                                 **kw)),
+        np.asarray(xla_reference(q, pk, pv, tables, pos, **kw)))
+
+
+def test_kernel_tree_window_past_bitmask_cap_raises_k004():
+    """W > 32 cannot be expressed in the int32 ancestor bitmask — the
+    call raises the K004 geometry rule even in interpret mode (it is a
+    correctness bound, not a TPU lowering rule)."""
+    q, pk, pv, tables, pos, kw = _setup(W=40, M=8,
+                                        pos=np.zeros(3, np.int32))
+    anc = jnp.asarray(np.zeros((3, 40), np.int32))
+    with pytest.raises(ValueError, match="K004"):
+        paged_decode_attention(q, pk, pv, tables, pos, anc=anc, **kw)
+
+
 # ------------------------------------------------- engine integration
 
-def _drive(cache_dtype, spec_k=0):
+def _drive(cache_dtype, spec_k=0, spec_tree=None):
     from mxtpu.models.transformer import (TransformerLM,
                                           transformer_lm_sharding_rules)
     from mxtpu.parallel import PagedContinuousBatchingEngine
@@ -122,7 +176,7 @@ def _drive(cache_dtype, spec_k=0):
     eng = PagedContinuousBatchingEngine(
         lm, DeviceMesh(dp=1), transformer_lm_sharding_rules(),
         num_slots=2, max_length=64, block_size=8, prefill_chunk=8,
-        cache_dtype=cache_dtype, spec_k=spec_k)
+        cache_dtype=cache_dtype, spec_k=spec_k, spec_tree=spec_tree)
     rng = np.random.RandomState(0)
     pat = rng.randint(0, 20, (1, 4))
     r1 = eng.submit(nd.array(np.tile(pat, 4).astype(np.int32)), 12)
@@ -162,6 +216,30 @@ def test_verify_pages_rides_kernel_when_gated(monkeypatch):
     got, st = _drive("int8", spec_k=3)
     assert pa.invocation_count() > before
     assert st["accepted_tokens"] > 0
+    for w, g in zip(want, got):
+        assert np.array_equal(w, g)
+
+
+@pytest.mark.slow
+def test_tree_verify_rides_kernel_when_gated(monkeypatch):
+    """TREE verify rides the kernel too (the ancestor bitmask flows in
+    as a fourth scalar-prefetch operand) — trees really accept and the
+    streams match the ungated XLA-path run bit-for-bit.
+
+    slow (round 23, tier-1 wall-time budget — the round-16 pattern of
+    test_verify_pages_rides_kernel_when_gated): kernel-vs-XLA TREE
+    parity stays in tier-1 via the ancestor-mask unit matrix above
+    (test_kernel_tree_ancestor_mask_matches_xla + the degenerate-chain
+    bitwise identity), and the gated engine integration via
+    test_step_pages_rides_kernel_when_gated."""
+    want, st0 = _drive("int8", spec_tree=(6, 2))
+    assert st0["tree_nodes_drafted"] > 0
+    assert st0["accepted_tokens"] > 0
+    monkeypatch.setenv("MXTPU_PALLAS_PAGED_ATTN", "1")
+    before = pa.invocation_count()
+    got, st = _drive("int8", spec_tree=(6, 2))
+    assert pa.invocation_count() > before
+    assert st["tree_nodes_drafted"] > 0
     for w, g in zip(want, got):
         assert np.array_equal(w, g)
 

@@ -225,6 +225,31 @@ def check_speculative():
                    and st["verify_calls"] > 0 and rate > 1.0)
         print("probe        :", "ok (accepts + >1.0 tokens/slot-iter)"
               if healthy else "UNEXPECTED counters %r" % (st,))
+
+        # TREE arm: a branchy prompt (trailing n-gram recurs with two
+        # continuations) through spec_tree drafting — ancestor-masked
+        # verify + side-branch fix-up on the same micro model
+        teng = ContinuousBatchingEngine(
+            lm, DeviceMesh(dp=1), transformer_lm_sharding_rules(),
+            num_slots=2, max_length=64, spec_tree=(6, 2))
+        teng.submit(nd.array(np.array(
+            [[1, 2, 3, 1, 2, 4, 1, 2, 3, 1, 2]], np.int32)), 16)
+        teng.submit(nd.array(np.array(
+            [[5, 6, 7, 5, 6, 8, 5, 6, 7, 5, 6]], np.int32)), 14)
+        teng.run()
+        ts = teng.stats
+        trate = (ts["generated_tokens"] / ts["slot_iterations"]
+                 if ts["slot_iterations"] else 0.0)
+        print("tree         : %d nodes drafted over %d paths, "
+              "%d accepted, %.2f tokens/slot-iteration"
+              % (ts["tree_nodes_drafted"], ts["tree_paths"],
+                 ts["accepted_tokens"], trate))
+        thealthy = (ts["tree_nodes_drafted"] > 0 and ts["tree_paths"] > 0
+                    and ts["accepted_tokens"] > 0
+                    and "verify_tree_slots" in ts["compiled_programs"])
+        print("tree probe   :", "ok (tree drafts + ancestor-masked "
+              "verify accepts)"
+              if thealthy else "UNEXPECTED counters %r" % (ts,))
     except Exception as e:
         print("speculative  : FAILED (%s: %s)" % (type(e).__name__, e))
     check_quantized()
