@@ -460,14 +460,28 @@ def _lint_release_paths(tree: ast.AST, filename: str, report: Report
                         "release helper _release_row()",
                         location="%s:%d" % (filename, m.lineno))
         # (c) a transport implementation's drain must drop both cache
-        # tiers (stub bodies — docstring + raise — are the protocol)
+        # tiers (stub bodies — docstring + raise — are the protocol).
+        # A cross-process transport discharges the obligation at the
+        # seam instead: the worker-side adapter's drain runs drop_cache
+        # (reached via an ``_rpc("drain")`` call), and on the failure
+        # path ``_kill_worker`` ends the address space holding the
+        # pages — either delegation is as page-zero as a local drop.
         if "drain" in methods and "cancel" in methods:
             m = methods["drain"]
             real = [s for s in m.body
                     if not (isinstance(s, ast.Expr)
                             and isinstance(s.value, ast.Constant))]
+            calls = _calls_in(m)
+            delegated = "_kill_worker" in calls or any(
+                isinstance(c, ast.Call)
+                and isinstance(c.func, ast.Attribute)
+                and c.func.attr == "_rpc"
+                and c.args
+                and isinstance(c.args[0], ast.Constant)
+                and c.args[0].value == "drain"
+                for c in ast.walk(m))
             if real and not all(isinstance(s, ast.Raise) for s in real) \
-                    and "drop_cache" not in _calls_in(m):
+                    and "drop_cache" not in calls and not delegated:
                 report.add(
                     _PASS, "V006", Severity.ERROR,
                     "%s.drain" % cls.name,

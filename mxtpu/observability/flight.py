@@ -58,17 +58,22 @@ def default_buffer() -> int:
 class Postmortem:
     """One failure snapshot: the trigger (kind, tick, context, counters
     delta) captured at failure time plus the implicated request ids
-    whose timelines materialize from the ring buffers at read time."""
+    whose timelines materialize from the ring buffers at read time.
+    ``noise`` is the non-deterministic side channel (worker pids, wall
+    clocks) — readable on the object and under ``include_noise=True``,
+    excluded from the deterministic serialization like event noise."""
 
-    __slots__ = ("kind", "tick", "rids", "context", "counters")
+    __slots__ = ("kind", "tick", "rids", "context", "counters", "noise")
 
     def __init__(self, kind: str, tick: int, rids: Tuple[str, ...],
-                 context: Dict[str, Any], counters: Dict[str, int]):
+                 context: Dict[str, Any], counters: Dict[str, int],
+                 noise: Optional[Dict[str, Any]] = None):
         self.kind = kind
         self.tick = tick
         self.rids = rids
         self.context = context
         self.counters = counters
+        self.noise = dict(noise or {})
 
     def __repr__(self):
         return "<Postmortem %s tick=%d rids=%r>" % (
@@ -160,12 +165,16 @@ class FlightRecorder:
             return {}
         return mod.counters()
 
-    def failure(self, kind: str, rids=(), **context) -> Optional[Postmortem]:
+    def failure(self, kind: str, rids=(), noise=None,
+                **context) -> Optional[Postmortem]:
         """Record one postmortem (no-op while inactive).  ``rids`` are
         correlation ids (resolved through the tracer's alias map);
         ``context`` must be JSON-able, deterministic host data —
         replica ids, site names, error TYPE names (never wall clocks or
-        memory addresses)."""
+        memory addresses).  Non-deterministic facts worth keeping (a
+        dead worker's pid) go in ``noise=``: present on the Postmortem
+        and under ``include_noise=True``, excluded from the
+        deterministic serialization."""
         if not self._attached:
             return None
         tr = get_tracer()
@@ -178,7 +187,8 @@ class FlightRecorder:
             tick=tr.ticks,
             rids=tuple(tr.resolve(r) for r in rids),
             context=dict(context),
-            counters=delta)
+            counters=delta,
+            noise=noise)
         if len(self._posts) >= MAX_POSTMORTEMS:
             self._posts.pop(0)
         self._posts.append(pm)
@@ -201,7 +211,7 @@ class FlightRecorder:
         context + counters delta + each implicated request's CURRENT
         ring-buffered timeline (read-time materialization — see module
         docstring)."""
-        return {
+        rec = {
             "kind": pm.kind,
             "tick": pm.tick,
             "context": pm.context,
@@ -211,6 +221,9 @@ class FlightRecorder:
                       for e in self.timeline(rid)]
                 for rid in pm.rids},
         }
+        if include_noise and pm.noise:
+            rec["noise"] = pm.noise
+        return rec
 
     def stats(self) -> Dict[str, int]:
         """Numeric summary (a MetricsRegistry source)."""

@@ -83,6 +83,15 @@ EVENT_TYPES: Dict[str, str] = {
                        "replica, load)",
     "transport.submit": "spec handed to a replica engine (aliases the "
                         "engine rid to the gateway rid)",
+    "transport.worker_spawn": "subprocess replica worker started and "
+                              "completed its init handshake (pid is "
+                              "noise — see docs/serving.md)",
+    "transport.worker_exit": "subprocess replica worker left the pool "
+                             "(graceful shutdown, kill, or reaped "
+                             "death; exit code when reapable)",
+    "transport.rpc_timeout": "a replica RPC exhausted its tick budget "
+                             "(method, ticks) — counted toward "
+                             "replica death as a transport failure",
     "replica.death": "supervisor declared a replica dead "
                      "(drain-and-requeue)",
     "replica.revive": "probation over — replica re-admitted",
@@ -136,6 +145,11 @@ EVENT_TYPES: Dict[str, str] = {
     "fault.router.dispatch": "injected fault fired at router.dispatch",
     "fault.replica.health": "injected fault fired at replica.health",
     "fault.replica.stream": "injected fault fired at replica.stream",
+    "fault.transport.rpc": "injected fault fired at transport.rpc",
+    "fault.transport.encode":
+        "injected fault fired at transport.encode",
+    "fault.transport.worker_death":
+        "injected fault fired at transport.worker_death",
     "fault.kvstore.reduce": "injected fault fired at kvstore.reduce",
     "fault.checkpoint.save": "injected fault fired at checkpoint.save",
     "fault.engine.flush": "injected fault fired at engine.flush",

@@ -38,6 +38,7 @@ __all__ = [
     "FaultPlan", "FaultRule", "InjectedFault", "fault_plan", "inject",
     "active_plan", "site_stats", "reload_env_plan", "SITES",
     "RetryPolicy", "LoadShedError", "QosShedError", "EngineShedError",
+    "TransportError", "TransportTimeoutError", "WorkerDiedError",
     "bump", "counters", "reset_counters",
     "CheckpointSet", "CorruptCheckpointError", "write_verified",
     "verify", "verify_dir", "rotate_history",
@@ -81,6 +82,48 @@ class QosShedError(LoadShedError):
     or a per-tenant quota tripped) while the engines below may be
     perfectly healthy.  Back off ``retry_after_ticks`` and resubmit
     (possibly at a higher class); see ``mxtpu.serving.Gateway``."""
+
+
+class TransportError(MXTPUError):
+    """A replica RPC failed at the TRANSPORT layer — the pipe broke,
+    the frame was malformed, or the worker answered garbage — as
+    opposed to the replica's engine raising a (marshalled) error of its
+    own.  A replica-level signal: the supervisor counts it toward the
+    same consecutive-failure death as a failed health probe, and its
+    death reason says "transport", never "stalled" (a worker that
+    cannot answer is not a worker that stopped decoding)."""
+
+
+class TransportTimeoutError(TransportError):
+    """A replica RPC exhausted its tick budget (``rpc_timeout_ticks``
+    waiter rounds — see ``mxtpu.serving.SubprocessReplica``) without a
+    response.  Structured context:
+
+    - ``method``: the RPC that timed out;
+    - ``ticks``: the budget that was exhausted.
+
+    A TRANSIENT timeout is recoverable — the transport discards the
+    late response by frame id when it eventually arrives — but the
+    supervisor still counts each one toward declared death."""
+
+    def __init__(self, message, method=None, ticks=None):
+        super().__init__(message)
+        self.method = method
+        self.ticks = ticks
+
+
+class WorkerDiedError(TransportError):
+    """The worker PROCESS behind a subprocess replica is gone — EOF on
+    the RPC pipe or a reaped exit — so no RPC can ever complete.
+    Terminal for the replica: the supervisor's death path drains the
+    parent-side tag mirror and requeues every held request (the worker
+    's pages died with its address space).  ``exit_code`` is the
+    process's ``returncode`` when it was reapable (e.g. ``-9`` after a
+    SIGKILL), else None."""
+
+    def __init__(self, message, exit_code=None):
+        super().__init__(message)
+        self.exit_code = exit_code
 
 
 class EngineShedError(LoadShedError):

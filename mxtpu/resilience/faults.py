@@ -73,6 +73,26 @@ site                fires at
                     polled (``InProcessReplica.poll``) — a raise models
                     a broken token stream and counts toward the same
                     consecutive-failure death as ``replica.health``
+``transport.rpc``   start of EVERY RPC a subprocess replica issues
+                    (``mxtpu.serving.SubprocessReplica._rpc``), keyed
+                    by replica id, before the request frame is written
+                    — a raise models a broken pipe / lost frame and
+                    surfaces as the typed
+                    :class:`~mxtpu.resilience.TransportError` family
+                    the supervisor counts toward death
+``transport.encode``
+                    before a request spec is encoded for the wire
+                    (``SubprocessReplica.submit``), keyed by replica id
+                    — a raise models an unmarshallable spec; the
+                    request fails alone, the replica stays alive
+``transport.worker_death``
+                    start of every RPC, keyed by replica id, AFTER
+                    ``transport.rpc`` — a raise here is INTERCEPTED by
+                    the transport, which ``SIGKILL``s its own worker
+                    process and lets the RPC fail with
+                    :class:`~mxtpu.resilience.WorkerDiedError` on the
+                    dead pipe: the plan-grammar spelling of a real
+                    mid-decode process kill (deterministic, replayable)
 ``kvstore.reduce``  inside the (retried) cross-worker reduce of
                     ``KVStore.push`` / ``pushpull``
 ``checkpoint.save`` inside the preemption save callback
@@ -145,6 +165,7 @@ SITES = ("serving.step", "serving.admit", "serving.prefix_lookup",
          "serving.draft", "serving.verify",
          "gateway.admit", "router.dispatch", "replica.health",
          "replica.stream",
+         "transport.rpc", "transport.encode", "transport.worker_death",
          "kvstore.reduce", "checkpoint.save", "engine.flush",
          "guardian.check", "ckpt.write", "ckpt.verify")
 

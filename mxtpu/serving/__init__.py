@@ -54,20 +54,34 @@ from .gateway import Gateway
 from .router import Router
 from .supervisor import ReplicaSupervisor
 from .transport import (InProcessReplica, ReplicaDownError,
-                        ReplicaTransport, request_spec)
+                        ReplicaTransport, SubprocessReplica,
+                        request_spec)
 
 __all__ = ["Gateway", "Router", "ReplicaSupervisor", "ReplicaTransport",
-           "InProcessReplica", "ReplicaDownError", "request_spec",
-           "replica_pool"]
+           "InProcessReplica", "SubprocessReplica", "ReplicaDownError",
+           "request_spec", "replica_pool"]
 
 
-def replica_pool(factory: Callable[[int], object],
-                 n: Optional[int] = None):
-    """Build N in-process replicas from an engine factory.
+def replica_pool(factory, n: Optional[int] = None,
+                 transport: Optional[str] = None,
+                 kwargs=None, **spawn_kw):
+    """Build N replicas from an engine factory.
 
-    ``factory(i)`` must return a fresh engine for replica i — pass
-    ``ledger_tag="r%d" % i`` through to the engine so each replica's
-    compiled-program family stays separable in the compile ledger.
+    ``transport`` selects the boundary (default from
+    ``MXTPU_REPLICA_TRANSPORT``, itself defaulting to ``inprocess``):
+
+    - ``"inprocess"`` — ``factory(i)`` is a CALLABLE returning a fresh
+      engine for replica i; pass ``ledger_tag="r%d" % i`` through so
+      each replica's compiled-program family stays separable in the
+      compile ledger.
+    - ``"subprocess"`` — ``factory`` is a ``"module:callable"`` SPEC
+      string resolved inside each spawned worker process
+      (:class:`SubprocessReplica`); ``kwargs`` is the factory's kwargs
+      dict, or a callable ``i -> dict`` for per-replica values (ledger
+      tags, ports).  Extra keyword arguments pass through to
+      :class:`SubprocessReplica` (``rpc_timeout_ticks``, ``codec``,
+      ``env``, ...).
+
     ``n`` defaults to ``MXTPU_REPLICAS`` (itself defaulting to 1: one
     replica is a plain engine behind the gateway's QoS front).
 
@@ -75,6 +89,11 @@ def replica_pool(factory: Callable[[int], object],
     ...     lambda i: PagedContinuousBatchingEngine(
     ...         block, mesh, rules, ledger_tag="r%d" % i), n=2)
     >>> gw = Gateway(pool)
+
+    >>> pool = replica_pool(
+    ...     "mxtpu.serving.worker:demo_paged_engine", n=2,
+    ...     transport="subprocess",
+    ...     kwargs=lambda i: {"ledger_tag": "r%d" % i})
     """
     if n is None:
         try:
@@ -83,4 +102,27 @@ def replica_pool(factory: Callable[[int], object],
             n = 1
     if n < 1:
         raise ValueError("replica_pool needs n >= 1, got %d" % n)
-    return [InProcessReplica(factory(i), "r%d" % i) for i in range(n)]
+    if transport is None:
+        transport = os.environ.get("MXTPU_REPLICA_TRANSPORT",
+                                   "inprocess").strip() or "inprocess"
+    if transport == "inprocess":
+        if not callable(factory):
+            raise ValueError(
+                "inprocess replica_pool needs a callable factory(i) "
+                "returning an engine, got %r" % (factory,))
+        return [InProcessReplica(factory(i), "r%d" % i)
+                for i in range(n)]
+    if transport == "subprocess":
+        if not isinstance(factory, str):
+            raise ValueError(
+                "subprocess replica_pool needs a 'module:callable' "
+                "factory spec string (resolved in the worker process), "
+                "got %r" % (factory,))
+        return [SubprocessReplica(
+            factory,
+            kwargs=(kwargs(i) if callable(kwargs)
+                    else dict(kwargs or {})),
+            replica_id="r%d" % i, **spawn_kw) for i in range(n)]
+    raise ValueError(
+        "unknown replica transport %r (MXTPU_REPLICA_TRANSPORT: "
+        "'inprocess' or 'subprocess')" % (transport,))

@@ -17,6 +17,15 @@ declared death replays bit-for-bit under a fault plan):
   the deltas-of-``stats()`` form of a hung process (chunked prefill
   advances the tuple every iteration, so long prompts never look like
   stalls).
+- **transport vs stall**: with remote replicas the progress tuple
+  itself arrives by RPC, and the two failure modes must never blur — a
+  poll/progress RPC that times out or hits a dead pipe is a TRANSPORT
+  failure (counted toward the consecutive-failure death, surfaced per
+  replica in ``stats()["transport_failures"]``, death reason
+  "transport ..."), while the stall counter only ever advances on a
+  progress tuple that was successfully READ and did not change.  A
+  slow-but-alive worker mid chunked prefill whose poll timed out once
+  can therefore never look stalled.
 
 Death runs **drain-and-requeue**: the dead replica cancels every held
 request through the engine's idempotent release path (zero pages may
@@ -35,6 +44,7 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 from ..base import MXTPUError
 from ..observability.flight import get_flight as _flight
 from ..observability.trace import gateway_rid, get_tracer as _tracer
+from ..resilience import TransportError
 from ..resilience.counters import bump as _bump
 from .transport import ReplicaTransport
 
@@ -90,6 +100,10 @@ class ReplicaSupervisor:
         self._revivals = 0
         self._requeued = 0
         self._last_errors: Dict[str, dict] = {}
+        # cumulative per-replica TRANSPORT failures (RPC timeouts, dead
+        # pipes) — split from stall counting, see module docstring
+        self._transport_failures: Dict[str, int] = {
+            r.replica_id: 0 for r in replicas}
 
     # -- introspection ---------------------------------------------------
     @property
@@ -116,6 +130,7 @@ class ReplicaSupervisor:
             "revivals": self._revivals,
             "requeued_requests": self._requeued,
             "consecutive_failures": dict(self._consec),
+            "transport_failures": dict(self._transport_failures),
             "last_errors": dict(self._last_errors),
         }
 
@@ -153,13 +168,26 @@ class ReplicaSupervisor:
         if fl.active:
             # the postmortem names the dead replica and every drained
             # request; their timelines (read-time materialized) carry
-            # the requeue/re-dispatch events that follow
+            # the requeue/re-dispatch events that follow.  For a
+            # subprocess replica it also names the drained TAGS and
+            # exit code (deterministic: -9 under a planned kill), and
+            # the worker pid under the noise channel so reruns stay
+            # byte-identical
+            ctx = {"replica": rep.replica_id, "reason": reason,
+                   "tick": self.tick_count,
+                   "error": (type(exc).__name__ if exc is not None
+                             else None),
+                   "drained_tags": [list(t) if isinstance(t, tuple)
+                                    else t for t in tags]}
+            code = getattr(rep, "exit_code", None)
+            if code is not None:
+                ctx["exit_code"] = code
+            pid = getattr(rep, "pid", None)
             fl.failure("replica_death",
                        rids=[gateway_rid(t) for t in tags],
-                       replica=rep.replica_id, reason=reason,
-                       tick=self.tick_count,
-                       error=(type(exc).__name__ if exc is not None
-                              else None))
+                       noise=({"pid": pid} if pid is not None
+                              else None),
+                       **ctx)
         if self._on_death is not None:
             self._on_death(rep, tags, reason)
         return tags
@@ -227,41 +255,65 @@ class ReplicaSupervisor:
             except Exception as exc:  # noqa: BLE001 — a replica-level
                 # failure must never take the pool down; it is counted
                 # toward THIS replica's death and contained there
-                dead = self._fail(rep, "probe/step/stream failure", exc)
+                if isinstance(exc, TransportError):
+                    self._transport_failures[rep.replica_id] += 1
+                    reason = ("transport failure (%s)"
+                              % type(exc).__name__)
+                else:
+                    reason = "probe/step/stream failure"
+                dead = self._fail(rep, reason, exc)
                 if dead:
                     requeue.extend(dead)
                 continue
             toks, fins = polled[0], polled[1]
             restarted.extend(polled[2] if len(polled) > 2 else [])
-            self._consec[rep.replica_id] = 0
+            stall_tags, clean = self._check_stall(rep)
+            if clean:
+                # only a fully clean round (probe + step + poll + a
+                # READABLE progress tuple) resets the consecutive count
+                # — a tick whose progress RPC failed was not clean
+                self._consec[rep.replica_id] = 0
             for tag, new in toks.items():
                 tokens.setdefault(tag, []).extend(new)
             finished.extend(fins)
-            stall_tags = self._check_stall(rep)
             if stall_tags:
                 requeue.extend(stall_tags)
         return tokens, finished, requeue, restarted
 
-    def _check_stall(self, rep: ReplicaTransport) -> Optional[List[Any]]:
+    def _check_stall(self, rep: ReplicaTransport
+                     ) -> Tuple[Optional[List[Any]], bool]:
+        """Stall check for one replica; returns ``(drained_tags,
+        clean)`` — ``drained_tags`` when this check declared a death
+        (stalled, or the transport-failure threshold crossed),
+        ``clean`` False when the progress read itself failed (a
+        TRANSPORT failure: the stall counter must not move — a worker
+        whose poll timed out has not been observed to stop decoding)."""
         if not self._stall_ticks:
-            return None
+            return None, True
         rid = rep.replica_id
         if rep.load == 0:
             self._stalled_for.pop(rid, None)
             self._last_progress.pop(rid, None)
-            return None
-        prog = rep.progress()
+            return None, True
+        try:
+            prog = rep.progress()
+        except Exception as exc:  # noqa: BLE001 — an unanswerable
+            # progress poll is a transport failure, NEVER a stall
+            self._transport_failures[rid] += 1
+            return self._fail(
+                rep, "transport failure (progress poll: %s)"
+                % type(exc).__name__, exc), False
         if prog != self._last_progress.get(rid):
             self._last_progress[rid] = prog
             self._stalled_for[rid] = 0
-            return None
+            return None, True
         self._stalled_for[rid] = self._stalled_for.get(rid, 0) + 1
         if self._stalled_for[rid] >= self._stall_ticks:
             return self._declare_dead(
                 rep, "stalled (no progress for %d ticks with %d "
                 "request(s) held)" % (self._stalled_for[rid], rep.load),
-                None)
-        return None
+                None), True
+        return None, True
 
     def require_alive(self) -> None:
         """Raise when the whole pool is down (the gateway's run() guard

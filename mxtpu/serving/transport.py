@@ -3,14 +3,17 @@ engine replica.
 
 The supervisor/router/gateway above never touch an engine directly —
 they speak :class:`ReplicaTransport`, a small imperative protocol
-(submit / step / poll / health / cancel / drain / prefix_probe).  Today
-the only implementation is :class:`InProcessReplica`, which adapts one
+(submit / step / poll / health / cancel / drain / prefix_probe).  Two
+implementations: :class:`InProcessReplica` adapts one
 ``ContinuousBatchingEngine`` / ``PagedContinuousBatchingEngine``
-instance in this process; the protocol is the seam where a
-process-per-replica or ICI/DCN transport (PAPER.md layer 3, the
-KVStore ``dist_tpu_sync`` heritage) slots in without the service layer
-changing — everything a remote transport needs is already host-side
-data (token ids, specs, counters), never device arrays.
+instance in this process, and :class:`SubprocessReplica` hosts the
+engine in a SPAWNED worker process over a length-prefixed pipe RPC
+(``mxtpu.serving.worker`` — PAPER.md layer 3, the KVStore
+``dist_tpu_sync`` heritage; replica death there is a real ``SIGKILL``).
+The protocol is the seam where an ICI/DCN transport slots in next
+without the service layer changing — everything a remote transport
+needs is already host-side data (token ids, specs, counters), never
+device arrays.
 
 Determinism: a transport call never consults a clock or randomness.
 ``poll()`` materializes newly decoded tokens in slot order, ``drain()``
@@ -23,6 +26,10 @@ replica death replays bit-for-bit.
 
 from __future__ import annotations
 
+import builtins
+import os
+import subprocess
+import sys
 from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as onp
@@ -31,10 +38,15 @@ from ..base import MXTPUError
 from ..ndarray import NDArray, array as nd_array
 from ..observability.trace import gateway_rid, get_tracer as _tracer
 from ..parallel.serving import _SpecTokens
-from ..resilience.faults import inject as _inject
+from ..resilience import (EngineShedError, LoadShedError, QosShedError,
+                          TransportError, TransportTimeoutError,
+                          WorkerDiedError)
+from ..resilience.faults import InjectedFault, inject as _inject
+from .worker import (decode_poll, make_codec, read_frame as _read_frame,
+                     write_frame as _write_frame)
 
 __all__ = ["ReplicaDownError", "ReplicaTransport", "InProcessReplica",
-           "request_spec"]
+           "SubprocessReplica", "request_spec"]
 
 #: engine-submit keyword names a request spec may carry (the seed is
 #: part of the spec, which is what makes a drained request's requeue
@@ -364,4 +376,535 @@ class InProcessReplica(ReplicaTransport):
         pool = getattr(self._eng, "_bp", None)
         if san is not None and pool is not None:
             san.check_drain(pool)           # V004: zero pins post-drain
+        return tags
+
+
+# -- the cross-process transport ------------------------------------------
+
+def _enc_tag(tag) -> Any:
+    return list(tag) if isinstance(tag, tuple) else tag
+
+
+#: exception type names rebuilt with their structured attributes so the
+#: gateway's typed shed handling works unchanged across the boundary
+_SHED_TYPES = {"LoadShedError": LoadShedError,
+               "QosShedError": QosShedError,
+               "EngineShedError": EngineShedError}
+
+
+def _rebuild_error(err: dict) -> BaseException:
+    """Reconstruct a worker-marshalled exception as the REAL type where
+    the service layer's handling depends on it (shed family, replica
+    down, injected faults, builtins); anything unrecognized surfaces as
+    a plainly-labelled MXTPUError."""
+    name = err.get("type") or "Exception"
+    msg = err.get("msg") or ""
+    attrs = err.get("attrs") or {}
+    if name in _SHED_TYPES:
+        return _SHED_TYPES[name](
+            msg, queue_depth=attrs.get("queue_depth"),
+            limit=attrs.get("limit"),
+            retry_after_ticks=attrs.get("retry_after_ticks"),
+            permanent=bool(attrs.get("permanent", False)))
+    if name == "ReplicaDownError":
+        return ReplicaDownError(msg)
+    if name == "InjectedFault":
+        return InjectedFault(msg)
+    if name == "MXTPUError":
+        return MXTPUError(msg)
+    cls = getattr(builtins, name, None)
+    if (isinstance(cls, type) and issubclass(cls, Exception)
+            and not issubclass(cls, (KeyboardInterrupt, SystemExit))):
+        try:
+            return cls(msg)
+        except Exception:  # noqa: BLE001 — odd constructor signature
+            pass
+    return MXTPUError("worker-side %s: %s" % (name, msg))
+
+
+def _default_waiter(pipe, seconds: float) -> bool:
+    """One readiness tick on the worker's stdout pipe (the pipe is
+    UNBUFFERED, so fd-level readiness is the truth).  Injectable: tests
+    pass a waiter that always returns False for an instant,
+    zero-wall-clock timeout."""
+    import select
+    ready, _, _ = select.select([pipe], [], [], seconds)
+    return bool(ready)
+
+
+def default_rpc_timeout_ticks() -> int:
+    """Ambient per-RPC tick budget (``MXTPU_RPC_TIMEOUT_TICKS``,
+    default 2400 — at the default 0.05s readiness tick that is 120s,
+    generous enough for a first-touch XLA compile inside a step RPC)."""
+    try:
+        return max(1, int(os.environ.get("MXTPU_RPC_TIMEOUT_TICKS",
+                                         2400)))
+    except ValueError:
+        return 2400
+
+
+class SubprocessReplica(ReplicaTransport):
+    """ReplicaTransport over one engine in a SPAWNED worker process
+    (``python -m mxtpu.serving.worker``) — replica death is a real
+    ``SIGKILL``, not a flag flip.
+
+    Every protocol call crosses the pipe as host data (length-prefixed
+    json/msgpack frames, :mod:`mxtpu.serving.worker` has the wire
+    format); the worker wraps its engine in an
+    :class:`InProcessReplica`, so tag/cursor/restart/drain semantics
+    are identical to the in-process transport.  Parent-side state is a
+    TAG MIRROR (engine-rid -> tag, submission order) — the drain
+    contract survives a worker that can no longer answer.
+
+    Robustness model:
+
+    - **tick-budget timeouts**: every RPC waits for its response in
+      ``tick_seconds`` readiness ticks through an injectable
+      ``waiter``; ``rpc_timeout_ticks`` ticks without a frame raise a
+      typed :class:`~mxtpu.resilience.TransportTimeoutError` — a
+      replica-level signal the supervisor counts toward death, NEVER a
+      stall.  A late response is discarded by frame id afterwards, so
+      a transient timeout is recoverable.
+    - **heartbeat-backed health**: the worker stamps every response
+      with its served-frame count; :meth:`health` asserts it advanced.
+    - **real process kill**: :meth:`kill` SIGKILLs the worker; the
+      ``transport.worker_death`` fault site is intercepted to do
+      exactly that, making a real mid-decode process kill
+      deterministic and replayable under the plan grammar.
+    - **fail-soft placement signals**: a transport failure inside
+      :meth:`prefix_probe` / the load properties degrades the signal
+      (no locality, looks full) instead of failing dispatch — the
+      router routes around it and the supervisor's own probes decide
+      death.
+    - **submit on a dead worker** raises :class:`ReplicaDownError`
+      (the router's typed reroute path), never a transport error: new
+      work reroutes immediately, death is declared by the supervisor.
+
+    The spawned environment inherits this process's, minus the ambient
+    fault/trace/flight variables (``MXTPU_FAULT_PLAN``, ``MXTPU_TRACE``,
+    ``MXTPU_FLIGHT_BUFFER``) — injection and observability are PARENT
+    concerns: fault plans drive the ``transport.*`` sites parent-side,
+    and worker trace events are forwarded per-RPC and re-emitted under
+    the parent's counter clock (one timeline per request spanning both
+    processes).  Pass ``env=`` to opt a worker into its own plan.
+    """
+
+    #: env vars NOT inherited by workers (see class docstring)
+    _SCRUBBED_ENV = ("MXTPU_FAULT_PLAN", "MXTPU_TRACE",
+                     "MXTPU_FLIGHT_BUFFER", "MXTPU_REPLICAS",
+                     "MXTPU_REPLICA_TRANSPORT")
+
+    def __init__(self, factory: str, kwargs: Optional[dict] = None,
+                 replica_id: str = "r0",
+                 rpc_timeout_ticks: Optional[int] = None,
+                 init_timeout_ticks: Optional[int] = None,
+                 tick_seconds: float = 0.05,
+                 waiter=None, codec: Optional[str] = None,
+                 env: Optional[dict] = None,
+                 python: Optional[str] = None):
+        self.replica_id = str(replica_id)
+        self.alive = True
+        self._timeout_ticks = (default_rpc_timeout_ticks()
+                               if rpc_timeout_ticks is None
+                               else max(1, int(rpc_timeout_ticks)))
+        self._init_ticks = (max(self._timeout_ticks, 4800)
+                            if init_timeout_ticks is None
+                            else max(1, int(init_timeout_ticks)))
+        self._tick_seconds = float(tick_seconds)
+        self._waiter = waiter or _default_waiter
+        codec = codec or os.environ.get("MXTPU_RPC_CODEC", "json")
+        self._codec = codec
+        self._dumps, self._loads = make_codec(codec)
+        self._mirror: Dict[int, Any] = {}   # engine rid -> tag
+        self._stale: set = set()            # timed-out frame ids
+        self._next_fid = 0
+        self._last_heartbeat = 0
+        self._last_drain: Optional[dict] = None
+        self._exit_emitted = False
+        self.pid: Optional[int] = None
+        child_env = dict(os.environ)
+        for var in self._SCRUBBED_ENV:
+            child_env.pop(var, None)
+        # the worker must import mxtpu from the same checkout
+        pkg_root = os.path.dirname(os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__))))
+        child_env["PYTHONPATH"] = (
+            pkg_root + os.pathsep + child_env["PYTHONPATH"]
+            if child_env.get("PYTHONPATH") else pkg_root)
+        child_env.update(env or {})
+        # -c (not -m): the package import graph already holds
+        # mxtpu.serving.worker, and runpy would warn about re-executing
+        # a module that import brought in
+        self._proc = subprocess.Popen(
+            [python or sys.executable, "-c",
+             "import sys; from mxtpu.serving.worker import main; "
+             "sys.exit(main())"],
+            stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+            env=child_env, bufsize=0)
+        try:
+            self._handshake(factory, kwargs)
+        except BaseException:
+            self._kill_worker()
+            raise
+        tr = _tracer()
+        if tr.active:
+            tr.emit("transport.worker_spawn", replica=self.replica_id,
+                    capacity=self._capacity, noise={"pid": self.pid})
+
+    def _handshake(self, factory: str, kwargs: Optional[dict]) -> None:
+        init = {"factory": factory, "kwargs": dict(kwargs or {}),
+                "replica_id": self.replica_id, "codec": self._codec}
+        import json
+        try:
+            _write_frame(self._proc.stdin,
+                         json.dumps(init, sort_keys=True).encode())
+        except (BrokenPipeError, OSError) as exc:
+            raise WorkerDiedError(
+                "replica %s worker died before init: %s"
+                % (self.replica_id, exc),
+                exit_code=self._reap()) from exc
+        resp = json.loads(self._read_raw_frame(
+            self._init_ticks, "init").decode())
+        if not resp.get("ok"):
+            raise TransportError(
+                "replica %s worker failed to initialize: %s"
+                % (self.replica_id,
+                   _rebuild_error(resp.get("error") or {})))
+        self.pid = resp.get("pid")
+        self._capacity = int(resp.get("capacity", 0))
+
+    # -- pipe plumbing ---------------------------------------------------
+    def _read_raw_frame(self, budget: int, method: str) -> bytes:
+        """One frame off the pipe under a tick budget (the RPC timeout
+        machinery; see class docstring)."""
+        proc = self._proc
+        waited = 0
+        while not self._waiter(proc.stdout, self._tick_seconds):
+            if proc.poll() is not None:
+                raise WorkerDiedError(
+                    "replica %s worker pid %s died awaiting %r "
+                    "(exit %s)" % (self.replica_id, self.pid, method,
+                                   proc.returncode),
+                    exit_code=proc.returncode)
+            waited += 1
+            if waited >= budget:
+                tr = _tracer()
+                if tr.active:
+                    tr.emit("transport.rpc_timeout",
+                            replica=self.replica_id, method=method,
+                            ticks=budget)
+                raise TransportTimeoutError(
+                    "replica %s RPC %r exhausted its %d-tick budget "
+                    "(tick=%.3fs)" % (self.replica_id, method, budget,
+                                      self._tick_seconds),
+                    method=method, ticks=budget)
+        buf = _read_frame(proc.stdout)
+        if buf is None:
+            code = self._reap()
+            raise WorkerDiedError(
+                "replica %s worker pid %s died mid-RPC %r (pipe EOF, "
+                "exit %s)" % (self.replica_id, self.pid, method, code),
+                exit_code=code)
+        return buf
+
+    def _read_response(self, want_id: int, method: str,
+                       budget: int) -> dict:
+        while True:
+            try:
+                resp = self._loads(self._read_raw_frame(budget, method))
+            except TransportTimeoutError:
+                # remember the outstanding frame so its late response
+                # is discarded (a TRANSIENT timeout stays recoverable)
+                self._stale.add(want_id)
+                raise
+            fid = resp.get("id")
+            if fid in self._stale:
+                self._stale.discard(fid)
+                continue
+            if fid != want_id:
+                raise TransportError(
+                    "replica %s answered frame %r while %r was "
+                    "outstanding (%s) — stream desynchronized"
+                    % (self.replica_id, fid, want_id, method))
+            return resp
+
+    def _rpc(self, method: str, params: Optional[dict] = None,
+             budget: Optional[int] = None):
+        _inject("transport.rpc", key=self.replica_id)
+        try:
+            _inject("transport.worker_death", key=self.replica_id)
+        except BaseException:
+            # the plan-grammar spelling of a REAL process kill: the
+            # injected raise is intercepted and converted into a
+            # SIGKILL of our own worker — the RPC below then fails on
+            # the dead pipe exactly as an unplanned kill would,
+            # deterministically at the planned hit
+            self._kill_worker()
+        proc = self._proc
+        if proc is None:
+            raise WorkerDiedError(
+                "replica %s has been closed — no worker to issue %r"
+                % (self.replica_id, method))
+        if proc.poll() is not None:
+            raise WorkerDiedError(
+                "replica %s worker pid %s is dead (exit %s) — cannot "
+                "issue %r" % (self.replica_id, self.pid,
+                              proc.returncode, method),
+                exit_code=proc.returncode)
+        fid = self._next_fid
+        self._next_fid += 1
+        tr = _tracer()
+        frame = {"id": fid, "method": method, "params": params or {}}
+        if tr.active:
+            frame["trace"] = True
+        try:
+            _write_frame(proc.stdin, self._dumps(frame))
+        except (BrokenPipeError, OSError) as exc:
+            code = self._reap()
+            raise WorkerDiedError(
+                "replica %s worker pid %s died writing %r frame "
+                "(exit %s)" % (self.replica_id, self.pid, method, code),
+                exit_code=code) from exc
+        resp = self._read_response(
+            fid, method,
+            self._timeout_ticks if budget is None else budget)
+        self._last_heartbeat = int(resp.get("served",
+                                            self._last_heartbeat))
+        if tr.active:
+            for ev in resp.get("events") or ():
+                etype, erid, phase, fields = ev
+                # worker events arrive pre-resolved to the gateway rid
+                # (the worker-side InProcessReplica registered the
+                # alias); re-emit under the parent's counter clock
+                tr.emit(etype, rid=erid, phase=phase,
+                        **{k: v for k, v in (fields or {}).items()
+                           if k not in ("rid", "phase", "noise")})
+        if resp.get("ok"):
+            return resp.get("result")
+        raise _rebuild_error(resp.get("error") or {})
+
+    # -- lifecycle -------------------------------------------------------
+    @property
+    def exit_code(self) -> Optional[int]:
+        return None if self._proc is None else self._proc.returncode
+
+    def _emit_exit(self) -> None:
+        if self._exit_emitted or self._proc is None:
+            return
+        self._exit_emitted = True
+        tr = _tracer()
+        if tr.active:
+            tr.emit("transport.worker_exit", replica=self.replica_id,
+                    code=self._proc.returncode,
+                    noise={"pid": self.pid})
+
+    def _reap(self) -> Optional[int]:
+        proc = self._proc
+        if proc is None:
+            return None
+        try:
+            proc.wait(timeout=30)
+        except Exception:  # noqa: BLE001 — unreapable stays unknown
+            return None
+        self._emit_exit()
+        return proc.returncode
+
+    def _kill_worker(self) -> Optional[int]:
+        proc = self._proc
+        if proc is None:
+            return None
+        if proc.poll() is None:
+            try:
+                proc.kill()             # SIGKILL — no goodbye
+            except OSError:
+                pass
+        return self._reap()
+
+    def kill(self) -> Optional[int]:
+        """SIGKILL the worker (tests/chaos drills); returns the exit
+        code (``-9`` once reaped).  The supervisor discovers the death
+        on its next probe and runs drain-and-requeue off the parent-
+        side tag mirror."""
+        return self._kill_worker()
+
+    def shutdown(self):
+        """GRACEFUL worker exit: the worker flushes its in-flight
+        cursors (one final poll crosses back) and leaves with exit
+        code 0.  Returns the final ``(tokens, finished, restarts)``;
+        the replica refuses work afterwards."""
+        proc = self._proc
+        if proc is None or proc.poll() is not None:
+            self.alive = False
+            return {}, [], []
+        res = self._rpc("shutdown")
+        final = decode_poll(res["final"])
+        try:
+            proc.wait(timeout=60)
+        except Exception:  # noqa: BLE001 — a worker that will not exit
+            proc.kill()    # gracefully is killed
+            self._reap()
+        self._emit_exit()
+        self.alive = False
+        self._mirror.clear()
+        return final
+
+    def close(self) -> None:
+        """Tear the worker down unconditionally (kill + reap + close
+        pipes).  Idempotent; also the destructor path, so an abandoned
+        transport never orphans its process."""
+        if self._proc is None:
+            return
+        self._kill_worker()
+        for pipe in (self._proc.stdin, self._proc.stdout):
+            try:
+                if pipe is not None:
+                    pipe.close()
+            except Exception:  # noqa: BLE001
+                pass
+        self._proc = None
+        self.alive = False
+
+    def __del__(self):  # pragma: no cover — gc timing
+        try:
+            self.close()
+        except Exception:  # noqa: BLE001
+            pass
+
+    # -- capacity / placement signals ------------------------------------
+    def _signals(self) -> dict:
+        if (not self.alive or self._proc is None
+                or self._proc.poll() is not None):
+            return {"capacity": self._capacity, "load": 0,
+                    "free_slots": 0}
+        try:
+            return self._rpc("signals")
+        except TransportError:
+            # fail-soft: a replica that cannot answer looks FULL (the
+            # router routes around it); liveness is the supervisor's
+            # call, made on its own probes
+            return {"capacity": self._capacity,
+                    "load": self._capacity, "free_slots": 0}
+
+    @property
+    def capacity(self) -> int:
+        return self._capacity
+
+    @property
+    def load(self) -> int:
+        return int(self._signals()["load"])
+
+    @property
+    def free_slots(self) -> int:
+        return int(self._signals()["free_slots"])
+
+    def prefix_probe(self, prompt) -> int:
+        if (not self.alive or self._proc is None
+                or self._proc.poll() is not None):
+            return 0
+        arr = prompt.asnumpy() if isinstance(prompt, NDArray) \
+            else onp.asarray(prompt)
+        try:
+            return int(self._rpc(
+                "prefix_probe",
+                {"prompt": onp.asarray(arr, dtype=onp.int32).tolist()}))
+        except TransportError:
+            return 0                    # fail-soft: no locality signal
+
+    def stats(self) -> dict:
+        """Worker engine stats; a DEAD worker reports zero resident
+        pages — its pool died with its address space, which is exactly
+        the zero-leak claim the kill-drain tests assert."""
+        if self._proc is None or self._proc.poll() is not None:
+            return {"blocks_in_use": 0, "pinned_blocks": 0,
+                    "worker": "dead"}
+        return dict(self._rpc("stats"))
+
+    # -- work ------------------------------------------------------------
+    def submit(self, spec: dict, tag) -> int:
+        if not self.alive:
+            raise ReplicaDownError(
+                "replica %s is down: submit refused" % self.replica_id)
+        if self._proc is None or self._proc.poll() is not None:
+            raise ReplicaDownError(
+                "replica %s worker process is dead: submit refused"
+                % self.replica_id)
+        _inject("transport.encode", key=self.replica_id)
+        wire = {k: spec[k] for k in SPEC_KEYS if k in spec}
+        wire["prompt"] = onp.asarray(spec["prompt"],
+                                     dtype=onp.int32).tolist()
+        try:
+            res = self._rpc("submit", {"spec": wire,
+                                       "tag": _enc_tag(tag)})
+        except WorkerDiedError as exc:
+            # new work reroutes through the router's typed path; the
+            # supervisor declares the death on its own next probe
+            raise ReplicaDownError(
+                "replica %s worker died during submit: %s"
+                % (self.replica_id, exc)) from exc
+        rid = int(res["rid"])
+        self._mirror[rid] = tag
+        tr = _tracer()
+        if tr.active:
+            tr.alias("%s:%s" % (self.replica_id, rid),
+                     gateway_rid(tag))
+        return rid
+
+    def step(self) -> None:
+        self._rpc("step")
+
+    def poll(self):
+        _inject("replica.stream", key=self.replica_id)
+        tokens, finished, restarts = decode_poll(self._rpc("poll"))
+        if finished:
+            done = {t for t, _, _, _ in finished}
+            for rid in [r for r, t in self._mirror.items()
+                        if t in done]:
+                del self._mirror[rid]
+        return tokens, finished, restarts
+
+    def health(self) -> None:
+        _inject("replica.health", key=self.replica_id)
+        before = self._last_heartbeat
+        self._rpc("health")
+        if self._last_heartbeat <= before:
+            raise TransportError(
+                "replica %s heartbeat did not advance (%d -> %d): the "
+                "worker is answering without serving"
+                % (self.replica_id, before, self._last_heartbeat))
+
+    def progress(self) -> tuple:
+        return tuple(self._rpc("progress"))
+
+    def cancel(self, tag) -> bool:
+        rid = next((r for r, t in self._mirror.items() if t == tag),
+                   None)
+        if rid is not None:
+            del self._mirror[rid]
+        if self._proc is None or self._proc.poll() is not None:
+            return False
+        try:
+            return bool(self._rpc("cancel", {"tag": _enc_tag(tag)}))
+        except TransportError:
+            return False                # released when the process died
+
+    def drain(self) -> List[Any]:
+        # the MIRROR is the source of truth (submission order = rid
+        # order): a drain is usually running precisely because the
+        # worker cannot answer, and the tag list must never be lost
+        tags = [self._mirror[rid] for rid in sorted(self._mirror)]
+        proc = self._proc
+        if proc is not None and proc.poll() is None:
+            try:
+                res = self._rpc("drain")
+                # the live worker drained clean (its in-process adapter
+                # runs the V004 sanitizer check); record its report for
+                # the death postmortem
+                self._last_drain = {
+                    "blocks_in_use": int(res["blocks_in_use"]),
+                    "pinned_blocks": int(res["pinned_blocks"])}
+            except Exception:  # noqa: BLE001 — a wedged worker's pages
+                # die with its process; make that true right now
+                self._kill_worker()
+        self._mirror.clear()
+        self._stale.clear()
         return tags
