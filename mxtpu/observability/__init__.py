@@ -42,8 +42,7 @@ from __future__ import annotations
 
 from .flight import (FlightRecorder, Postmortem, flight_recording,
                      get_flight)
-from .metrics import (MetricsRegistry, default_registry, get_registry,
-                      with_deprecated_aliases)
+from .metrics import MetricsRegistry, default_registry, get_registry
 from .trace import (EVENT_TYPES, TraceEvent, Tracer, export_chrome_trace,
                     gateway_rid, get_tracer, tracing)
 
@@ -52,5 +51,4 @@ __all__ = [
     "EVENT_TYPES", "export_chrome_trace",
     "FlightRecorder", "Postmortem", "get_flight", "flight_recording",
     "MetricsRegistry", "get_registry", "default_registry",
-    "with_deprecated_aliases",
 ]

@@ -55,20 +55,7 @@ import json
 import re
 from typing import Any, Callable, Dict, Iterable, List, Optional
 
-__all__ = ["MetricsRegistry", "get_registry", "default_registry",
-           "with_deprecated_aliases"]
-
-
-def with_deprecated_aliases(stats: Dict[str, Any],
-                            aliases: Dict[str, str]) -> Dict[str, Any]:
-    """Add deprecated key aliases to a stats dict: ``aliases`` maps
-    OLD (deprecated) name -> NEW (canonical) name; the old keys are
-    kept for one release pointing at the same values
-    (docs/observability.md "Stats key normalization")."""
-    for old, new in aliases.items():
-        if new in stats and old not in stats:
-            stats[old] = stats[new]
-    return stats
+__all__ = ["MetricsRegistry", "get_registry", "default_registry"]
 
 
 def _flatten(prefix: str, value: Any, out: Dict[str, float]) -> None:

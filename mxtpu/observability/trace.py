@@ -94,7 +94,23 @@ EVENT_TYPES: Dict[str, str] = {
                              "replica death as a transport failure",
     "replica.death": "supervisor declared a replica dead "
                      "(drain-and-requeue)",
-    "replica.revive": "probation over — replica re-admitted",
+    "replica.revive": "probation over — replica re-admitted (a "
+                      "subprocess replica respawned a fresh worker "
+                      "first)",
+    # -- elastic serving (mxtpu.serving.autoscale) ----------------------
+    "autoscale.decision": "one autoscaler policy evaluation that acted "
+                          "(direction, shed delta, queue depth, pool "
+                          "size)",
+    "autoscale.spawn": "autoscaler grew the pool by one replica (or "
+                       "failed to — error field; capacity unchanged)",
+    "autoscale.retire": "graceful scale-down lifecycle (stage: begin/"
+                        "released/reopened) — the victim drains at "
+                        "stream completion, never the death path",
+    "serving.adopt": "live weight hot-swap lifecycle (stage: staged/"
+                     "installed/failed) — new param generation adopted "
+                     "at an iteration boundary",
+    "serving.rollback": "previous param generation re-staged "
+                        "(hot-swap rollback)",
     # -- engines (mxtpu.parallel.serving) -------------------------------
     "engine.iteration": "one engine scheduler iteration (span)",
     "engine.admit": "admission started (prompt tokens)",
@@ -156,6 +172,11 @@ EVENT_TYPES: Dict[str, str] = {
     "fault.guardian.check": "injected fault fired at guardian.check",
     "fault.ckpt.write": "injected fault fired at ckpt.write",
     "fault.ckpt.verify": "injected fault fired at ckpt.verify",
+    "fault.autoscale.spawn":
+        "injected fault fired at autoscale.spawn",
+    "fault.autoscale.retire":
+        "injected fault fired at autoscale.retire",
+    "fault.serving.adopt": "injected fault fired at serving.adopt",
     "fault.unregistered": "injected fault fired at a site with no "
                           "declared event type (site in fields)",
 }

@@ -20,6 +20,7 @@ are drop-in interchangeable and testable against each other.
 
 from __future__ import annotations
 
+import re
 from typing import Any, Dict, Optional
 
 import jax
@@ -33,6 +34,14 @@ from .mesh import DeviceMesh
 from .sharding import ShardingRules
 
 __all__ = ["ShardedDecoder"]
+
+
+def _strip_instance_prefix(name: str) -> str:
+    """Drop the outermost ``<block><N>_`` instance prefix from a
+    parameter name (``transformerlm1_embed_weight`` ->
+    ``embed_weight``): the per-process block-instance counter that
+    makes the same architecture's names differ across processes."""
+    return re.sub(r"^[a-z][a-z0-9]*?\d+_", "", name)
 
 
 def _bucket(n, base=8):
@@ -145,6 +154,11 @@ class ShardedDecoder:
                               key=lambda p: p.name)
         self._staged = False
         self._jit_cache: Dict[Any, Any] = {}
+        # live weight hot-swap (docs/serving.md "Elastic serving"):
+        # when set, every compiled call runs with THESE placed leaves
+        # instead of the parameters' own data — the serving engines
+        # install a new generation here at an iteration boundary
+        self._adopted: Optional[tuple] = None
         self._validate_kv_sharding()
 
     def _iter_blocks(self):
@@ -207,6 +221,69 @@ class ShardedDecoder:
             sh = self._rules.sharding_for(p.name, holder.ndim, self._mesh)
             holder._rebind(jax.device_put(holder._data, sh))
         self._staged = True
+
+    # -- live weight hot-swap (docs/serving.md "Elastic serving") --------
+    def _live_param_leaves(self):
+        """The param leaves every compiled call runs with: the adopted
+        generation when one is installed, else the parameters' own
+        staged data.  Swapping leaves costs zero recompiles — the jit
+        cache keys on shapes/dtypes, which adoption preserves."""
+        if self._adopted is not None:
+            return self._adopted
+        return tuple(p.data()._data for p in self._params)
+
+    def prepare_adoption(self, named):
+        """Validate a ``name -> host array`` map against this block's
+        parameter tree and place each array on the mesh by the SAME
+        sharding rules as :meth:`_stage` — returned as a leaves tuple
+        ready for :meth:`install_leaves`, WITHOUT installing anything.
+        Split from install so the serving engines can stage a verified
+        checkpoint while streams are in flight and install only at an
+        empty iteration boundary.  Extra names are ignored (a broader
+        checkpoint may feed a narrower block).
+
+        Names match exactly first; on a miss the lookup retries with
+        the outermost instance prefix stripped (``transformerlm1_`` vs
+        ``transformerlm0_``): the same architecture built in another
+        process numbers its root block differently, and a checkpoint
+        written there must still adopt here.  An ambiguous stripped
+        name stays a mismatch."""
+        stripped = None
+        for k in named:
+            key = _strip_instance_prefix(k)
+            if stripped is None:
+                stripped = {}
+            if key in stripped:
+                stripped[key] = None      # ambiguous: refuse to guess
+            else:
+                stripped[key] = k
+        leaves = []
+        for p in self._params:
+            src = p.name
+            if src not in named:
+                alt = (stripped or {}).get(_strip_instance_prefix(src))
+                if alt is None:
+                    raise ValueError(
+                        "checkpoint is missing parameter %r — "
+                        "architecture mismatch" % p.name)
+                src = alt
+            holder = p.data()
+            arr = jnp.asarray(named[src], dtype=holder.dtype)
+            if tuple(arr.shape) != tuple(holder.shape):
+                raise ValueError(
+                    "checkpoint parameter %r has shape %r, block "
+                    "expects %r — architecture mismatch"
+                    % (p.name, tuple(arr.shape), tuple(holder.shape)))
+            sh = self._rules.sharding_for(p.name, holder.ndim, self._mesh)
+            leaves.append(jax.device_put(arr, sh))
+        return tuple(leaves)
+
+    def install_leaves(self, leaves):
+        """Point every subsequent compiled call at ``leaves`` (from
+        :meth:`prepare_adoption`, or a previously captured
+        :meth:`_live_param_leaves` for rollback).  ``None`` reverts to
+        the parameters' own data."""
+        self._adopted = None if leaves is None else tuple(leaves)
 
     # -- the compiled programs -------------------------------------------
     def _scale_spec(self):
@@ -495,7 +572,7 @@ class ShardedDecoder:
         if not hit:
             self._jit_cache[key] = self._build_program(
                 self._step_body, cache_leaves, n_extra_inputs=2)
-        param_leaves = tuple(p.data()._data for p in self._params)
+        param_leaves = self._live_param_leaves()
         return self._jit_cache[key](param_leaves, cache_leaves, token, pos)
 
     def _prefill_jitted(self, cache_leaves, tokens):
@@ -506,7 +583,7 @@ class ShardedDecoder:
         if not hit:
             self._jit_cache[key] = self._build_program(
                 self._prefill_body, cache_leaves, n_extra_inputs=1)
-        param_leaves = tuple(p.data()._data for p in self._params)
+        param_leaves = self._live_param_leaves()
         return self._jit_cache[key](param_leaves, cache_leaves, tokens)
 
     def _step_slots_jitted(self, cache_leaves, token, pos):
@@ -518,7 +595,7 @@ class ShardedDecoder:
             self._jit_cache[key] = self._build_program(
                 self._step_slots_body, cache_leaves,
                 n_extra_inputs=2)
-        param_leaves = tuple(p.data()._data for p in self._params)
+        param_leaves = self._live_param_leaves()
         return self._jit_cache[key](param_leaves, cache_leaves, token, pos)
 
     def _slot_prefill_jitted(self, cache_leaves, tokens, slot):
@@ -531,7 +608,7 @@ class ShardedDecoder:
             self._jit_cache[key] = self._build_program(
                 self._slot_prefill_body, cache_leaves,
                 n_extra_inputs=2)
-        param_leaves = tuple(p.data()._data for p in self._params)
+        param_leaves = self._live_param_leaves()
         return self._jit_cache[key](param_leaves, cache_leaves, tokens,
                                     slot)
 
@@ -550,7 +627,7 @@ class ShardedDecoder:
             self._jit_cache[key] = self._build_program(
                 self._verify_slots_body, cache_leaves,
                 n_extra_inputs=3)
-        param_leaves = tuple(p.data()._data for p in self._params)
+        param_leaves = self._live_param_leaves()
         return self._jit_cache[key](param_leaves, cache_leaves, tokens,
                                     pos, valid_len)
 
@@ -568,7 +645,7 @@ class ShardedDecoder:
             self._jit_cache[key] = self._build_program(
                 self._verify_pages_body, cache_leaves,
                 n_extra_inputs=4)
-        param_leaves = tuple(p.data()._data for p in self._params)
+        param_leaves = self._live_param_leaves()
         return self._jit_cache[key](param_leaves, cache_leaves, tokens,
                                     tables, pos, valid_len)
 
@@ -589,7 +666,7 @@ class ShardedDecoder:
             self._jit_cache[key] = self._build_program(
                 self._verify_tree_slots_body, cache_leaves,
                 n_extra_inputs=5)
-        param_leaves = tuple(p.data()._data for p in self._params)
+        param_leaves = self._live_param_leaves()
         return self._jit_cache[key](param_leaves, cache_leaves, tokens,
                                     pos, valid_len, perm, depth)
 
@@ -608,7 +685,7 @@ class ShardedDecoder:
             self._jit_cache[key] = self._build_program(
                 self._verify_tree_pages_body, cache_leaves,
                 n_extra_inputs=7)
-        param_leaves = tuple(p.data()._data for p in self._params)
+        param_leaves = self._live_param_leaves()
         return self._jit_cache[key](param_leaves, cache_leaves, tokens,
                                     tables, pos, valid_len, perm, depth,
                                     anc)
@@ -624,7 +701,7 @@ class ShardedDecoder:
         if not hit:
             self._jit_cache[key] = self._build_program(
                 self._fixup_slots_body, cache_leaves, n_extra_inputs=2)
-        param_leaves = tuple(p.data()._data for p in self._params)
+        param_leaves = self._live_param_leaves()
         _, caches = self._jit_cache[key](param_leaves, cache_leaves,
                                          pos, src_lane)
         return caches
@@ -639,7 +716,7 @@ class ShardedDecoder:
         if not hit:
             self._jit_cache[key] = self._build_program(
                 self._fixup_pages_body, cache_leaves, n_extra_inputs=3)
-        param_leaves = tuple(p.data()._data for p in self._params)
+        param_leaves = self._live_param_leaves()
         _, caches = self._jit_cache[key](param_leaves, cache_leaves,
                                          tables, pos, src_lane)
         return caches
@@ -654,7 +731,7 @@ class ShardedDecoder:
             self._jit_cache[key] = self._build_program(
                 self._step_pages_body, cache_leaves,
                 n_extra_inputs=3)
-        param_leaves = tuple(p.data()._data for p in self._params)
+        param_leaves = self._live_param_leaves()
         return self._jit_cache[key](param_leaves, cache_leaves, token,
                                     tables, pos)
 
@@ -673,7 +750,7 @@ class ShardedDecoder:
             self._jit_cache[key] = self._build_program(
                 functools.partial(self._page_prefill_body, total_len),
                 cache_leaves, n_extra_inputs=5)
-        param_leaves = tuple(p.data()._data for p in self._params)
+        param_leaves = self._live_param_leaves()
         return self._jit_cache[key](param_leaves, cache_leaves, tokens,
                                     table, start_pos, cow_src, cow_dst)
 

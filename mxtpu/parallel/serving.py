@@ -96,7 +96,6 @@ from jax.sharding import PartitionSpec as P
 from .. import random as _random
 from ..ndarray import NDArray, array as nd_array
 from ..observability.flight import get_flight as _flight
-from ..observability.metrics import with_deprecated_aliases
 from ..observability.trace import get_tracer as _tracer
 from ..resilience import LoadShedError
 from ..resilience.counters import bump as _bump
@@ -109,26 +108,6 @@ from .sharding import ShardingRules
 
 __all__ = ["ContinuousBatchingEngine", "PagedContinuousBatchingEngine",
            "Request"]
-
-#: deprecated stats-key spellings kept for one release (old ->
-#: canonical; the canonical names follow the *_requests/*_tokens/
-#: *_blocks suffix convention — mapping table in docs/observability.md)
-_ENGINE_STATS_ALIASES = {
-    "tokens_generated": "generated_tokens",
-    "quarantined": "quarantined_requests",
-    "retries": "retried_requests",
-    "deadline_evictions": "expired_requests",
-    "shed": "shed_requests",
-}
-_PAGED_STATS_ALIASES = {
-    "prefix_hits": "prefix_hit_requests",
-    "cow_copies": "cow_copied_blocks",
-    "swap_ins": "swapped_in_blocks",
-    "swap_outs": "swapped_out_blocks",
-    "deferred_swap_ins": "deferred_swap_in_requests",
-    "session_hits": "session_hit_requests",
-}
-
 
 def _parse_spec_tree(value):
     """Normalize a tree-speculation config to ``(max_nodes, branch)``
@@ -269,7 +248,7 @@ class _Slot:
     across both entry forms."""
 
     __slots__ = ("req", "row", "pos", "emitted", "keys", "history",
-                 "n_emitted")
+                 "n_emitted", "param_gen")
 
     def __init__(self, req, row, pos, first_tokens, keys):
         self.req = req
@@ -280,6 +259,8 @@ class _Slot:
         self.keys = keys
         self.history = None        # host ints; set when speculating
         self.n_emitted = 1
+        self.param_gen = 0         # weight generation pinned at
+        #                            admission (hot-swap invariant)
 
 
 class ContinuousBatchingEngine:
@@ -434,6 +415,17 @@ class ContinuousBatchingEngine:
         self._slot_iterations = 0   # slot-participations in decode
         #                             calls: tokens/slot_iterations is
         #                             the per-cache-read multiplier
+        # -- live weight hot-swap (docs/serving.md "Elastic serving") ----
+        self._param_gen = 0                 # current weight generation
+        self._staged_adoption = None        # placed leaves awaiting an
+        #                                     empty iteration boundary
+        self._prev_leaves = None            # rollback target
+        self._adoption_staged_step = None   # _steps when staged
+        self._adoptions = 0
+        self._adoption_failures = 0
+        self._rollbacks = 0
+        self._last_adoption_steps = 0       # stage->install latency in
+        #                                     engine iterations
         # -- observability (docs/observability.md) -----------------------
         # correlation-id scope: replica pools stamp the replica id via
         # InProcessReplica; standalone multi-engine tracing should pass
@@ -484,9 +476,9 @@ class ContinuousBatchingEngine:
     @property
     def stats(self):
         # canonical key names use the *_requests/*_tokens/*_blocks
-        # suffix convention; the deprecated aliases (kept one release)
-        # are mapped in docs/observability.md
-        return with_deprecated_aliases({
+        # suffix convention (the deprecated pre-PR-14 spellings are
+        # gone — mapping table in docs/observability.md)
+        return {
             "steps": self._steps,
             "generated_tokens": self._tokens_generated,
             "quarantined_requests": self._quarantined,
@@ -502,9 +494,16 @@ class ContinuousBatchingEngine:
                 self._accepted_tokens / self._drafted_tokens
                 if self._drafted_tokens else 0.0),
             "verify_calls": self._verify_calls,
+            # live weight hot-swap (docs/serving.md "Elastic serving")
+            "param_generation": self._param_gen,
+            "adoptions": self._adoptions,
+            "adoption_failures": self._adoption_failures,
+            "rollbacks": self._rollbacks,
+            "adoption_staged": int(self._staged_adoption is not None),
+            "last_adoption_steps": self._last_adoption_steps,
             "compiled_programs": sorted(
                 k[0] for k in self._dec._jit_cache),
-        }, _ENGINE_STATS_ALIASES)
+        }
 
     def status(self, rid) -> str:
         """Lifecycle status of one request: ``queued`` / ``active`` /
@@ -804,6 +803,7 @@ class ContinuousBatchingEngine:
             self._last_tokens = jnp.zeros((self._num_slots,), jnp.int32)
         self._last_tokens = self._last_tokens.at[slot_idx].set(tok[0])
         slot = _Slot(req, slot_idx, Tp, self._last_tokens, keys)
+        slot.param_gen = self._param_gen
         if self._slot_done(slot):
             self._finish(None, req, slot.emitted, slot_idx)
             return
@@ -1496,12 +1496,16 @@ class ContinuousBatchingEngine:
         other slot with bit-identical results."""
         finished_before = set(self._results)
         self._evict_expired()
-        if self._queue:
+        self._maybe_install_adoption()
+        if self._queue and self._staged_adoption is None:
             self._ensure_pool(nd_array(self._queue[0].prompt))
         # admission at the iteration boundary (Orca-style): joiners
-        # prefill now and take part in the very next pooled step
+        # prefill now and take part in the very next pooled step —
+        # gated while a staged weight generation awaits its empty
+        # boundary (a fresh admission would pin the OLD generation
+        # and starve the install under continuous load)
         for i in range(self._num_slots):
-            if not self._queue:
+            if not self._queue or self._staged_adoption is not None:
                 break
             if self._slots[i] is None:
                 req = self._queue.pop(0)
@@ -1519,6 +1523,11 @@ class ContinuousBatchingEngine:
                                              row=i)
 
         active = [i for i, s in enumerate(self._slots) if s is not None]
+        # hot-swap invariant: every decoding slot rides the weight
+        # generation pinned at its admission (installs happen only at
+        # empty boundaries, so these can never diverge)
+        assert all(self._slots[i].param_gen == self._param_gen
+                   for i in active), "slot outlived a weight install"
         # per-slot fault site, consulted at the iteration boundary in
         # slot order (deterministic hit counting): a raise here models a
         # per-request step failure and quarantines exactly that slot
@@ -1619,6 +1628,140 @@ class ContinuousBatchingEngine:
         no cache tiers)."""
         return 0
 
+    # -- live weight hot-swap (docs/serving.md "Elastic serving") --------
+    @staticmethod
+    def _hotswap_enabled():
+        """MXTPU_HOTSWAP kill switch (default enabled): ``0`` refuses
+        every ``adopt()`` process-wide, so an operator can freeze a
+        fleet's weights without touching call sites."""
+        return os.environ.get("MXTPU_HOTSWAP", "1").strip().lower() \
+            not in ("0", "false", "off")
+
+    def adopt(self, checkpoint):
+        """Stage a guardian-verified checkpoint as the NEXT weight
+        generation; it installs at the first iteration boundary with no
+        active slots.  Returns the staged generation number.
+
+        The contract (docs/serving.md "Elastic serving"):
+
+        - the checkpoint is CRC-verified host-side
+          (:func:`~mxtpu.resilience.checkpoint.verify`) and its params
+          validated against this block's tree BEFORE anything changes —
+          a corrupt/torn file raises
+          :class:`~mxtpu.resilience.CorruptCheckpointError` (a
+          mismatched one ``ValueError``) and the replica keeps serving
+          the old generation untouched;
+        - in-flight streams finish bit-identical on the OLD weights:
+          each slot pins its generation at admission and install waits
+          for every slot to drain (new admissions are gated while a
+          generation is staged, so the boundary arrives);
+        - new admissions after install ride the new generation; cached
+          prefix state (radix index, pinned/host tiers, sessions) is
+          dropped at install — its KV was computed under the old
+          weights and must never satisfy a new-generation hit;
+        - :meth:`rollback` re-stages the previous generation through
+          the same machinery.
+
+        ``checkpoint`` is a path to a guardian pickle blob (the
+        ``{"params": {name: array}, ...}`` form) or a raw
+        ``{name: array}`` pickle.  The ``serving.adopt`` fault site
+        fires FIRST, keyed by the checkpoint's basename — an injected
+        raise models an adoption that never started."""
+        import pickle
+
+        from ..resilience.checkpoint import (CorruptCheckpointError,
+                                             verify as _ckpt_verify)
+
+        if not self._hotswap_enabled():
+            raise RuntimeError(
+                "live weight hot-swap is disabled (MXTPU_HOTSWAP=0) — "
+                "adopt() refused; the serving generation is frozen")
+        name = os.path.basename(str(checkpoint))
+        try:
+            _inject("serving.adopt", key=name)
+            with open(checkpoint, "rb") as f:
+                payload = f.read()
+            _ckpt_verify(str(checkpoint), required=True, data=payload)
+            try:
+                blob = pickle.loads(payload)
+            except Exception as exc:
+                raise CorruptCheckpointError(
+                    "checkpoint payload failed to unpickle: %s" % exc,
+                    path=str(checkpoint))
+            named = blob.get("params", blob) if isinstance(blob, dict) \
+                else None
+            if not isinstance(named, dict):
+                raise CorruptCheckpointError(
+                    "checkpoint payload is not a params mapping "
+                    "(got %s)" % type(blob).__name__,
+                    path=str(checkpoint))
+            leaves = self._dec.prepare_adoption(named)
+        except Exception as exc:
+            self._adoption_failures += 1
+            _bump("adoption_failures")
+            self._emit("serving.adopt", None, stage="failed",
+                       checkpoint=name, error=type(exc).__name__,
+                       param_generation=self._param_gen)
+            self._flight_failure("adoption_failed", checkpoint=name,
+                                 error=type(exc).__name__,
+                                 param_generation=self._param_gen)
+            raise
+        return self._stage_leaves(leaves, name)
+
+    def rollback(self):
+        """Re-stage the PREVIOUS weight generation (the leaves live on
+        until the next successful install, so rollback needs no
+        checkpoint file).  Same boundary semantics as :meth:`adopt`;
+        raises ``RuntimeError`` when nothing was ever adopted."""
+        if self._prev_leaves is None:
+            raise RuntimeError(
+                "rollback() has no previous weight generation — no "
+                "adoption has installed on this engine yet")
+        self._rollbacks += 1
+        _bump("adoption_rollbacks")
+        self._emit("serving.rollback", None,
+                   param_generation=self._param_gen)
+        return self._stage_leaves(self._prev_leaves, "<rollback>")
+
+    def _stage_leaves(self, leaves, name):
+        """Shared adopt/rollback tail: park the placed leaves and gate
+        admissions until the pool drains to an empty boundary."""
+        self._staged_adoption = leaves
+        self._adoption_staged_step = self._steps
+        self._emit("serving.adopt", None, stage="staged",
+                   checkpoint=name, param_generation=self._param_gen,
+                   active_slots=self.active)
+        return self._param_gen + 1
+
+    def _maybe_install_adoption(self):
+        """Iteration-boundary install: when a generation is staged and
+        every slot has drained, swap the decoder's live leaves, bump
+        the generation, and drop all cached prefix state (computed
+        under the old weights).  Runs FIRST in ``_step_impl`` so the
+        admissions that follow in the same iteration already ride the
+        new generation."""
+        if self._staged_adoption is None:
+            return
+        if any(s is not None for s in self._slots):
+            return                  # streams still pinned to old gen
+        self._prev_leaves = self._dec._live_param_leaves()
+        self._dec.install_leaves(self._staged_adoption)
+        self._staged_adoption = None
+        self._param_gen += 1
+        self._last_adoption_steps = \
+            self._steps - self._adoption_staged_step
+        self._adoption_staged_step = None
+        self._adoptions += 1
+        _bump("adoptions")
+        freed = self.drop_cache()
+        san = _sanitizer()
+        if san is not None and getattr(self, "_bp", None) is not None:
+            san.check_drain(self._bp)       # V004: zero pins survive
+        self._emit("serving.adopt", None, stage="installed",
+                   param_generation=self._param_gen,
+                   latency_steps=self._last_adoption_steps,
+                   dropped_pages=freed)
+
     # -- drain -----------------------------------------------------------
     def run(self):
         """Drain the queue and every active slot; returns {request id →
@@ -1672,6 +1815,7 @@ class _PagedSlot(_Slot):
         self.keys = None
         self.history = None
         self.n_emitted = 0
+        self.param_gen = 0
         self.Tp = Tp
         self.chunks = chunks          # [(start, T_actual, T_bucketed)]
         self.chunk_i = 0
@@ -1831,7 +1975,7 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
     @property
     def stats(self):
         out = dict(super().stats)
-        out.update(with_deprecated_aliases({
+        out.update({
             "blocks_in_use": self._bp.in_use,
             "blocks_free": self._bp.free_count,
             "blocks_shared": self._bp.shared_count,
@@ -1851,7 +1995,7 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
             "session_hit_requests": self._session_hits,
             "sessions_open": len(self._sessions),
             "prefill_tokens_avoided": self._prefill_tokens_avoided,
-        }, _PAGED_STATS_ALIASES))
+        })
         return out
 
     # -- paged pool plumbing ---------------------------------------------
@@ -2357,6 +2501,7 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
                        dst=int(pages[len(full)]))
             self._cow_copies += 1
         slot = _PagedSlot(req, slot_idx, Tp, chunks, cow)
+        slot.param_gen = self._param_gen
         self._slots[slot_idx] = slot
         self._status[req.rid] = "active"
         self._swap_attempted.discard(req.rid)   # bounded bookkeeping
@@ -2495,6 +2640,7 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
         ``engine.iteration`` trace span — base class.)"""
         finished_before = set(self._results)
         self._evict_expired()
+        self._maybe_install_adoption()
         # chunked prefill FIRST: slots already prefilling advance one
         # chunk per iteration, interleaved with (never stalling) the
         # decode step below; slots admitted later this iteration ran
@@ -2506,11 +2652,12 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
                     self._advance_prefill(i)
                 except Exception as exc:
                     self._quarantine(i, exc, "serving.admit")
-        if self._queue:
+        if self._queue and self._staged_adoption is None:
             self._ensure_pool(nd_array(self._queue[0].prompt))
         deferred = False
         for i in range(self._num_slots):
-            if not self._queue or deferred:
+            if not self._queue or deferred \
+                    or self._staged_adoption is not None:
                 break
             if self._slots[i] is None:
                 req = self._queue.pop(0)
@@ -2532,6 +2679,10 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
 
         active = [i for i, s in enumerate(self._slots)
                   if s is not None and not s.prefilling]
+        # hot-swap invariant (base _step_impl docstring): decoding
+        # slots ride their admission-pinned weight generation
+        assert all(self._slots[i].param_gen == self._param_gen
+                   for i in active), "slot outlived a weight install"
         for i in list(active):
             try:
                 _inject("serving.step", key=self._slots[i].req.rid)

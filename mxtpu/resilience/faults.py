@@ -108,6 +108,22 @@ site                fires at
                     a raise models a failed write, previous file intact
 ``ckpt.verify``     at each checkpoint verification
                     (``resilience.checkpoint.verify`` / ``verify_dir``)
+``autoscale.spawn`` before the autoscaler's factory call grows the pool
+                    (``mxtpu.serving.Autoscaler``), keyed by the new
+                    replica id — a raise degrades to serving at the
+                    CURRENT capacity (the decision is counted, the pool
+                    is unchanged, nothing half-spawned joins)
+``autoscale.retire``
+                    at the RELEASE step of a graceful scale-down, keyed
+                    by the victim replica id, after the victim drained
+                    to zero load but before anything is removed — a
+                    raise clears the retiring flag and re-opens
+                    admissions on the victim (no stream was ever at
+                    risk: the graceful path never requeues)
+``serving.adopt``   start of an engine's ``adopt(checkpoint)``, keyed
+                    by the checkpoint basename, before any byte is read
+                    — a raise (like a corrupt checkpoint) leaves the
+                    old parameter generation serving untouched
 ==================  =====================================================
 
 ``inject(site, key=...)`` may be called with any site name — the table
@@ -167,7 +183,8 @@ SITES = ("serving.step", "serving.admit", "serving.prefix_lookup",
          "replica.stream",
          "transport.rpc", "transport.encode", "transport.worker_death",
          "kvstore.reduce", "checkpoint.save", "engine.flush",
-         "guardian.check", "ckpt.write", "ckpt.verify")
+         "guardian.check", "ckpt.write", "ckpt.verify",
+         "autoscale.spawn", "autoscale.retire", "serving.adopt")
 
 
 class InjectedFault(MXTPUError):

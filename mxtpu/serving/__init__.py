@@ -50,6 +50,7 @@ from __future__ import annotations
 import os
 from typing import Callable, Optional
 
+from .autoscale import Autoscaler
 from .gateway import Gateway
 from .router import Router
 from .supervisor import ReplicaSupervisor
@@ -57,9 +58,9 @@ from .transport import (InProcessReplica, ReplicaDownError,
                         ReplicaTransport, SubprocessReplica,
                         request_spec)
 
-__all__ = ["Gateway", "Router", "ReplicaSupervisor", "ReplicaTransport",
-           "InProcessReplica", "SubprocessReplica", "ReplicaDownError",
-           "request_spec", "replica_pool"]
+__all__ = ["Autoscaler", "Gateway", "Router", "ReplicaSupervisor",
+           "ReplicaTransport", "InProcessReplica", "SubprocessReplica",
+           "ReplicaDownError", "request_spec", "replica_pool"]
 
 
 def replica_pool(factory, n: Optional[int] = None,

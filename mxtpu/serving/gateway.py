@@ -52,7 +52,6 @@ import numpy as onp
 from ..base import MXTPUError
 from ..ndarray import NDArray
 from ..observability.flight import get_flight as _flight
-from ..observability.metrics import with_deprecated_aliases
 from ..observability.trace import gateway_rid, get_tracer as _tracer
 from ..resilience import (EngineShedError, LoadShedError, QosShedError,
                           RetryPolicy)
@@ -64,14 +63,6 @@ from .transport import (InProcessReplica, ReplicaDownError,
                         ReplicaTransport, request_spec)
 
 __all__ = ["Gateway"]
-
-#: deprecated stats-key spellings kept for one release (old ->
-#: canonical — docs/observability.md "Stats key normalization")
-_GATEWAY_STATS_ALIASES = {
-    "qos_sheds": "qos_shed_requests",
-    "engine_sheds": "engine_shed_requests",
-    "hedges": "hedged_requests",
-}
 
 
 def _env_int(name, default):
@@ -250,10 +241,10 @@ class Gateway:
 
     @property
     def stats(self) -> dict:
-        # canonical key names use the *_requests suffix convention;
-        # the deprecated aliases (kept one release) are mapped in
-        # docs/observability.md
-        return with_deprecated_aliases({
+        # canonical key names use the *_requests suffix convention
+        # (the deprecated pre-PR-14 spellings are gone — mapping table
+        # in docs/observability.md)
+        return {
             "ticks": self._tick,
             "queued": len(self._queue),
             "outstanding": sum(1 for r in self._reqs.values()
@@ -265,7 +256,7 @@ class Gateway:
             "ttft_ticks": dict(self._ttft),
             "supervisor": self._sup.stats,
             "router": self._router.stats,
-        }, _GATEWAY_STATS_ALIASES)
+        }
 
     # -- observability plumbing (docs/observability.md) ------------------
     @staticmethod

@@ -93,8 +93,11 @@ class Router:
 
     # -- selection -------------------------------------------------------
     def _routable(self, exclude: Set[str]) -> List[ReplicaTransport]:
+        # retiring replicas (autoscaler graceful scale-down) keep
+        # decoding their in-flight streams but take no new work
         return [r for r in self._sup.alive
-                if r.replica_id not in exclude]
+                if r.replica_id not in exclude
+                and not getattr(r, "retiring", False)]
 
     def has_capacity(self, exclude: Sequence[str] = ()) -> bool:
         return any(self._has_room(r) for r in self._routable(set(exclude)))

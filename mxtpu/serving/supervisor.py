@@ -120,6 +120,34 @@ class ReplicaSupervisor:
                 return r
         raise KeyError(replica_id)
 
+    # -- elastic pool membership (docs/serving.md "Elastic serving") -----
+    def add_replica(self, rep: ReplicaTransport) -> None:
+        """Admit one freshly spawned replica into the pool (the
+        autoscaler's scale-up path).  It joins with clean failure
+        counters and becomes routable on the next tick."""
+        if any(r.replica_id == rep.replica_id for r in self._replicas):
+            raise ValueError("duplicate replica id %r"
+                             % (rep.replica_id,))
+        self._replicas.append(rep)
+        self._consec[rep.replica_id] = 0
+        self._transport_failures[rep.replica_id] = 0
+
+    def remove_replica(self, replica_id: str) -> ReplicaTransport:
+        """Drop one replica from the pool and forget its supervision
+        state (the autoscaler's retire release step — the replica must
+        already be drained; the caller owns process teardown for
+        subprocess transports).  The pool never shrinks below one."""
+        rep = self.replica(replica_id)
+        if len(self._replicas) <= 1:
+            raise ValueError(
+                "cannot remove the last replica from the pool")
+        self._replicas.remove(rep)
+        for d in (self._consec, self._transport_failures,
+                  self._last_progress, self._stalled_for,
+                  self._death_tick, self._last_errors):
+            d.pop(replica_id, None)
+        return rep
+
     @property
     def stats(self) -> dict:
         return {
@@ -195,10 +223,23 @@ class ReplicaSupervisor:
     def revive(self, replica_id: str) -> None:
         """Re-admit one drained replica (probation over, or an operator
         decision in tests/tools): failure counters reset, the replica
-        rejoins empty and routable."""
+        rejoins empty and routable.
+
+        A transport whose worker PROCESS is dead (a killed
+        :class:`~mxtpu.serving.transport.SubprocessReplica`) is
+        respawned first — fresh pipe, fresh handshake, factory re-run
+        worker-side — because flipping ``alive`` on a corpse would
+        re-admit a replica that fails every probe and immediately
+        re-dies.  Duck-typed on ``respawn``/``worker_dead`` so stub
+        transports in tests opt in by providing them; a respawn that
+        raises leaves the replica dead (probation keeps retrying on
+        later ticks)."""
         rep = self.replica(replica_id)
         if rep.alive:
             return
+        if (hasattr(rep, "respawn")
+                and getattr(rep, "worker_dead", False)):
+            rep.respawn()           # a raise keeps the replica dead
         rep.alive = True
         self._consec[replica_id] = 0
         self._stalled_for.pop(replica_id, None)
@@ -240,7 +281,17 @@ class ReplicaSupervisor:
                 t0 = self._death_tick.get(r.replica_id)
                 if (not r.alive and t0 is not None
                         and self.tick_count - t0 >= self._revive_after):
-                    self.revive(r.replica_id)
+                    try:
+                        self.revive(r.replica_id)
+                    except Exception as exc:  # noqa: BLE001 — a failed
+                        # respawn keeps the replica dead; its death
+                        # tick stands, so probation retries next tick
+                        self._last_errors[r.replica_id] = {
+                            "reason": "revive/respawn failed",
+                            "type": type(exc).__name__,
+                            "error": str(exc),
+                            "tick": self.tick_count,
+                        }
         tokens: Dict[Any, List[int]] = {}
         finished: List[Tuple[Any, str, Any]] = []
         requeue: List[Any] = []

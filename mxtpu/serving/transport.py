@@ -96,6 +96,12 @@ class ReplicaTransport:
     #: flipped False by the supervisor on declared death; transports
     #: refuse new work while down
     alive: bool = True
+    #: flipped True by the autoscaler's graceful scale-down
+    #: (docs/serving.md "Elastic serving"): a retiring replica refuses
+    #: NEW admissions but keeps decoding its in-flight streams to
+    #: completion — the opposite of the death path, which drains and
+    #: requeues.  The router skips retiring replicas at dispatch.
+    retiring: bool = False
 
     # -- capacity / placement signals ------------------------------------
     @property
@@ -168,6 +174,17 @@ class ReplicaTransport:
         elsewhere.  After drain the replica holds zero pages."""
         raise NotImplementedError
 
+    def adopt(self, checkpoint) -> int:
+        """Stage a verified checkpoint as the replica engine's next
+        weight generation (docs/serving.md "Elastic serving"); returns
+        the staged generation number.  In-flight streams finish on the
+        old weights; failures leave the old generation serving."""
+        raise NotImplementedError
+
+    def rollback(self) -> int:
+        """Re-stage the engine's previous weight generation."""
+        raise NotImplementedError
+
 
 class InProcessReplica(ReplicaTransport):
     """ReplicaTransport over one engine instance in this process.
@@ -221,6 +238,10 @@ class InProcessReplica(ReplicaTransport):
         if not self.alive:
             raise ReplicaDownError(
                 "replica %s is down: submit refused" % self.replica_id)
+        if self.retiring:
+            raise ReplicaDownError(
+                "replica %s is retiring: submit refused (in-flight "
+                "streams are draining to completion)" % self.replica_id)
         kw = {k: spec[k] for k in SPEC_KEYS if k in spec}
         rid = self._eng.submit(nd_array(spec["prompt"]),
                                kw.pop("max_new_tokens"), **kw)
@@ -243,7 +264,10 @@ class InProcessReplica(ReplicaTransport):
         return rid
 
     def step(self) -> None:
-        if self._eng.pending or self._eng.active:
+        # a staged weight generation installs at an EMPTY iteration
+        # boundary, so an otherwise-idle engine still needs the step
+        if self._eng.pending or self._eng.active \
+                or getattr(self._eng, "_staged_adoption", None) is not None:
             self._eng.step()
 
     def _slot_of(self, rid):
@@ -378,6 +402,12 @@ class InProcessReplica(ReplicaTransport):
             san.check_drain(pool)           # V004: zero pins post-drain
         return tags
 
+    def adopt(self, checkpoint) -> int:
+        return self._eng.adopt(checkpoint)
+
+    def rollback(self) -> int:
+        return self._eng.rollback()
+
 
 # -- the cross-process transport ------------------------------------------
 
@@ -410,6 +440,12 @@ def _rebuild_error(err: dict) -> BaseException:
         return ReplicaDownError(msg)
     if name == "InjectedFault":
         return InjectedFault(msg)
+    if name == "CorruptCheckpointError":
+        # typed so the hot-swap contract (corrupt checkpoint -> old
+        # generation keeps serving, caller sees the REAL error class)
+        # survives the process boundary
+        from ..resilience.checkpoint import CorruptCheckpointError
+        return CorruptCheckpointError(msg)
     if name == "MXTPUError":
         return MXTPUError(msg)
     cls = getattr(builtins, name, None)
@@ -522,6 +558,11 @@ class SubprocessReplica(ReplicaTransport):
         self._last_drain: Optional[dict] = None
         self._exit_emitted = False
         self.pid: Optional[int] = None
+        # everything a FRESH worker needs is kept so respawn() (the
+        # supervisor's probation revival of a dead worker) can rebuild
+        # pipe + handshake + factory call from scratch
+        self._factory = factory
+        self._factory_kwargs = dict(kwargs or {})
         child_env = dict(os.environ)
         for var in self._SCRUBBED_ENV:
             child_env.pop(var, None)
@@ -532,17 +573,25 @@ class SubprocessReplica(ReplicaTransport):
             pkg_root + os.pathsep + child_env["PYTHONPATH"]
             if child_env.get("PYTHONPATH") else pkg_root)
         child_env.update(env or {})
+        self._child_env = child_env
+        self._python = python
+        self._proc: Optional[subprocess.Popen] = None
+        self._spawn()
+
+    def _spawn(self) -> None:
+        """Start one worker process and handshake it (shared by
+        construction and :meth:`respawn`)."""
         # -c (not -m): the package import graph already holds
         # mxtpu.serving.worker, and runpy would warn about re-executing
         # a module that import brought in
         self._proc = subprocess.Popen(
-            [python or sys.executable, "-c",
+            [self._python or sys.executable, "-c",
              "import sys; from mxtpu.serving.worker import main; "
              "sys.exit(main())"],
             stdin=subprocess.PIPE, stdout=subprocess.PIPE,
-            env=child_env, bufsize=0)
+            env=self._child_env, bufsize=0)
         try:
-            self._handshake(factory, kwargs)
+            self._handshake(self._factory, self._factory_kwargs)
         except BaseException:
             self._kill_worker()
             raise
@@ -550,6 +599,45 @@ class SubprocessReplica(ReplicaTransport):
         if tr.active:
             tr.emit("transport.worker_spawn", replica=self.replica_id,
                     capacity=self._capacity, noise={"pid": self.pid})
+
+    def respawn(self) -> None:
+        """Spawn a FRESH worker for this replica — new pipe, new
+        handshake, factory re-run worker-side — after the old one
+        died.  The supervisor's probation ``revive()`` calls this for
+        subprocess replicas instead of re-admitting a corpse; per-
+        worker protocol state (tag mirror, frame ids, heartbeat) resets
+        because the new process shares none of it.  Raises
+        :class:`~mxtpu.resilience.TransportError` while the old worker
+        is still running (kill or shut it down first)."""
+        if self._proc is not None and self._proc.poll() is None:
+            raise TransportError(
+                "replica %s worker pid %s is still running — respawn "
+                "only replaces a DEAD worker" % (self.replica_id,
+                                                 self.pid))
+        if self._proc is not None:
+            self._emit_exit()
+            for pipe in (self._proc.stdin, self._proc.stdout):
+                try:
+                    if pipe is not None:
+                        pipe.close()
+                except Exception:  # noqa: BLE001
+                    pass
+        self._proc = None
+        self._mirror.clear()
+        self._stale.clear()
+        self._next_fid = 0
+        self._last_heartbeat = 0
+        self._last_drain = None
+        self._exit_emitted = False
+        self.pid = None
+        self._spawn()
+
+    @property
+    def worker_dead(self) -> bool:
+        """Whether the worker PROCESS is gone (closed, exited, or
+        killed) — the supervisor's revive() respawns exactly when this
+        is true."""
+        return self._proc is None or self._proc.poll() is not None
 
     def _handshake(self, factory: str, kwargs: Optional[dict]) -> None:
         init = {"factory": factory, "kwargs": dict(kwargs or {}),
@@ -824,6 +912,10 @@ class SubprocessReplica(ReplicaTransport):
         if not self.alive:
             raise ReplicaDownError(
                 "replica %s is down: submit refused" % self.replica_id)
+        if self.retiring:
+            raise ReplicaDownError(
+                "replica %s is retiring: submit refused (in-flight "
+                "streams are draining to completion)" % self.replica_id)
         if self._proc is None or self._proc.poll() is not None:
             raise ReplicaDownError(
                 "replica %s worker process is dead: submit refused"
@@ -908,3 +1000,14 @@ class SubprocessReplica(ReplicaTransport):
         self._mirror.clear()
         self._stale.clear()
         return tags
+
+    def adopt(self, checkpoint) -> int:
+        """Hot-swap RPC: the checkpoint path crosses the wire as a
+        string (same-host shared filesystem); the worker-side engine
+        reads, CRC-verifies, and stages it itself, so a corrupt file
+        raises here as the rebuilt typed error and the worker keeps
+        serving its old generation."""
+        return int(self._rpc("adopt", {"checkpoint": str(checkpoint)}))
+
+    def rollback(self) -> int:
+        return int(self._rpc("rollback"))

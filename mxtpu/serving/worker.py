@@ -267,6 +267,13 @@ def _dispatch(rep, method: str,
         return bool(rep.cancel(_dec_tag(params["tag"]))), False
     if method == "stats":
         return rep.stats(), False
+    if method == "adopt":
+        # hot-swap: the path names a file on the shared (same-host)
+        # filesystem; verification/staging happen engine-side so the
+        # typed failure contract is identical to in-process adoption
+        return int(rep.adopt(params["checkpoint"])), False
+    if method == "rollback":
+        return int(rep.rollback()), False
     if method == "drain":
         tags = rep.drain()
         st = rep.stats()

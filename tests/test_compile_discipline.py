@@ -295,8 +295,8 @@ def test_paged_engine_holds_compile_budget():
             [shared, rng.randint(0, 50, (1, 5))], axis=1),
             dtype="int32"), 3)
         eng.run()
-    assert eng.stats["prefix_hits"] >= 1
-    assert eng.stats["cow_copies"] >= 1
+    assert eng.stats["prefix_hit_requests"] >= 1
+    assert eng.stats["cow_copied_blocks"] >= 1
     # the discipline checker sees only bounded bucketed growth here
     assert "serving.page_prefill" not in [
         d.subject for d in check_compiles().filter(code="C001")]

@@ -141,6 +141,7 @@ def test_dist_kvstore_single_process():
     np.testing.assert_allclose(out.asnumpy(), np.full(4, 5.0))
 
 
+@pytest.mark.slow
 def test_bert_forward_and_sharded_training():
     np.random.seed(0)
     mesh = make_mesh(dp=4, tp=2)

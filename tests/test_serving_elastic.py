@@ -1,0 +1,476 @@
+"""Elastic serving: deterministic autoscaling, graceful retire, live
+weight hot-swap (docs/serving.md "Elastic serving").
+
+The acceptance contract exercised here: a retire and an adoption BOTH
+preserve every in-flight stream bit-identical to an isolated
+``ShardedDecoder.generate`` with the same sampling spec (greedy /
+seeded / penalized), a retired replica releases with
+``blocks_in_use == 0`` and requeues ZERO tags (the graceful path is
+the opposite of the death path's drain-and-requeue), and the three
+new fault sites — ``autoscale.spawn``, ``autoscale.retire``,
+``serving.adopt`` — drive their degradation paths from literal
+``MXTPU_FAULT_PLAN`` rules with byte-identical trace/flight artifacts
+across reruns.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+
+import numpy as np
+import pytest
+
+import mxtpu as mx
+from mxtpu.analysis import check_observability
+from mxtpu.models.transformer import (llama_tiny,
+                                      transformer_lm_sharding_rules)
+from mxtpu.observability.flight import flight_recording
+from mxtpu.observability.trace import get_tracer, tracing
+from mxtpu.parallel import (PagedContinuousBatchingEngine,
+                            ShardedDecoder, make_mesh)
+from mxtpu.resilience import fault_plan
+from mxtpu.resilience.checkpoint import (CorruptCheckpointError,
+                                         write_verified)
+from mxtpu.serving import (Autoscaler, Gateway, ReplicaDownError,
+                           replica_pool, request_spec)
+
+VOCAB = 50
+MAX_LEN = 32
+
+# the acceptance trio: greedy, seeded sampling, penalized sampling —
+# every elastic-path stream must stay bit-identical to the isolated
+# reference under each
+SAMPLING = (
+    {},
+    {"temperature": 0.8, "top_k": 8, "seed": 23},
+    {"repetition_penalty": 1.3, "seed": 5},
+)
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    return make_mesh(dp=1)
+
+
+@pytest.fixture(scope="module")
+def rules():
+    return transformer_lm_sharding_rules()
+
+
+def _materialized_net(seed):
+    mx.random.seed(seed)
+    net = llama_tiny(vocab_size=VOCAB)
+    net.initialize()
+    # one forward materializes the deferred-init parameters (their
+    # shapes are only known after shape inference)
+    net(mx.nd.array(np.asarray([[1, 2]], dtype=np.int32)))
+    return net
+
+
+@pytest.fixture(scope="module")
+def net_a():
+    return _materialized_net(7)
+
+
+@pytest.fixture(scope="module")
+def net_b():
+    return _materialized_net(13)
+
+
+@pytest.fixture(scope="module")
+def dec_a(net_a, mesh, rules):
+    return ShardedDecoder(net_a, mesh, rules)
+
+
+@pytest.fixture(scope="module")
+def dec_b(net_b, mesh, rules):
+    return ShardedDecoder(net_b, mesh, rules)
+
+
+@pytest.fixture(scope="module")
+def ckpt(dec_b, tmp_path_factory):
+    """A guardian-shaped verified checkpoint holding net_b's weights
+    (written from a DIFFERENT net instance, so adoption also covers
+    the instance-prefix name normalization)."""
+    named = {p.name: np.asarray(p.data()._data) for p in dec_b._params}
+    blob = pickle.dumps({"step": 42, "num_update": 1, "params": named,
+                         "opt_states": {}, "scale_state": None,
+                         "rng": None})
+    path = str(tmp_path_factory.mktemp("elastic") / "step42.ckpt")
+    write_verified(path, blob)
+    return path
+
+
+@pytest.fixture(autouse=True)
+def _clean_tracer():
+    yield
+    get_tracer().reset()
+
+
+def _factory(net, mesh, rules, prefix="el"):
+    def make(i):
+        return PagedContinuousBatchingEngine(
+            net, mesh, rules, num_slots=2, max_length=MAX_LEN,
+            block_size=8, prefill_chunk=8,
+            ledger_tag="%s%d" % (prefix, i))
+    return make
+
+
+def _ref(dec, prompt, n, **kw):
+    return dec.generate(mx.nd.array(prompt), max_new_tokens=n,
+                        max_length=MAX_LEN, **kw).asnumpy()
+
+
+def _prompts(seed, lengths):
+    rng = np.random.RandomState(seed)
+    return [np.asarray(rng.randint(0, VOCAB, (1, t)), dtype=np.int32)
+            for t in lengths]
+
+
+def _drive(gw, asc, rids, bound=400):
+    for _ in range(bound):
+        gw.pump()
+        asc.tick()
+        if all(gw.status(r) in ("ok", "failed", "expired", "shed")
+               for r in rids):
+            return
+    raise AssertionError("streams did not finish within %d pumps"
+                         % bound)
+
+
+# --------------------------------------------------------------------------
+# the policy loop: grow under pressure, retire when idle
+# --------------------------------------------------------------------------
+
+def test_autoscaler_grows_on_backlog_then_retires_idle(
+        net_a, mesh, rules, dec_a):
+    gw = Gateway(replica_pool(_factory(net_a, mesh, rules), n=1),
+                 hedge_fraction=None)
+    asc = Autoscaler(gw, _factory(net_a, mesh, rules), min_replicas=1,
+                     max_replicas=3, cooldown_ticks=2)
+    prompts = _prompts(3, [3, 4, 3, 5, 4, 3])
+    with tracing() as tr:
+        rids = [gw.submit(p, 5, **SAMPLING[i % 3])
+                for i, p in enumerate(prompts)]
+        _drive(gw, asc, rids)
+        assert asc.stats["scale_ups"] >= 1
+        assert len(gw.supervisor.replicas) >= 2
+        # sustained idleness ramps the pool back down to min_replicas,
+        # one graceful retirement at a time
+        for _ in range(120):
+            gw.pump()
+            asc.tick()
+            if len(gw.supervisor.replicas) == 1:
+                break
+        assert len(gw.supervisor.replicas) == 1
+        etypes = [e.etype for e in tr.events()]
+    for wanted in ("autoscale.decision", "autoscale.spawn",
+                   "autoscale.retire"):
+        assert wanted in etypes, wanted
+    # the graceful path requeued NOTHING and dropped NOTHING: every
+    # stream is bit-identical to the isolated sharded reference
+    assert gw.stats["requeued_requests"] == 0
+    for i, rid in enumerate(rids):
+        assert gw.status(rid) == "ok"
+        np.testing.assert_array_equal(
+            gw.result(rid).asnumpy(),
+            _ref(dec_a, prompts[i], 5, **SAMPLING[i % 3]))
+    st = asc.stats
+    assert st["retired_replicas"] == st["scale_downs"] >= 1
+    assert st["retiring_replicas"] == 0
+
+
+@pytest.mark.slow
+def test_operator_retire_preserves_inflight_streams(
+        net_a, mesh, rules, dec_a):
+    gw = Gateway(replica_pool(_factory(net_a, mesh, rules, "rt"), n=2),
+                 hedge_fraction=None)
+    asc = Autoscaler(gw, _factory(net_a, mesh, rules, "rt"),
+                     min_replicas=1, max_replicas=2, cooldown_ticks=3)
+    prompts = _prompts(9, [3, 4, 5, 3])
+    rids = [gw.submit(p, 6, **SAMPLING[i % 3])
+            for i, p in enumerate(prompts)]
+    for _ in range(3):
+        gw.pump()
+        asc.tick()
+    victim = gw.supervisor.replica("r1")
+    assert victim.load > 0, "victim must be mid-stream for this test"
+    asc.retire("r1")
+    assert victim.retiring
+    # fresh admissions are refused on the draining victim; in-flight
+    # streams keep decoding to natural completion
+    with pytest.raises(ReplicaDownError, match="retiring"):
+        victim.submit(request_spec(prompts[0], 1), ("probe", 0))
+    _drive(gw, asc, rids)
+    for _ in range(40):
+        gw.pump()
+        asc.tick()
+        if len(gw.supervisor.replicas) == 1:
+            break
+    assert len(gw.supervisor.replicas) == 1
+    assert gw.supervisor.replicas[0].replica_id == "r0"
+    # zero requeues: nothing was torn off the victim (the release path
+    # itself asserted blocks_in_use == 0 and pinned_blocks == 0)
+    assert gw.stats["requeued_requests"] == 0
+    for i, rid in enumerate(rids):
+        assert gw.status(rid) == "ok"
+        np.testing.assert_array_equal(
+            gw.result(rid).asnumpy(),
+            _ref(dec_a, prompts[i], 6, **SAMPLING[i % 3]))
+    assert asc.stats["retired_replicas"] == 1
+
+
+def test_retire_refuses_to_drop_below_min(net_a, mesh, rules):
+    gw = Gateway(replica_pool(_factory(net_a, mesh, rules, "mn"), n=1),
+                 hedge_fraction=None)
+    asc = Autoscaler(gw, _factory(net_a, mesh, rules, "mn"),
+                     min_replicas=1, max_replicas=2)
+    with pytest.raises(ValueError, match="min_replicas"):
+        asc.retire("r0")
+
+
+# --------------------------------------------------------------------------
+# fault sites: literal-plan driven degradation
+# --------------------------------------------------------------------------
+
+def test_autoscale_spawn_fault_degrades_to_current_capacity(
+        net_a, mesh, rules, dec_a):
+    gw = Gateway(replica_pool(_factory(net_a, mesh, rules, "sf"), n=1),
+                 hedge_fraction=None)
+    asc = Autoscaler(gw, _factory(net_a, mesh, rules, "sf"),
+                     min_replicas=1, max_replicas=3, cooldown_ticks=2)
+    prompts = _prompts(5, [3, 4, 3, 4])
+    with flight_recording() as fl:
+        with fault_plan(
+                "autoscale.spawn@1+:raise=RuntimeError(spawn refused)"):
+            rids = [gw.submit(p, 5, seed=11) for p in prompts]
+            _drive(gw, asc, rids)
+    # every grow decision degraded: the pool that IS serving kept
+    # serving at current capacity, and no stream was lost
+    assert len(gw.supervisor.replicas) == 1
+    assert asc.stats["spawn_failures"] >= 1
+    assert asc.stats["scale_ups"] == 0
+    for i, rid in enumerate(rids):
+        assert gw.status(rid) == "ok"
+        np.testing.assert_array_equal(
+            gw.result(rid).asnumpy(),
+            _ref(dec_a, prompts[i], 5, seed=11))
+    kinds = [pm.kind for pm in fl.postmortems]
+    assert "autoscale_spawn_failed" in kinds
+
+
+def test_autoscale_retire_fault_reopens_admissions(
+        net_a, mesh, rules, dec_a):
+    gw = Gateway(replica_pool(_factory(net_a, mesh, rules, "rf"), n=2),
+                 hedge_fraction=None)
+    asc = Autoscaler(gw, _factory(net_a, mesh, rules, "rf"),
+                     min_replicas=1, max_replicas=2, cooldown_ticks=2)
+    with flight_recording() as fl:
+        with fault_plan(
+                "autoscale.retire@1:raise=RuntimeError(release denied)"):
+            for _ in range(30):
+                gw.pump()
+                asc.tick()
+                if asc.stats["retire_reopened"]:
+                    break
+    assert asc.stats["retire_reopened"] == 1
+    # the victim rejoined the pool fully intact: no replica lost, no
+    # replica left half-retired
+    assert len(gw.supervisor.replicas) == 2
+    assert not any(r.retiring for r in gw.supervisor.replicas)
+    assert "autoscale_retire_reopened" in \
+        [pm.kind for pm in fl.postmortems]
+    # and it still serves: route a request through the reopened pool
+    prompt = _prompts(2, [4])[0]
+    rid = gw.submit(prompt, 5, seed=7)
+    for _ in range(200):
+        gw.pump()
+        if gw.status(rid) == "ok":
+            break
+    np.testing.assert_array_equal(
+        gw.result(rid).asnumpy(), _ref(dec_a, prompt, 5, seed=7))
+
+
+def test_serving_adopt_fault_keeps_old_generation(
+        net_a, mesh, rules, dec_a, ckpt):
+    eng = PagedContinuousBatchingEngine(
+        net_a, mesh, rules, num_slots=2, max_length=MAX_LEN,
+        block_size=8, prefill_chunk=8, ledger_tag="af")
+    with fault_plan("serving.adopt@1:raise=RuntimeError(torn read)"):
+        with pytest.raises(RuntimeError, match="torn read"):
+            eng.adopt(ckpt)
+    assert eng.stats["adoption_failures"] == 1
+    assert eng.stats["param_generation"] == 0
+    # the replica keeps serving the old generation, bit-exact
+    prompt = _prompts(4, [4])[0]
+    rid = eng.submit(prompt, 5, seed=3)
+    for _ in range(60):
+        eng.step()
+        if eng.status(rid) == "ok":
+            break
+    np.testing.assert_array_equal(
+        np.asarray(eng.take_result(rid)._data),
+        _ref(dec_a, prompt, 5, seed=3))
+
+
+# --------------------------------------------------------------------------
+# live weight hot-swap
+# --------------------------------------------------------------------------
+
+def test_hot_swap_lifecycle_bit_exact(net_a, mesh, rules, dec_a, dec_b,
+                                      ckpt, tmp_path):
+    eng = PagedContinuousBatchingEngine(
+        net_a, mesh, rules, num_slots=2, max_length=MAX_LEN,
+        block_size=8, prefill_chunk=8, ledger_tag="hs")
+    prompt = _prompts(6, [4])[0]
+    ref_old = _ref(dec_a, prompt, 6, seed=11)
+    ref_new = _ref(dec_b, prompt, 6, seed=11)
+
+    # -- adopt with a stream in flight: the stream is pinned to the
+    # generation it was admitted under and finishes bit-identical on
+    # the OLD weights; the install waits for the iteration boundary
+    r_old = eng.submit(prompt, 6, seed=11)
+    eng.step()
+    gen = eng.adopt(ckpt)
+    assert eng.stats["adoption_staged"] == 1
+    for _ in range(60):
+        eng.step()
+        if eng.status(r_old) == "ok":
+            break
+    np.testing.assert_array_equal(
+        np.asarray(eng.take_result(r_old)._data), ref_old)
+    eng.step()      # the drained boundary: the staged generation installs
+    assert eng.stats["param_generation"] == gen == 1
+    assert eng.stats["adoptions"] == 1
+    assert eng.stats["last_adoption_steps"] >= 1
+    assert eng.stats["adoption_staged"] == 0
+
+    # -- new admissions ride the new generation
+    r_new = eng.submit(prompt, 6, seed=11)
+    for _ in range(60):
+        eng.step()
+        if eng.status(r_new) == "ok":
+            break
+    np.testing.assert_array_equal(
+        np.asarray(eng.take_result(r_new)._data), ref_new)
+
+    # -- rollback re-stages the previous generation
+    gen2 = eng.rollback()
+    eng.step()
+    assert eng.stats["param_generation"] == gen2 == 2
+    assert eng.stats["rollbacks"] == 1
+    r_back = eng.submit(prompt, 6, seed=11)
+    for _ in range(60):
+        eng.step()
+        if eng.status(r_back) == "ok":
+            break
+    np.testing.assert_array_equal(
+        np.asarray(eng.take_result(r_back)._data), ref_old)
+
+    # -- a corrupt checkpoint raises typed and changes NOTHING
+    bad = str(tmp_path / "bad.ckpt")
+    with open(ckpt, "rb") as f:
+        payload = f.read()
+    write_verified(bad, payload)
+    with open(bad, "r+b") as f:
+        f.seek(10)
+        f.write(b"\xff\xff\xff")
+    with pytest.raises(CorruptCheckpointError):
+        eng.adopt(bad)
+    assert eng.stats["adoption_failures"] == 1
+    assert eng.stats["param_generation"] == gen2
+    r_still = eng.submit(prompt, 6, seed=11)
+    for _ in range(60):
+        eng.step()
+        if eng.status(r_still) == "ok":
+            break
+    np.testing.assert_array_equal(
+        np.asarray(eng.take_result(r_still)._data), ref_old)
+
+    # -- the kill switch refuses adoption outright
+    os.environ["MXTPU_HOTSWAP"] = "0"
+    try:
+        with pytest.raises(RuntimeError, match="MXTPU_HOTSWAP"):
+            eng.adopt(ckpt)
+    finally:
+        del os.environ["MXTPU_HOTSWAP"]
+
+
+@pytest.mark.slow
+def test_autoscaler_adopt_fans_out_and_covers_late_spawns(
+        net_a, mesh, rules, dec_b, ckpt):
+    """Pool-wide adopt stages on every active replica, and a replica
+    spawned AFTER the swap adopts the remembered checkpoint instead of
+    serving stale factory weights."""
+    gw = Gateway(replica_pool(_factory(net_a, mesh, rules, "fo"), n=2),
+                 hedge_fraction=None)
+    asc = Autoscaler(gw, _factory(net_a, mesh, rules, "fo"),
+                     min_replicas=1, max_replicas=3, cooldown_ticks=1)
+    staged = asc.adopt(ckpt)
+    assert staged == {"r0": 1, "r1": 1}
+    prompt = _prompts(8, [4])[0]
+    ref_new = _ref(dec_b, prompt, 5, seed=9)
+    rids = [gw.submit(prompt, 5, seed=9) for _ in range(6)]
+    _drive(gw, asc, rids)
+    assert asc.stats["scale_ups"] >= 1, "backlog must have grown the pool"
+    for rid in rids:
+        assert gw.status(rid) == "ok"
+        np.testing.assert_array_equal(gw.result(rid).asnumpy(), ref_new)
+
+
+# --------------------------------------------------------------------------
+# determinism + observability coverage
+# --------------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_elastic_fault_artifacts_byte_identical(net_a, mesh, rules):
+    """Same seeds + same literal fault plan => byte-identical trace
+    AND flight JSON across reruns of the autoscaling scenario."""
+    prompts = _prompts(7, [3, 4, 3])
+
+    def run_once():
+        get_tracer().reset()
+        gw = Gateway(replica_pool(_factory(net_a, mesh, rules, "bi"),
+                                  n=1), hedge_fraction=None)
+        asc = Autoscaler(gw, _factory(net_a, mesh, rules, "bi"),
+                         min_replicas=1, max_replicas=2,
+                         cooldown_ticks=2)
+        # warm the compiled programs OUTSIDE the traced region so the
+        # first run's compile activity cannot skew the artifact
+        warm = gw.submit(prompts[0], 2, seed=1)
+        for _ in range(60):
+            gw.pump()
+            if gw.status(warm) == "ok":
+                break
+        get_tracer().reset()
+        with tracing() as tr, flight_recording() as fl:
+            with fault_plan("autoscale.spawn@1+:raise="
+                            "RuntimeError(no capacity)"):
+                rids = [gw.submit(p, 4, seed=3) for p in prompts]
+                _drive(gw, asc, rids)
+            return tr.to_json(), fl.to_json()
+
+    t1, f1 = run_once()
+    t2, f2 = run_once()
+    assert t1 == t2
+    assert f1 == f2
+    assert '"autoscale.decision"' in t1
+
+
+def test_obs_check_covers_elastic_sites():
+    """O001 stays clean for the three new fault sites: each has its
+    ``fault.*`` trace event type registered in the taxonomy."""
+    rep = check_observability(sites=("autoscale.spawn",
+                                    "autoscale.retire",
+                                    "serving.adopt"))
+    assert len(rep.filter(code="O001")) == 0, str(rep)
+
+
+def test_elastic_trace_event_types_registered():
+    from mxtpu.observability import EVENT_TYPES
+    for etype in ("autoscale.decision", "autoscale.spawn",
+                  "autoscale.retire", "serving.adopt",
+                  "serving.rollback", "fault.autoscale.spawn",
+                  "fault.autoscale.retire", "fault.serving.adopt"):
+        assert etype in EVENT_TYPES, etype

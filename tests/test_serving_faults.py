@@ -94,8 +94,8 @@ def test_quarantine_preserves_other_streams_and_retry_completes(
     err = eng.error(r2)   # last error kept for observability
     assert err["type"] == "RuntimeError" and err["site"] == "serving.step"
     after = eng.stats
-    assert after["quarantined"] - before["quarantined"] == 1
-    assert after["retries"] - before["retries"] == 1
+    assert after["quarantined_requests"] - before["quarantined_requests"] == 1
+    assert after["retried_requests"] - before["retried_requests"] == 1
 
 
 def test_quarantine_without_retries_fails_with_partial_output(
@@ -198,7 +198,7 @@ def test_deadline_eviction_at_iteration_boundary(eng, isolated):
     t0 = CLK["t"]
     rng = np.random.RandomState(23)
     p1, p2, p3 = _prompts(rng, (3, 4, 3))
-    before = eng.stats["deadline_evictions"]
+    before = eng.stats["expired_requests"]
     ra = eng.submit(p1, 8, deadline_s=5.0)
     rb = eng.submit(p2, 8)
     eng.step()
@@ -207,7 +207,7 @@ def test_deadline_eviction_at_iteration_boundary(eng, isolated):
     CLK["t"] = t0 + 10.0                 # past ra's deadline only
     eng.step()
     assert eng.status(ra) == "expired" and eng.status(rb) == "active"
-    assert eng.stats["deadline_evictions"] - before == 1
+    assert eng.stats["expired_requests"] - before == 1
     # queued requests expire too, without ever taking a slot
     rq = eng.submit(p3, 4, deadline_s=-1.0)
     eng.step()
@@ -238,11 +238,12 @@ def test_bounded_admission_sheds_with_typed_error(tiny, mesh):
     with pytest.raises(LoadShedError, match="max_pending"):
         e.submit(p, 3)
     assert issubclass(LoadShedError, MXTPUError)
-    assert e.pending == 2 and e.stats["shed"] == 1
+    assert e.pending == 2 and e.stats["shed_requests"] == 1
 
 
 def test_stats_exposes_resilience_counters(eng):
-    for key in ("quarantined", "retries", "deadline_evictions", "shed"):
+    for key in ("quarantined_requests", "retried_requests",
+                "expired_requests", "shed_requests"):
         assert key in eng.stats
 
 
@@ -288,7 +289,7 @@ def test_draft_fault_quarantines_only_offending_slot(spec_eng):
                      temperature=0.8, top_k=10, seed=101).asnumpy())
     assert eng.status(r2) == "failed"
     assert eng.error(r2)["site"] == "serving.draft"
-    assert eng.stats["quarantined"] - before["quarantined"] == 1
+    assert eng.stats["quarantined_requests"] - before["quarantined_requests"] == 1
     assert eng.free_slots == eng.num_slots
 
 

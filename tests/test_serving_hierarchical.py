@@ -170,11 +170,11 @@ def test_pinned_chain_survives_lull_and_rehits(tiny, mesh, isolated,
     res = eng.run()
     np.testing.assert_array_equal(res[r2].asnumpy(), want)
     st = eng.stats
-    assert st["prefix_hits"] >= 1
+    assert st["prefix_hit_requests"] >= 1
     # 19-token prompt + 5 emitted, last token unwritten -> 2 full pages
     # pinned; the re-hit skips both
     assert st["prefill_tokens_avoided"] == 2 * BS
-    assert st["swap_ins"] == st["swap_outs"] == 0
+    assert st["swapped_in_blocks"] == st["swapped_out_blocks"] == 0
 
 
 def test_pin_budget_lru_eviction_order(tiny, mesh, isolated):
@@ -227,15 +227,15 @@ def test_swap_out_swap_in_round_trip_bit_exact(tiny, mesh, isolated,
     np.testing.assert_array_equal(res[r1].asnumpy(), want)
     st = eng.stats
     assert st["pinned_blocks"] == 0 and st["blocks_in_use"] == 0
-    assert st["spilled_blocks"] == 2 and st["swap_outs"] == 2
+    assert st["spilled_blocks"] == 2 and st["swapped_out_blocks"] == 2
     r2 = eng.submit(p, 5)
     res = eng.run()
     np.testing.assert_array_equal(res[r2].asnumpy(), want)
     st = eng.stats
-    assert st["swap_ins"] == 2
+    assert st["swapped_in_blocks"] == 2
     assert st["prefill_tokens_avoided"] == 2 * BS
     # the restored chain was re-pinned, then budget-spilled again
-    assert st["swap_outs"] == 4 and st["spilled_blocks"] == 2
+    assert st["swapped_out_blocks"] == 4 and st["spilled_blocks"] == 2
     # ONE bounded copy program serves both directions
     assert len([k for k in eng._dec._jit_cache if k[0] == "swap"]) == 1
 
@@ -253,7 +253,7 @@ def test_swapped_in_seeded_sampled_parity(tiny, mesh, isolated):
     r2 = eng.submit(p, 6, temperature=0.8, top_k=12, seed=404)
     res = eng.run()
     np.testing.assert_array_equal(res[r2].asnumpy(), want)
-    assert eng.stats["swap_ins"] == 2
+    assert eng.stats["swapped_in_blocks"] == 2
 
 
 # --------------------------------------------------- multi-turn sessions
@@ -286,7 +286,7 @@ def test_session_turns_prefill_only_new_suffix(tiny, mesh, isolated,
             transcript = prompt.shape[1] - 4     # before the new msg
             assert avoided[-1] - avoided[-2] == \
                 (transcript - 1) // BS * BS
-            assert st["session_hits"] == turn
+            assert st["session_hit_requests"] == turn
         prompt = np.concatenate(
             [res[rid].asnumpy(), rng.randint(0, VOCAB, (1, 4))], axis=1)
     st = eng.stats
@@ -363,14 +363,14 @@ def test_pool_pressure_evicts_pinned_before_deferring(tiny, mesh,
     np.testing.assert_array_equal(res[rb].asnumpy(),
                                   _want(isolated, pb, 19))
     st = eng.stats
-    assert st["swap_outs"] == 2                 # spilled, not dropped
+    assert st["swapped_out_blocks"] == 2                 # spilled, not dropped
     assert st["spilled_blocks"] == 2
     # A's prefix restores on the next identical submit
     r2 = eng.submit(pa, 5)
     res = eng.run()
     np.testing.assert_array_equal(res[r2].asnumpy(),
                                   _want(isolated, pa, 5))
-    assert eng.stats["swap_ins"] == 2
+    assert eng.stats["swapped_in_blocks"] == 2
 
 
 def test_session_chains_evict_last_under_pressure(tiny, mesh, isolated):
@@ -414,7 +414,7 @@ def test_session_chains_evict_last_under_pressure(tiny, mesh, isolated):
     np.testing.assert_array_equal(res[r2].asnumpy(),
                                   _want(isolated, ps, 4))
     st = eng.stats
-    assert st["swap_ins"] >= 2
+    assert st["swapped_in_blocks"] >= 2
     assert st["prefill_tokens_avoided"] - avoided0 == 2 * BS
     eng.close_session("s")
     eng._enforce_pin_budget()
@@ -444,7 +444,7 @@ def test_pinned_page_as_cow_donor_keeps_refcounts(tiny, mesh, isolated):
     rb = eng.submit(pb, 6)
     eng.step()                                   # B admits: COW clone
     st = eng.stats
-    assert st["cow_copies"] >= 1
+    assert st["cow_copied_blocks"] >= 1
     assert eng._bp.pin_count(donor_pages[1]) == 1   # donor still pinned
     # spill the donor chain while B is mid-decode
     eng._spill_chain(donor_chain)
@@ -485,9 +485,9 @@ def test_swap_in_fault_quarantines_and_retry_restores(tiny, mesh,
         res[rn].asnumpy(),
         _want(isolated, pn, 4, temperature=0.6, seed=99))
     st = eng.stats
-    assert st["quarantined"] - before["quarantined"] == 1
-    assert st["retries"] - before["retries"] == 1
-    assert st["swap_ins"] == 2                  # the clean retry only
+    assert st["quarantined_requests"] - before["quarantined_requests"] == 1
+    assert st["retried_requests"] - before["retried_requests"] == 1
+    assert st["swapped_in_blocks"] == 2                  # the clean retry only
     assert st["blocks_in_use"] == 0
 
 
@@ -506,7 +506,7 @@ def test_swap_out_fault_drops_chain_without_poisoning(tiny, mesh,
     np.testing.assert_array_equal(res[r1].asnumpy(),
                                   _want(isolated, p, 5))
     st = eng.stats
-    assert st["spilled_blocks"] == 0 and st["swap_outs"] == 0
+    assert st["spilled_blocks"] == 0 and st["swapped_out_blocks"] == 0
     assert st["pinned_blocks"] == 0 and st["blocks_in_use"] == 0
     # next submit recomputes (no host copy) and spills cleanly
     r2 = eng.submit(p, 5)
@@ -605,7 +605,7 @@ def test_partial_restore_keeps_host_tail_for_session(tiny, mesh,
     np.testing.assert_array_equal(res[rs].asnumpy(),
                                   _want(isolated, ps, 4))
     st = eng.stats
-    assert st["swap_ins"] == 2                   # prefix only
+    assert st["swapped_in_blocks"] == 2                   # prefix only
     assert eng._hc.host_chains >= 1              # tail NOT discarded
     # the session's next turn restores the rest of its transcript
     p2 = np.concatenate([transcript, rng.randint(0, VOCAB, (1, 4))], 1)
@@ -617,7 +617,7 @@ def test_partial_restore_keeps_host_tail_for_session(tiny, mesh,
         isolated.generate(nd.array(p2, dtype="int32"),
                           max_new_tokens=4, max_length=96).asnumpy())
     st = eng.stats
-    assert st["swap_ins"] == chain_len           # tail restored too
+    assert st["swapped_in_blocks"] == chain_len           # tail restored too
     assert st["prefill_tokens_avoided"] - avoided0 == chain_len * BS
     eng.close_session("s")
     eng._hc.pin_blocks = 0
@@ -649,7 +649,7 @@ def test_swap_round_trip_on_tp_sharded_pool(tiny):
     res = eng.run()
     np.testing.assert_array_equal(res[r2].asnumpy(), want)
     st = eng.stats
-    assert st["swap_ins"] == 2 and st["blocks_in_use"] == 0
+    assert st["swapped_in_blocks"] == 2 and st["blocks_in_use"] == 0
 
 
 # ------------------------------------------------- compile discipline
@@ -675,7 +675,7 @@ def test_swap_tier_adds_one_bounded_copy_program(tiny, mesh):
         eng.run()
         eng.close_session("z")
     st = eng.stats
-    assert st["swap_ins"] > 0 and st["swap_outs"] > 0
+    assert st["swapped_in_blocks"] > 0 and st["swapped_out_blocks"] > 0
     cache = eng._dec._jit_cache
     assert len([k for k in cache if k[0] == "swap"]) == 1
     assert st["blocks_in_use"] == st["pinned_blocks"]

@@ -159,8 +159,8 @@ def test_prefix_sharing_parity_and_cow_at_divergence(eng, isolated):
     assert pages_b[1] != pages_a[1]          # COW clone at divergence
     mid = eng.stats
     assert mid["blocks_shared"] == 1
-    assert mid["prefix_hits"] - before["prefix_hits"] == 1
-    assert mid["cow_copies"] - before["cow_copies"] == 1
+    assert mid["prefix_hit_requests"] - before["prefix_hit_requests"] == 1
+    assert mid["cow_copied_blocks"] - before["cow_copied_blocks"] == 1
     while eng.pending or eng.active:
         eng.step()
     np.testing.assert_array_equal(eng.take_result(ra).asnumpy(),
@@ -276,8 +276,8 @@ def test_step_fault_plan_retry_parity(eng, isolated):
     np.testing.assert_array_equal(res[r2].asnumpy(),
                                   _want(isolated, p2, 5))
     after = eng.stats
-    assert after["quarantined"] - before["quarantined"] == 1
-    assert after["retries"] - before["retries"] == 1
+    assert after["quarantined_requests"] - before["quarantined_requests"] == 1
+    assert after["retried_requests"] - before["retried_requests"] == 1
     assert after["blocks_in_use"] == 0
 
 
@@ -324,7 +324,7 @@ def test_pool_exhaustion_sheds_impossible_defers_transient(tiny, mesh,
     with pytest.raises(LoadShedError, match="can never be admitted"):
         small.submit(p, 15)                 # needs 4 pages > 3
     assert issubclass(LoadShedError, MXTPUError)
-    assert small.stats["shed"] == 1 and small.pending == 0
+    assert small.stats["shed_requests"] == 1 and small.pending == 0
 
     p1, p2 = _prompts(rng, (6, 7))
     r1 = small.submit(p1, 10)               # 2 pages
@@ -349,8 +349,9 @@ def test_request_edge_cases_and_stats_surface(eng):
     with pytest.raises(ValueError):         # doesn't fit max_length
         eng.submit(p, MAXLEN)
     for key in ("blocks_in_use", "blocks_free", "blocks_shared",
-                "shared_extra_refs", "prefix_hits", "cow_copies",
-                "block_size", "num_blocks", "quarantined", "shed"):
+                "shared_extra_refs", "prefix_hit_requests",
+                "cow_copied_blocks", "block_size", "num_blocks",
+                "quarantined_requests", "shed_requests"):
         assert key in eng.stats, key
     assert eng.stats["blocks_in_use"] == 0
 
@@ -381,4 +382,4 @@ def test_moe_paged_engine_parity(mesh):
         want = dec.generate(p, max_new_tokens=3,
                             max_length=16).asnumpy()
         np.testing.assert_array_equal(res[rid].asnumpy(), want)
-    assert peng.stats["prefix_hits"] == 0   # sharing disabled for MoE
+    assert peng.stats["prefix_hit_requests"] == 0   # sharing disabled for MoE

@@ -398,7 +398,7 @@ def test_hedged_redispatch_after_deadline_fraction(engines, isolated):
     np.testing.assert_array_equal(
         res[rid].asnumpy(),
         _want(isolated, p, 12, temperature=0.6, seed=9))
-    assert gw.stats["hedges"] == 1
+    assert gw.stats["hedged_requests"] == 1
     for eng in engines[:2]:
         st = eng.stats
         assert st["blocks_in_use"] == st["pinned_blocks"], st
@@ -459,7 +459,7 @@ def test_qos_overflow_sheds_lowest_class_first(engines, isolated):
                                   _want(isolated, p1, 3))
     np.testing.assert_array_equal(res[rc].asnumpy(),
                                   _want(isolated, p3, 3))
-    assert gw.stats["qos_sheds"] == 2
+    assert gw.stats["qos_shed_requests"] == 2
 
 
 def test_tenant_quota_sheds_typed(engines, isolated):
@@ -539,7 +539,8 @@ def test_replica_pool_and_env_defaults(monkeypatch):
         num_slots = 1
         active = pending = 0
         free_slots = 1
-        stats = {"steps": 0, "tokens_generated": 0, "quarantined": 0}
+        stats = {"steps": 0, "generated_tokens": 0,
+                 "quarantined_requests": 0}
 
         def prefix_probe(self, p):
             return 0
@@ -596,7 +597,7 @@ def _drive_cold_chain(eng, isolated, seed):
     P3 = np.concatenate([P, rng.randint(0, 50, (1, 3))], axis=1)
     eng.submit(nd.array(P, dtype="int32"), 4)
     eng.run()
-    assert eng.stats["swap_outs"] >= 2      # chain lives on host now
+    assert eng.stats["swapped_out_blocks"] >= 2      # chain lives on host now
     r2 = eng.submit(nd.array(Q, dtype="int32"), 12)
     for _ in range(3):
         eng.step()
@@ -626,6 +627,7 @@ def _drive_cold_chain(eng, isolated, seed):
     return deltas, eng.stats
 
 
+@pytest.mark.slow
 def test_overlap_swaps_defers_restore_without_token_gap(ov_engines,
                                                         isolated):
     """Satellite: with overlap_swaps the cold-chain restore moves to
@@ -635,9 +637,9 @@ def test_overlap_swaps_defers_restore_without_token_gap(ov_engines,
     bit-exact; the synchronous twin produces identical streams."""
     deltas_s, st_s = _drive_cold_chain(ov_engines[False], isolated, 5)
     deltas_o, st_o = _drive_cold_chain(ov_engines[True], isolated, 5)
-    assert st_o["deferred_swap_ins"] == 1
-    assert st_s["deferred_swap_ins"] == 0
-    assert st_o["swap_ins"] >= 2 and st_s["swap_ins"] >= 2
+    assert st_o["deferred_swap_in_requests"] == 1
+    assert st_s["deferred_swap_in_requests"] == 0
+    assert st_o["swapped_in_blocks"] >= 2 and st_s["swapped_in_blocks"] >= 2
     assert all(d == 1 for d in deltas_o), deltas_o
     assert st_o["prefill_tokens_avoided"] == \
         st_s["prefill_tokens_avoided"]
@@ -654,8 +656,8 @@ def test_overlap_swap_in_fault_retries_bit_exact(ov_engines, isolated):
     P2 = np.concatenate([P, rng.randint(0, 50, (1, 2))], axis=1)
     eng.submit(nd.array(P, dtype="int32"), 3)
     eng.run()
-    assert eng.stats["swap_outs"] >= 2
-    swap_ins0 = eng.stats["swap_ins"]
+    assert eng.stats["swapped_out_blocks"] >= 2
+    swap_ins0 = eng.stats["swapped_in_blocks"]
     r2 = eng.submit(nd.array(P2, dtype="int32"), 4, retries=1)
     with fault_plan("serving.swap_in#%d@1:raise=OSError(copy-fail)"
                     % r2) as plan:
@@ -666,7 +668,7 @@ def test_overlap_swap_in_fault_retries_bit_exact(ov_engines, isolated):
         res[r2].asnumpy(),
         isolated.generate(nd.array(P2, dtype="int32"),
                           max_new_tokens=4, max_length=48).asnumpy())
-    assert eng.stats["swap_ins"] > swap_ins0     # the retry restored
+    assert eng.stats["swapped_in_blocks"] > swap_ins0     # the retry restored
     assert eng.stats["blocks_in_use"] == 0
 
 

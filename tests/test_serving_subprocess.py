@@ -419,6 +419,7 @@ def _fault_artifact_run():
     return outcome, trace_js, flight_js
 
 
+@pytest.mark.slow
 def test_transport_fault_artifacts_byte_identical():
     """Counter-determinism acceptance for the transport failure modes:
     the same seed + plan on two FRESH workers produce byte-identical
@@ -528,3 +529,209 @@ def test_graceful_shutdown_flushes_inflight_cursors(pool):
     assert got == want[0, prompt.shape[1]:].tolist()
     # idempotent: a second shutdown of a dead transport is a no-op
     assert rep.shutdown() == ({}, [], [])
+
+
+# --------------------------------------------------------------------------
+# probation revival respawns a dead worker (docs/serving.md
+# "Elastic serving" — the revive() fix: flipping alive on a corpse is
+# not a revival)
+# --------------------------------------------------------------------------
+
+class _DeadWorkerStub(_StubReplica):
+    """A stub transport whose worker process can 'die': opts into the
+    supervisor's duck-typed respawn protocol via respawn/worker_dead.
+    The scriptable failure rides health() (probed every tick), not
+    progress() (only read under stall detection)."""
+
+    def __init__(self):
+        super().__init__("r0", lambda: (1, 1, 0, 1, 0))
+        self.worker_dead = False
+        self.respawns = 0
+        self.fail_respawn = False
+        self.health_exc = None
+
+    def health(self):
+        if self.health_exc is not None:
+            raise self.health_exc
+
+    def respawn(self):
+        if self.fail_respawn:
+            raise TransportError("spawn refused")
+        self.respawns += 1
+        self.worker_dead = False
+
+
+def test_probation_revive_respawns_dead_worker_stub():
+    """revive() must respawn a transport whose worker PROCESS died
+    before flipping alive — otherwise probation re-admits a corpse
+    that fails every probe and immediately re-dies."""
+    rep = _DeadWorkerStub()
+    rep.health_exc = TransportTimeoutError("no answer", method="health",
+                                           ticks=4)
+    sup = ReplicaSupervisor([rep], fail_threshold=1, stall_ticks=None,
+                            revive_after_ticks=2)
+    sup.tick()                      # health raises -> death + drain
+    assert rep.alive is False
+    assert sup.stats["transport_failures"]["r0"] == 1
+    rep.worker_dead = True          # the corpse: process gone too
+    rep.health_exc = None
+    sup.tick()                      # probation not yet elapsed
+    assert rep.alive is False and rep.respawns == 0
+    sup.tick()                      # probation over: respawn + revive
+    assert rep.respawns == 1
+    assert rep.worker_dead is False
+    assert rep.alive is True
+    assert sup.stats["revivals"] == 1
+
+
+def test_probation_revive_retries_after_failed_respawn():
+    """A respawn that raises keeps the replica DEAD (its death tick
+    stands), records the failure, and probation retries next tick."""
+    rep = _DeadWorkerStub()
+    rep.fail_respawn = True
+    # kill it through the transport-failure path
+    rep.health_exc = TransportTimeoutError("no answer", method="health",
+                                           ticks=4)
+    sup = ReplicaSupervisor([rep], fail_threshold=1, stall_ticks=None,
+                            revive_after_ticks=1)
+    sup.tick()
+    assert rep.alive is False
+    rep.worker_dead = True
+    rep.health_exc = None
+    sup.tick()                      # respawn raises -> stays dead
+    assert rep.alive is False
+    assert sup.stats["last_errors"]["r0"]["reason"] == \
+        "revive/respawn failed"
+    rep.fail_respawn = False
+    sup.tick()                      # probation retried: revived now
+    assert rep.alive is True and rep.respawns == 1
+    assert sup.stats["revivals"] == 1
+
+
+@pytest.mark.slow
+def test_kill_revive_respawn_serves_bit_exact(pool):
+    """The real thing: SIGKILL a worker, let probation respawn it
+    (fresh pipe + handshake + factory re-run), then serve a stream
+    through the revived replica bit-identical to the isolated
+    reference."""
+    rep = SubprocessReplica(FACTORY, kwargs={"ledger_tag": "rv"},
+                            replica_id="rv")
+    try:
+        # respawn refuses to replace a LIVE worker
+        with pytest.raises(TransportError, match="DEAD"):
+            rep.respawn()
+        pid_before = rep.pid
+        sup = ReplicaSupervisor([rep], fail_threshold=1,
+                                stall_ticks=None, revive_after_ticks=2)
+        rep.kill()
+        assert rep.worker_dead
+        sup.tick()                  # dead pipe -> declared dead
+        assert rep.alive is False
+        for _ in range(4):
+            sup.tick()
+            if rep.alive:
+                break
+        assert rep.alive is True, "probation never revived the worker"
+        assert rep.worker_dead is False
+        assert rep.pid != pid_before
+        assert sup.stats["revivals"] == 1
+        # the respawned worker serves, bit-exact
+        prompt = np.array([[4, 5, 6, 7]], dtype=np.int32)
+        want = _want(prompt, 4)
+        rid = rep.submit(request_spec(prompt, 4), ("back", 0))
+        assert isinstance(rid, int)
+        got = None
+        for _ in range(64):
+            rep.step()
+            _toks, fins, _re = rep.poll()
+            if fins:
+                got = fins[0]
+                break
+        assert got is not None and got[1] == "ok"
+        assert np.array_equal(np.asarray(got[2]), want)
+    finally:
+        rep.close()
+
+
+# --------------------------------------------------------------------------
+# live weight hot-swap across the process boundary
+# --------------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_adopt_and_rollback_across_process_boundary(pool, tmp_path):
+    """adopt()/rollback() RPC through the pipe: the checkpoint path
+    crosses as a string (same-host shared filesystem), the WORKER
+    verifies and stages it, in-flight streams finish on the old
+    weights, and a corrupt file surfaces as a typed
+    CorruptCheckpointError rebuilt parent-side."""
+    import pickle
+
+    from mxtpu.resilience.checkpoint import (CorruptCheckpointError,
+                                             write_verified)
+
+    # fresh weights from a DIFFERENT seed, materialized locally
+    mx.random.seed(101)
+    net = llama_tiny(vocab_size=VOCAB)
+    net.initialize()
+    dec = ShardedDecoder(net, make_mesh(dp=1),
+                         transformer_lm_sharding_rules())
+    prompt = np.array([[3, 4, 5, 6]], dtype=np.int32)
+    want_old = _want(prompt, 4)
+    want_new = dec.generate(mx.nd.array(prompt), max_new_tokens=4,
+                            max_length=MAX_LEN).asnumpy()
+    named = {p.name: np.asarray(p.data()._data) for p in dec._params}
+    ck = str(tmp_path / "step7.ckpt")
+    write_verified(ck, pickle.dumps(
+        {"step": 7, "num_update": 1, "params": named,
+         "opt_states": {}, "scale_state": None, "rng": None}))
+
+    rep = SubprocessReplica(FACTORY, kwargs={"ledger_tag": "ad"},
+                            replica_id="ad")
+    try:
+        def finish(tag):
+            for _ in range(64):
+                rep.step()
+                _toks, fins, _re = rep.poll()
+                for f in fins:
+                    if f[0] == tag:
+                        return f
+            raise AssertionError("stream %r never finished" % (tag,))
+
+        # stream admitted BEFORE the swap finishes on the old weights
+        rep.submit(request_spec(prompt, 4), ("old", 0))
+        rep.step()
+        gen = rep.adopt(ck)
+        assert gen == 1
+        fin = finish(("old", 0))
+        assert fin[1] == "ok"
+        assert np.array_equal(np.asarray(fin[2]), want_old)
+        rep.step()              # drained boundary: install worker-side
+        assert rep.stats()["param_generation"] == 1
+        # new admissions ride the new generation
+        rep.submit(request_spec(prompt, 4), ("new", 0))
+        fin = finish(("new", 0))
+        assert fin[1] == "ok"
+        assert np.array_equal(np.asarray(fin[2]), want_new)
+        # a corrupt checkpoint raises TYPED across the boundary and
+        # leaves the worker on its current generation
+        bad = str(tmp_path / "bad.ckpt")
+        with open(ck, "rb") as f:
+            payload = f.read()
+        write_verified(bad, payload)
+        with open(bad, "r+b") as f:
+            f.seek(10)
+            f.write(b"\xff\xff\xff")
+        with pytest.raises(CorruptCheckpointError):
+            rep.adopt(bad)
+        assert rep.stats()["param_generation"] == 1
+        assert rep.stats()["adoption_failures"] == 1
+        # rollback re-stages the previous generation worker-side
+        assert rep.rollback() == 2
+        rep.step()
+        assert rep.stats()["param_generation"] == 2
+        rep.submit(request_spec(prompt, 4), ("back", 0))
+        fin = finish(("back", 0))
+        assert fin[1] == "ok"
+        assert np.array_equal(np.asarray(fin[2]), want_old)
+    finally:
+        rep.close()

@@ -129,11 +129,11 @@ def test_tp2_hierarchical_swap_over_sharded_kernel(monkeypatch):
 
     monkeypatch.setenv(GATE, "0")
     want, st0 = run()
-    assert st0["swap_ins"] >= 1
+    assert st0["swapped_in_blocks"] >= 1
     monkeypatch.setenv(GATE, "1")
     counters.reset()
     got, st1 = run()
-    assert st1["swap_ins"] >= 1
+    assert st1["swapped_in_blocks"] >= 1
     assert counters.counts().get("paged_attention", 0) >= 1
     assert np.array_equal(want, got)
 

@@ -260,7 +260,7 @@ def test_draft_model_mode_full_acceptance(tiny, mesh, isolated):
     assert st["drafted_tokens"] > 0 and st["accepted_tokens"] > 0
     # the greedy request's windows accept fully (draft == target);
     # pooled with a sampled rider the per-STEP average still clears 1
-    assert st["tokens_generated"] / st["steps"] > 1.0
+    assert st["generated_tokens"] / st["steps"] > 1.0
 
 
 def test_moe_blocks_opt_out_of_speculation(mesh):

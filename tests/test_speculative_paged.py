@@ -136,7 +136,7 @@ def test_paged_spec_composes_with_prefix_sharing(eng, isolated):
         res[rb].asnumpy(), _want(isolated, pb, 12, temperature=0.6,
                                  seed=21))
     st = eng.stats
-    assert st["prefix_hits"] > before["prefix_hits"]
+    assert st["prefix_hit_requests"] > before["prefix_hit_requests"]
     assert st["blocks_in_use"] == 0
 
 

@@ -6,6 +6,8 @@ import os
 import subprocess
 import sys
 
+import pytest
+
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
@@ -97,6 +99,7 @@ INFO Epoch[1] Validation-accuracy=0.77
     assert tsv.splitlines()[0].startswith("epoch\t")
 
 
+@pytest.mark.slow
 def test_diagnose_runs():
     """diagnose dumps env/library/device info and exits 0 (parity:
     tools/diagnose.py)."""

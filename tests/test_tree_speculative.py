@@ -221,6 +221,7 @@ def paged_tree_eng(tiny, mesh):
         prefill_chunk=8, spec_k=3, spec_tree=(6, 2))
 
 
+@pytest.mark.slow
 def test_slot_tree_streams_bit_identical(slot_tree_eng, isolated):
     """ISSUE-18 acceptance, slot engine: greedy, seeded-sampled and
     penalized tree-speculated streams all equal the isolated
@@ -281,7 +282,7 @@ def test_paged_tree_shared_prefix_composes(tiny, mesh, isolated):
         res[r1].asnumpy(), _want(isolated, _arr(long), 10))
     np.testing.assert_array_equal(
         res[r2].asnumpy(), _want(isolated, _arr(long + [2]), 10))
-    assert eng.stats["prefix_hits"] >= 1
+    assert eng.stats["prefix_hit_requests"] >= 1
     assert eng.stats["tree_nodes_drafted"] > 0
 
 

@@ -537,6 +537,126 @@ def check_lifecycle():
               else "UNEXPECTED (viol=%d stats=%r)" % (new_viol, st))
     except Exception as e:
         print("lifecycle    : FAILED (%s: %s)" % (type(e).__name__, e))
+    check_elastic()
+
+
+def check_elastic():
+    """Exercise elastic serving once (docs/serving.md "Elastic
+    serving"): a 1-replica micro pool ramps up under backlog pressure,
+    adopts a fresh checkpoint generation mid-stream (the in-flight
+    stream finishes bit-exact on the OLD weights), and retires back
+    down through the graceful drain — zero requeues, zero pages on
+    the retired replica, every decision postmortemed."""
+    print("----------Serving (elastic: autoscale / hot-swap)----------")
+    try:
+        import os
+        import pickle
+        import tempfile
+
+        import numpy as np
+
+        import mxtpu as mx
+        from mxtpu import nd
+        from mxtpu.models.transformer import (
+            TransformerLM, transformer_lm_sharding_rules)
+        from mxtpu.observability import flight_recording
+        from mxtpu.parallel import (PagedContinuousBatchingEngine,
+                                    ShardedDecoder)
+        from mxtpu.parallel.mesh import DeviceMesh
+        from mxtpu.resilience.checkpoint import write_verified
+        from mxtpu.serving import Autoscaler, Gateway, replica_pool
+
+        def build_lm(seed):
+            mx.random.seed(seed)
+            net = TransformerLM(32, units=16, hidden_size=32,
+                                num_layers=1, num_heads=2,
+                                num_kv_heads=2)
+            net.initialize()
+            net(nd.array(np.asarray([[1, 2]], dtype=np.int32)))
+            return net
+
+        lm, lm_b = build_lm(7), build_lm(23)
+        mesh = DeviceMesh(dp=1)
+        rules = transformer_lm_sharding_rules()
+        fac = lambda i: PagedContinuousBatchingEngine(  # noqa: E731
+            lm, mesh, rules, num_slots=1, max_length=32, block_size=8,
+            prefill_chunk=8, ledger_tag="probe-el%d" % i)
+        gw = Gateway(replica_pool(fac, n=1), hedge_fraction=None)
+        asc = Autoscaler(gw, fac, min_replicas=1, max_replicas=2,
+                         cooldown_ticks=2)
+        rng = np.random.RandomState(1)
+        iso_old = ShardedDecoder(lm, mesh, rules)
+        prompts = [nd.array(rng.randint(0, 32, (1, 5)), dtype="int32")
+                   for _ in range(3)]
+        wants_old = [iso_old.generate(p, max_new_tokens=4,
+                                      max_length=32).asnumpy()
+                     for p in prompts]
+        ck = os.path.join(tempfile.mkdtemp(prefix="probe_el_"),
+                          "gen1.ckpt")
+        dec_b = ShardedDecoder(lm_b, mesh, rules)
+        write_verified(ck, pickle.dumps({
+            "step": 1, "num_update": 1,
+            "params": {p.name: np.asarray(p.data()._data)
+                       for p in dec_b._params},
+            "opt_states": {}, "scale_state": None, "rng": None}))
+        with flight_recording(buffer=64) as fl:
+            rids = [gw.submit(p, 4) for p in prompts[:2]]  # 2 > 1
+            for _ in range(4):                             # slot:
+                gw.pump()                                  # backlog
+                asc.tick()
+            grew = asc.stats["scale_ups"]
+            staged = asc.adopt(ck)      # mid-stream: the in-flight
+            for _ in range(200):        # streams pin the OLD weights
+                gw.pump()
+                asc.tick()
+                if not gw.stats["outstanding"]:
+                    break
+            exact_old = all(
+                np.array_equal(gw.result(r).asnumpy(), w)
+                for r, w in zip(rids, wants_old))
+            r_new = gw.submit(prompts[2], 4)   # post-adopt admission:
+            for _ in range(200):               # the NEW generation
+                gw.pump()
+                asc.tick()
+                if not gw.stats["outstanding"]:
+                    break
+            exact_new = np.array_equal(
+                gw.result(r_new).asnumpy(),
+                ShardedDecoder(lm_b, mesh, rules).generate(
+                    prompts[2], max_new_tokens=4,
+                    max_length=32).asnumpy())
+            for _ in range(30):         # idle lull: retire back down
+                gw.pump()
+                asc.tick()
+                if len(asc.supervisor.replicas) == 1:
+                    break
+            st = asc.stats
+            gen = max(r.stats().get("param_generation", 0)
+                      for r in gw.supervisor.alive)
+            pms = [p.kind for p in fl.postmortems]
+        print("scaling      : %d scale-up(s), %d retire(s), "
+              "%d replica(s) final, cooldown %d tick(s)"
+              % (st["scale_ups"], st["retired_replicas"],
+                 st["replicas"], st["cooldown_remaining"]))
+        print("hot-swap     : %d replica(s) staged gen %d, live "
+              "generation %d, %d adoption(s) pushed to late spawns"
+              % (len(staged), max(staged.values()) if staged else 0,
+                 gen, st["adoptions_pushed"]))
+        print("streams      : %d in-flight bit-exact on OLD weights, "
+              "1 post-adopt bit-exact on NEW weights, %d requeued"
+              % (len(rids), gw.stats["requeued_requests"]))
+        healthy = (grew >= 1 and st["retired_replicas"] >= 1
+                   and st["replicas"] == 1 and gen >= 1
+                   and exact_old and exact_new
+                   and gw.stats["requeued_requests"] == 0)
+        print("probe        :", "ok (backlog grow -> mid-stream adopt "
+              "-> graceful retire, zero requeues, streams bit-exact; "
+              "postmortems: %s)" % (sorted(set(pms)) or "none")
+              if healthy else
+              "UNEXPECTED (grew=%r old=%r new=%r gen=%r stats=%r)"
+              % (grew, exact_old, exact_new, gen, st))
+    except Exception as e:
+        print("elastic      : FAILED (%s: %s)" % (type(e).__name__, e))
 
 
 def check_resilience():
